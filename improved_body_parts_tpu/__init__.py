@@ -1,0 +1,25 @@
+"""improved_body_parts_tpu — a TPU-native (JAX/XLA/Flax/pjit) bottom-up multi-person
+2D pose estimation framework with the capabilities of hellojialee/Improved-Body-Parts
+("SimplePose", AAAI-2020).
+
+Design stance (see SURVEY.md §7): this is a from-scratch framework, not a port.
+The compute path is JAX/Flax NHWC lowered to XLA for the MXU; distribution is
+single-program SPMD over a `jax.sharding.Mesh` (ICI collectives inserted by XLA);
+mixed precision is bf16 compute with fp32 params; the post-processing decoder has
+a vectorized NumPy path and a native C++ path (ctypes).
+
+Subpackages
+-----------
+- ``config``    typed configs (reference: config/config.py and variants)
+- ``data``      augmentation + GT synthesis + HDF5 corpus + loader
+                (reference: py_cocodata_server/, data/)
+- ``models``    Flax IMHN layer library and PoseNet variants (reference: models/)
+- ``ops``       jitted losses, NMS, resize primitives
+- ``parallel``  mesh construction and sharding rules (reference: train_distributed.py,
+                parallel_encoding/paralle.py — obsolete under SPMD)
+- ``train``     schedules, train state, SPMD training loop, SWA
+- ``infer``     multi-scale flip-ensemble prediction, decoding, COCO evaluation
+- ``utils``     meters, padding, logging helpers
+"""
+
+__version__ = "0.1.0"

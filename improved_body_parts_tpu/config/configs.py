@@ -1,0 +1,354 @@
+"""Typed configuration system.
+
+Replaces the reference's class-constant configs (reference: config/config.py:8-260,
+config/config2.py, config/config_dense.py, config/config_final.py) with frozen
+dataclasses and a named registry.  Derived tables (limb indices, flip permutation
+orders, channel layout) are *computed* from the part/limb name tables instead of
+being hand-maintained arrays; tests pin them against the reference's asserted
+golden values (config/config.py:87-92,121-124).
+
+Channel layout (critical invariant, reference config/config.py:96-103):
+    [0, paf_layers)                     body-part (limb) heatmaps
+    [paf_layers, paf_layers+heat)       keypoint heatmaps
+    [bkg_start]                         person-mask background channel
+    [bkg_start+1]                       reverse-keypoint background channel
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# COCO keypoint order (reference: config/config.py:146-148).
+COCO_PARTS: Tuple[str, ...] = (
+    "nose", "Leye", "Reye", "Lear", "Rear", "Lsho", "Rsho", "Lelb",
+    "Relb", "Lwri", "Rwri", "Lhip", "Rhip", "Lkne", "Rkne", "Lank", "Rank",
+)
+
+# Internal (CMU-style) part order shared by canonical/3-stack/final variants
+# (reference: config/config.py:61-62).
+_PARTS_CANONICAL: Tuple[str, ...] = (
+    "nose", "neck", "Rsho", "Relb", "Rwri", "Lsho", "Lelb", "Lwri", "Rhip",
+    "Rkne", "Rank", "Lhip", "Lkne", "Lank", "Reye", "Leye", "Rear", "Lear",
+)
+# The dense variant swaps the eye/ear ordering (reference: config_dense.py parts).
+_PARTS_DENSE: Tuple[str, ...] = (
+    "nose", "neck", "Rsho", "Relb", "Rwri", "Lsho", "Lelb", "Lwri", "Rhip",
+    "Rkne", "Rank", "Lhip", "Lkne", "Lank", "Reye", "Rear", "Leye", "Lear",
+)
+
+# Limb tables as (from, to) name pairs (reference: config/config.py:74-82).
+_LIMBS_CANONICAL: Tuple[Tuple[str, str], ...] = tuple(zip(
+    ["neck", "neck", "neck", "neck", "neck", "nose", "nose", "Reye", "Leye",
+     "neck", "Rsho", "Relb", "neck", "Lsho", "Lelb",
+     "neck", "Rhip", "Rkne", "neck", "Lhip", "Lkne",
+     "nose", "nose", "Rsho", "Rhip", "Lsho", "Lhip", "Rear", "Lear", "Rhip"],
+    ["nose", "Reye", "Leye", "Rear", "Lear", "Reye", "Leye", "Rear", "Lear",
+     "Rsho", "Relb", "Rwri", "Lsho", "Lelb", "Lwri",
+     "Rhip", "Rkne", "Rank", "Lhip", "Lkne", "Lank",
+     "Rsho", "Lsho", "Rhip", "Lkne", "Lhip", "Rkne", "Rsho", "Lsho", "Lhip"],
+))
+# 3-stack 384 variant: 24 limbs (reference: config2.py limb tables).
+_LIMBS_3STACK: Tuple[Tuple[str, str], ...] = tuple(zip(
+    ["neck", "neck", "neck", "neck", "neck", "nose", "nose", "Reye", "Leye",
+     "neck", "Rsho", "Relb", "neck", "Lsho", "Lelb",
+     "neck", "Rhip", "Rkne", "neck", "Lhip", "Lkne", "Rhip", "Rsho", "Lsho"],
+    ["nose", "Reye", "Leye", "Rear", "Lear", "Reye", "Leye", "Rear", "Lear",
+     "Rsho", "Relb", "Rwri", "Lsho", "Lelb", "Lwri",
+     "Rhip", "Rkne", "Rank", "Lhip", "Lkne", "Lank", "Lhip", "Rear", "Lear"],
+))
+# Densely connected skeleton: 49 limbs (reference: config_dense.py limb tables;
+# header notes the redundant limbs *hurt* AP — kept for parity/ablation).
+_LIMBS_DENSE_FROM = [1, 1, 1, 1, 1, 0, 14, 0, 16, 0, 0, 14, 1, 0, 15, 1, 0, 17,
+                     2, 1, 5, 1, 3, 3, 2, 6, 5, 1, 2, 5, 1, 5, 2, 8, 4, 7, 8,
+                     11, 2, 11, 8, 5, 9, 9, 8, 12, 12, 11, 9]
+_LIMBS_DENSE_TO = [0, 14, 15, 16, 17, 14, 15, 16, 17, 15, 17, 16, 2, 2, 2, 5,
+                   5, 5, 3, 3, 6, 6, 6, 4, 4, 7, 7, 8, 8, 8, 11, 11, 11, 11,
+                   8, 11, 9, 9, 9, 12, 12, 12, 12, 10, 10, 10, 13, 13, 13]
+_LIMBS_DENSE: Tuple[Tuple[str, str], ...] = tuple(
+    (_PARTS_DENSE[f], _PARTS_DENSE[t])
+    for f, t in zip(_LIMBS_DENSE_FROM, _LIMBS_DENSE_TO)
+)
+
+_LEFT_PARTS = ("Lsho", "Lelb", "Lwri", "Lhip", "Lkne", "Lank", "Leye", "Lear")
+_RIGHT_PARTS = ("Rsho", "Relb", "Rwri", "Rhip", "Rkne", "Rank", "Reye", "Rear")
+
+
+def _mirror_name(name: str) -> str:
+    if name in _LEFT_PARTS:
+        return "R" + name[1:]
+    if name in _RIGHT_PARTS:
+        return "L" + name[1:]
+    return name
+
+
+@dataclass(frozen=True)
+class TransformParams:
+    """Augmentation hyper-parameters (reference: config/config.py:26-49)."""
+    target_dist: float = 0.6
+    scale_prob: float = 0.8
+    scale_min: float = 0.7
+    scale_max: float = 1.3
+    max_rotate_degree: float = 40.0
+    center_perterb_max: float = 50.0
+    flip_prob: float = 0.5
+    tint_prob: float = 0.2
+    sigma: float = 9.0
+    keypoint_gaussian_thre: float = 0.015
+    limb_gaussian_thre: float = 0.015
+    paf_sigma: float = 7.0
+    paf_thre_stride_mult: float = 1.0  # paf_thre = mult * stride (config.py:47)
+
+
+@dataclass(frozen=True)
+class SkeletonConfig:
+    """Skeleton definition + channel layout.
+
+    All derived index tables are computed in ``__post_init__`` from the name
+    tables; the reference hardcodes them (config/config.py:84-124).
+    """
+    parts: Tuple[str, ...] = _PARTS_CANONICAL
+    limbs: Tuple[Tuple[str, str], ...] = _LIMBS_CANONICAL
+    width: int = 512
+    height: int = 512
+    stride: int = 4
+    transform_params: TransformParams = field(default_factory=TransformParams)
+    # Derived (filled in __post_init__):
+    parts_dict: Dict[str, int] = field(default_factory=dict, compare=False)
+    limbs_conn: Tuple[Tuple[int, int], ...] = field(default=(), compare=False)
+    flip_heat_ord: Tuple[int, ...] = field(default=(), compare=False)
+    flip_paf_ord: Tuple[int, ...] = field(default=(), compare=False)
+    left_parts: Tuple[int, ...] = field(default=(), compare=False)
+    right_parts: Tuple[int, ...] = field(default=(), compare=False)
+
+    def __post_init__(self):
+        pd = {p: i for i, p in enumerate(self.parts)}
+        limbs_conn = tuple((pd[f], pd[t]) for f, t in self.limbs)
+        # Keypoint flip permutation: part -> mirrored part, plus the 2
+        # background channels which map to themselves
+        # (golden: config/config.py:121).
+        mirror = [pd[_mirror_name(p)] for p in self.parts]
+        flip_heat = tuple(mirror) + (self.num_parts, self.num_parts + 1)
+        # Limb flip permutation: limb -> index of the mirrored limb
+        # (golden: config/config.py:122-124).
+        mirrored_limbs = [(_mirror_name(f), _mirror_name(t)) for f, t in self.limbs]
+        limb_index = {pair: i for i, pair in enumerate(self.limbs)}
+        # A limb's scalar map is symmetric in direction, so a mirrored limb may
+        # appear reversed in the table (e.g. Rhip->Lhip mirrors to itself).
+        flip_paf = []
+        for orig, m in zip(self.limbs, mirrored_limbs):
+            if m in limb_index:
+                flip_paf.append(limb_index[m])
+            elif (m[1], m[0]) in limb_index:
+                flip_paf.append(limb_index[(m[1], m[0])])
+            else:
+                raise ValueError(
+                    f"limb table is not closed under L/R mirroring: limb "
+                    f"{orig} mirrors to {m}, which is absent (flip ensembling "
+                    f"needs every limb's mirror in the table)")
+        flip_paf = tuple(flip_paf)
+        object.__setattr__(self, "parts_dict", pd)
+        object.__setattr__(self, "limbs_conn", limbs_conn)
+        object.__setattr__(self, "flip_heat_ord", flip_heat)
+        object.__setattr__(self, "flip_paf_ord", flip_paf)
+        object.__setattr__(self, "left_parts", tuple(pd[p] for p in _LEFT_PARTS))
+        object.__setattr__(self, "right_parts", tuple(pd[p] for p in _RIGHT_PARTS))
+
+    # --- channel layout (reference: config/config.py:96-110) ---
+    @property
+    def num_parts(self) -> int:
+        return len(self.parts)
+
+    @property
+    def paf_layers(self) -> int:
+        return len(self.limbs)
+
+    @property
+    def heat_layers(self) -> int:
+        return self.num_parts
+
+    @property
+    def num_layers(self) -> int:
+        return self.paf_layers + self.heat_layers + 2
+
+    @property
+    def paf_start(self) -> int:
+        return 0
+
+    @property
+    def heat_start(self) -> int:
+        return self.paf_layers
+
+    @property
+    def bkg_start(self) -> int:
+        return self.paf_layers + self.heat_layers
+
+    @property
+    def grid_shape(self) -> Tuple[int, int]:
+        """(H, W) of the stride-4 output grid."""
+        return (self.height // self.stride, self.width // self.stride)
+
+    @property
+    def parts_shape(self) -> Tuple[int, int, int]:
+        h, w = self.grid_shape
+        return (h, w, self.num_layers)
+
+    @property
+    def paf_thre(self) -> float:
+        return self.transform_params.paf_thre_stride_mult * self.stride
+
+    # COCO detection id -> COCO gt id mapping used when writing results
+    # (reference: config/config.py:117-118). Computed from name tables.
+    @property
+    def dt_gt_mapping(self) -> Dict[int, int]:
+        coco_index = {p: i for i, p in enumerate(COCO_PARTS)}
+        return {i: coco_index.get(p) for i, p in enumerate(self.parts)}
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """IMHN architecture knobs (reference: config/config.py:14-16)."""
+    nstack: int = 4
+    inp_dim: int = 256
+    increase: int = 128
+    hourglass_depth: int = 4
+    variant: str = "imhn"  # imhn | imhn_final | imhn_light | imhn_independent | ae
+    use_bn: bool = True
+    se_reduction: int = 16
+    leaky_slope: float = 0.01
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training hyper-parameters (reference: config/config.py:8-23,
+    train_distributed.py:123-124, 382-400)."""
+    batch_size_per_device: int = 4
+    learning_rate_per_device: float = 2.5e-5
+    momentum: float = 0.9
+    weight_decay: float = 5e-4        # train_distributed.py:124 (train.py uses 1e-4)
+    nstack_weight: Tuple[float, ...] = (1.0, 1.0, 1.0, 1.0)
+    scale_weight: Tuple[float, ...] = (0.1, 0.2, 0.4, 1.6, 6.4)
+    multi_task_weight: float = 0.1
+    keypoint_task_weight: float = 3.0
+    epochs: int = 100
+    warmup_epochs: int = 3            # train_distributed.py:392-396
+    lr_decay_factor: float = 0.2
+    lr_step_epochs: int = 15          # /5 every 15 epochs ...
+    lr_late_epoch: int = 78           # ... every 5 epochs after epoch 78
+    lr_late_step_epochs: int = 5
+    abnormal_loss_thre: float = 2e5   # drop batch if loss explodes (:259-261)
+    max_grad_norm: float = 0.0        # 0 disables (flag kept; ref has it disabled)
+    print_freq: int = 10
+    checkpoint_dir: str = "checkpoints"
+    hdf5_train_data: str = "data/dataset/coco_train_dataset512.h5"
+    hdf5_val_data: str = "data/dataset/coco_val_dataset512.h5"
+    # normalization convention: True = divide by global batch (distributed
+    # semantics, loss_model.py:39); False = caller divides (parallel twin).
+    normalize_by_global_batch: bool = True
+    bf16_compute: bool = True
+
+
+@dataclass(frozen=True)
+class Config:
+    """Bundle handed to models/losses/pipelines."""
+    name: str = "canonical"
+    skeleton: SkeletonConfig = field(default_factory=SkeletonConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def _canonical() -> Config:
+    return Config()
+
+
+def _three_stack_384() -> Config:
+    """3-stack 384×384 variant (reference: config/config2.py; ckpt note
+    'epoch 102 AP=0.658')."""
+    return Config(
+        name="three_stack_384",
+        skeleton=SkeletonConfig(
+            parts=_PARTS_CANONICAL, limbs=_LIMBS_3STACK, width=384, height=384,
+            transform_params=TransformParams(
+                scale_min=0.75, scale_max=1.25, center_perterb_max=40.0,
+                tint_prob=0.4, keypoint_gaussian_thre=0.01,
+                limb_gaussian_thre=0.01),
+        ),
+        model=ModelConfig(nstack=3),
+        train=TrainConfig(
+            batch_size_per_device=8,
+            nstack_weight=(1.0, 1.0, 1.0),
+            scale_weight=(0.2, 0.1, 0.4, 1.0, 4.0),
+            keypoint_task_weight=1.0,
+            hdf5_train_data="data/dataset/coco_train_dataset384.h5",
+            hdf5_val_data="data/dataset/coco_val_dataset384.h5"),
+    )
+
+
+def _dense_384() -> Config:
+    """Densely connected skeleton experiment (reference: config/config_dense.py;
+    header notes the extra limbs hurt AP)."""
+    return Config(
+        name="dense_384",
+        skeleton=SkeletonConfig(
+            parts=_PARTS_DENSE, limbs=_LIMBS_DENSE, width=384, height=384,
+            transform_params=TransformParams(
+                scale_min=0.75, scale_max=1.25, center_perterb_max=40.0,
+                tint_prob=0.1, keypoint_gaussian_thre=0.005,
+                limb_gaussian_thre=0.1),
+        ),
+        model=ModelConfig(nstack=3, inp_dim=384, increase=192),
+        train=TrainConfig(
+            batch_size_per_device=5,
+            learning_rate_per_device=1e-4,
+            nstack_weight=(1.0, 1.0, 1.0),
+            scale_weight=(0.2, 0.1, 0.4, 1.0, 4.0),
+            multi_task_weight=0.2,
+            keypoint_task_weight=6.0,
+            hdf5_train_data="data/dataset/coco_train_dataset384.h5",
+            hdf5_val_data="data/dataset/coco_val_dataset384.h5"),
+    )
+
+
+def _final_384() -> Config:
+    """4-stack 384 variant with stronger augmentation for posenet_final
+    (reference: config/config_final.py:32-40)."""
+    return Config(
+        name="final_384",
+        skeleton=SkeletonConfig(
+            parts=_PARTS_CANONICAL, limbs=_LIMBS_CANONICAL, width=384, height=384,
+            transform_params=TransformParams(
+                scale_min=0.6, scale_max=1.5, max_rotate_degree=50.0,
+                tint_prob=0.35, keypoint_gaussian_thre=0.01,
+                limb_gaussian_thre=0.04),
+        ),
+        model=ModelConfig(variant="imhn_final"),
+        train=TrainConfig(
+            batch_size_per_device=8,
+            learning_rate_per_device=2.5e-4,
+            hdf5_train_data="data/dataset/coco_train_dataset384.h5",
+            hdf5_val_data="data/dataset/coco_val_dataset384.h5"),
+    )
+
+
+_REGISTRY = {
+    "canonical": _canonical,
+    "three_stack_384": _three_stack_384,
+    "dense_384": _dense_384,
+    "final_384": _final_384,
+}
+
+
+def get_config(name: str = "canonical") -> Config:
+    """Named registry (reference: config/config.py:239-260 ``GetConfig``)."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown config '{name}'; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]()
+
+
+def available_configs() -> List[str]:
+    return sorted(_REGISTRY)

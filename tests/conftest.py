@@ -1,0 +1,35 @@
+"""Test harness: force JAX onto a virtual 8-device CPU mesh.
+
+This is the TPU-native answer to "multi-node testing without a cluster"
+(SURVEY.md §4): every sharding/collective test runs against 8 host devices via
+``--xla_force_host_platform_device_count`` so pjit/shard_map programs compile
+and execute exactly as they would across chips.
+
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected >=8 virtual devices, got {len(devices)}"
+    return devices

@@ -1,0 +1,113 @@
+"""Golden-value tests for the config system.
+
+The index tables here are the reference's *asserted* constants
+(config/config.py:87-92 for limb indices, :121-124 for flip orders,
+:117-118 for dt_gt_mapping); our configs derive them from name tables, so
+these tests prove the derivation reproduces the reference layout exactly.
+"""
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import (
+    available_configs,
+    default_inference_params,
+    get_config,
+)
+
+GOLDEN_LIMB_FROM = [1, 1, 1, 1, 1, 0, 0, 14, 15, 1, 2, 3, 1, 5, 6, 1, 8, 9, 1,
+                    11, 12, 0, 0, 2, 8, 5, 11, 16, 17, 8]
+GOLDEN_LIMB_TO = [0, 14, 15, 16, 17, 14, 15, 16, 17, 2, 3, 4, 5, 6, 7, 8, 9,
+                  10, 11, 12, 13, 2, 5, 8, 12, 11, 9, 2, 5, 11]
+GOLDEN_FLIP_HEAT = [0, 1, 5, 6, 7, 2, 3, 4, 11, 12, 13, 8, 9, 10, 15, 14, 17,
+                    16, 18, 19]
+GOLDEN_FLIP_PAF = [0, 2, 1, 4, 3, 6, 5, 8, 7, 12, 13, 14, 9, 10, 11, 18, 19,
+                   20, 15, 16, 17, 22, 21, 25, 26, 23, 24, 28, 27, 29]
+GOLDEN_DT_GT = {0: 0, 1: None, 2: 6, 3: 8, 4: 10, 5: 5, 6: 7, 7: 9, 8: 12,
+                9: 14, 10: 16, 11: 11, 12: 13, 13: 15, 14: 2, 15: 1, 16: 4,
+                17: 3}
+
+
+def test_canonical_channel_layout():
+    cfg = get_config("canonical")
+    sk = cfg.skeleton
+    assert sk.num_parts == 18
+    assert sk.paf_layers == 30
+    assert sk.heat_layers == 18
+    assert sk.num_layers == 50
+    assert sk.paf_start == 0
+    assert sk.heat_start == 30
+    assert sk.bkg_start == 48
+    assert sk.grid_shape == (128, 128)
+    assert sk.parts_shape == (128, 128, 50)
+    assert sk.paf_thre == 4.0
+
+
+def test_canonical_limb_indices_match_reference():
+    sk = get_config("canonical").skeleton
+    assert [f for f, _ in sk.limbs_conn] == GOLDEN_LIMB_FROM
+    assert [t for _, t in sk.limbs_conn] == GOLDEN_LIMB_TO
+
+
+def test_canonical_flip_orders_match_reference():
+    sk = get_config("canonical").skeleton
+    assert list(sk.flip_heat_ord) == GOLDEN_FLIP_HEAT
+    assert list(sk.flip_paf_ord) == GOLDEN_FLIP_PAF
+
+
+def test_canonical_dt_gt_mapping():
+    sk = get_config("canonical").skeleton
+    assert sk.dt_gt_mapping == GOLDEN_DT_GT
+
+
+def test_three_stack_variant():
+    cfg = get_config("three_stack_384")
+    sk = cfg.skeleton
+    assert sk.paf_layers == 24
+    assert sk.num_layers == 44
+    assert (sk.width, sk.height) == (384, 384)
+    assert cfg.model.nstack == 3
+    assert cfg.train.scale_weight == (0.2, 0.1, 0.4, 1.0, 4.0)
+    # golden from config2.py (extracted from the reference module)
+    assert [f for f, _ in sk.limbs_conn] == \
+        [1, 1, 1, 1, 1, 0, 0, 14, 15, 1, 2, 3, 1, 5, 6, 1, 8, 9, 1, 11, 12, 8, 2, 5]
+    assert list(sk.flip_paf_ord) == \
+        [0, 2, 1, 4, 3, 6, 5, 8, 7, 12, 13, 14, 9, 10, 11, 18, 19, 20, 15, 16,
+         17, 21, 23, 22]
+
+
+def test_dense_variant():
+    cfg = get_config("dense_384")
+    sk = cfg.skeleton
+    assert sk.paf_layers == 49
+    assert sk.num_layers == 69
+    assert cfg.model.inp_dim == 384 and cfg.model.increase == 192
+    # flip orders golden from config_dense.py
+    assert list(sk.flip_heat_ord) == \
+        [0, 1, 5, 6, 7, 2, 3, 4, 11, 12, 13, 8, 9, 10, 16, 17, 14, 15, 18, 19]
+    assert list(sk.flip_paf_ord) == \
+        [0, 3, 4, 1, 2, 7, 8, 5, 6, 10, 9, 11, 15, 16, 17, 12, 13, 14, 20, 21,
+         18, 19, 22, 25, 26, 23, 24, 30, 31, 32, 27, 28, 29, 33, 35, 34, 39,
+         40, 41, 36, 37, 38, 42, 46, 47, 48, 43, 44, 45]
+
+
+def test_final_variant():
+    cfg = get_config("final_384")
+    assert cfg.model.variant == "imhn_final"
+    tp = cfg.skeleton.transform_params
+    assert (tp.scale_min, tp.scale_max, tp.max_rotate_degree) == (0.6, 1.5, 50.0)
+
+
+def test_registry():
+    assert set(available_configs()) >= {
+        "canonical", "three_stack_384", "dense_384", "final_384"}
+    with pytest.raises(KeyError):
+        get_config("nope")
+
+
+def test_inference_params():
+    params, model_params = default_inference_params()
+    assert params.thre1 == 0.1 and params.thre2 == 0.1
+    assert params.connect_ration == 0.8 and params.mid_num == 20
+    assert params.len_rate == 16.0 and params.connection_tole == 0.7
+    assert model_params.boxsize == 640 and model_params.max_downsample == 64
+    assert model_params.pad_value == 128

@@ -1,0 +1,187 @@
+"""Tests for augmentation (transformer) and GT heatmap synthesis (heatmapper).
+
+Expectations are derived from first principles (Gaussian values at stride
+centers, affine fixed points), mirroring the reference semantics
+(py_cocodata_server/py_data_transformer.py, py_data_heatmapper.py).
+"""
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import get_config
+from improved_body_parts_tpu.data.heatmapper import Heatmapper, limb_response
+from improved_body_parts_tpu.data.transformer import (
+    AugmentParams,
+    Transformer,
+    build_affine,
+)
+
+CFG = get_config("canonical").skeleton
+
+
+def _neutral_scale():
+    # scale_provided that makes the composed scale factor exactly 1
+    return CFG.transform_params.target_dist * (CFG.height - 1) / CFG.height
+
+
+class TestAffine:
+    def test_center_maps_to_output_center(self):
+        M, s = build_affine(AugmentParams.identity(), (100.0, 200.0),
+                            _neutral_scale(), CFG)
+        assert s == pytest.approx(1.0)
+        pt = M @ np.array([100.0, 200.0, 1.0])
+        assert pt == pytest.approx([CFG.width / 2 - 0.5, CFG.height / 2 - 0.5])
+
+    def test_shift_applies(self):
+        aug = AugmentParams(shift=(7, -3))
+        M, _ = build_affine(aug, (50.0, 60.0), _neutral_scale(), CFG)
+        pt = M @ np.array([50.0, 60.0, 1.0])
+        assert pt == pytest.approx(
+            [CFG.width / 2 - 0.5 + 7, CFG.height / 2 - 0.5 - 3])
+
+    def test_person_height_normalized_to_target_dist(self):
+        # a person of height 0.3*H in the source ends up 0.6*H tall
+        scale_provided = 0.3
+        M, s = build_affine(AugmentParams.identity(), (0.0, 0.0),
+                            scale_provided, CFG)
+        head = M @ np.array([0.0, 0.0, 1.0])
+        foot = M @ np.array([0.0, 0.3 * CFG.height, 1.0])
+        height_out = foot[1] - head[1]
+        assert height_out == pytest.approx(0.6 * (CFG.height - 1), rel=1e-6)
+
+    def test_flip_mirrors_and_swaps_lr(self):
+        tr = Transformer(CFG)
+        img = np.zeros((CFG.height, CFG.width, 3), np.uint8)
+        mask = np.full((CFG.height, CFG.width), 255, np.uint8)
+        joints = np.zeros((1, CFG.num_parts, 3), np.float32)
+        rsho = CFG.parts_dict["Rsho"]
+        lsho = CFG.parts_dict["Lsho"]
+        joints[0, rsho] = [100.0, 250.0, 1]
+        joints[0, lsho] = [150.0, 250.0, 1]
+        center = (CFG.width / 2, CFG.height / 2)
+        aug = AugmentParams(flip=True)
+        _, _, _, out = tr.transform(img, mask, 255 - mask, joints, center,
+                                    _neutral_scale(), aug=aug)
+        # after flip the Lsho slot holds the (mirrored) original Rsho
+        M, _ = build_affine(aug, center, _neutral_scale(), CFG)
+        expect_r = M @ np.array([100.0, 250.0, 1.0])
+        assert out[0, lsho, :2] == pytest.approx(expect_r, abs=1e-3)
+
+    def test_output_shapes_and_ranges(self):
+        tr = Transformer(CFG)
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 255, (300, 400, 3), dtype=np.uint8)
+        mask = np.full((300, 400), 255, np.uint8)
+        joints = np.zeros((2, CFG.num_parts, 3), np.float32)
+        img_o, mm, ma, j = tr.transform(img, mask, mask, joints, (200, 150),
+                                        0.4, aug=None, rng=rng)
+        assert img_o.shape == (CFG.height, CFG.width, 3)
+        assert mm.shape == CFG.grid_shape and ma.shape == CFG.grid_shape
+        assert img_o.dtype == np.float32
+        assert 0.0 <= img_o.min() and img_o.max() <= 1.0
+
+
+class TestHeatmapper:
+    def setup_method(self):
+        self.hm = Heatmapper(CFG)
+
+    def _joints(self, entries):
+        """entries: list of (part, x, y, v) for one person each."""
+        joints = np.full((len(entries), CFG.num_parts, 3), 0, np.float32)
+        joints[:, :, 2] = 2  # absent by default
+        for p, (part, x, y, v) in enumerate(entries):
+            joints[p, part] = [x, y, v]
+        return joints
+
+    def test_single_keypoint_peak(self):
+        # joint exactly on a stride-center → response 1.0 at that cell
+        gx, gy = 40, 60  # grid cell
+        x = gx * CFG.stride + CFG.stride / 2 - 0.5
+        y = gy * CFG.stride + CFG.stride / 2 - 0.5
+        joints = self._joints([(0, x, y, 1)])
+        maps = self.hm.create_heatmaps(joints, np.zeros(CFG.grid_shape, np.float32))
+        chan = maps[:, :, CFG.heat_start + 0]
+        assert chan[gy, gx] == pytest.approx(1.0)
+        # analytic Gaussian decay one cell away (distance = stride)
+        expect = np.exp(-CFG.stride ** 2 / (2 * CFG.transform_params.sigma ** 2))
+        assert chan[gy, gx + 1] == pytest.approx(expect, rel=1e-5)
+        assert chan[gy + 1, gx] == pytest.approx(expect, rel=1e-5)
+        # far away stays zero (outside the window)
+        assert chan[0, 0] == 0.0
+
+    def test_overlap_is_max_not_sum(self):
+        x = 40 * CFG.stride + CFG.stride / 2 - 0.5
+        y = 60 * CFG.stride + CFG.stride / 2 - 0.5
+        joints = self._joints([(3, x, y, 1), (3, x, y, 0)])
+        maps = self.hm.create_heatmaps(joints, np.zeros(CFG.grid_shape, np.float32))
+        assert maps[60, 40, CFG.heat_start + 3] == pytest.approx(1.0)
+
+    def test_absent_keypoints_ignored(self):
+        joints = self._joints([(5, 100.0, 100.0, 2)])
+        maps = self.hm.create_heatmaps(joints, np.zeros(CFG.grid_shape, np.float32))
+        assert maps[:, :, CFG.heat_start + 5].max() == 0.0
+
+    def test_limb_response_on_segment(self):
+        # horizontal limb: max response along the segment line
+        fr, to = CFG.limbs_conn[9]  # neck->Rsho
+        joints = self._joints([(fr, 100.0, 200.0, 1)])
+        joints[0, to] = [180.0, 200.0, 1]
+        maps = self.hm.create_heatmaps(joints, np.zeros(CFG.grid_shape, np.float32))
+        chan = maps[:, :, 9]
+        iy = int(round((200.0 - (CFG.stride / 2 - 0.5)) / CFG.stride))
+        ix = int(round((140.0 - (CFG.stride / 2 - 0.5)) / CFG.stride))
+        # nearest grid center is 1.5 px off the line: exp(-1.5²/2σ²)
+        sig = CFG.transform_params.paf_sigma
+        assert chan[iy, ix] == pytest.approx(np.exp(-1.5 ** 2 / (2 * sig ** 2)),
+                                             rel=1e-5)
+        # outside the window there is nothing
+        assert chan[0, 0] == 0.0
+
+    def test_limb_floor_value(self):
+        X = np.array([[0.0]])
+        Y = np.array([[100.0]])  # far from the segment
+        r = limb_response(X, Y, CFG.transform_params.paf_sigma,
+                          0.0, 0.0, 10.0, 0.0, CFG.transform_params.limb_gaussian_thre)
+        assert r[0, 0] == pytest.approx(0.01)
+
+    def test_two_identical_limbs_average_to_same(self):
+        fr, to = CFG.limbs_conn[9]
+        joints = self._joints([(fr, 100.0, 200.0, 1), (fr, 100.0, 200.0, 1)])
+        joints[0, to] = [180.0, 200.0, 1]
+        joints[1, to] = [180.0, 200.0, 1]
+        single = self._joints([(fr, 100.0, 200.0, 1)])
+        single[0, to] = [180.0, 200.0, 1]
+        m2 = self.hm.create_heatmaps(joints, np.zeros(CFG.grid_shape, np.float32))
+        m1 = self.hm.create_heatmaps(single, np.zeros(CFG.grid_shape, np.float32))
+        np.testing.assert_allclose(m2[:, :, 9], m1[:, :, 9], atol=1e-6)
+
+    def test_zero_length_limb_skipped(self):
+        fr, to = CFG.limbs_conn[0]
+        joints = self._joints([(fr, 100.0, 100.0, 1)])
+        joints[0, to] = [100.0, 100.0, 1]
+        maps = self.hm.create_heatmaps(joints, np.zeros(CFG.grid_shape, np.float32))
+        assert maps[:, :, 0].max() == 0.0
+
+    def test_background_channels(self):
+        mask_all = np.ones(CFG.grid_shape, np.float32)
+        mask_all[:10, :] = 0.0
+        x = 40 * CFG.stride + CFG.stride / 2 - 0.5
+        joints = self._joints([(0, x, x, 1)])
+        maps = self.hm.create_heatmaps(joints, mask_all)
+        # bkg_start: eroded person mask — border of the hole grows by erosion
+        assert maps[5, 64, CFG.bkg_start] == 0.0
+        assert maps[64, 64, CFG.bkg_start] == 1.0
+        assert maps[10, 64, CFG.bkg_start] == 0.0  # eroded boundary
+        # bkg_start+1: max over keypoint channels
+        sl = maps[:, :, CFG.heat_start:CFG.bkg_start]
+        np.testing.assert_allclose(maps[:, :, CFG.bkg_start + 1],
+                                   sl.max(axis=2), atol=1e-6)
+
+    def test_offscreen_keypoint_is_cropped(self):
+        joints = self._joints([(0, -500.0, -500.0, 1)])
+        maps = self.hm.create_heatmaps(joints, np.zeros(CFG.grid_shape, np.float32))
+        assert maps[:, :, CFG.heat_start].max() == 0.0
+
+    def test_clip_to_unit_interval(self):
+        rngj = self._joints([(i, 50.0 + i, 60.0, 1) for i in range(18)])
+        maps = self.hm.create_heatmaps(rngj, np.ones(CFG.grid_shape, np.float32))
+        assert maps.min() >= 0.0 and maps.max() <= 1.0
