@@ -1,0 +1,50 @@
+"""Benchmark: single-image 512x512 network inference FPS on one chip.
+
+Mirrors the reference's pure-network FPS benchmark
+(reference: test_inference_speed.py:90-120; baseline 38.5 FPS on a 2080 Ti,
+README.md:67) on the flagship 4-stack IMHN with bf16 compute.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+BASELINE_FPS = 38.5
+
+
+def main():
+    import jax
+
+    sys.path.insert(0, ".")
+    from __graft_entry__ import entry
+
+    forward, (variables, imgs) = entry()
+    fn = jax.jit(forward)
+
+    out = fn(variables, imgs)  # compile
+    jax.block_until_ready(out)
+
+    # warmup
+    for _ in range(5):
+        out = fn(variables, imgs)
+    jax.block_until_ready(out)
+
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(variables, imgs)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+
+    fps = iters / dt
+    print(json.dumps({
+        "metric": "single_image_512x512_inference_fps",
+        "value": round(fps, 2),
+        "unit": "imgs/sec",
+        "vs_baseline": round(fps / BASELINE_FPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+from .dataset import (
+    CocoPoseDataset,
+    batches,
+    convert_joints,
+    epoch_permutation,
+    host_shard,
+)
+from .fixture import build_fixture
+from .heatmapper import Heatmapper
+from .transformer import AugmentParams, Transformer
+
+__all__ = [
+    "CocoPoseDataset", "batches", "convert_joints", "epoch_permutation",
+    "host_shard", "build_fixture", "Heatmapper", "AugmentParams",
+    "Transformer",
+]

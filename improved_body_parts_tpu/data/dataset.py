@@ -1,0 +1,183 @@
+"""Online training dataset: HDF5 corpus → (image, mask_miss, labels) samples.
+
+Replaces the reference's torch Dataset + iterator
+(reference: data/mydataset.py, py_cocodata_server/py_data_iterator.py) with a
+seedable, host-shardable pipeline:
+
+- per-sample randomness comes from a ``(seed, epoch, index)``-derived
+  ``numpy.random.Generator`` — deterministic and fork-safe (fixes the
+  DataLoader numpy-seed hazard noted at data/mydataset.py:33);
+- epoch shuffling is an epoch-seeded permutation and multi-host sharding is a
+  strided slice of it — replacing ``DistributedSampler.set_epoch``
+  (train_distributed.py:205-213, 231-232);
+- HDF5 handles are opened lazily per process (py_data_iterator.py:41-44).
+
+Outputs are channel-LAST: image (H, W, 3) float32 in [0,1], mask_miss
+(h, w, 1), labels (h, w, num_layers) on the stride-4 grid.
+"""
+from __future__ import annotations
+
+import json
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..config import COCO_PARTS, Config, SkeletonConfig
+from .heatmapper import Heatmapper
+from .transformer import AugmentParams, Transformer
+
+
+def convert_joints(coco_joints: np.ndarray, skeleton: SkeletonConfig
+                   ) -> np.ndarray:
+    """COCO 17-part → internal 18-part order with neck = mean of shoulders
+    (reference: config/config.py:155-224 ``COCOSourceConfig.convert``).
+
+    Visibility: 3 = never marked in this dataset (the synthetic neck gets 2
+    when either shoulder is unknown, else min of the shoulder flags).
+    """
+    coco_index = {p: i for i, p in enumerate(COCO_PARTS)}
+    n_people = coco_joints.shape[0]
+    out = np.zeros((n_people, skeleton.num_parts, 3), dtype=np.float64)
+    out[:, :, 2] = 3.0
+    for part, gid in skeleton.parts_dict.items():
+        cid = coco_index.get(part)
+        if cid is not None:
+            out[:, gid, :] = coco_joints[:, cid, :]
+    if "neck" in skeleton.parts_dict:
+        neck = skeleton.parts_dict["neck"]
+        rs, ls = coco_index["Rsho"], coco_index["Lsho"]
+        known = (coco_joints[:, rs, 2] < 2) & (coco_joints[:, ls, 2] < 2)
+        out[~known, neck, 2] = 2.0
+        out[known, neck, 0:2] = (coco_joints[known, rs, 0:2]
+                                 + coco_joints[known, ls, 0:2]) / 2
+        out[known, neck, 2] = np.minimum(coco_joints[known, rs, 2],
+                                         coco_joints[known, ls, 2])
+    return out
+
+
+class CocoPoseDataset:
+    """Random-access view over the HDF5 corpus."""
+
+    def __init__(self, h5_path: str, config: Config, augment: bool = True,
+                 seed: int = 0):
+        self.h5_path = h5_path
+        self.config = config
+        self.skeleton = config.skeleton
+        self.augment = augment
+        self.seed = seed
+        self.transformer = Transformer(self.skeleton)
+        self.heatmapper = Heatmapper(self.skeleton)
+        self._file = None
+        import h5py
+        with h5py.File(h5_path, "r") as f:
+            self.keys = sorted(f["dataset"].keys())
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    def _groups(self):
+        if self._file is None:
+            import h5py
+            self._file = h5py.File(self.h5_path, "r")
+        f = self._file
+        return f["dataset"], f["images"], f.get("masks")
+
+    def read_raw(self, index: int):
+        """(img, mask_miss, mask_all, joints, objpos, scale_provided)
+        (py_data_iterator.py:109-144 'new format' reader)."""
+        dataset, images, masks = self._groups()
+        entry = dataset[self.keys[index]]
+        meta = json.loads(entry[()])
+        img = images[meta["image"]][()]
+        if masks is not None and meta["image"] in masks:
+            mask_concat = masks[meta["image"]][()]
+            mask_miss, mask_all = mask_concat[..., 0], mask_concat[..., 1]
+        else:  # MPII-style corpus without masks (py_data_iterator.py:140-142)
+            mask_miss = np.full(img.shape[:2], 255, np.uint8)
+            mask_all = np.zeros(img.shape[:2], np.uint8)
+        joints = convert_joints(np.asarray(meta["joints"]), self.skeleton)
+        return (img, mask_miss, mask_all, joints,
+                tuple(meta["objpos"][0]), float(meta["scale_provided"][0]))
+
+    def sample(self, index: int, epoch: int = 0
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Generate one training sample deterministically from
+        (seed, epoch, index)."""
+        img, mask_miss, mask_all, joints, objpos, scale = self.read_raw(index)
+        rng = np.random.default_rng((self.seed, epoch, index))
+        aug = None if self.augment else AugmentParams.identity()
+        img, mask_miss, mask_all, joints = self.transformer.transform(
+            img, mask_miss, mask_all, joints, objpos, scale, aug=aug, rng=rng)
+        labels = self.heatmapper.create_heatmaps(joints, mask_all)
+        return img, mask_miss[..., None], labels
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+def epoch_permutation(n: int, epoch: int, seed: int = 0) -> np.ndarray:
+    return np.random.default_rng((seed, epoch)).permutation(n)
+
+
+def host_shard(indices: np.ndarray, process_index: int, process_count: int,
+               batch_size: int) -> np.ndarray:
+    """This host's strided slice, truncated so every host yields the same
+    number of full batches (drop_last semantics,
+    train_distributed.py:205-213).
+
+    The batch count is computed from the GLOBAL minimum shard length — a host
+    with one extra sample must not run an extra step, or its collective would
+    wait forever on the other hosts.
+    """
+    shard = indices[process_index::process_count]
+    min_shard_len = len(indices) // process_count
+    n_batches = min_shard_len // batch_size
+    return shard[: n_batches * batch_size]
+
+
+def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
+            process_index: int = 0, process_count: int = 1,
+            num_workers: int = 0
+            ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Yield batched (images, mask_miss, labels) for one epoch.
+
+    ``num_workers > 0`` generates samples in a process pool (the reference's
+    DataLoader workers, train_distributed.py:205-213); 0 is synchronous.
+    """
+    perm = epoch_permutation(len(dataset), epoch, dataset.seed)
+    shard = host_shard(perm, process_index, process_count, batch_size)
+
+    def collate(samples):
+        imgs, masks, labels = zip(*samples)
+        return (np.stack(imgs), np.stack(masks), np.stack(labels))
+
+    if num_workers <= 0:
+        for start in range(0, len(shard), batch_size):
+            idxs = shard[start: start + batch_size]
+            yield collate([dataset.sample(int(i), epoch) for i in idxs])
+        return
+
+    import multiprocessing as mp
+
+    ctx = mp.get_context("fork")
+    with ctx.Pool(num_workers, initializer=_worker_init,
+                  initargs=(dataset.h5_path, dataset.config, dataset.augment,
+                            dataset.seed)) as pool:
+        for start in range(0, len(shard), batch_size):
+            idxs = [(int(i), epoch) for i in shard[start: start + batch_size]]
+            yield collate(pool.starmap(_worker_sample, idxs))
+
+
+_WORKER_DATASET: Optional[CocoPoseDataset] = None
+
+
+def _worker_init(h5_path, config, augment, seed):
+    global _WORKER_DATASET
+    _WORKER_DATASET = CocoPoseDataset(h5_path, config, augment=augment,
+                                      seed=seed)
+
+
+def _worker_sample(index, epoch):
+    return _WORKER_DATASET.sample(index, epoch)

@@ -1,0 +1,90 @@
+"""Deterministic synthetic corpus fixture for tests and smoke runs.
+
+Writes a tiny HDF5 file with the exact schema of the real corpus builder
+(see hdf5_corpus.py / reference data/coco_masks_hdf5.py): groups ``dataset`` /
+``images`` / ``masks``, per-main-person JSON records.  People are simple stick
+figures with plausible COCO-order joints so the GT synthesis produces
+non-trivial heatmaps.
+"""
+from __future__ import annotations
+
+import json
+from typing import Tuple
+
+import numpy as np
+
+from .hdf5_corpus import write_record
+
+# rough upright stick figure in a unit box: (x, y) per COCO part
+_UNIT_POSE = {
+    "nose": (0.50, 0.10), "Leye": (0.55, 0.07), "Reye": (0.45, 0.07),
+    "Lear": (0.60, 0.09), "Rear": (0.40, 0.09),
+    "Lsho": (0.65, 0.25), "Rsho": (0.35, 0.25),
+    "Lelb": (0.70, 0.42), "Relb": (0.30, 0.42),
+    "Lwri": (0.72, 0.58), "Rwri": (0.28, 0.58),
+    "Lhip": (0.60, 0.55), "Rhip": (0.40, 0.55),
+    "Lkne": (0.60, 0.75), "Rkne": (0.40, 0.75),
+    "Lank": (0.60, 0.95), "Rank": (0.40, 0.95),
+}
+
+
+def synthetic_person(rng: np.random.Generator, img_w: int, img_h: int,
+                     image_size: int):
+    from ..config import COCO_PARTS
+
+    h = rng.uniform(0.4, 0.8) * img_h
+    w = 0.5 * h
+    x0 = rng.uniform(0, max(img_w - w, 1))
+    y0 = rng.uniform(0, max(img_h - h, 1))
+    joints = np.zeros((len(COCO_PARTS), 3))
+    for i, part in enumerate(COCO_PARTS):
+        ux, uy = _UNIT_POSE[part]
+        joints[i, 0] = x0 + ux * w + rng.normal(0, 2)
+        joints[i, 1] = y0 + uy * h + rng.normal(0, 2)
+        joints[i, 2] = rng.choice([0, 1], p=[0.2, 0.8])  # hidden/visible
+    bbox = [x0, y0, w, h]
+    return {
+        "objpos": [x0 + w / 2, y0 + h / 2],
+        "bbox": bbox,
+        "segment_area": w * h,
+        "num_keypoints": 17,
+        "joint": joints,
+        "scale_provided": h / image_size,
+    }
+
+
+def build_fixture(path: str, num_images: int = 4, img_size: Tuple[int, int]
+                  = (240, 320), people_per_image: int = 2,
+                  image_size: int = 512, seed: int = 0) -> int:
+    """Write the fixture; returns the number of records."""
+    import h5py
+
+    from .hdf5_corpus import build_masks, iter_records
+
+    rng = np.random.default_rng(seed)
+    h, w = img_size
+    count = 0
+    with h5py.File(path, "w") as f:
+        grp = f.create_group("dataset")
+        img_grp = f.create_group("images")
+        mask_grp = f.create_group("masks")
+        for image_index in range(num_images):
+            img_id = 1000 + image_index
+            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+            persons = [synthetic_person(rng, w, h, image_size)
+                       for _ in range(people_per_image)]
+            person_masks = []
+            for p in persons:
+                m = np.zeros((h, w), np.uint8)
+                x0, y0, bw, bh = [int(v) for v in p["bbox"]]
+                m[max(y0, 0): y0 + bh, max(x0, 0): x0 + bw] = 1
+                person_masks.append(m)
+            mask_miss, mask_all = build_masks(
+                (h, w), person_masks, [p["num_keypoints"] for p in persons])
+            image_rec = {"width": w, "height": h}
+            for rec in iter_records(image_rec, img_id, image_index, persons,
+                                    "SYNTH", is_validation=False):
+                write_record(grp, img_grp, mask_grp, rec, count, img,
+                             mask_miss, mask_all)
+                count += 1
+    return count

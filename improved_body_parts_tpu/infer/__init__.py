@@ -1,0 +1,15 @@
+from .decode import (
+    decode,
+    find_connections,
+    find_peaks,
+    find_people,
+    subsets_to_keypoints,
+)
+from .native import native_available
+from .predict import Predictor, pad_right_down
+
+__all__ = [
+    "decode", "find_connections", "find_peaks", "find_people",
+    "subsets_to_keypoints", "native_available", "Predictor",
+    "pad_right_down",
+]

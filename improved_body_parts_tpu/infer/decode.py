@@ -1,0 +1,331 @@
+"""Heatmap decoding: peaks → limb connections → greedy person assembly.
+
+Re-implementation of the reference's CPU post-processing
+(reference: evaluate.py:169-498) with the pair-scoring loops vectorized with
+NumPy (the reference's pure-Python double loops are its acknowledged 5.2 FPS
+bottleneck, README.md:68).  A native C++ path for the assembly lives in
+``improved_body_parts_tpu.infer.native`` (same semantics, built from
+native/decoder.cpp); ``find_people`` here is the reference NumPy path.
+
+Data model (matches the reference so AP-sensitive tie-breaking is preserved):
+- ``peaks``: per part, an (n_i, 4) array of [x, y, score, global_peak_id]
+- ``connections``: per limb, an (m_k, 6) array of
+  [peak_id_A, peak_id_B, score, index_in_candA, index_in_candB, length]
+- ``subset``: (P, num_parts+2, 2) — per person, per part
+  [peak_id, confidence]; row -2 = [total score, —]; row -1 =
+  [part count, longest limb length]
+
+Documented deviation: the reference's sub-pixel refinement transposes its x/y
+offset grids (evaluate.py:194 → utils/util.py:205-207), adding the y-offset to
+x and vice versa; we apply the offsets to their own axes (the reference notes
+the refinement "dose not affect the results").
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import InferenceParams, SkeletonConfig
+from ..ops.nms import keypoint_nms, refine_peaks
+
+
+def find_peaks(heatmap: np.ndarray, params: InferenceParams,
+               num_parts: int = 18) -> List[np.ndarray]:
+    """Peak lists per keypoint channel (reference: evaluate.py:169-203).
+
+    :param heatmap: (H, W, >=num_parts) averaged keypoint maps
+    :returns: per part, (n_i, 4) array [x, y, score, global id]
+    """
+    import jax.numpy as jnp
+
+    suppressed = np.asarray(keypoint_nms(
+        jnp.asarray(heatmap[:, :, :num_parts], jnp.float32),
+        kernel=3, thre=params.thre1))
+
+    all_peaks: List[np.ndarray] = []
+    peak_counter = 0
+    for part in range(num_parts):
+        ys, xs = np.nonzero(suppressed[:, :, part])
+        x_ref, y_ref, score = refine_peaks(
+            heatmap[:, :, part].astype(np.float64), xs, ys,
+            params.offset_radius)
+        n = xs.shape[0]
+        ids = np.arange(peak_counter, peak_counter + n, dtype=np.float64)
+        all_peaks.append(
+            np.stack([x_ref, y_ref, score, ids], axis=1) if n else
+            np.zeros((0, 4)))
+        peak_counter += n
+    return all_peaks
+
+
+def _sample_limb_scores(paf_channel: np.ndarray, a: np.ndarray, b: np.ndarray,
+                        m: np.ndarray, num_samples: int) -> np.ndarray:
+    """Sample the limb map between every A/B pair.
+
+    Pair (i, j) is sampled at m[i,j] points evenly spaced over the FULL
+    segment — linspace(A, B, m) like the reference (evaluate.py:232-239) —
+    laid out in the first m slots of a fixed (nA, nB, num_samples) tensor
+    (nearest-pixel lookup).
+    """
+    h, w = paf_channel.shape
+    s = np.arange(num_samples, dtype=np.float64)
+    # t[i,j,s] = s / (m[i,j]-1), the linspace positions for that pair
+    denom = np.maximum(m - 1, 1).astype(np.float64)
+    t = np.minimum(s[None, None, :] / denom[:, :, None], 1.0)
+    pts = a[:, None, None, :] + t[..., None] * (
+        b[None, :, None, :] - a[:, None, None, :])
+    xi = np.clip(np.round(pts[..., 0]).astype(np.int64), 0, w - 1)
+    yi = np.clip(np.round(pts[..., 1]).astype(np.int64), 0, h - 1)
+    return paf_channel[yi, xi]
+
+
+def find_connections(all_peaks: Sequence[np.ndarray], paf: np.ndarray,
+                     image_size: int, params: InferenceParams,
+                     limbs_conn: Sequence[Tuple[int, int]]
+                     ) -> Tuple[List[np.ndarray], List[int]]:
+    """Score and greedily select limb connections
+    (reference: evaluate.py:206-276).
+
+    :param paf: (H, W, paf_layers) averaged limb maps
+    :param image_size: the length-penalty scale; the reference passes the
+        image *height* (evaluate.py:510 passes ``oriImg.shape[0]``)
+    :returns: (connections per limb, indices of limbs with no candidates)
+    """
+    connection_all: List[np.ndarray] = []
+    special_k: List[int] = []
+    S = params.mid_num
+
+    for k, (ia, ib) in enumerate(limbs_conn):
+        cand_a, cand_b = all_peaks[ia], all_peaks[ib]
+        na, nb = len(cand_a), len(cand_b)
+        if na == 0 or nb == 0:
+            special_k.append(k)
+            connection_all.append(np.zeros((0, 6)))
+            continue
+
+        a_xy, b_xy = cand_a[:, :2], cand_b[:, :2]
+        vec = b_xy[None, :, :] - a_xy[:, None, :]
+        norm = np.sqrt((vec ** 2).sum(-1))                     # (na, nb)
+        # the reference samples min(round(norm+1), S) points per pair
+        m = np.minimum(np.round(norm + 1).astype(np.int64), S)  # (na, nb)
+        scores = _sample_limb_scores(paf[:, :, k], a_xy, b_xy, m, S)
+        sample_idx = np.arange(S)[None, None, :]
+        valid = sample_idx < m[:, :, None]
+        msum = np.where(m > 0, m, 1)
+        mean_score = (scores * valid).sum(-1) / msum
+        above = ((scores > params.thre2) & valid).sum(-1)
+
+        with np.errstate(divide="ignore", invalid="ignore"):
+            prior = mean_score + np.minimum(0.5 * image_size / norm - 1.0, 0.0)
+        ok = ((above >= params.connect_ration * m)
+              & (prior > 0) & (norm > 0))
+
+        ii, jj = np.nonzero(ok)
+        if ii.size == 0:
+            connection_all.append(np.zeros((0, 6)))
+            continue
+        sel_prior = prior[ii, jj]
+        rank = (0.5 * sel_prior + 0.25 * cand_a[ii, 2] + 0.25 * cand_b[jj, 2])
+        order = np.argsort(-rank, kind="stable")
+
+        used_a = np.zeros(na, bool)
+        used_b = np.zeros(nb, bool)
+        rows = []
+        limit = min(na, nb)
+        for o in order:
+            i, j = ii[o], jj[o]
+            if used_a[i] or used_b[j]:
+                continue
+            used_a[i] = used_b[j] = True
+            rows.append([cand_a[i, 3], cand_b[j, 3], sel_prior[o],
+                         float(i), float(j), norm[i, j]])
+            if len(rows) >= limit:
+                break
+        connection_all.append(np.asarray(rows, dtype=np.float64))
+    return connection_all, special_k
+
+
+def find_people(connection_all: Sequence[np.ndarray],
+                special_k: Sequence[int],
+                all_peaks: Sequence[np.ndarray],
+                params: InferenceParams,
+                limbs_conn: Sequence[Tuple[int, int]],
+                num_parts: int = 18) -> Tuple[np.ndarray, np.ndarray]:
+    """Greedy assembly of limb connections into people
+    (reference: evaluate.py:279-498).  Tie-breaking order preserved.
+
+    :returns: (subset (P, num_parts+2, 2), candidate (total_peaks, 4))
+    """
+    rows = num_parts + 2
+    subset = -1 * np.ones((0, rows, 2))
+    candidate = (np.concatenate([p for p in all_peaks], axis=0)
+                 if sum(len(p) for p in all_peaks) else np.zeros((0, 4)))
+
+    for k, (index_a, index_b) in enumerate(limbs_conn):
+        if k in special_k:
+            continue
+        conns = connection_all[k]
+        part_as = conns[:, 0]
+        part_bs = conns[:, 1]
+
+        for i in range(len(conns)):
+            score = conns[i][2]
+            limb_len = conns[i][-1]
+            found_idx = []
+            for j in range(len(subset)):
+                if int(subset[j][index_a][0]) == int(part_as[i]) or \
+                        int(subset[j][index_b][0]) == int(part_bs[i]):
+                    if len(found_idx) < 2:
+                        found_idx.append(j)
+            found = len(found_idx)
+
+            if found == 1:
+                j = found_idx[0]
+                if int(subset[j][index_b][0]) == -1 and \
+                        params.len_rate * subset[j][-1][1] > limb_len:
+                    # slot empty and the new limb is not absurdly long:
+                    # assign part B to this person (evaluate.py:320-344)
+                    subset[j][index_b][0] = part_bs[i]
+                    subset[j][index_b][1] = score
+                    subset[j][-1][0] += 1
+                    subset[j][-2][0] += candidate[int(part_bs[i]), 2] + score
+                    subset[j][-1][1] = max(limb_len, subset[j][-1][1])
+                elif int(subset[j][index_b][0]) != int(part_bs[i]):
+                    if subset[j][index_b][1] >= score:
+                        pass  # existing connection is more confident
+                    elif params.len_rate * subset[j][-1][1] <= limb_len:
+                        pass
+                    else:
+                        # replace the weaker existing part B
+                        # (evaluate.py:346-363)
+                        subset[j][-2][0] -= (
+                            candidate[int(subset[j][index_b][0]), 2]
+                            + subset[j][index_b][1])
+                        subset[j][index_b][0] = part_bs[i]
+                        subset[j][index_b][1] = score
+                        subset[j][-2][0] += candidate[int(part_bs[i]), 2] + score
+                        subset[j][-1][1] = max(limb_len, subset[j][-1][1])
+                elif int(subset[j][index_b][0]) == int(part_bs[i]) and \
+                        subset[j][index_b][1] <= score:
+                    # same part re-detected with higher confidence: rescore
+                    # (evaluate.py:368-380)
+                    subset[j][-2][0] -= (
+                        candidate[int(subset[j][index_b][0]), 2]
+                        + subset[j][index_b][1])
+                    subset[j][index_b][0] = part_bs[i]
+                    subset[j][index_b][1] = score
+                    subset[j][-2][0] += candidate[int(part_bs[i]), 2] + score
+                    subset[j][-1][1] = max(limb_len, subset[j][-1][1])
+
+            elif found == 2:
+                j1, j2 = found_idx
+                membership1 = (subset[j1][:-2, 0] >= 0).astype(int)
+                membership2 = (subset[j2][:-2, 0] >= 0).astype(int)
+                if ((membership1 + membership2) == 2).sum() == 0:
+                    # disjoint people sharing this limb: merge, gated by
+                    # confidence and length priors (evaluate.py:403-424)
+                    min_limb1 = np.min(subset[j1, :-2, 1][membership1 == 1])
+                    min_limb2 = np.min(subset[j2, :-2, 1][membership2 == 1])
+                    min_tolerance = min(min_limb1, min_limb2)
+                    if score < params.connection_tole * min_tolerance or \
+                            params.len_rate * subset[j1][-1][1] <= limb_len:
+                        continue
+                    subset[j1][:-2] += subset[j2][:-2] + 1
+                    subset[j1][-2:, 0] += subset[j2][-2:, 0]
+                    subset[j1][-2][0] += score
+                    subset[j1][-1][1] = max(limb_len, subset[j1][-1][1])
+                    subset = np.delete(subset, j2, 0)
+                else:
+                    # two people compete for this limb (evaluate.py:426-460)
+                    if conns[i][0] in subset[j1, :-2, 0]:
+                        c1 = np.where(subset[j1, :-2, 0] == conns[i][0])
+                        c2 = np.where(subset[j2, :-2, 0] == conns[i][1])
+                    else:
+                        c1 = np.where(subset[j1, :-2, 0] == conns[i][1])
+                        c2 = np.where(subset[j2, :-2, 0] == conns[i][0])
+                    c1, c2 = int(c1[0][0]), int(c2[0][0])
+                    assert c1 != c2, "one keypoint shared by two people"
+                    if score < subset[j1][c1][1] and score < subset[j2][c2][1]:
+                        continue
+                    small_j, remove_c = j1, c1
+                    if subset[j1][c1][1] > subset[j2][c2][1]:
+                        small_j, remove_c = j2, c2
+                    if params.remove_recon > 0:
+                        subset[small_j][-2][0] -= (
+                            candidate[int(subset[small_j][remove_c][0]), 2]
+                            + subset[small_j][remove_c][1])
+                        subset[small_j][remove_c][0] = -1
+                        subset[small_j][remove_c][1] = -1
+                        subset[small_j][-1][0] -= 1
+
+            elif found == 0:
+                # no owner: create a new person (evaluate.py:473-488)
+                row = -1 * np.ones((rows, 2))
+                row[index_a][0] = part_as[i]
+                row[index_a][1] = score
+                row[index_b][0] = part_bs[i]
+                row[index_b][1] = score
+                row[-1][0] = 2
+                row[-1][1] = limb_len
+                row[-2][0] = (candidate[conns[i, :2].astype(int), 2].sum()
+                              + score)
+                subset = np.concatenate((subset, row[None]), axis=0)
+
+    # prune sparse / low-confidence people (evaluate.py:491-496)
+    keep = []
+    for i in range(len(subset)):
+        parts_count = subset[i][-1][0]
+        if parts_count >= params.min_parts and \
+                subset[i][-2][0] / parts_count >= params.min_mean_score:
+            keep.append(i)
+    return subset[keep], candidate
+
+
+def subsets_to_keypoints(subset: np.ndarray, candidate: np.ndarray,
+                         skeleton: SkeletonConfig
+                         ) -> List[Tuple[List[Optional[Tuple[float, float]]],
+                                         float]]:
+    """Convert assembled subsets to COCO-order keypoints + person score
+    (reference: evaluate.py:523-543; score = 1 - 1/total_score)."""
+    results = []
+    mapping = skeleton.dt_gt_mapping
+    n = skeleton.num_parts
+    for person in subset:
+        coords = []
+        for idx in person[:n, 0]:
+            if idx == -1:
+                coords.append((0.0, 0.0))
+            else:
+                x, y = candidate[int(idx)][:2]
+                coords.append((float(x), float(y)))
+        coco_coords: List[Optional[Tuple[float, float]]] = [None] * 17
+        for dt_index, gt_index in mapping.items():
+            if gt_index is None:
+                continue
+            coco_coords[gt_index] = coords[dt_index]
+        score = 1.0 - 1.0 / person[n, 0]
+        results.append((coco_coords, float(score)))
+    return results
+
+
+def decode(heatmap: np.ndarray, paf: np.ndarray, params: InferenceParams,
+           skeleton: SkeletonConfig, use_native: bool = True):
+    """Full decode: (H,W,heat+bkg) + (H,W,paf) maps → list of
+    (coco keypoints, score) (reference: evaluate.py:501-543 ``process``)."""
+    all_peaks = find_peaks(heatmap, params, skeleton.num_parts)
+    image_size = heatmap.shape[0]
+    if use_native:
+        from .native import native_available, native_find_connections_people
+        if native_available():
+            subset, candidate = native_find_connections_people(
+                all_peaks, paf, image_size, params, skeleton.limbs_conn,
+                skeleton.num_parts)
+            return subsets_to_keypoints(subset, candidate, skeleton)
+    connection_all, special_k = find_connections(
+        all_peaks, paf, image_size, params, skeleton.limbs_conn)
+    subset, candidate = find_people(connection_all, special_k, all_peaks,
+                                    params, skeleton.limbs_conn,
+                                    skeleton.num_parts)
+    return subsets_to_keypoints(subset, candidate, skeleton)

@@ -1,0 +1,103 @@
+"""ctypes binding to the native C++ decoder (native/decoder.cpp).
+
+The reference's keypoint-assignment stage is pure Python at 5.2 FPS
+(reference: README.md:68, evaluate.py:206-498); the framework ships a C++
+implementation of connection scoring + greedy assembly with identical
+semantics, loaded via ctypes (no pybind11 dependency).  Falls back to the
+NumPy path in ``decode.py`` when the shared library hasn't been built.
+
+Build: ``python tools/build_native.py`` (or ``make -C native``).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import InferenceParams
+
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+
+_LIB_PATHS = (
+    os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                 "libposedecoder.so"),
+    os.path.join(os.path.dirname(__file__), "libposedecoder.so"),
+)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    for path in _LIB_PATHS:
+        path = os.path.abspath(path)
+        if os.path.exists(path):
+            lib = ctypes.CDLL(path)
+            lib.decode_people.restype = ctypes.c_int
+            lib.decode_people.argtypes = [
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int,   # peaks, n
+                ctypes.POINTER(ctypes.c_int),                    # peaks per part
+                ctypes.c_int,                                    # num_parts
+                ctypes.POINTER(ctypes.c_float),                  # paf
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,        # H, W, C
+                ctypes.POINTER(ctypes.c_int), ctypes.c_int,      # limbs, n_limbs
+                ctypes.c_int,                                    # image_size
+                ctypes.POINTER(ctypes.c_double),                 # params[8]
+                ctypes.POINTER(ctypes.c_double),                 # out subsets
+                ctypes.c_int,                                    # max people
+            ]
+            _LIB = lib
+            break
+    return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_find_connections_people(
+        all_peaks: Sequence[np.ndarray], paf: np.ndarray, image_size: int,
+        params: InferenceParams, limbs_conn: Sequence[Tuple[int, int]],
+        num_parts: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Run the native connection-scoring + assembly; returns (subset,
+    candidate) with the same layout as the NumPy path."""
+    lib = _load()
+    assert lib is not None, "native decoder not built"
+
+    counts = np.asarray([len(p) for p in all_peaks], dtype=np.int32)
+    candidate = (np.concatenate([p for p in all_peaks], axis=0)
+                 if counts.sum() else np.zeros((0, 4)))
+    peaks_flat = np.ascontiguousarray(candidate, dtype=np.float64)
+    paf_c = np.ascontiguousarray(paf, dtype=np.float32)
+    limbs = np.ascontiguousarray(
+        np.asarray(limbs_conn, dtype=np.int32).reshape(-1))
+    p = np.asarray([
+        params.thre2, params.connect_ration, float(params.mid_num),
+        params.len_rate, params.connection_tole, float(params.remove_recon),
+        float(params.min_parts), params.min_mean_score,
+    ], dtype=np.float64)
+
+    max_people = max(int(counts.sum()), 1)
+    rows = num_parts + 2
+    out = np.full((max_people, rows, 2), -1.0, dtype=np.float64)
+
+    n_people = lib.decode_people(
+        peaks_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        int(counts.sum()),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        num_parts,
+        paf_c.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        paf.shape[0], paf.shape[1], paf.shape[2],
+        limbs.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        len(limbs_conn),
+        image_size,
+        p.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        max_people,
+    )
+    assert n_people >= 0, "native decoder failed"
+    return out[:n_people], candidate

@@ -1,0 +1,135 @@
+"""Multi-scale / rotation / flip-ensemble heatmap prediction.
+
+Reference: evaluate.py:83-166 ``predict``.  TPU-first redesign: the whole
+flip ensemble — forward on [image, mirrored image], mirror-back, channel
+permutation, averaging — and the ×stride bicubic upsample are fused into ONE
+jitted program per input shape, so only the final full-resolution maps cross
+the device boundary (the reference round-trips through NumPy/cv2 per scale,
+evaluate.py:126-158).
+
+Dynamic shapes: inputs are padded up to a shape *bucket* (multiple of
+``bucket`` ≥ the network's max downsample of 64) so the scale/rotation grid
+reuses a handful of compiled programs instead of recompiling per image
+(SURVEY.md §7 hard part e).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, Optional, Sequence, Tuple
+
+import cv2
+import numpy as np
+
+from ..config import (
+    InferenceModelParams,
+    InferenceParams,
+    SkeletonConfig,
+)
+
+
+def pad_right_down(img: np.ndarray, multiple: int, pad_value: int
+                   ) -> Tuple[np.ndarray, Tuple[int, int]]:
+    """Pad bottom/right to the next multiple (reference: utils/util.py:44-65
+    pads with the edge value scaled to padValue; we pad constant)."""
+    h, w = img.shape[:2]
+    ph = (multiple - h % multiple) % multiple
+    pw = (multiple - w % multiple) % multiple
+    if ph or pw:
+        img = cv2.copyMakeBorder(img, 0, ph, 0, pw, cv2.BORDER_CONSTANT,
+                                 value=(pad_value,) * 3)
+    return img, (ph, pw)
+
+
+class Predictor:
+    """Holds the jitted ensemble forward, cached per padded input shape."""
+
+    def __init__(self, model, variables, skeleton: SkeletonConfig,
+                 params: Optional[InferenceParams] = None,
+                 model_params: Optional[InferenceModelParams] = None,
+                 bucket: int = 128):
+        from ..config import default_inference_params
+
+        d_params, d_model_params = default_inference_params()
+        self.model = model
+        self.variables = variables
+        self.skeleton = skeleton
+        self.params = params or d_params
+        self.model_params = model_params or d_model_params
+        self.bucket = max(bucket, self.model_params.max_downsample)
+        self._fns: Dict[Tuple[int, int], object] = {}
+
+    # ------------------------------------------------------------------ #
+    def _ensemble_fn(self, shape: Tuple[int, int]):
+        """Jitted: (H, W, 3) float image → (H, W, C) ensembled maps."""
+        if shape in self._fns:
+            return self._fns[shape]
+
+        import jax
+        import jax.numpy as jnp
+
+        sk = self.skeleton
+        flip_paf = jnp.asarray(sk.flip_paf_ord)
+        flip_heat = jnp.asarray(sk.flip_heat_ord)
+        stride = sk.stride
+
+        def fn(variables, img):
+            both = jnp.stack([img, img[:, ::-1, :]], axis=0)
+            preds = self.model.apply(variables, both, train=False)
+            out = preds[-1][0]  # last stack, scale 0: (2, H/4, W/4, C)
+            straight, mirrored = out[0], out[1][:, ::-1, :]
+            paf = (straight[..., :sk.paf_layers]
+                   + mirrored[..., :sk.paf_layers][..., flip_paf]) / 2
+            heat = (straight[..., sk.heat_start:sk.num_layers]
+                    + mirrored[..., sk.heat_start:sk.num_layers][..., flip_heat]
+                    ) / 2
+            maps = jnp.concatenate([paf, heat], axis=-1)
+            h, w = maps.shape[0] * stride, maps.shape[1] * stride
+            maps = jax.image.resize(maps, (h, w, maps.shape[-1]),
+                                    method="cubic")
+            return maps
+
+        jitted = jax.jit(fn)
+        self._fns[shape] = jitted
+        return jitted
+
+    # ------------------------------------------------------------------ #
+    def predict(self, image_bgr: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray]:
+        """Average maps over the scale × rotation grid at original resolution.
+
+        :param image_bgr: (H, W, 3) uint8 (cv2 imread order, like the
+            reference's pipeline end-to-end)
+        :returns: (heatmap (H, W, heat_layers+2), paf (H, W, paf_layers))
+        """
+        sk, prm, mp = self.skeleton, self.params, self.model_params
+        oh, ow = image_bgr.shape[:2]
+        heat_avg = np.zeros((oh, ow, sk.heat_layers + 2), np.float64)
+        paf_avg = np.zeros((oh, ow, sk.paf_layers), np.float64)
+
+        multipliers = [s * mp.boxsize / oh for s in prm.scale_search]
+        grid = [(s, a) for s in multipliers for a in prm.rotation_search]
+        for scale, angle in grid:
+            if scale * oh > mp.max_height or scale * ow > mp.max_width:
+                scale = min(mp.max_height / oh, mp.max_width / ow)
+            resized = cv2.resize(image_bgr, (0, 0), fx=scale, fy=scale,
+                                 interpolation=cv2.INTER_CUBIC)
+            if angle != 0:
+                rc = (resized.shape[0] / 2, resized.shape[1] / 2)
+                rot = cv2.getRotationMatrix2D(rc, angle, 1)
+                rot_back = cv2.getRotationMatrix2D(rc, -angle, 1)
+                resized = cv2.warpAffine(resized, rot, (0, 0))
+            rh, rw = resized.shape[:2]
+            padded, _ = pad_right_down(resized, self.bucket, mp.pad_value)
+
+            img = padded.astype(np.float32) / 255.0
+            maps = np.asarray(
+                self._ensemble_fn(img.shape[:2])(self.variables, img),
+                dtype=np.float64)
+            maps = maps[:rh, :rw]  # unpad
+            if angle != 0:
+                maps = cv2.warpAffine(maps, rot_back, (0, 0))
+            maps = cv2.resize(maps, (ow, oh), interpolation=cv2.INTER_CUBIC)
+            paf_avg += maps[..., :sk.paf_layers] / len(grid)
+            heat_avg += maps[..., sk.paf_layers:] / len(grid)
+        return heat_avg, paf_avg

@@ -1,0 +1,7 @@
+from .layers import Backbone, ConvBlock, Hourglass, Residual, SELayer
+from .posenet import Features, PoseNet, PoseNetLight, build_model
+
+__all__ = [
+    "Backbone", "ConvBlock", "Hourglass", "Residual", "SELayer",
+    "Features", "PoseNet", "PoseNetLight", "build_model",
+]

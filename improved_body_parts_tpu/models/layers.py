@@ -1,0 +1,205 @@
+"""Flax layer library for the IMHN (Identity-Mapping Hourglass Network).
+
+NHWC-native re-design of the reference layer library
+(reference: models/layers_transposed.py).  The reference permutes NHWC input to
+NCHW at the door (models/posenet.py:84); on TPU we stay NHWC end-to-end, the
+layout XLA tiles best onto the MXU.
+
+Mixed precision: every module takes ``dtype`` (compute dtype, bf16 on TPU) and
+keeps parameters in fp32 (``param_dtype``), replacing the reference's Apex AMP
+(train_distributed.py:136-139).
+
+BatchNorm under SPMD: inside one jitted program with a batch-sharded input,
+XLA turns the batch-mean reductions into global collectives automatically, so
+cross-replica (Sync) BN needs no special wrapper — the TPU-native equivalent of
+``apex.parallel.convert_syncbn_model`` (train_distributed.py:90-97).  For
+pmap/shard_map use, pass ``bn_axis_name``.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+# Weight init matching the reference (models/posenet.py:119-139):
+# conv N(0, 0.001), SE-dense N(0, 0.01), biases zero, BN (1, 0).
+conv_init = nn.initializers.normal(stddev=0.001)
+dense_init = nn.initializers.normal(stddev=0.01)
+
+LEAKY_SLOPE = 0.01
+
+
+def leaky_relu(x):
+    return nn.leaky_relu(x, negative_slope=LEAKY_SLOPE)
+
+
+def max_pool_2x2(x):
+    return nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+
+
+def upsample_nearest_2x(x):
+    """Nearest-neighbour 2x upsample (reference: layers_transposed.py:210)."""
+    n, h, w, c = x.shape
+    x = jnp.broadcast_to(x[:, :, None, :, None, :], (n, h, 2, w, 2, c))
+    return x.reshape(n, h * 2, w * 2, c)
+
+
+class ConvBlock(nn.Module):
+    """conv + optional BN + LeakyReLU (reference: layers_transposed.py:90-120).
+
+    With BN the conv has no bias; without BN it does — matching the reference
+    so parameter counts line up.  Dilation generalizes the reference's separate
+    ``DilatedConv`` (layers_transposed.py:123-155).
+    """
+    features: int
+    kernel_size: int = 3
+    stride: int = 1
+    use_bn: bool = True
+    relu: bool = True
+    dilation: int = 1
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(
+            self.features, (self.kernel_size, self.kernel_size),
+            strides=(self.stride, self.stride),
+            kernel_dilation=(self.dilation, self.dilation),
+            padding="SAME",
+            use_bias=not self.use_bn,
+            kernel_init=conv_init,
+            dtype=self.dtype, param_dtype=jnp.float32)(x)
+        if self.use_bn:
+            x = nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                axis_name=self.bn_axis_name,
+                dtype=self.dtype, param_dtype=jnp.float32)(x)
+        if self.relu:
+            x = leaky_relu(x)
+        return x
+
+
+class Residual(nn.Module):
+    """Bottleneck residual block (reference: layers_transposed.py:12-48).
+
+    1x1 (out/2) → 3x3 (out/2) → 1x1 (out), BN after each conv, LeakyReLU
+    between, 1x1+BN skip projection when channel counts differ, LeakyReLU
+    after the add.
+    """
+    features: int
+    use_bn: bool = True  # the reference instantiates Residual(bn=True) always
+    relu_out: bool = True
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def conv(f, k, y):
+            return nn.Conv(f, (k, k), padding="SAME", use_bias=False,
+                           kernel_init=conv_init, dtype=self.dtype,
+                           param_dtype=jnp.float32)(y)
+
+        def bn(y):
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                epsilon=1e-5, axis_name=self.bn_axis_name,
+                                dtype=self.dtype, param_dtype=jnp.float32)(y)
+
+        mid = self.features // 2
+        y = leaky_relu(bn(conv(mid, 1, x)))
+        y = leaky_relu(bn(conv(mid, 3, y)))
+        y = bn(conv(self.features, 1, y))
+        if x.shape[-1] != self.features:
+            x = bn(conv(self.features, 1, x))
+        y = y + x
+        return leaky_relu(y) if self.relu_out else y
+
+
+class SELayer(nn.Module):
+    """Squeeze-and-Excitation channel gate (reference: layers_transposed.py:285-306)."""
+    reduction: int = 16
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        assert c > self.reduction, (
+            f"input channels {c} must exceed SE reduction {self.reduction}")
+        y = jnp.mean(x, axis=(1, 2))  # global average pool → (N, C)
+        y = nn.Dense(c // self.reduction, kernel_init=dense_init,
+                     dtype=self.dtype, param_dtype=jnp.float32)(y)
+        y = leaky_relu(y)
+        y = nn.Dense(c, kernel_init=dense_init, dtype=self.dtype,
+                     param_dtype=jnp.float32)(y)
+        y = nn.sigmoid(y)
+        return x * y[:, None, None, :]
+
+
+class Backbone(nn.Module):
+    """Stride-4 stem (reference: layers_transposed.py:158-194).
+
+    7x7/2 conv → Residual(64→128) → maxpool/2 → Residual(128) →
+    6 dilated 3x3 convs (d = 3,3,4,4,5,5) → channel-concat with the pre-dilation
+    features → 2*128 = nFeat channels.
+    """
+    features: int = 256
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        half = self.features // 2
+        x = ConvBlock(64, kernel_size=7, stride=2, **kw)(x, train)
+        x = Residual(half, **kw)(x, train)
+        x = max_pool_2x2(x)
+        x = Residual(half, **kw)(x, train)
+        y = x
+        for d in (3, 3, 4, 4, 5, 5):
+            y = ConvBlock(half, kernel_size=3, dilation=d, **kw)(y, train)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class Hourglass(nn.Module):
+    """5-scale hourglass, written iteratively (reference recursion:
+    layers_transposed.py:197-282).
+
+    Returns features at all depth+1 scales, largest first:
+    [(H,W,nf), (H/2,W/2,nf+inc), ..., (H/16,W/16,nf+4*inc)] for depth 4 —
+    the multi-scale supervision points of the IMHN.
+    """
+    depth: int = 4
+    features: int = 256
+    increase: int = 128
+    use_bn: bool = True  # BN usage inside ConvBlock refine convs
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+
+        def ch(i):
+            return self.features + self.increase * i
+
+        # down path: keep the skip ("up1") branch at each depth
+        skips = []
+        for i in range(self.depth):
+            skips.append(Residual(ch(i), **kw)(x, train))
+            x = max_pool_2x2(x)
+            x = Residual(ch(i + 1), **kw)(x, train)
+        # innermost
+        y = Residual(ch(self.depth), **kw)(x, train)
+
+        # up path; collect the per-scale outputs, smallest first
+        scales = [y]
+        for i in reversed(range(self.depth)):
+            low3 = Residual(ch(i), **kw)(y, train)
+            up2 = upsample_nearest_2x(low3)
+            refined = ConvBlock(ch(i), kernel_size=3, use_bn=self.use_bn,
+                                **kw)(up2, train)
+            y = skips[i] + refined
+            scales.append(y)
+        return scales[::-1]  # largest scale first

@@ -1,0 +1,176 @@
+"""The IMHN PoseNet in Flax (reference: models/posenet.py).
+
+Architecture: stride-4 Backbone stem → ``nstack`` hourglasses, each emitting 5
+scales of features; per scale a Features head (2 convs + SE) and a 1x1 output
+head regress ``num_layers`` heatmap channels; identity (residual) connections
+carry merged features+predictions across stacks at every scale
+(reference: models/posenet.py:82-117).
+
+Returns ``[nstack][5]`` NHWC prediction tensors, largest scale first.
+
+Variants (selected by ``ModelConfig.variant``):
+- ``imhn``              the production 4-stack network (posenet.py)
+- ``imhn_independent``  no cross-stack residual connections
+                        (posenet_independent.py:1-3 ablation)
+- ``imhn_final``        SE applied before the cache add + compressing Features
+                        (posenet_final.py:37-43,78-113)
+- ``imhn_light``        light variant: simple conv stem, single-conv Features
+                        (posenet3.py:34-37,56-62)
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..config import Config
+from .layers import Backbone, ConvBlock, Hourglass, Residual, SELayer, max_pool_2x2
+
+
+class Features(nn.Module):
+    """Per-scale pre-regression head: 2x Conv3x3 + SE
+    (reference: models/posenet.py:24-40)."""
+    inp_dim: int
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+    se_reduction: int = 16
+
+    @nn.compact
+    def __call__(self, fms, train: bool = False):
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        out = []
+        for f in fms:
+            f = ConvBlock(self.inp_dim, kernel_size=3, **kw)(f, train)
+            f = ConvBlock(self.inp_dim, kernel_size=3, **kw)(f, train)
+            f = SELayer(reduction=self.se_reduction, dtype=self.dtype)(f)
+            out.append(f)
+        return out
+
+
+class PoseNet(nn.Module):
+    """Stacked IMHN (reference: models/posenet.py:43-117)."""
+    nstack: int = 4
+    inp_dim: int = 256
+    oup_dim: int = 50
+    increase: int = 128
+    hourglass_depth: int = 4
+    cross_stack_residual: bool = True  # False = posenet_independent ablation
+    se_reduction: int = 16
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        """images: (N, H, W, 3) float in [0, 1] — NHWC end-to-end."""
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = images.astype(self.dtype)
+        x = Backbone(features=self.inp_dim, **kw)(x, train)
+
+        nscale = self.hourglass_depth + 1
+        preds: List[List[jnp.ndarray]] = []
+        cache: List[Optional[jnp.ndarray]] = [None] * nscale
+        for i in range(self.nstack):
+            feats = Hourglass(
+                depth=self.hourglass_depth, features=self.inp_dim,
+                increase=self.increase, **kw)(x, train)
+            if self.cross_stack_residual and i > 0:
+                feats = [f + c for f, c in zip(feats, cache)]
+            feats = Features(self.inp_dim, se_reduction=self.se_reduction,
+                             **kw)(feats, train)
+
+            preds_instack = []
+            for j in range(nscale):
+                pred = ConvBlock(self.oup_dim, kernel_size=1, use_bn=False,
+                                 relu=False, dtype=self.dtype)(feats[j], train)
+                preds_instack.append(pred.astype(jnp.float32))
+                if i != self.nstack - 1:
+                    # Merge prediction + features back to the scale's width for
+                    # the next stack (reference: posenet.py:102-114; the
+                    # reference evaluates merge twice for scale 0 — same values,
+                    # we compute once).
+                    width = self.inp_dim + j * self.increase
+                    merged = (
+                        ConvBlock(width, kernel_size=1, relu=False, **kw)(
+                            pred.astype(self.dtype), train)
+                        + ConvBlock(width, kernel_size=1, relu=False, **kw)(
+                            feats[j], train))
+                    if j == 0:
+                        x = x + merged
+                    cache[j] = merged
+            preds.append(preds_instack)
+        return preds
+
+
+class PoseNetLight(nn.Module):
+    """Light IMHN: plain conv stem and single-conv Features
+    (reference: models/posenet3.py:34-62)."""
+    nstack: int = 4
+    inp_dim: int = 256
+    oup_dim: int = 50
+    increase: int = 128
+    hourglass_depth: int = 4
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = images.astype(self.dtype)
+        # stem: 7x7/2 conv → res → pool → res → res (posenet3.py:56-62)
+        x = ConvBlock(64, kernel_size=7, stride=2, **kw)(x, train)
+        x = Residual(128, **kw)(x, train)
+        x = max_pool_2x2(x)
+        x = Residual(128, **kw)(x, train)
+        x = Residual(self.inp_dim, **kw)(x, train)
+
+        nscale = self.hourglass_depth + 1
+        preds: List[List[jnp.ndarray]] = []
+        cache: List[Optional[jnp.ndarray]] = [None] * nscale
+        for i in range(self.nstack):
+            feats = Hourglass(
+                depth=self.hourglass_depth, features=self.inp_dim,
+                increase=self.increase, **kw)(x, train)
+            if i > 0:
+                feats = [f + c for f, c in zip(feats, cache)]
+            feats = [ConvBlock(self.inp_dim, kernel_size=3, **kw)(f, train)
+                     for f in feats]
+            preds_instack = []
+            for j in range(nscale):
+                pred = ConvBlock(self.oup_dim, kernel_size=1, use_bn=False,
+                                 relu=False, dtype=self.dtype)(feats[j], train)
+                preds_instack.append(pred.astype(jnp.float32))
+                if i != self.nstack - 1:
+                    width = self.inp_dim + j * self.increase
+                    merged = (
+                        ConvBlock(width, kernel_size=1, relu=False, **kw)(
+                            pred.astype(self.dtype), train)
+                        + ConvBlock(width, kernel_size=1, relu=False, **kw)(
+                            feats[j], train))
+                    if j == 0:
+                        x = x + merged
+                    cache[j] = merged
+            preds.append(preds_instack)
+        return preds
+
+
+def build_model(config: Config, dtype=None) -> nn.Module:
+    """Construct the model selected by ``config.model.variant``."""
+    m = config.model
+    oup = config.skeleton.num_layers
+    if dtype is None:
+        dtype = jnp.bfloat16 if config.train.bf16_compute else jnp.float32
+    common = dict(nstack=m.nstack, inp_dim=m.inp_dim, oup_dim=oup,
+                  increase=m.increase, hourglass_depth=m.hourglass_depth,
+                  dtype=dtype)
+    if m.variant in ("imhn", "imhn_final"):
+        # imhn_final's structural deltas (compressed Features, pre-cache SE)
+        # are modelled by the same module for now; tracked as a TODO variant.
+        return PoseNet(cross_stack_residual=True,
+                       se_reduction=m.se_reduction, **common)
+    if m.variant == "imhn_independent":
+        return PoseNet(cross_stack_residual=False,
+                       se_reduction=m.se_reduction, **common)
+    if m.variant == "imhn_light":
+        return PoseNetLight(**common)
+    raise ValueError(f"unknown model variant '{m.variant}'")

@@ -1,0 +1,4 @@
+from .losses import avg_pool_to, downsample_mask, focal_l2, l2, multi_task_loss
+
+__all__ = ["avg_pool_to", "downsample_mask", "focal_l2", "l2",
+           "multi_task_loss"]

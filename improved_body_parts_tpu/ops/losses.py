@@ -1,0 +1,118 @@
+"""Multi-scale masked focal L2 loss (jitted).
+
+Unifies the reference's two loss modules (reference: models/loss_model.py —
+the distributed path, canonical; models/loss_model_parallel.py — the
+DataParallel twin) behind one function family.  Canonical semantics are the
+distributed path's (SURVEY.md §7 hard-part b): focal factor
+``st = where(gt >= 0.01, s - alpha, 1 - s - beta)``, ``factor = |1 - st|``
+(γ=1 linearization, loss_model.py:151-152), mask modulation on the person-mask
+channel by ``multi_task_weight`` and on keypoint channels by
+``keypoint_task_weight`` (loss_model.py:146-149), per-scale GT downsampling by
+average pooling and mask downsampling by bilinear interpolation binarized at
+0.5 (loss_model.py:52-56), scale losses combined by ``scale_weight`` and
+divided by the global batch (loss_model.py:37-40).
+
+Everything is channel-LAST (N, H, W, C): predictions come from the NHWC model,
+GT from the heatmapper.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+
+
+def avg_pool_to(x: jnp.ndarray, size) -> jnp.ndarray:
+    """Adaptive average pool NHWC → (N, size, size, C) for power-of-two ratios
+    (replaces F.adaptive_avg_pool2d, loss_model.py:52)."""
+    n, h, w, c = x.shape
+    th, tw = size
+    assert h % th == 0 and w % tw == 0, (h, w, size)
+    kh, kw = h // th, w // tw
+    if kh == 1 and kw == 1:
+        return x
+    x = x.reshape(n, th, kh, tw, kw, c)
+    return x.mean(axis=(2, 4))
+
+
+def downsample_mask(mask: jnp.ndarray, size) -> jnp.ndarray:
+    """Bilinear-resize the miss mask then zero everything < 0.5
+    (loss_model.py:55-56)."""
+    n, h, w, c = mask.shape
+    th, tw = size
+    if (h, w) != (th, tw):
+        mask = jax.image.resize(mask, (n, th, tw, c), method="bilinear")
+    return jnp.where(mask < 0.5, 0.0, mask)
+
+
+def _modulated_mask(mask: jnp.ndarray, num_layers: int, heat_start: int,
+                    bkg_start: int, multi_task_weight: float,
+                    keypoint_task_weight: float) -> jnp.ndarray:
+    """Broadcast the (N,H,W,1) miss mask over channels and scale task groups
+    (loss_model.py:146-149): person-mask channel × multi_task_weight,
+    keypoint channels × keypoint_task_weight."""
+    chan_scale = jnp.ones((num_layers,), dtype=mask.dtype)
+    chan_scale = chan_scale.at[heat_start:bkg_start].mul(keypoint_task_weight)
+    chan_scale = chan_scale.at[bkg_start].mul(multi_task_weight)
+    return mask * chan_scale  # (N,H,W,1)*(C,) → (N,H,W,C)
+
+
+def focal_l2(pred: jnp.ndarray, gt: jnp.ndarray, mask: jnp.ndarray,
+             gamma: float = 1.0, alpha: float = 0.0, beta: float = 0.0
+             ) -> jnp.ndarray:
+    """Per-stack focal L2 (loss_model.py:133-161). pred: (nstack,N,H,W,C);
+    gt/mask broadcast along the stack axis. Returns per-stack sums (nstack,)."""
+    st = jnp.where(gt >= 0.01, pred - alpha, 1.0 - pred - beta)
+    if gamma == 1.0:
+        factor = jnp.abs(1.0 - st)
+    else:
+        factor = jnp.abs(1.0 - st) ** gamma
+    out = (pred - gt) ** 2 * factor * mask
+    return out.sum(axis=(1, 2, 3, 4))
+
+
+def l2(pred: jnp.ndarray, gt: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Plain masked L2 (loss_model.py:102-131). Same shapes as focal_l2."""
+    return ((pred - gt) ** 2 * mask).sum(axis=(1, 2, 3, 4))
+
+
+def multi_task_loss(preds: Sequence[Sequence[jnp.ndarray]], gt: jnp.ndarray,
+                    mask_miss: jnp.ndarray, config: Config,
+                    use_focal: bool = True) -> jnp.ndarray:
+    """Total training loss over nstack stacks × 5 scales.
+
+    :param preds: [nstack][5] NHWC tensors from the model (fp32)
+    :param gt: (N, H, W, num_layers) GT heatmaps at stride 4
+    :param mask_miss: (N, H, W, 1) miss mask in [0, 1]
+    :returns: scalar — summed per-stack losses weighted by nstack_weight /
+        scale_weight, divided by the global batch size
+        (loss_model.py:34-40, 133-161).
+    """
+    sk, tr = config.skeleton, config.train
+    nstack = len(preds)
+    nscale = len(preds[0])
+    nstack_w = jnp.asarray(tr.nstack_weight, dtype=jnp.float32)
+    scale_w = list(tr.scale_weight)
+    assert len(scale_w) == nscale and nstack_w.shape[0] == nstack
+
+    loss_fn = focal_l2 if use_focal else l2
+    total = 0.0
+    for s in range(nscale):
+        pred_s = jnp.stack([preds[i][s] for i in range(nstack)], axis=0)
+        size = pred_s.shape[2:4]
+        gt_s = avg_pool_to(gt, size)[None]
+        mask_s = downsample_mask(mask_miss, size)
+        mask_s = _modulated_mask(
+            mask_s, sk.num_layers, sk.heat_start, sk.bkg_start,
+            tr.multi_task_weight, tr.keypoint_task_weight)[None]
+        per_stack = loss_fn(pred_s, gt_s, mask_s)
+        total = total + (per_stack * nstack_w).sum() / nstack_w.sum() * scale_w[s]
+
+    total = total / sum(scale_w)
+    if tr.normalize_by_global_batch:
+        total = total / gt.shape[0]
+    return total

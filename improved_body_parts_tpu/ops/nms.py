@@ -1,0 +1,60 @@
+"""Peak NMS (jitted, on-device) + vectorized sub-pixel refinement (host).
+
+Reference: utils/util.py:177-183 ``keypoint_heatmap_nms`` (3x3 max-pool with
+reflect padding, threshold thre1) and :186-211 ``refine_centroid`` (weighted
+centroid over a (2r+1)² box; falls back to the raw anchor when the box
+crosses the border).  The reference refines peak-by-peak in Python; here all
+peaks refine in one vectorized gather.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("kernel",))
+def keypoint_nms(heat: jnp.ndarray, kernel: int = 3, thre: float = 0.1
+                 ) -> jnp.ndarray:
+    """heat: (H, W, C) score maps → same shape with non-peaks zeroed."""
+    pad = (kernel - 1) // 2
+    padded = jnp.pad(heat, ((pad, pad), (pad, pad), (0, 0)), mode="reflect")
+    hmax = jax.lax.reduce_window(
+        padded, -jnp.inf, jax.lax.max,
+        window_dimensions=(kernel, kernel, 1),
+        window_strides=(1, 1, 1), padding="VALID")
+    keep = (hmax == heat) & (heat >= thre)
+    return jnp.where(keep, heat, 0.0)
+
+
+def refine_peaks(score_map: np.ndarray, xs: np.ndarray, ys: np.ndarray,
+                 radius: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Weighted-centroid refinement of integer peaks on one channel.
+
+    Returns (x_refined, y_refined, score).  Peaks whose window crosses the
+    border keep their integer coords and raw score (util.py:201-202).
+    """
+    h, w = score_map.shape
+    n = xs.shape[0]
+    if n == 0:
+        return (np.zeros(0), np.zeros(0), np.zeros(0))
+    r = radius
+    inside = (xs - r >= 0) & (xs + r + 1 <= w) & (ys - r >= 0) & (ys + r + 1 <= h)
+
+    offs = np.arange(-r, r + 1)
+    wy = np.clip(ys[:, None] + offs[None, :], 0, h - 1)
+    wx = np.clip(xs[:, None] + offs[None, :], 0, w - 1)
+    boxes = score_map[wy[:, :, None], wx[:, None, :]]  # (n, 2r+1, 2r+1)
+
+    total = boxes.sum(axis=(1, 2))
+    total = np.where(total == 0, 1.0, total)
+    gx = (boxes * offs[None, None, :]).sum(axis=(1, 2)) / total
+    gy = (boxes * offs[None, :, None]).sum(axis=(1, 2)) / total
+
+    x_ref = np.where(inside, xs + gx, xs.astype(np.float64))
+    y_ref = np.where(inside, ys + gy, ys.astype(np.float64))
+    score = np.where(inside, boxes.mean(axis=(1, 2)), score_map[ys, xs])
+    return x_ref, y_ref, score

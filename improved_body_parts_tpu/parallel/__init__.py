@@ -1,0 +1,13 @@
+from .mesh import (
+    batch_sharding,
+    batch_spec,
+    initialize_distributed,
+    make_mesh,
+    replicated,
+    shard_batch,
+)
+
+__all__ = [
+    "batch_sharding", "batch_spec", "initialize_distributed", "make_mesh",
+    "replicated", "shard_batch",
+]

@@ -1,0 +1,21 @@
+from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
+from .loop import eval_epoch, fit, train_epoch
+from .schedule import cyclic_swa_schedule, step_decay_schedule
+from .state import (
+    TrainState,
+    create_train_state,
+    make_optimizer,
+    start_swa,
+    swap_swa_params,
+    update_swa,
+)
+from .step import make_eval_step, make_train_step
+
+__all__ = [
+    "latest_checkpoint", "restore_checkpoint", "save_checkpoint",
+    "eval_epoch", "fit", "train_epoch",
+    "cyclic_swa_schedule", "step_decay_schedule",
+    "TrainState", "create_train_state", "make_optimizer", "start_swa",
+    "swap_swa_params", "update_swa",
+    "make_eval_step", "make_train_step",
+]

@@ -1,0 +1,80 @@
+"""Orbax checkpointing.
+
+Replaces the reference's torch.save dict {weights, optimizer_weight,
+train_loss, epoch} and its resume-time 'module.' key remapping
+(reference: train.py:149-162, train_distributed.py:149-197, 304-324) — under
+functional params there is nothing to remap.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from .state import TrainState
+
+
+def _to_host(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def save_checkpoint(directory: str, state: TrainState, epoch: int,
+                    train_loss: float, best_loss: float) -> str:
+    """Write checkpoint ``<directory>/epoch_<N>`` and return its path."""
+    path = os.path.abspath(os.path.join(directory, f"epoch_{epoch}"))
+    payload = {
+        "params": _to_host(state.params),
+        "batch_stats": _to_host(state.batch_stats),
+        "opt_state": _to_host(state.opt_state),
+        "step": int(state.step),
+        "swa_params": (_to_host(state.swa_params)
+                       if state.swa_params is not None else None),
+        "swa_count": (int(state.swa_count)
+                      if state.swa_count is not None else None),
+        "epoch": epoch,
+        "train_loss": float(train_loss),
+        "best_loss": float(best_loss),
+    }
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, payload, force=True)
+    return path
+
+
+def restore_checkpoint(path: str, state: Optional[TrainState] = None
+                       ) -> Dict[str, Any]:
+    """Load a checkpoint; if ``state`` is given, return (state, meta) with the
+    arrays restored into it (resume semantics of train_distributed.py:149-197).
+    """
+    ckptr = ocp.PyTreeCheckpointer()
+    payload = ckptr.restore(os.path.abspath(path))
+    if state is None:
+        return payload
+    restored = state.replace(
+        params=payload["params"],
+        batch_stats=payload["batch_stats"],
+        opt_state=payload["opt_state"],
+        step=np.asarray(payload["step"], np.int32),
+        swa_params=payload.get("swa_params"),
+        swa_count=(np.asarray(payload["swa_count"], np.int32)
+                   if payload.get("swa_count") is not None else None),
+    )
+    meta = {k: payload[k] for k in ("epoch", "train_loss", "best_loss")}
+    return restored, meta
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    if not os.path.isdir(directory):
+        return None
+    epochs = []
+    for name in os.listdir(directory):
+        if name.startswith("epoch_"):
+            try:
+                epochs.append((int(name.split("_")[1]), name))
+            except ValueError:
+                continue
+    if not epochs:
+        return None
+    return os.path.join(directory, max(epochs)[1])

@@ -1,0 +1,123 @@
+"""Epoch-level training loop.
+
+The framework equivalent of the reference entry scripts' train()/test()
+(reference: train.py:104-206, train_distributed.py:225-379): per-epoch batch
+loop over a host data source, device placement with batch sharding, throttled
+metric readback, append-only epoch log, per-epoch checkpointing.
+
+Host→device: batches are placed with ``shard_batch`` (data-parallel over the
+mesh); metric readback happens every ``print_freq`` steps only — the TPU
+analogue of the reference's throttled all-reduce + cuda.synchronize
+(train_distributed.py:272-298).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..config import Config
+from ..parallel import make_mesh, replicated, shard_batch
+from ..utils import AverageMeter, StepTimer
+from . import checkpoint as ckpt
+from .state import TrainState
+
+
+def _log_line(checkpoint_dir: str, text: str) -> None:
+    """Append-only epoch log (reference: train_distributed.py:304-310)."""
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    with open(os.path.join(checkpoint_dir, "log"), "a") as f:
+        f.write(text)
+
+
+def train_epoch(state: TrainState, train_step: Callable,
+                batches: Iterable, config: Config, epoch: int,
+                mesh=None, print_freq: Optional[int] = None,
+                is_lead_host: bool = True,
+                log_fn: Callable[[str], None] = print
+                ) -> Tuple[TrainState, float]:
+    """Run one epoch; returns (state, mean loss).
+
+    ``batches`` yields (images, mask_miss, labels) host arrays — this host's
+    shard of the global batch when running multi-host.
+    """
+    print_freq = print_freq or config.train.print_freq
+    losses = AverageMeter()
+    timer = StepTimer()
+    pending = []  # device losses not yet read back
+
+    global_batch = None
+    for step_idx, batch in enumerate(batches):
+        if mesh is not None:
+            batch = shard_batch(batch, mesh)
+        images, mask_miss, labels = batch
+        global_batch = images.shape[0]
+        state, loss = train_step(state, images, mask_miss, labels)
+        pending.append(loss)
+
+        if (step_idx + 1) % print_freq == 0:
+            # one device sync per print_freq steps
+            vals = [float(v) for v in pending]
+            pending.clear()
+            for v in vals:
+                losses.update(v, global_batch)
+            dt = timer.mark(print_freq)
+            if is_lead_host:
+                log_fn(
+                    f"==> Epoch [{epoch}][{step_idx + 1}] "
+                    f"loss {losses.val:.6f} ({losses.avg:.6f}) "
+                    f"imgs/s {global_batch / max(dt, 1e-9):.1f}")
+
+    for v in pending:
+        losses.update(float(v), global_batch or 1)
+    return state, losses.avg
+
+
+def eval_epoch(state: TrainState, eval_step: Callable, batches: Iterable,
+               mesh=None) -> float:
+    losses = AverageMeter()
+    for batch in batches:
+        if mesh is not None:
+            batch = shard_batch(batch, mesh)
+        images, mask_miss, labels = batch
+        loss = eval_step(state, images, mask_miss, labels)
+        losses.update(float(loss), images.shape[0])
+    return losses.avg
+
+
+def fit(state: TrainState, train_step: Callable, config: Config,
+        make_batches: Callable[[int], Iterable], epochs: int,
+        start_epoch: int = 0, mesh=None,
+        eval_step: Optional[Callable] = None,
+        make_eval_batches: Optional[Callable[[int], Iterable]] = None,
+        is_lead_host: bool = True,
+        checkpoint_dir: Optional[str] = None,
+        log_fn: Callable[[str], None] = print) -> TrainState:
+    """Multi-epoch driver with per-epoch rank-0 checkpoint + log
+    (reference: train_distributed.py:300-324, 441-444).
+
+    ``make_batches(epoch)`` returns that epoch's (shuffled) batch iterable —
+    the epoch-seeded permutation replaces DistributedSampler.set_epoch
+    (train_distributed.py:231-232).
+    """
+    checkpoint_dir = checkpoint_dir or config.train.checkpoint_dir
+    best_loss = float("inf")
+    for epoch in range(start_epoch, start_epoch + epochs):
+        state, train_loss = train_epoch(
+            state, train_step, make_batches(epoch), config, epoch, mesh=mesh,
+            is_lead_host=is_lead_host, log_fn=log_fn)
+        if is_lead_host:
+            _log_line(checkpoint_dir,
+                      f"\nEpoch {epoch}\ttrain_loss: {train_loss}")
+            best_loss = min(best_loss, train_loss)
+            ckpt.save_checkpoint(checkpoint_dir, state, epoch, train_loss,
+                                 best_loss)
+        if eval_step is not None and make_eval_batches is not None:
+            val_loss = eval_epoch(state, eval_step, make_eval_batches(epoch),
+                                  mesh=mesh)
+            if is_lead_host:
+                _log_line(checkpoint_dir, f"\tval_loss: {val_loss}")
+                log_fn(f"Epoch {epoch} val_loss {val_loss:.6f}")
+    return state

@@ -1,0 +1,52 @@
+"""Learning-rate schedules.
+
+Replicates the reference's hand-rolled per-step LR adjustment
+(reference: train_distributed.py:382-400 ``adjust_learning_rate``) and the SWA
+cyclic schedule (train_distributed_SWA.py:365-371) as optax-compatible
+``step -> lr`` functions (pure, jittable).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..config import TrainConfig
+
+
+def step_decay_schedule(cfg: TrainConfig, steps_per_epoch: int,
+                        world_size: int = 1, use_warmup: bool = True):
+    """LR = base·world_size·0.2^factor with a 3-epoch linear warmup.
+
+    factor = epoch // 15, switching to (epoch - 78) // 5 after epoch 78
+    (train_distributed.py:385-396).  ``step`` is the global step count.
+    """
+    base = cfg.learning_rate_per_device * world_size
+
+    def schedule(step):
+        step = jnp.asarray(step)
+        epoch = step // steps_per_epoch
+        factor = jnp.where(
+            epoch >= cfg.lr_late_epoch,
+            (epoch - cfg.lr_late_epoch) // cfg.lr_late_step_epochs,
+            epoch // cfg.lr_step_epochs)
+        lr = base * cfg.lr_decay_factor ** factor.astype(jnp.float32)
+        if use_warmup:
+            warm_steps = cfg.warmup_epochs * steps_per_epoch
+            warm = lr * (1.0 + step).astype(jnp.float32) / warm_steps
+            lr = jnp.where(epoch < cfg.warmup_epochs, warm, lr)
+        return lr
+
+    return schedule
+
+
+def cyclic_swa_schedule(steps_per_epoch: int, swa_freq: int = 5,
+                        lr_max: float = 4e-5, lr_min: float = 2e-5):
+    """Sawtooth LR for SWA fine-tuning: decays lr_max→lr_min over each
+    ``swa_freq``-epoch cycle (train_distributed_SWA.py:365-371)."""
+
+    def schedule(step):
+        epoch = jnp.asarray(step) // steps_per_epoch
+        phase = epoch - (epoch // swa_freq) * swa_freq
+        return lr_max - (lr_max - lr_min) / (swa_freq - 1) * phase.astype(
+            jnp.float32)
+
+    return schedule

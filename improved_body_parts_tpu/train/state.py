@@ -1,0 +1,78 @@
+"""Train state and optimizer construction.
+
+Functional replacement for the reference's torch SGD + Apex AMP + checkpoint
+dict (reference: train_distributed.py:123-139, 304-324).  Parameters stay
+fp32; compute dtype is bf16 inside the model (no loss scaling needed on TPU).
+
+SWA: a running average of parameters kept inside the state
+(reference: train_distributed_SWA.py:403-435 via torchcontrib) — trivial under
+functional params.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from ..config import Config
+
+
+@struct.dataclass
+class TrainState:
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    step: jnp.ndarray
+    # SWA running average (None until SWA starts)
+    swa_params: Any = None
+    swa_count: Any = None
+
+
+def make_optimizer(config: Config, schedule: Callable) -> optax.GradientTransformation:
+    """SGD(momentum=0.9) + L2 weight decay 5e-4 + optional global-norm clip
+    (reference: train_distributed.py:123-124; clip parsed but disabled at
+    :36-38, 266 — same default here)."""
+    tr = config.train
+    parts = []
+    if tr.max_grad_norm and tr.max_grad_norm > 0:
+        parts.append(optax.clip_by_global_norm(tr.max_grad_norm))
+    parts.append(optax.add_decayed_weights(tr.weight_decay))
+    parts.append(optax.sgd(learning_rate=schedule, momentum=tr.momentum))
+    return optax.chain(*parts)
+
+
+def create_train_state(model, config: Config, optimizer, rng,
+                       sample_images) -> TrainState:
+    variables = model.init(rng, sample_images, train=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    opt_state = optimizer.init(params)
+    return TrainState(params=params, batch_stats=batch_stats,
+                      opt_state=opt_state, step=jnp.zeros((), jnp.int32),
+                      swa_params=None, swa_count=None)
+
+
+def start_swa(state: TrainState) -> TrainState:
+    """Begin stochastic weight averaging from the current params."""
+    return state.replace(swa_params=jax.tree.map(jnp.copy, state.params),
+                         swa_count=jnp.ones((), jnp.int32))
+
+
+def update_swa(state: TrainState) -> TrainState:
+    """Running average update (torchcontrib SWA ``update_swa`` semantics)."""
+    assert state.swa_params is not None, "call start_swa first"
+    n = state.swa_count.astype(jnp.float32)
+    new_avg = jax.tree.map(
+        lambda avg, p: (avg * n + p) / (n + 1.0), state.swa_params,
+        state.params)
+    return state.replace(swa_params=new_avg, swa_count=state.swa_count + 1)
+
+
+def swap_swa_params(state: TrainState) -> TrainState:
+    """Swap averaged params in for evaluation/checkpointing
+    (``swap_swa_sgd`` semantics)."""
+    assert state.swa_params is not None
+    return state.replace(params=state.swa_params, swa_params=state.params)

@@ -1,0 +1,3 @@
+from .meters import AverageMeter, StepTimer
+
+__all__ = ["AverageMeter", "StepTimer"]

@@ -1,0 +1,277 @@
+// Native pose decoder: limb-connection scoring + greedy person assembly.
+//
+// C++ twin of improved_body_parts_tpu/infer/decode.py (find_connections +
+// find_people), which itself re-implements the reference's pure-Python
+// post-processing (reference: evaluate.py:206-498 — the 5.2 FPS bottleneck,
+// README.md:68).  Semantics, including tie-breaking order, match the NumPy
+// path bit-for-bit up to float summation order; a parity test pins the two
+// paths against each other (tests/test_native_decoder.py).
+//
+// Exposed as a C ABI for ctypes (no pybind11 dependency):
+//   int decode_people(...)  -> number of people written, or -1 on error.
+//
+// Build: make -C native   (or python tools/build_native.py)
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Connection {
+  double id_a, id_b;   // global peak ids
+  double score;        // distance-prior score
+  int i, j;            // indices into candA / candB
+  double length;       // euclidean limb length
+};
+
+struct Candidate {
+  int i, j;
+  double prior;
+  double norm;
+  double rank;
+};
+
+// Greedy per-limb connection selection (evaluate.py:206-276).
+std::vector<Connection> find_connections_for_limb(
+    const double* peaks, const int* part_offset, int part_a, int part_b,
+    const float* paf, int H, int W, int C, int limb_channel, int image_size,
+    double thre2, double connect_ration, int mid_num) {
+  std::vector<Connection> out;
+  const int na = part_offset[part_a + 1] - part_offset[part_a];
+  const int nb = part_offset[part_b + 1] - part_offset[part_b];
+  if (na == 0 || nb == 0) return out;
+  const double* cand_a = peaks + 4 * part_offset[part_a];
+  const double* cand_b = peaks + 4 * part_offset[part_b];
+
+  std::vector<Candidate> cands;
+  cands.reserve(static_cast<size_t>(na) * nb);
+  for (int i = 0; i < na; ++i) {
+    const double ax = cand_a[4 * i], ay = cand_a[4 * i + 1];
+    for (int j = 0; j < nb; ++j) {
+      const double bx = cand_b[4 * j], by = cand_b[4 * j + 1];
+      const double dx = bx - ax, dy = by - ay;
+      const double norm = std::sqrt(dx * dx + dy * dy);
+      if (norm == 0.0) continue;  // overlapping parts (evaluate.py:228)
+      int m = static_cast<int>(std::lround(norm + 1.0));
+      if (m > mid_num) m = mid_num;
+      if (m < 1) m = 1;
+      // sample linspace(A, B, m) inclusive on the limb channel
+      double sum = 0.0;
+      int above = 0;
+      for (int s = 0; s < m; ++s) {
+        const double t = (m == 1) ? 0.0 : static_cast<double>(s) / (m - 1);
+        int x = static_cast<int>(std::lround(ax + t * dx));
+        int y = static_cast<int>(std::lround(ay + t * dy));
+        x = std::min(std::max(x, 0), W - 1);
+        y = std::min(std::max(y, 0), H - 1);
+        const double v = paf[(static_cast<size_t>(y) * W + x) * C + limb_channel];
+        sum += v;
+        if (v > thre2) ++above;
+      }
+      const double mean = sum / m;
+      const double prior =
+          mean + std::min(0.5 * image_size / norm - 1.0, 0.0);
+      if (above >= connect_ration * m && prior > 0.0) {
+        const double rank =
+            0.5 * prior + 0.25 * cand_a[4 * i + 2] + 0.25 * cand_b[4 * j + 2];
+        cands.push_back({i, j, prior, norm, rank});
+      }
+    }
+  }
+  std::stable_sort(cands.begin(), cands.end(),
+                   [](const Candidate& a, const Candidate& b) {
+                     return a.rank > b.rank;
+                   });
+  std::vector<char> used_a(na, 0), used_b(nb, 0);
+  const size_t limit = static_cast<size_t>(std::min(na, nb));
+  for (const auto& c : cands) {
+    if (used_a[c.i] || used_b[c.j]) continue;
+    used_a[c.i] = used_b[c.j] = 1;
+    out.push_back({cand_a[4 * c.i + 3], cand_b[4 * c.j + 3], c.prior, c.i,
+                   c.j, c.norm});
+    if (out.size() >= limit) break;
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" int decode_people(
+    const double* peaks, int total_peaks, const int* peaks_per_part,
+    int num_parts, const float* paf, int H, int W, int C, const int* limbs,
+    int n_limbs, int image_size, const double* params, double* out_subsets,
+    int max_people) {
+  const double thre2 = params[0];
+  const double connect_ration = params[1];
+  const int mid_num = static_cast<int>(params[2]);
+  const double len_rate = params[3];
+  const double connection_tole = params[4];
+  const bool remove_recon = params[5] > 0.0;
+  const double min_parts = params[6];
+  const double min_mean_score = params[7];
+
+  std::vector<int> part_offset(num_parts + 1, 0);
+  for (int p = 0; p < num_parts; ++p)
+    part_offset[p + 1] = part_offset[p] + peaks_per_part[p];
+  if (part_offset[num_parts] != total_peaks) return -1;
+
+  const int rows = num_parts + 2;
+  // subset rows: [part 0..num_parts-1][0]=peak id, [1]=confidence;
+  // row -2 = total score; row -1 = (count, longest limb)
+  std::vector<std::vector<double>> subset;  // each row: 2*rows doubles
+
+  auto new_row = [&]() {
+    return std::vector<double>(2 * rows, -1.0);
+  };
+
+  for (int k = 0; k < n_limbs; ++k) {
+    const int index_a = limbs[2 * k];
+    const int index_b = limbs[2 * k + 1];
+    const auto conns = find_connections_for_limb(
+        peaks, part_offset.data(), index_a, index_b, paf, H, W, C, k,
+        image_size, thre2, connect_ration, mid_num);
+
+    for (const auto& conn : conns) {
+      const double score = conn.score;
+      const double limb_len = conn.length;
+      int found_idx[2] = {-1, -1};
+      int found = 0;
+      for (size_t j = 0; j < subset.size(); ++j) {
+        const bool hit =
+            static_cast<long>(subset[j][2 * index_a]) ==
+                static_cast<long>(conn.id_a) ||
+            static_cast<long>(subset[j][2 * index_b]) ==
+                static_cast<long>(conn.id_b);
+        if (hit && found < 2) found_idx[found++] = static_cast<int>(j);
+      }
+
+      if (found == 1) {
+        auto& s = subset[found_idx[0]];
+        const long slot_b = static_cast<long>(s[2 * index_b]);
+        if (slot_b == -1 && len_rate * s[2 * (rows - 1) + 1] > limb_len) {
+          // empty slot: assign part B (evaluate.py:320-344)
+          s[2 * index_b] = conn.id_b;
+          s[2 * index_b + 1] = score;
+          s[2 * (rows - 1)] += 1.0;
+          s[2 * (rows - 2)] +=
+              peaks[4 * static_cast<long>(conn.id_b) + 2] + score;
+          s[2 * (rows - 1) + 1] = std::max(limb_len, s[2 * (rows - 1) + 1]);
+        } else if (slot_b != static_cast<long>(conn.id_b)) {
+          if (s[2 * index_b + 1] >= score) {
+            // keep the more confident existing connection
+          } else if (len_rate * s[2 * (rows - 1) + 1] <= limb_len) {
+            // new limb absurdly long: skip
+          } else {
+            // replace the weaker part B (evaluate.py:346-363)
+            s[2 * (rows - 2)] -=
+                peaks[4 * slot_b + 2] + s[2 * index_b + 1];
+            s[2 * index_b] = conn.id_b;
+            s[2 * index_b + 1] = score;
+            s[2 * (rows - 2)] +=
+                peaks[4 * static_cast<long>(conn.id_b) + 2] + score;
+            s[2 * (rows - 1) + 1] = std::max(limb_len, s[2 * (rows - 1) + 1]);
+          }
+        } else if (slot_b == static_cast<long>(conn.id_b) &&
+                   s[2 * index_b + 1] <= score) {
+          // same part, higher confidence: rescore (evaluate.py:368-380)
+          s[2 * (rows - 2)] -= peaks[4 * slot_b + 2] + s[2 * index_b + 1];
+          s[2 * index_b] = conn.id_b;
+          s[2 * index_b + 1] = score;
+          s[2 * (rows - 2)] +=
+              peaks[4 * static_cast<long>(conn.id_b) + 2] + score;
+          s[2 * (rows - 1) + 1] = std::max(limb_len, s[2 * (rows - 1) + 1]);
+        }
+      } else if (found == 2) {
+        const int j1 = found_idx[0], j2 = found_idx[1];
+        auto& s1 = subset[j1];
+        auto& s2 = subset[j2];
+        bool overlap = false;
+        for (int p = 0; p < num_parts; ++p)
+          if (s1[2 * p] >= 0 && s2[2 * p] >= 0) overlap = true;
+        if (!overlap) {
+          // disjoint people sharing the limb: merge (evaluate.py:403-424)
+          double min1 = 1e30, min2 = 1e30;
+          for (int p = 0; p < num_parts; ++p) {
+            if (s1[2 * p] >= 0) min1 = std::min(min1, s1[2 * p + 1]);
+            if (s2[2 * p] >= 0) min2 = std::min(min2, s2[2 * p + 1]);
+          }
+          const double min_tol = std::min(min1, min2);
+          if (score < connection_tole * min_tol ||
+              len_rate * s1[2 * (rows - 1) + 1] <= limb_len)
+            continue;
+          for (int p = 0; p < num_parts; ++p) {
+            s1[2 * p] += s2[2 * p] + 1.0;
+            s1[2 * p + 1] += s2[2 * p + 1] + 1.0;
+          }
+          s1[2 * (rows - 2)] += s2[2 * (rows - 2)];
+          s1[2 * (rows - 1)] += s2[2 * (rows - 1)];
+          s1[2 * (rows - 2)] += score;
+          s1[2 * (rows - 1) + 1] = std::max(limb_len, s1[2 * (rows - 1) + 1]);
+          subset.erase(subset.begin() + j2);
+        } else {
+          // two people compete for this limb (evaluate.py:426-460)
+          int c1 = -1, c2 = -1;
+          bool a_in_j1 = false;
+          for (int p = 0; p < num_parts; ++p)
+            if (static_cast<long>(s1[2 * p]) == static_cast<long>(conn.id_a))
+              a_in_j1 = true;
+          const double want1 = a_in_j1 ? conn.id_a : conn.id_b;
+          const double want2 = a_in_j1 ? conn.id_b : conn.id_a;
+          for (int p = 0; p < num_parts; ++p) {
+            if (c1 < 0 && static_cast<long>(s1[2 * p]) ==
+                              static_cast<long>(want1))
+              c1 = p;
+            if (c2 < 0 && static_cast<long>(s2[2 * p]) ==
+                              static_cast<long>(want2))
+              c2 = p;
+          }
+          if (c1 < 0 || c2 < 0 || c1 == c2) return -2;
+          if (score < s1[2 * c1 + 1] && score < s2[2 * c2 + 1]) continue;
+          int small_j = j1, remove_c = c1;
+          if (s1[2 * c1 + 1] > s2[2 * c2 + 1]) {
+            small_j = j2;
+            remove_c = c2;
+          }
+          if (remove_recon) {
+            auto& sm = subset[small_j];
+            sm[2 * (rows - 2)] -=
+                peaks[4 * static_cast<long>(sm[2 * remove_c]) + 2] +
+                sm[2 * remove_c + 1];
+            sm[2 * remove_c] = -1.0;
+            sm[2 * remove_c + 1] = -1.0;
+            sm[2 * (rows - 1)] -= 1.0;
+          }
+        }
+      } else {
+        // no owner: create a new person (evaluate.py:473-488)
+        auto row = new_row();
+        row[2 * index_a] = conn.id_a;
+        row[2 * index_a + 1] = score;
+        row[2 * index_b] = conn.id_b;
+        row[2 * index_b + 1] = score;
+        row[2 * (rows - 1)] = 2.0;
+        row[2 * (rows - 1) + 1] = limb_len;
+        row[2 * (rows - 2)] = peaks[4 * static_cast<long>(conn.id_a) + 2] +
+                              peaks[4 * static_cast<long>(conn.id_b) + 2] +
+                              score;
+        subset.push_back(std::move(row));
+      }
+    }
+  }
+
+  // prune sparse / low-confidence people (evaluate.py:491-496)
+  int n_out = 0;
+  for (const auto& s : subset) {
+    const double count = s[2 * (rows - 1)];
+    if (count < min_parts || s[2 * (rows - 2)] / count < min_mean_score)
+      continue;
+    if (n_out >= max_people) break;
+    std::memcpy(out_subsets + static_cast<size_t>(n_out) * 2 * rows, s.data(),
+                sizeof(double) * 2 * rows);
+    ++n_out;
+  }
+  return n_out;
+}

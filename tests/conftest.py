@@ -10,7 +10,10 @@ Must run before the first ``import jax`` anywhere in the test session.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the launch environment pins JAX_PLATFORMS to a real
+# accelerator (the TPU is exclusive — concurrent test runs would deadlock on
+# the device, and tests must not occupy it).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flag = "--xla_force_host_platform_device_count=8"
 if _flag not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
@@ -19,6 +22,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    import jax
+
+    # The env assignment above is too late when sitecustomize has already
+    # imported jax (it does in the TPU-tunnel environment, with
+    # JAX_PLATFORMS=axon); jax.config still honours an update made before
+    # first backend use.
+    jax.config.update("jax_platforms", "cpu")
+
+    # Persistent compilation cache makes repeated CPU test runs fast.
+    cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
 @pytest.fixture(scope="session")

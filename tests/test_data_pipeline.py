@@ -1,0 +1,154 @@
+"""Data-layer tests: corpus schema, joint conversion, dataset determinism,
+epoch sharding, batching — all on the synthetic fixture.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import get_config
+from improved_body_parts_tpu.data import (
+    CocoPoseDataset,
+    batches,
+    build_fixture,
+    convert_joints,
+    epoch_permutation,
+    host_shard,
+)
+from improved_body_parts_tpu.data.hdf5_corpus import (
+    build_masks,
+    person_record,
+    recode_visibility,
+    select_main_persons,
+)
+
+CFG = get_config("canonical")
+SK = CFG.skeleton
+
+
+@pytest.fixture(scope="module")
+def fixture_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("corpus") / "fixture.h5")
+    n = build_fixture(path, num_images=3, people_per_image=2, seed=1)
+    assert n > 0
+    return path
+
+
+class TestCorpusBuilder:
+    def test_visibility_recode(self):
+        # COCO v=2 visible→1, v=1 occluded→0, v=0 unlabeled→2
+        assert recode_visibility(2) == 1
+        assert recode_visibility(1) == 0
+        assert recode_visibility(0) == 2
+
+    def test_person_record(self):
+        ann = {"bbox": [10, 20, 30, 60], "area": 1800, "num_keypoints": 9,
+               "keypoints": [5, 6, 2] * 17}
+        rec = person_record(ann, image_size=512)
+        assert rec["objpos"] == [25, 50]
+        assert rec["scale_provided"] == pytest.approx(60 / 512)
+        assert (rec["joint"][:, 2] == 1).all()
+
+    def test_main_person_selection(self):
+        def mk(cx, cy, side=100, nk=10, area=5000):
+            return {"objpos": [cx, cy], "bbox": [cx - side / 2, cy - side / 2,
+                                                 side, side],
+                    "segment_area": area, "num_keypoints": nk}
+
+        persons = [
+            mk(100, 100),             # main
+            mk(110, 100),             # too close to first (dist 10 < 30)
+            mk(300, 300),             # main
+            mk(500, 100, nk=3),       # too few keypoints
+            mk(500, 300, area=100),   # too small
+        ]
+        assert select_main_persons(persons) == [0, 2]
+
+    def test_build_masks(self):
+        h, w = 32, 32
+        m1 = np.zeros((h, w), np.uint8); m1[0:8, 0:8] = 1      # annotated
+        m2 = np.zeros((h, w), np.uint8); m2[16:24, 16:24] = 1  # no keypoints
+        crowd = np.zeros((h, w), np.uint8); crowd[28:, 28:] = 1
+        mask_miss, mask_all = build_masks((h, w), [m1, m2], [10, 0], [crowd])
+        assert mask_miss[4, 4] == 255       # annotated person not masked out
+        assert mask_miss[20, 20] == 0       # unannotated person masked
+        assert mask_miss[30, 30] == 0       # crowd masked
+        assert mask_all[4, 4] == 255 and mask_all[20, 20] == 255
+        assert mask_all[30, 30] == 255
+        assert mask_miss[12, 12] == 255 and mask_all[12, 12] == 0
+
+
+class TestConvertJoints:
+    def test_neck_is_mean_of_shoulders(self):
+        from improved_body_parts_tpu.config import COCO_PARTS
+
+        coco = np.zeros((1, 17, 3))
+        coco[:, :, 2] = 2  # absent
+        rs, ls = COCO_PARTS.index("Rsho"), COCO_PARTS.index("Lsho")
+        coco[0, rs] = [100, 200, 1]
+        coco[0, ls] = [140, 210, 0]
+        out = convert_joints(coco, SK)
+        neck = SK.parts_dict["neck"]
+        assert out[0, neck, 0] == 120 and out[0, neck, 1] == 205
+        assert out[0, neck, 2] == 0  # min of the shoulder visibilities
+        # unmapped parts default to 3 (never marked in this dataset)
+        nose = SK.parts_dict["nose"]
+        assert out[0, nose, 2] == 2  # copied from the absent coco nose
+
+    def test_neck_absent_without_both_shoulders(self):
+        from improved_body_parts_tpu.config import COCO_PARTS
+
+        coco = np.zeros((1, 17, 3))
+        coco[:, :, 2] = 2
+        coco[0, COCO_PARTS.index("Rsho")] = [100, 200, 1]  # only one shoulder
+        out = convert_joints(coco, SK)
+        assert out[0, SK.parts_dict["neck"], 2] == 2
+
+
+class TestDataset:
+    def test_shapes_and_determinism(self, fixture_path):
+        ds = CocoPoseDataset(fixture_path, CFG, augment=True, seed=7)
+        assert len(ds) == 6  # 3 images × 2 main persons
+        img, mask, labels = ds.sample(0, epoch=0)
+        assert img.shape == (SK.height, SK.width, 3)
+        assert mask.shape == (*SK.grid_shape, 1)
+        assert labels.shape == SK.parts_shape
+        # keypoint channels populated
+        assert labels[:, :, SK.heat_start:SK.bkg_start].max() > 0.9
+        # determinism: same (seed, epoch, index) → identical sample
+        img2, mask2, labels2 = ds.sample(0, epoch=0)
+        np.testing.assert_array_equal(img, img2)
+        np.testing.assert_array_equal(labels, labels2)
+        # different epoch → different augmentation
+        img3, _, _ = ds.sample(0, epoch=1)
+        assert not np.array_equal(img, img3)
+        ds.close()
+
+    def test_unaugmented_is_identity_aug(self, fixture_path):
+        ds = CocoPoseDataset(fixture_path, CFG, augment=False, seed=7)
+        a = ds.sample(1, epoch=0)
+        b = ds.sample(1, epoch=5)  # epoch must not matter without augment
+        np.testing.assert_array_equal(a[0], b[0])
+        ds.close()
+
+    def test_batches_and_sharding(self, fixture_path):
+        ds = CocoPoseDataset(fixture_path, CFG, augment=False)
+        got = list(batches(ds, batch_size=2, epoch=0))
+        assert len(got) == 3
+        imgs, masks, labels = got[0]
+        assert imgs.shape == (2, SK.height, SK.width, 3)
+        assert labels.shape == (2, *SK.grid_shape, SK.num_layers)
+
+        # two-host sharding: disjoint index sets, same batch count per host
+        perm = epoch_permutation(len(ds), 0, ds.seed)
+        s0 = host_shard(perm, 0, 2, batch_size=1)
+        s1 = host_shard(perm, 1, 2, batch_size=1)
+        assert set(s0).isdisjoint(set(s1))
+        assert len(s0) == len(s1) == 3
+        ds.close()
+
+    def test_epoch_permutation_changes(self):
+        p0 = epoch_permutation(100, 0, seed=3)
+        p1 = epoch_permutation(100, 1, seed=3)
+        assert not np.array_equal(p0, p1)
+        np.testing.assert_array_equal(p0, epoch_permutation(100, 0, seed=3))

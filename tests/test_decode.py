@@ -1,0 +1,133 @@
+"""Decoder tests on synthetic heatmaps with known people.
+
+Builds GT-style heatmaps from the framework's own Heatmapper (stride-center
+Gaussians + limb maps), upsampled to image resolution, and checks the decode
+pipeline recovers the planted people (the reference's integration check is
+COCOeval; this is the deterministic unit analogue).
+"""
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import default_inference_params, get_config
+from improved_body_parts_tpu.data.fixture import _UNIT_POSE
+from improved_body_parts_tpu.data.heatmapper import Heatmapper
+from improved_body_parts_tpu.infer.decode import (
+    decode,
+    find_connections,
+    find_peaks,
+    find_people,
+)
+
+CFG = get_config("canonical")
+SK = CFG.skeleton
+PARAMS, _ = default_inference_params()
+
+
+def synth_person_joints(x0, y0, height):
+    """Stick figure in internal part order at image coords."""
+    from improved_body_parts_tpu.config import COCO_PARTS
+    from improved_body_parts_tpu.data.dataset import convert_joints
+
+    w = 0.5 * height
+    coco = np.zeros((1, 17, 3))
+    for i, part in enumerate(COCO_PARTS):
+        ux, uy = _UNIT_POSE[part]
+        coco[0, i] = [x0 + ux * w, y0 + uy * height, 2]  # coco visible
+    # recode COCO v=2 → ours 1 (corpus builder semantics)
+    coco[:, :, 2] = 1
+    return convert_joints(coco, SK)
+
+
+def synth_maps(people):
+    """Full-resolution (H, W, C) maps from stride-4 GT via cubic upsample."""
+    import cv2
+
+    hm = Heatmapper(SK)
+    joints = np.concatenate(people, axis=0)
+    labels = hm.create_heatmaps(joints.astype(np.float32),
+                                np.ones(SK.grid_shape, np.float32))
+    full = cv2.resize(labels, (SK.width, SK.height),
+                      interpolation=cv2.INTER_CUBIC)
+    # break exact plateau ties the upsample creates (real network outputs
+    # never tie exactly; NMS keeps all tied maxima, like the reference's)
+    rng = np.random.default_rng(0)
+    full = full + rng.uniform(0, 1e-6, full.shape)
+    paf = full[..., :SK.paf_layers]
+    heat = full[..., SK.heat_start:]
+    return heat.astype(np.float64), paf.astype(np.float64)
+
+
+@pytest.fixture(scope="module")
+def two_people_maps():
+    p1 = synth_person_joints(60, 80, 300)
+    p2 = synth_person_joints(300, 120, 260)
+    return synth_maps([p1, p2]), (p1, p2)
+
+
+class TestFindPeaks:
+    def test_recovers_planted_keypoints(self, two_people_maps):
+        (heat, _), (p1, p2) = two_people_maps
+        peaks = find_peaks(heat, PARAMS, SK.num_parts)
+        assert len(peaks) == 18
+        for part in range(18):
+            assert len(peaks[part]) == 2, f"part {part}"
+        # nose positions recovered within 2px
+        nose = SK.parts_dict["nose"]
+        got = sorted(peaks[nose][:, 0])
+        want = sorted([p1[0, nose, 0], p2[0, nose, 0]])
+        np.testing.assert_allclose(got, want, atol=2.0)
+
+    def test_peak_ids_are_global(self, two_people_maps):
+        (heat, _), _ = two_people_maps
+        peaks = find_peaks(heat, PARAMS, SK.num_parts)
+        ids = np.concatenate([p[:, 3] for p in peaks])
+        np.testing.assert_array_equal(np.sort(ids), np.arange(len(ids)))
+
+
+class TestConnections:
+    def test_connects_within_person_not_across(self, two_people_maps):
+        (heat, paf), _ = two_people_maps
+        peaks = find_peaks(heat, PARAMS, SK.num_parts)
+        conns, special = find_connections(peaks, paf, heat.shape[0], PARAMS,
+                                          SK.limbs_conn)
+        assert len(conns) == 30
+        assert special == []
+        # every limb type should find exactly 2 connections (both people)
+        n_found = [len(c) for c in conns]
+        assert min(n_found) >= 1
+        assert max(n_found) <= 2
+
+
+class TestAssembly:
+    def test_two_people_assembled(self, two_people_maps):
+        (heat, paf), _ = two_people_maps
+        results = decode(heat, paf, PARAMS, SK, use_native=False)
+        assert len(results) == 2
+        for coords, score in results:
+            assert len(coords) == 17
+            found = sum(1 for c in coords if c is not None and c != (0.0, 0.0))
+            assert found >= 15
+            assert 0 < score <= 1
+
+    def test_decoded_positions_match_planted(self, two_people_maps):
+        (heat, paf), (p1, p2) = two_people_maps
+        results = decode(heat, paf, PARAMS, SK, use_native=False)
+        # match people by nose x coordinate
+        from improved_body_parts_tpu.config import COCO_PARTS
+
+        nose_c = COCO_PARTS.index("nose")
+        got = sorted(r[0][nose_c][0] for r in results)
+        nose_i = SK.parts_dict["nose"]
+        want = sorted([p1[0, nose_i, 0], p2[0, nose_i, 0]])
+        np.testing.assert_allclose(got, want, atol=3.0)
+
+    def test_empty_maps_give_no_people(self):
+        heat = np.zeros((SK.height, SK.width, SK.heat_layers + 2))
+        paf = np.zeros((SK.height, SK.width, SK.paf_layers))
+        assert decode(heat, paf, PARAMS, SK, use_native=False) == []
+
+    def test_single_person(self):
+        p = synth_person_joints(150, 100, 320)
+        heat, paf = synth_maps([p])
+        results = decode(heat, paf, PARAMS, SK, use_native=False)
+        assert len(results) == 1

@@ -1,0 +1,155 @@
+"""Tests for the jitted multi-scale masked focal L2 loss.
+
+Semantics pinned against the reference's distributed loss path
+(models/loss_model.py:23-161): focal factor with γ=1 linearization, mask
+modulation of person-mask/keypoint channels, avg-pool GT downsampling,
+bilinear+binarize mask downsampling, scale/stack weighting, global-batch
+normalization.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import get_config
+from improved_body_parts_tpu.ops import (
+    avg_pool_to,
+    downsample_mask,
+    focal_l2,
+    l2,
+    multi_task_loss,
+)
+
+CFG = get_config("canonical")
+SK = CFG.skeleton
+
+
+def _fake_batch(rng, n=2, h=16, w=16):
+    gt = jnp.asarray(rng.uniform(0, 1, (n, h, w, SK.num_layers)), jnp.float32)
+    mask = jnp.ones((n, h, w, 1), jnp.float32)
+    return gt, mask
+
+
+def _fake_preds(rng, n=2, h=16, w=16, nstack=4, nscale=5):
+    preds = []
+    for _ in range(nstack):
+        stack = []
+        for s in range(nscale):
+            hs, ws = h // (2 ** s), w // (2 ** s)
+            stack.append(jnp.asarray(
+                rng.uniform(0, 1, (n, max(hs, 1), max(ws, 1), SK.num_layers)),
+                jnp.float32))
+        preds.append(stack)
+    return preds
+
+
+def test_focal_l2_manual_value():
+    """Hand-computed: st = where(gt>=0.01, s, 1-s); factor=|1-st|; (s-gt)²·f·m."""
+    pred = jnp.array([0.8, 0.3]).reshape(1, 1, 1, 1, 2)
+    gt = jnp.array([1.0, 0.0]).reshape(1, 1, 1, 1, 2)
+    mask = jnp.ones_like(gt)
+    # elem 1: gt>=0.01 → st=0.8, factor=0.2, (0.8-1)²·0.2 = 0.008
+    # elem 2: gt<0.01 → st=0.7, factor=0.3, (0.3-0)²·0.3 = 0.027
+    out = focal_l2(pred, gt, mask)
+    assert out.shape == (1,)
+    assert float(out[0]) == pytest.approx(0.008 + 0.027, rel=1e-5)
+
+
+def test_l2_manual_value():
+    pred = jnp.full((1, 1, 2, 2, 1), 0.5)
+    gt = jnp.zeros((1, 1, 2, 2, 1))
+    mask = jnp.ones_like(gt)
+    assert float(l2(pred, gt, mask)[0]) == pytest.approx(0.25 * 4)
+
+
+def test_avg_pool_to():
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y = avg_pool_to(x, (2, 2))
+    expect = np.array([[2.5, 4.5], [10.5, 12.5]])
+    np.testing.assert_allclose(np.asarray(y)[0, :, :, 0], expect)
+
+
+def test_downsample_mask_binarizes():
+    m = jnp.ones((1, 8, 8, 1)).at[0, :4].set(0.0)
+    out = downsample_mask(m, (4, 4))
+    arr = np.asarray(out)[0, :, :, 0]
+    # values < 0.5 are zeroed; values >= 0.5 keep their fractional weight
+    # (loss_model.py:55-56 zeroes but does not round up)
+    np.testing.assert_allclose(arr[0], 0.0)
+    np.testing.assert_allclose(arr[1], 0.0)          # 0.125 → zeroed
+    np.testing.assert_allclose(arr[2], 0.875, atol=1e-5)  # kept fractional
+    np.testing.assert_allclose(arr[3], 1.0, atol=1e-5)
+
+
+def test_multi_task_loss_scalar_and_jit():
+    rng = np.random.default_rng(0)
+    gt, mask = _fake_batch(rng)
+    preds = _fake_preds(rng)
+    loss = multi_task_loss(preds, gt, mask, CFG)
+    assert loss.shape == () and np.isfinite(float(loss))
+    jitted = jax.jit(lambda p, g, m: multi_task_loss(p, g, m, CFG))
+    loss_j = jitted(preds, gt, mask)
+    assert float(loss_j) == pytest.approx(float(loss), rel=1e-5)
+
+
+def test_batch_normalization_convention():
+    rng = np.random.default_rng(1)
+    gt, mask = _fake_batch(rng, n=4)
+    preds = _fake_preds(rng, n=4)
+    loss_global = multi_task_loss(preds, gt, mask, CFG)
+    cfg_local = CFG.replace(train=CFG.train.__class__(
+        normalize_by_global_batch=False))
+    loss_local = multi_task_loss(preds, gt, mask, cfg_local)
+    assert float(loss_local) == pytest.approx(4 * float(loss_global), rel=1e-5)
+
+
+def test_mask_modulation_weights_channels():
+    """keypoint channels weighted ×3, person-mask channel ×0.1
+    (loss_model.py:146-149)."""
+    rng = np.random.default_rng(2)
+    n, h, w = 1, 16, 16
+    mask = jnp.ones((n, h, w, 1), jnp.float32)
+    base_gt = jnp.zeros((n, h, w, SK.num_layers), jnp.float32)
+    nstack = len(CFG.train.nstack_weight)
+
+    def loss_with_error_on(channel):
+        preds = []
+        for _ in range(nstack):
+            stack = []
+            for s in range(5):
+                hs = max(h // (2 ** s), 1)
+                p = jnp.zeros((n, hs, hs, SK.num_layers), jnp.float32)
+                p = p.at[..., channel].set(0.5)
+                stack.append(p)
+            preds.append(stack)
+        return float(multi_task_loss(preds, base_gt, mask, CFG))
+
+    limb = loss_with_error_on(0)                    # weight 1
+    keyp = loss_with_error_on(SK.heat_start)        # weight 3
+    bkg = loss_with_error_on(SK.bkg_start)          # weight 0.1
+    rev = loss_with_error_on(SK.bkg_start + 1)      # weight 1
+    assert keyp == pytest.approx(3 * limb, rel=1e-5)
+    assert bkg == pytest.approx(0.1 * limb, rel=1e-5)
+    assert rev == pytest.approx(limb, rel=1e-5)
+
+
+def test_mask_miss_zeroes_loss():
+    rng = np.random.default_rng(3)
+    gt, _ = _fake_batch(rng)
+    preds = _fake_preds(rng)
+    zero_mask = jnp.zeros((2, 16, 16, 1), jnp.float32)
+    loss = multi_task_loss(preds, gt, zero_mask, CFG)
+    assert float(loss) == 0.0
+
+
+def test_gradients_flow():
+    rng = np.random.default_rng(4)
+    gt, mask = _fake_batch(rng, n=1, h=8, w=8)
+    preds = _fake_preds(rng, n=1, h=8, w=8, nstack=4, nscale=5)
+
+    def f(p):
+        return multi_task_loss(p, gt, mask, CFG)
+
+    grads = jax.grad(f)(preds)
+    gmax = max(float(jnp.abs(g).max()) for s in grads for g in s)
+    assert gmax > 0 and np.isfinite(gmax)

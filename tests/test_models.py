@@ -1,0 +1,140 @@
+"""Model tests: parameter-count parity with the reference, shapes, variants.
+
+The param-count golden (128,998,760 + 207,744 BN running stats) was measured
+on the reference ``PoseNet(4, 256, 50, bn=True)`` (models/posenet.py:43-139);
+matching it pins the Flax IMHN as structurally identical.  Runtime tests use
+tiny configs (depth-2 hourglass, 16 channels) to keep CPU compiles fast.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import get_config
+from improved_body_parts_tpu.models import PoseNet, build_model
+from improved_body_parts_tpu.models.layers import (
+    Hourglass,
+    SELayer,
+    upsample_nearest_2x,
+)
+
+REF_PARAM_COUNT = 128_998_760
+REF_BN_STATS = 207_744
+
+
+def tiny_model(**kw):
+    defaults = dict(nstack=2, inp_dim=16, oup_dim=8, increase=8,
+                    hourglass_depth=2, se_reduction=4, dtype=jnp.float32)
+    defaults.update(kw)
+    return PoseNet(**defaults)
+
+
+TINY_IMGS = jnp.zeros((1, 32, 32, 3))
+
+
+def test_param_count_matches_reference():
+    model = build_model(get_config("canonical"), dtype=jnp.float32)
+    imgs = jnp.zeros((1, 128, 128, 3))
+    shapes = jax.eval_shape(
+        lambda k: model.init(k, imgs, train=False), jax.random.PRNGKey(0))
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(shapes["params"]))
+    nb = sum(int(np.prod(p.shape))
+             for p in jax.tree.leaves(shapes["batch_stats"]))
+    assert n == REF_PARAM_COUNT
+    assert nb == REF_BN_STATS
+
+
+def test_full_model_output_shapes_via_eval_shape():
+    """512-input canonical model: [4 stacks][5 scales], largest 128²
+    (reference: posenet.py:116-117) — eval_shape only, no FLOPs."""
+    model = build_model(get_config("canonical"), dtype=jnp.bfloat16)
+    imgs = jnp.zeros((2, 512, 512, 3))
+    vars_shapes = jax.eval_shape(
+        lambda k: model.init(k, imgs, train=False), jax.random.PRNGKey(0))
+    out = jax.eval_shape(
+        lambda v: model.apply(v, imgs, train=False), vars_shapes)
+    assert len(out) == 4 and len(out[0]) == 5
+    assert [tuple(p.shape) for p in out[0]] == [
+        (2, 128, 128, 50), (2, 64, 64, 50), (2, 32, 32, 50),
+        (2, 16, 16, 50), (2, 8, 8, 50)]
+    assert all(p.dtype == jnp.float32 for s in out for p in s)
+
+
+def test_tiny_forward_and_variants():
+    """One compile: pyramid shapes + fp32 outputs; the independent ablation
+    (posenet_independent.py:1-3) keeps the identical parameter structure
+    (checked via eval_shape — no extra compile)."""
+    dep = tiny_model(cross_stack_residual=True)
+    ind = tiny_model(cross_stack_residual=False)
+    imgs = jax.random.uniform(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    v = dep.init(jax.random.PRNGKey(0), imgs, train=False)
+    p1 = dep.apply(v, imgs, train=False)
+
+    shapes = [tuple(p.shape) for p in p1[0]]
+    assert shapes == [(2, 8, 8, 8), (2, 4, 4, 8), (2, 2, 2, 8)]
+    assert all(p.dtype == jnp.float32 for s in p1 for p in s)
+
+    v_ind = jax.eval_shape(
+        lambda k: ind.init(k, imgs, train=False), jax.random.PRNGKey(0))
+    s1 = jax.tree.map(lambda a: a.shape, v["params"])
+    s2 = jax.tree.map(lambda a: a.shape, v_ind["params"])
+    assert jax.tree.structure(s1) == jax.tree.structure(s2)
+    assert jax.tree.leaves(s1) == jax.tree.leaves(s2)
+
+
+def test_bf16_compute_keeps_fp32_params():
+    model = tiny_model(nstack=1, dtype=jnp.bfloat16)
+    vars_ = model.init(jax.random.PRNGKey(0), TINY_IMGS, train=False)
+    assert all(p.dtype == jnp.float32
+               for p in jax.tree.leaves(vars_["params"]))
+    preds = model.apply(vars_, TINY_IMGS, train=False)
+    assert preds[0][0].dtype == jnp.float32  # outputs upcast for the loss
+
+
+def test_train_mode_updates_batch_stats():
+    model = tiny_model(nstack=1)
+    imgs = jax.random.uniform(jax.random.PRNGKey(2), (2, 32, 32, 3))
+    vars_ = model.init(jax.random.PRNGKey(0), imgs, train=True)
+    _, updated = model.apply(vars_, imgs, train=True, mutable=["batch_stats"])
+    before = jax.tree.leaves(vars_["batch_stats"])
+    after = jax.tree.leaves(updated["batch_stats"])
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_hourglass_scale_channels():
+    hg = Hourglass(depth=2, features=16, increase=8, dtype=jnp.float32)
+    x = jnp.zeros((1, 8, 8, 16))
+    vars_ = hg.init(jax.random.PRNGKey(0), x, train=False)
+    feats = hg.apply(vars_, x, train=False)
+    assert [f.shape[-1] for f in feats] == [16, 24, 32]
+    assert [f.shape[1] for f in feats] == [8, 4, 2]
+
+
+def test_upsample_nearest():
+    x = jnp.arange(4.0).reshape(1, 2, 2, 1)
+    y = upsample_nearest_2x(x)
+    expect = np.array([[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3], [2, 2, 3, 3]],
+                      dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(y)[0, :, :, 0], expect)
+
+
+def test_se_layer_gates_channels():
+    se = SELayer(reduction=4, dtype=jnp.float32)
+    x = jax.random.uniform(jax.random.PRNGKey(3), (1, 8, 8, 16))
+    vars_ = se.init(jax.random.PRNGKey(0), x)
+    y = se.apply(vars_, x)
+    assert y.shape == x.shape
+    with pytest.raises(AssertionError):
+        SELayer(reduction=32, dtype=jnp.float32).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4, 4, 16)))
+
+
+def test_light_variant_builds():
+    cfg = get_config("canonical")
+    cfg = cfg.replace(model=cfg.model.__class__(
+        nstack=1, inp_dim=16, increase=8, hourglass_depth=2,
+        variant="imhn_light"))
+    model = build_model(cfg, dtype=jnp.float32)
+    vars_ = model.init(jax.random.PRNGKey(0), TINY_IMGS, train=False)
+    preds = model.apply(vars_, TINY_IMGS, train=False)
+    assert len(preds) == 1 and len(preds[0]) == 3

@@ -1,0 +1,110 @@
+"""Parity tests: native C++ decoder vs the NumPy decode path.
+
+The two implement identical semantics (reference: evaluate.py:206-498); this
+pins them against each other on synthetic multi-person heatmaps, including the
+assembled subsets' peak ids, confidences, counts and total scores.
+"""
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import default_inference_params, get_config
+from improved_body_parts_tpu.infer.decode import (
+    decode,
+    find_connections,
+    find_peaks,
+    find_people,
+)
+from improved_body_parts_tpu.infer.native import (
+    native_available,
+    native_find_connections_people,
+)
+
+CFG = get_config("canonical")
+SK = CFG.skeleton
+PARAMS, _ = default_inference_params()
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native decoder not built "
+    "(python tools/build_native.py)")
+
+
+def _maps(seed, n_people=3):
+    sys.path.insert(0, "tests")
+    from test_decode import synth_maps, synth_person_joints
+
+    rng = np.random.default_rng(seed)
+    people = []
+    for _ in range(n_people):
+        x0 = rng.uniform(20, SK.width - 180)
+        y0 = rng.uniform(20, SK.height - 280)
+        people.append(synth_person_joints(x0, y0, rng.uniform(200, 320)))
+    return synth_maps(people)
+
+
+@pytest.mark.parametrize("seed,n_people", [(0, 1), (1, 2), (2, 3), (3, 4)])
+def test_native_matches_numpy(seed, n_people):
+    heat, paf = _maps(seed, n_people)
+    all_peaks = find_peaks(heat, PARAMS, SK.num_parts)
+    image_size = heat.shape[0]
+
+    conns, special = find_connections(all_peaks, paf, image_size, PARAMS,
+                                      SK.limbs_conn)
+    subset_np, cand_np = find_people(conns, special, all_peaks, PARAMS,
+                                     SK.limbs_conn, SK.num_parts)
+    subset_cc, cand_cc = native_find_connections_people(
+        all_peaks, paf.astype(np.float32), image_size, PARAMS,
+        SK.limbs_conn, SK.num_parts)
+
+    np.testing.assert_array_equal(cand_np, cand_cc)
+    assert subset_np.shape == subset_cc.shape, (
+        f"people count differs: numpy {subset_np.shape[0]} "
+        f"vs native {subset_cc.shape[0]}")
+    # peak-id assignments must be identical
+    np.testing.assert_array_equal(subset_np[:, :SK.num_parts, 0],
+                                  subset_cc[:, :SK.num_parts, 0])
+    # confidences/scores match to float tolerance (paf sampled as float32
+    # in the native path)
+    np.testing.assert_allclose(subset_np[:, :SK.num_parts, 1],
+                               subset_cc[:, :SK.num_parts, 1], atol=1e-5)
+    np.testing.assert_allclose(subset_np[:, SK.num_parts:, :],
+                               subset_cc[:, SK.num_parts:, :], atol=1e-4)
+
+
+def test_decode_uses_native_path():
+    heat, paf = _maps(5, 2)
+    res_native = decode(heat, paf, PARAMS, SK, use_native=True)
+    res_numpy = decode(heat, paf, PARAMS, SK, use_native=False)
+    assert len(res_native) == len(res_numpy) == 2
+    for (ca, sa), (cb, sb) in zip(res_native, res_numpy):
+        assert sa == pytest.approx(sb, abs=1e-6)
+        for pa, pb in zip(ca, cb):
+            assert (pa is None) == (pb is None)
+            if pa is not None:
+                np.testing.assert_allclose(pa, pb, atol=1e-6)
+
+
+def test_native_speedup():
+    """The C++ path should comfortably beat NumPy on a busy scene."""
+    import time
+
+    heat, paf = _maps(7, 4)
+    all_peaks = find_peaks(heat, PARAMS, SK.num_parts)
+    paf32 = paf.astype(np.float32)
+
+    t0 = time.perf_counter()
+    for _ in range(3):
+        conns, special = find_connections(all_peaks, paf, heat.shape[0],
+                                          PARAMS, SK.limbs_conn)
+        find_people(conns, special, all_peaks, PARAMS, SK.limbs_conn,
+                    SK.num_parts)
+    t_np = (time.perf_counter() - t0) / 3
+
+    t0 = time.perf_counter()
+    for _ in range(3):
+        native_find_connections_people(all_peaks, paf32, heat.shape[0],
+                                       PARAMS, SK.limbs_conn, SK.num_parts)
+    t_cc = (time.perf_counter() - t0) / 3
+    assert t_cc < t_np, f"native {t_cc:.4f}s not faster than numpy {t_np:.4f}s"

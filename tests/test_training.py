@@ -1,0 +1,174 @@
+"""Training-layer tests: LR schedules (golden vs the reference formula),
+SWA math, checkpoint roundtrip, and an SPMD train step on the 8-device mesh.
+
+The mesh test is the "multi-node without a cluster" strategy (SURVEY.md §4):
+the same jitted program the TPU pod runs, executed over 8 virtual CPU devices,
+including the implicit gradient all-reduce from batch sharding.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import get_config
+from improved_body_parts_tpu.models import PoseNet
+from improved_body_parts_tpu.parallel import (
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_batch,
+)
+from improved_body_parts_tpu.train import (
+    create_train_state,
+    cyclic_swa_schedule,
+    latest_checkpoint,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+    restore_checkpoint,
+    save_checkpoint,
+    start_swa,
+    step_decay_schedule,
+    swap_swa_params,
+    update_swa,
+)
+
+CFG = get_config("canonical")
+
+
+class TestSchedules:
+    def test_step_decay_matches_reference_formula(self):
+        """Reference adjust_learning_rate (train_distributed.py:382-400):
+        factor = epoch // 15 (or (epoch-78)//5 late), lr = base·ws·0.2^factor,
+        warmup lr·(1 + step + epoch·len)/（3·len) for epoch < 3."""
+        steps_per_epoch = 10
+        ws = 4
+        sched = step_decay_schedule(CFG.train, steps_per_epoch, world_size=ws)
+        base = CFG.train.learning_rate_per_device * ws
+
+        def ref(epoch, step):
+            factor = epoch // 15
+            if epoch >= 78:
+                factor = (epoch - 78) // 5
+            lr = base * 0.2 ** factor
+            if epoch < 3:
+                lr = lr * float(1 + step + epoch * steps_per_epoch) / (
+                    3.0 * steps_per_epoch)
+            return lr
+
+        for epoch, step in [(0, 0), (0, 5), (1, 3), (2, 9), (3, 0), (14, 9),
+                            (15, 0), (30, 0), (78, 0), (83, 0), (90, 5)]:
+            got = float(sched(epoch * steps_per_epoch + step))
+            assert got == pytest.approx(ref(epoch, step), rel=1e-6), (epoch, step)
+
+    def test_cyclic_swa(self):
+        """Sawtooth over 5-epoch cycles (train_distributed_SWA.py:365-371)."""
+        sched = cyclic_swa_schedule(steps_per_epoch=10, swa_freq=5,
+                                    lr_max=4e-5, lr_min=2e-5)
+        vals = [float(sched(e * 10)) for e in range(6)]
+        assert vals[0] == pytest.approx(4e-5)
+        assert vals[4] == pytest.approx(2e-5)
+        assert vals[5] == pytest.approx(4e-5)  # cycle restarts
+        assert all(vals[i] > vals[i + 1] for i in range(4))
+
+
+class TestSWA:
+    def test_running_average(self):
+        params = {"w": jnp.array([2.0])}
+        state = _dummy_state(params)
+        state = start_swa(state)
+        state = state.replace(params={"w": jnp.array([4.0])})
+        state = update_swa(state)  # avg of 2, 4 = 3
+        assert float(state.swa_params["w"][0]) == pytest.approx(3.0)
+        state = state.replace(params={"w": jnp.array([6.0])})
+        state = update_swa(state)  # avg of 2, 4, 6 = 4
+        assert float(state.swa_params["w"][0]) == pytest.approx(4.0)
+        swapped = swap_swa_params(state)
+        assert float(swapped.params["w"][0]) == pytest.approx(4.0)
+        assert float(swapped.swa_params["w"][0]) == pytest.approx(6.0)
+
+
+def _dummy_state(params):
+    from improved_body_parts_tpu.train.state import TrainState
+
+    return TrainState(params=params, batch_stats={}, opt_state=(),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def _tiny_setup(mesh=None):
+    cfg = CFG.replace(model=CFG.model.__class__(
+        nstack=2, inp_dim=16, increase=8, hourglass_depth=2, se_reduction=4))
+    model = PoseNet(nstack=2, inp_dim=16, oup_dim=cfg.skeleton.num_layers,
+                    increase=8, hourglass_depth=2, se_reduction=4,
+                    dtype=jnp.float32)
+    # 3 scales for depth-2 hourglass
+    cfg = cfg.replace(train=cfg.train.__class__(
+        scale_weight=(0.5, 1.0, 2.0), nstack_weight=(1.0, 1.0)))
+    sched = step_decay_schedule(cfg.train, steps_per_epoch=4)
+    opt = make_optimizer(cfg, sched)
+    imgs = jnp.zeros((8, 32, 32, 3))
+    state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0), imgs)
+    return cfg, model, opt, state
+
+
+class TestTrainStep:
+    def test_spmd_step_on_8_device_mesh(self, eight_devices):
+        cfg, model, opt, state = _tiny_setup()
+        mesh = make_mesh(data=8, model=1)
+        state = jax.device_put(state, replicated(mesh))
+        rng = np.random.default_rng(0)
+        images = np.asarray(rng.uniform(0, 1, (8, 32, 32, 3)), np.float32)
+        labels = np.asarray(
+            rng.uniform(0, 1, (8, 8, 8, cfg.skeleton.num_layers)), np.float32)
+        mask = np.ones((8, 8, 8, 1), np.float32)
+        batch = shard_batch((images, mask, labels), mesh)
+
+        step = make_train_step(model, cfg, opt, donate=False)
+        new_state, loss = step(state, *batch)
+        assert np.isfinite(float(loss))
+        assert int(new_state.step) == 1
+        # params actually moved
+        delta = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()),
+                             new_state.params, state.params)
+        assert max(jax.tree.leaves(delta)) > 0
+        # batch is sharded across 'data'; params replicated
+        sh = batch[0].sharding
+        assert sh.is_equivalent_to(batch_sharding(mesh), images.ndim)
+
+        # second step reuses the compiled program
+        newer_state, loss2 = step(new_state, *batch)
+        assert float(loss2) <= float(loss) * 1.5  # sane trajectory
+
+        # abnormal-loss drop: huge labels blow the loss past the threshold,
+        # parameters must stay frozen (train_distributed.py:259-261)
+        bad_labels = labels + 1e6
+        bad_batch = shard_batch((images, mask, bad_labels), mesh)
+        dropped_state, bad_loss = step(newer_state, *bad_batch)
+        assert float(bad_loss) > cfg.train.abnormal_loss_thre
+        same = jax.tree.map(lambda a, b: bool((a == b).all()),
+                            dropped_state.params, newer_state.params)
+        assert all(jax.tree.leaves(same))
+
+        # eval step runs with running BN stats
+        ev = make_eval_step(model, cfg)
+        val = ev(dropped_state, *batch)
+        assert np.isfinite(float(val))
+
+        self.__class__.ckpt_state = dropped_state  # reuse in checkpoint test
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        state = getattr(self.__class__, "ckpt_state", None)
+        if state is None:
+            pytest.skip("depends on test_spmd_step_on_8_device_mesh")
+        path = save_checkpoint(str(tmp_path), state, epoch=3, train_loss=1.5,
+                               best_loss=1.2)
+        assert latest_checkpoint(str(tmp_path)) == path
+        restored, meta = restore_checkpoint(path, state)
+        assert meta["epoch"] == 3 and meta["best_loss"] == 1.2
+        eq = jax.tree.map(lambda a, b: bool(np.allclose(a, b)),
+                          jax.tree.map(np.asarray, restored.params),
+                          jax.tree.map(np.asarray, state.params))
+        assert all(jax.tree.leaves(eq))
+        assert int(restored.step) == int(state.step)

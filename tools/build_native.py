@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Build the native C++ decoder (native/libposedecoder.so) with g++.
+
+Equivalent to ``make -C native``; kept as a Python entry point so the build
+works without make.
+"""
+import os
+import subprocess
+import sys
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+
+def build(verbose: bool = True) -> str:
+    src = os.path.join(NATIVE_DIR, "decoder.cpp")
+    out = os.path.join(NATIVE_DIR, "libposedecoder.so")
+    cmd = ["g++", "-O3", "-march=native", "-fPIC", "-std=c++17", "-Wall",
+           "-Wextra", "-shared", "-o", out, src]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build()
+    print("built", path)
+    sys.exit(0)
