@@ -114,6 +114,9 @@ class SkeletonConfig:
     width: int = 512
     height: int = 512
     stride: int = 4
+    # curated subset of limbs rendered by the demo (reference:
+    # config/config.py:126 ``draw_list``; canonical = [0, 5..20, 29])
+    draw_limbs: Tuple[int, ...] = (0,) + tuple(range(5, 21)) + (29,)
     transform_params: TransformParams = field(default_factory=TransformParams)
     # Derived (filled in __post_init__):
     parts_dict: Dict[str, int] = field(default_factory=dict, compare=False)
@@ -273,6 +276,7 @@ def _three_stack_384() -> Config:
         name="three_stack_384",
         skeleton=SkeletonConfig(
             parts=_PARTS_CANONICAL, limbs=_LIMBS_3STACK, width=384, height=384,
+            draw_limbs=(0,) + tuple(range(5, 22)),
             transform_params=TransformParams(
                 scale_min=0.75, scale_max=1.25, center_perterb_max=40.0,
                 tint_prob=0.4, keypoint_gaussian_thre=0.01,
@@ -296,6 +300,8 @@ def _dense_384() -> Config:
         name="dense_384",
         skeleton=SkeletonConfig(
             parts=_PARTS_DENSE, limbs=_LIMBS_DENSE, width=384, height=384,
+            draw_limbs=(0, 5, 7, 6, 8, 12, 18, 23, 15, 20, 25, 27, 36, 43,
+                        30, 39, 46, 33),
             transform_params=TransformParams(
                 scale_min=0.75, scale_max=1.25, center_perterb_max=40.0,
                 tint_prob=0.1, keypoint_gaussian_thre=0.005,
@@ -335,11 +341,28 @@ def _final_384() -> Config:
     )
 
 
+def _tiny() -> Config:
+    """Framework-native smoke-test config (no reference counterpart): a
+    depth-2, 2-stack, 16-channel IMHN at 128px for CPU tests and CLI
+    dry-runs."""
+    return Config(
+        name="tiny",
+        skeleton=SkeletonConfig(width=128, height=128),
+        model=ModelConfig(nstack=2, inp_dim=16, increase=8,
+                          hourglass_depth=2, se_reduction=4),
+        train=TrainConfig(batch_size_per_device=1,
+                          nstack_weight=(1.0, 1.0),
+                          scale_weight=(0.5, 1.0, 2.0),
+                          epochs=2, warmup_epochs=1),
+    )
+
+
 _REGISTRY = {
     "canonical": _canonical,
     "three_stack_384": _three_stack_384,
     "dense_384": _dense_384,
     "final_384": _final_384,
+    "tiny": _tiny,
 }
 
 

@@ -310,22 +310,28 @@ def subsets_to_keypoints(subset: np.ndarray, candidate: np.ndarray,
     return results
 
 
-def decode(heatmap: np.ndarray, paf: np.ndarray, params: InferenceParams,
-           skeleton: SkeletonConfig, use_native: bool = True):
-    """Full decode: (H,W,heat+bkg) + (H,W,paf) maps → list of
-    (coco keypoints, score) (reference: evaluate.py:501-543 ``process``)."""
+def assemble(heatmap: np.ndarray, paf: np.ndarray, params: InferenceParams,
+             skeleton: SkeletonConfig, use_native: bool = True
+             ) -> Tuple[np.ndarray, np.ndarray]:
+    """(heat, paf) maps → (subset, candidate): peaks + connection scoring +
+    greedy assembly, dispatched to the native C++ path when built."""
     all_peaks = find_peaks(heatmap, params, skeleton.num_parts)
     image_size = heatmap.shape[0]
     if use_native:
         from .native import native_available, native_find_connections_people
         if native_available():
-            subset, candidate = native_find_connections_people(
+            return native_find_connections_people(
                 all_peaks, paf, image_size, params, skeleton.limbs_conn,
                 skeleton.num_parts)
-            return subsets_to_keypoints(subset, candidate, skeleton)
     connection_all, special_k = find_connections(
         all_peaks, paf, image_size, params, skeleton.limbs_conn)
-    subset, candidate = find_people(connection_all, special_k, all_peaks,
-                                    params, skeleton.limbs_conn,
-                                    skeleton.num_parts)
+    return find_people(connection_all, special_k, all_peaks, params,
+                       skeleton.limbs_conn, skeleton.num_parts)
+
+
+def decode(heatmap: np.ndarray, paf: np.ndarray, params: InferenceParams,
+           skeleton: SkeletonConfig, use_native: bool = True):
+    """Full decode: (H,W,heat+bkg) + (H,W,paf) maps → list of
+    (coco keypoints, score) (reference: evaluate.py:501-543 ``process``)."""
+    subset, candidate = assemble(heatmap, paf, params, skeleton, use_native)
     return subsets_to_keypoints(subset, candidate, skeleton)

@@ -1,0 +1,99 @@
+"""COCO keypoint evaluation driver.
+
+Reference: evaluate.py:501-622 — per-image predict → decode → COCO-format
+results JSON → COCOeval.  pycocotools stays a host-side dependency
+(SURVEY.md §2.9); everything device-side goes through ``Predictor``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+import cv2
+import numpy as np
+
+from ..config import (
+    Config,
+    InferenceModelParams,
+    InferenceParams,
+    default_inference_params,
+)
+from ..utils import AverageMeter
+from .decode import decode
+from .predict import Predictor
+
+
+def process_image(predictor: Predictor, image_bgr: np.ndarray,
+                  params: InferenceParams, use_native: bool = True,
+                  timer: Optional[AverageMeter] = None):
+    """predict + decode one image → [(coco keypoints, score)]
+    (reference: evaluate.py:501-543)."""
+    heat, paf = predictor.predict(image_bgr)
+    t0 = time.perf_counter()
+    results = decode(heat, paf, params, predictor.skeleton,
+                     use_native=use_native)
+    if timer is not None:
+        timer.update(time.perf_counter() - t0)
+    return results
+
+
+def format_results(keypoints: Dict[int, list], res_file: str) -> None:
+    """COCO results JSON (reference: evaluate.py:563-582); v=1 when either
+    coordinate is nonzero."""
+    out = []
+    for image_id, people in keypoints.items():
+        for keypoint_list, score in people:
+            flat: List[float] = []
+            for pt in keypoint_list:
+                x, y = (0.0, 0.0) if pt is None else pt
+                flat.extend([x, y, 1 if x > 0 or y > 0 else 0])
+            out.append({"image_id": image_id, "category_id": 1,
+                        "keypoints": flat, "score": score})
+    os.makedirs(os.path.dirname(os.path.abspath(res_file)), exist_ok=True)
+    with open(res_file, "w") as f:
+        json.dump(out, f)
+
+
+def validation(predictor: Predictor, anno_file: str, images_dir: str,
+               dump_name: str = "tpu", validation_ids: Optional[Sequence[int]]
+               = None, max_images: int = 500,
+               params: Optional[InferenceParams] = None,
+               use_native: bool = True, results_dir: str = "results"):
+    """Run COCOeval on ``validation_ids`` (default: first ``max_images`` val
+    ids — the reference's first-500 protocol, evaluate.py:597-598).
+
+    Returns the COCOeval object (stats[0] is AP).
+    """
+    from pycocotools.coco import COCO
+    from pycocotools.cocoeval import COCOeval
+
+    params = params or default_inference_params()[0]
+    coco_gt = COCO(anno_file)
+    if validation_ids is None:
+        validation_ids = coco_gt.getImgIds()[:max_images]
+    assert not set(validation_ids).difference(set(coco_gt.getImgIds()))
+
+    decode_timer = AverageMeter()
+    keypoints: Dict[int, list] = {}
+    for image_id in validation_ids:
+        name = coco_gt.imgs[image_id]["file_name"]
+        image = cv2.imread(os.path.join(images_dir, name))
+        if image is None:
+            raise IOError(f"missing image {name}")
+        keypoints[image_id] = process_image(predictor, image, params,
+                                            use_native, decode_timer)
+
+    res_file = os.path.join(results_dir, f"person_keypoints_{dump_name}.json")
+    format_results(keypoints, res_file)
+    coco_dt = coco_gt.loadRes(res_file)
+    coco_eval = COCOeval(coco_gt, coco_dt, "keypoints")
+    coco_eval.params.imgIds = list(validation_ids)
+    coco_eval.evaluate()
+    coco_eval.accumulate()
+    coco_eval.summarize()
+    if decode_timer.count:
+        print(f"keypoint assignment: {1.0 / max(decode_timer.avg, 1e-9):.1f} "
+              f"FPS (avg {decode_timer.avg * 1000:.1f} ms)")
+    return coco_eval

@@ -41,6 +41,22 @@ def pad_right_down(img: np.ndarray, multiple: int, pad_value: int
     return img, (ph, pw)
 
 
+def center_pad(img: np.ndarray, multiple: int, pad_value: int
+               ) -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
+    """Symmetric padding to the next multiple; returns (image,
+    (top, left, bottom, right)) (reference: utils/util.py:68-100)."""
+    h, w = img.shape[:2]
+    dh = (multiple - h % multiple) % multiple
+    dw = (multiple - w % multiple) % multiple
+    top, left = int((h + dh - h) / 2), int((w + dw - w) / 2)
+    bottom, right = dh - top, dw - left
+    if dh or dw:
+        img = cv2.copyMakeBorder(img, top, bottom, left, right,
+                                 cv2.BORDER_CONSTANT,
+                                 value=(pad_value,) * 3)
+    return img, (top, left, bottom, right)
+
+
 class Predictor:
     """Holds the jitted ensemble forward, cached per padded input shape."""
 

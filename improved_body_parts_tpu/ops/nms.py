@@ -30,6 +30,26 @@ def keypoint_nms(heat: jnp.ndarray, kernel: int = 3, thre: float = 0.1
     return jnp.where(keep, heat, 0.0)
 
 
+@partial(jax.jit, static_argnames=("kernel_size",))
+def gaussian_blur(maps: jnp.ndarray, kernel_size: int = 5,
+                  sigma: float = 3.0) -> jnp.ndarray:
+    """Depthwise Gaussian smoothing with reflect padding, (H, W, C)
+    (reference: utils/util.py:103-174 ``GaussianSmoothing`` — kept for the
+    inventory; the final decode path deliberately does not smooth,
+    evaluate.py:178-182)."""
+    r = (kernel_size - 1) / 2
+    grid = jnp.arange(kernel_size, dtype=jnp.float32) - r
+    k1 = jnp.exp(-(grid ** 2) / (2 * sigma * sigma))
+    kernel = jnp.outer(k1, k1)
+    kernel = kernel / kernel.sum()
+    pad = (kernel_size - 1) // 2
+    x = jnp.pad(maps, ((pad, pad), (pad, pad), (0, 0)), mode="reflect")
+    x = jnp.moveaxis(x, -1, 0)[:, None]            # (C, 1, H, W)
+    out = jax.lax.conv_general_dilated(
+        x, kernel[None, None], window_strides=(1, 1), padding="VALID")
+    return jnp.moveaxis(out[:, 0], 0, -1)
+
+
 def refine_peaks(score_map: np.ndarray, xs: np.ndarray, ys: np.ndarray,
                  radius: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Weighted-centroid refinement of integer peaks on one channel.

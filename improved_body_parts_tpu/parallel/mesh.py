@@ -67,7 +67,18 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(batch, mesh: Mesh, spatial_shard: bool = False):
-    """Place a host array batch (pytree of arrays with leading batch dim) onto
-    the mesh with batch sharding."""
+    """Place a host batch (pytree of arrays with leading batch dim) onto the
+    mesh with batch sharding.
+
+    Single-process: a plain device_put.  Multi-host: each process passes its
+    LOCAL slice of the global batch (global_batch // process_count rows) and
+    the slices are assembled into one global array
+    (``jax.make_array_from_process_local_data``) — the SPMD replacement for
+    DistributedSampler feeding each rank its shard
+    (reference: train_distributed.py:205-213).
+    """
     sharding = batch_sharding(mesh, spatial_shard)
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), batch)

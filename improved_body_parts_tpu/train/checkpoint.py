@@ -47,15 +47,34 @@ def restore_checkpoint(path: str, state: Optional[TrainState] = None
                        ) -> Dict[str, Any]:
     """Load a checkpoint; if ``state`` is given, return (state, meta) with the
     arrays restored into it (resume semantics of train_distributed.py:149-197).
+
+    Orbax serializes custom pytree nodes (optax namedtuple states) as plain
+    containers; with a ``state`` template we re-impose the original structure
+    on the restored leaves so ``optimizer.update`` keeps working.
     """
     ckptr = ocp.PyTreeCheckpointer()
     payload = ckptr.restore(os.path.abspath(path))
     if state is None:
         return payload
+
+    def rebuild(template, restored):
+        """Unflatten restored leaves into the template's pytree structure.
+
+        Leaf correspondence holds because orbax preserves each container's
+        key/field layout (namedtuples round-trip as dicts keyed by field
+        name, whose serialization order jax also uses when flattening).
+        """
+        leaves = jax.tree.leaves(restored)
+        treedef = jax.tree.structure(template)
+        assert treedef.num_leaves == len(leaves), (
+            f"checkpoint opt_state has {len(leaves)} leaves, "
+            f"optimizer expects {treedef.num_leaves}")
+        return jax.tree.unflatten(treedef, leaves)
+
     restored = state.replace(
         params=payload["params"],
         batch_stats=payload["batch_stats"],
-        opt_state=payload["opt_state"],
+        opt_state=rebuild(state.opt_state, payload["opt_state"]),
         step=np.asarray(payload["step"], np.int32),
         swa_params=payload.get("swa_params"),
         swa_count=(np.asarray(payload["swa_count"], np.int32)

@@ -43,6 +43,9 @@ def cyclic_swa_schedule(steps_per_epoch: int, swa_freq: int = 5,
     """Sawtooth LR for SWA fine-tuning: decays lr_max→lr_min over each
     ``swa_freq``-epoch cycle (train_distributed_SWA.py:365-371)."""
 
+    if swa_freq <= 1:  # degenerate cycle: constant lr_max
+        return lambda step: jnp.asarray(lr_max, jnp.float32)
+
     def schedule(step):
         epoch = jnp.asarray(step) // steps_per_epoch
         phase = epoch - (epoch // swa_freq) * swa_freq

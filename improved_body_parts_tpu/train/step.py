@@ -29,12 +29,25 @@ from .state import TrainState
 def make_train_step(model, config: Config,
                     optimizer: optax.GradientTransformation,
                     use_focal: bool = True,
-                    donate: bool = True) -> Callable:
-    """Build the jitted (state, images, mask_miss, gt) -> (state, loss) step."""
+                    donate: bool = True,
+                    freeze_bn: bool = False) -> Callable:
+    """Build the jitted (state, images, mask_miss, gt) -> (state, loss) step.
+
+    ``freeze_bn=True`` runs BatchNorm on its running averages without
+    updating them — the SWA fine-tuning mode (reference:
+    train_distributed_SWA.py:219-221, utils/util.py:214-223).
+    """
 
     def train_step(state: TrainState, images, mask_miss, gt
                    ) -> Tuple[TrainState, jnp.ndarray]:
         def loss_fn(params):
+            if freeze_bn:
+                preds = model.apply(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    images, train=False)
+                return (multi_task_loss(preds, gt, mask_miss, config,
+                                        use_focal=use_focal),
+                        state.batch_stats)
             outputs = model.apply(
                 {"params": params, "batch_stats": state.batch_stats},
                 images, train=True, mutable=["batch_stats"])

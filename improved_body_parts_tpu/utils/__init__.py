@@ -1,3 +1,6 @@
 from .meters import AverageMeter, StepTimer
+from .profiling import profile_trace, timed
+from .visualize import colorize_jet, export_stablehlo, param_table
 
-__all__ = ["AverageMeter", "StepTimer"]
+__all__ = ["AverageMeter", "StepTimer", "profile_trace", "timed",
+           "colorize_jet", "export_stablehlo", "param_table"]

@@ -1,0 +1,43 @@
+"""Profiling helpers.
+
+The reference's tracing is wall-clock meters around cuda.synchronize
+(reference: train_distributed.py:285-298, test_inference_speed.py:106-115);
+on TPU the first-class tool is the XLA profiler — these helpers wrap
+``jax.profiler`` traces and add a simple step-time report.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+from .meters import AverageMeter
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str) -> Iterator[None]:
+    """Capture a device trace viewable in TensorBoard / xprof."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def timed(label: str, meter: Optional[AverageMeter] = None,
+          sync_value=None) -> Iterator[None]:
+    """Wall-clock a block; pass a jax array as ``sync_value`` to block on
+    device completion first (the cuda.synchronize analogue)."""
+    import jax
+
+    t0 = time.perf_counter()
+    yield
+    if sync_value is not None:
+        jax.block_until_ready(sync_value)
+    dt = time.perf_counter() - t0
+    if meter is not None:
+        meter.update(dt)
+    print(f"[{label}] {dt * 1000:.2f} ms")

@@ -1,0 +1,66 @@
+"""Network introspection & heatmap colorization.
+
+TPU-native replacement for the reference's autograd-graphviz / ONNX export
+(reference: visulizatoin/draw_net.py): under XLA the compiled artifact IS the
+graph, so we expose a parameter-shape table and the StableHLO text of a jitted
+forward — inspectable with any HLO tooling.  Plus the jet colorizer used in
+the reference's debug overlays (utils/util.py:12-41), vectorized.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def param_table(variables, max_rows: Optional[int] = None) -> str:
+    """Human-readable parameter listing with totals."""
+    import jax
+
+    rows = []
+    total = 0
+    flat = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        n = int(np.prod(leaf.shape))
+        total += n
+        rows.append(f"{name:<80s} {str(leaf.shape):>20s} {n:>12,d}")
+    if max_rows is not None:
+        rows = rows[:max_rows] + [f"... ({len(flat) - max_rows} more)"]
+    rows.append(f"{'TOTAL':<80s} {'':>20s} {total:>12,d}")
+    return "\n".join(rows)
+
+
+def export_stablehlo(model, variables, sample_images) -> str:
+    """StableHLO text of the jitted forward — the XLA-world ONNX export
+    (reference: visulizatoin/draw_net.py:89-93)."""
+    import jax
+
+    def forward(variables, imgs):
+        return model.apply(variables, imgs, train=False)
+
+    lowered = jax.jit(forward).lower(variables, sample_images)
+    return lowered.as_text()
+
+
+def colorize_jet(gray: np.ndarray) -> np.ndarray:
+    """Jet colormap (values in [0,1]) → float BGR array in [0,255]
+    (reference: utils/util.py:12-41, vectorized)."""
+    v = np.clip(gray, 0.0, 1.0)
+    out = np.zeros((*v.shape, 3))
+    b, g, r = out[..., 0], out[..., 1], out[..., 2]
+    seg0 = v < 0.125
+    seg1 = (v >= 0.125) & (v < 0.375)
+    seg2 = (v >= 0.375) & (v < 0.625)
+    seg3 = (v >= 0.625) & (v < 0.875)
+    seg4 = v >= 0.875
+    b[seg0] = 256 * (0.5 + v[seg0] * 4)
+    b[seg1] = 255
+    g[seg1] = 256 * (v[seg1] - 0.125) * 4
+    b[seg2] = 256 * (-4 * v[seg2] + 2.5)
+    g[seg2] = 255
+    r[seg2] = 256 * (4 * (v[seg2] - 0.375))
+    g[seg3] = 256 * (-4 * v[seg3] + 3.5)
+    r[seg3] = 255
+    r[seg4] = 256 * (-4 * v[seg4] + 4.5)
+    return np.clip(out, 0, 255)

@@ -1,0 +1,93 @@
+"""Tests for evaluation formatting, demo rendering, padding and utils."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import default_inference_params, get_config
+from improved_body_parts_tpu.infer.demo import draw_skeletons, limb_flow_bgr
+from improved_body_parts_tpu.infer.evaluate import format_results
+from improved_body_parts_tpu.infer.predict import center_pad, pad_right_down
+from improved_body_parts_tpu.utils import colorize_jet, param_table
+
+CFG = get_config("canonical")
+SK = CFG.skeleton
+
+
+def test_format_results(tmp_path):
+    res = str(tmp_path / "r.json")
+    keypoints = {
+        42: [([(10.0, 20.0)] + [None] * 16, 0.9)],
+        43: [],
+    }
+    format_results(keypoints, res)
+    data = json.load(open(res))
+    assert len(data) == 1
+    rec = data[0]
+    assert rec["image_id"] == 42 and rec["category_id"] == 1
+    assert len(rec["keypoints"]) == 51
+    assert rec["keypoints"][:3] == [10.0, 20.0, 1]
+    assert rec["keypoints"][3:6] == [0.0, 0.0, 0]  # None → invisible
+    assert rec["score"] == 0.9
+
+
+def test_pad_right_down():
+    img = np.zeros((100, 130, 3), np.uint8)
+    out, (ph, pw) = pad_right_down(img, 64, 128)
+    assert out.shape == (128, 192, 3)
+    assert (ph, pw) == (28, 62)
+    assert out[127, 191, 0] == 128  # pad value
+    out2, pads = pad_right_down(np.zeros((64, 64, 3), np.uint8), 64, 128)
+    assert out2.shape == (64, 64, 3) and pads == (0, 0)
+
+
+def test_center_pad():
+    img = np.zeros((100, 130, 3), np.uint8)
+    out, (top, left, bottom, right) = center_pad(img, 64, 128)
+    assert out.shape == (128, 192, 3)
+    assert top + bottom == 28 and left + right == 62
+    assert abs(top - bottom) <= 1 and abs(left - right) <= 1
+
+
+def test_draw_skeletons_renders():
+    img = np.zeros((200, 200, 3), np.uint8)
+    candidate = np.array([[50.0, 50.0, 0.9, 0], [80.0, 60.0, 0.8, 1]])
+    subset = -1 * np.ones((1, SK.num_parts + 2, 2))
+    neck, nose = SK.parts_dict["neck"], SK.parts_dict["nose"]
+    subset[0, neck, 0] = 0
+    subset[0, nose, 0] = 1
+    subset[0, -1, 0] = 2
+    subset[0, -2, 0] = 2.0
+    canvas = draw_skeletons(img, subset, candidate, SK)
+    assert canvas.shape == img.shape
+    assert canvas.sum() > 0  # something was drawn
+
+
+def test_limb_flow_render():
+    limb = np.zeros((64, 64))
+    limb[30:34, 10:50] = 1.0
+    bgr = limb_flow_bgr(limb)
+    assert bgr.shape == (64, 64, 3) and bgr.dtype == np.uint8
+    assert bgr[32, 30].sum() > 0 and bgr[0, 0].sum() == 0
+
+
+def test_colorize_jet_endpoints():
+    out = colorize_jet(np.array([0.0, 0.5, 1.0]))
+    assert out.shape == (3, 3)
+    # v=0 → half blue; v=0.5 → green-dominated; v=1 → half red
+    assert out[0, 0] > 0 and out[0, 2] == 0
+    assert out[1, 1] == 255
+    assert out[2, 2] > 0 and out[2, 0] == 0
+
+
+def test_param_table():
+    import jax
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.models.layers import SELayer
+
+    se = SELayer(reduction=4, dtype=jnp.float32)
+    v = se.init(jax.random.PRNGKey(0), jnp.zeros((1, 4, 4, 16)))
+    table = param_table(v)
+    assert "TOTAL" in table and "Dense_0" in table

@@ -172,3 +172,17 @@ class TestTrainStep:
                           jax.tree.map(np.asarray, state.params))
         assert all(jax.tree.leaves(eq))
         assert int(restored.step) == int(state.step)
+        # the optax state structure must survive the round-trip: a restored
+        # state must be able to take another optimizer step (regression for
+        # orbax flattening namedtuple states into dicts)
+        assert (jax.tree.structure(restored.opt_state)
+                == jax.tree.structure(state.opt_state))
+        cfg, model, opt, _ = _tiny_setup()
+        step = make_train_step(model, cfg, opt, donate=False)
+        rng = np.random.default_rng(1)
+        images = np.asarray(rng.uniform(0, 1, (8, 32, 32, 3)), np.float32)
+        labels = np.asarray(
+            rng.uniform(0, 1, (8, 8, 8, cfg.skeleton.num_layers)), np.float32)
+        mask = np.ones((8, 8, 8, 1), np.float32)
+        new_state, loss = step(restored, images, mask, labels)
+        assert np.isfinite(float(loss))

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Single-image demo CLI (reference: demo_image.py, ``--image/--output``).
+
+    python tools/demo.py --checkpoint checkpoints/epoch_99 \
+        --image person.jpg --output result.jpg
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description="Pose demo")
+    ap.add_argument("--config", default="canonical")
+    ap.add_argument("--checkpoint", required=True)
+    ap.add_argument("--image", required=True)
+    ap.add_argument("--output", default="result.jpg")
+    ap.add_argument("--no-native", action="store_true")
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.infer.demo import run_demo
+    from tools.evaluate import load_predictor
+
+    predictor = load_predictor(args.config, args.checkpoint)
+    _, (subset, _) = run_demo(predictor, args.image, args.output,
+                              use_native=not args.no_native)
+    print(f"{len(subset)} people -> {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""COCO evaluation CLI (reference: evaluate.py __main__, :625-650).
+
+    python tools/evaluate.py --checkpoint checkpoints/epoch_99 \
+        --anno annotations/person_keypoints_val2017.json --images val2017
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_predictor(config_name: str, checkpoint: str, bucket: int = 128):
+    import jax
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.infer import Predictor
+    from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.train import restore_checkpoint
+
+    cfg = get_config(config_name)
+    model = build_model(cfg)
+    payload = restore_checkpoint(checkpoint)
+    variables = {"params": payload["params"],
+                 "batch_stats": payload["batch_stats"]}
+    return Predictor(model, variables, cfg.skeleton, bucket=bucket)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="COCO keypoint evaluation")
+    ap.add_argument("--config", default="canonical")
+    ap.add_argument("--checkpoint", required=True)
+    ap.add_argument("--anno", required=True,
+                    help="person_keypoints_val2017.json")
+    ap.add_argument("--images", required=True, help="val2017 image dir")
+    ap.add_argument("--max-images", type=int, default=500,
+                    help="first-N protocol (reference: evaluate.py:597-598)")
+    ap.add_argument("--dump-name", default="tpu")
+    ap.add_argument("--no-native", action="store_true",
+                    help="use the NumPy decoder instead of the C++ one")
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.infer.evaluate import validation
+
+    predictor = load_predictor(args.config, args.checkpoint)
+    coco_eval = validation(predictor, args.anno, args.images,
+                           dump_name=args.dump_name,
+                           max_images=args.max_images,
+                           use_native=not args.no_native)
+    print("AP:", coco_eval.stats[0])
+
+
+if __name__ == "__main__":
+    main()

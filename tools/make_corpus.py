@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""COCO → HDF5 corpus CLI (reference: data/coco_masks_hdf5.py __main__).
+
+    python tools/make_corpus.py --anno annotations/person_keypoints_train2017.json \
+        --images train2017 --out-train coco_train_dataset512.h5 \
+        --out-val coco_val_dataset512.h5 --image-size 512
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description="build the training corpus")
+    ap.add_argument("--anno", required=True)
+    ap.add_argument("--images", required=True)
+    ap.add_argument("--out-train", required=True)
+    ap.add_argument("--out-val", required=True)
+    ap.add_argument("--image-size", type=int, default=512)
+    ap.add_argument("--val-size", type=int, default=100)
+    ap.add_argument("--limit", type=int, default=None)
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.data.hdf5_corpus import build_coco_corpus
+
+    t0 = time.time()
+    tr, va = build_coco_corpus(args.anno, args.images, args.out_train,
+                               args.out_val, image_size=args.image_size,
+                               val_size=args.val_size, limit=args.limit)
+    print(f"train records: {tr}, val records: {va} "
+          f"({(time.time() - t0) / 60:.1f} min)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Pure-network inference FPS benchmark
+(reference: test_inference_speed.py:90-120; baseline ~38.5 imgs/s at 512x512
+on a 2080 Ti, README.md:67).
+
+    python tools/speed_test.py --batch 1 --size 512 --iters 50
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description="network FPS benchmark")
+    ap.add_argument("--config", default="canonical")
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--size", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--fp32", action="store_true", help="disable bf16 compute")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.models import build_model
+
+    cfg = get_config(args.config)
+    model = build_model(cfg, dtype=jnp.float32 if args.fp32 else None)
+    imgs = jnp.zeros((args.batch, args.size, args.size, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), imgs, train=False)
+
+    @jax.jit
+    def forward(variables, imgs):
+        return model.apply(variables, imgs, train=False)[-1][0]
+
+    out = forward(variables, imgs)
+    jax.block_until_ready(out)
+    for _ in range(5):
+        out = forward(variables, imgs)
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out = forward(variables, imgs)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    fps = args.iters * args.batch / dt
+    print(f"{fps:.2f} imgs/s  ({dt / args.iters * 1000:.2f} ms/iter, "
+          f"batch {args.batch}, {args.size}x{args.size}, "
+          f"{'fp32' if args.fp32 else 'bf16'})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Training CLI.
+
+One script covers the reference's three entry points — train.py (single
+device), train_parallel.py (single-process multi-GPU DataParallel) and
+train_distributed.py (multi-process NCCL DDP) — because under SPMD they are
+the same program over different meshes.  Multi-host runs add
+``--coordinator/--num-processes/--process-id`` (jax.distributed), the
+TPU-native replacement for ``torch.distributed.launch``
+(reference: train_distributed.py:69-84, README.md:104).
+
+Example:
+    python tools/train.py --config canonical --epochs 100 \
+        --train-h5 data/coco_train_dataset512.h5 --workers 4
+    python tools/train.py --swa --resume checkpoints/epoch_90  # SWA fine-tune
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description="IMHN pose training (SPMD)")
+    ap.add_argument("--config", default="canonical")
+    ap.add_argument("--epochs", type=int, default=None)
+    ap.add_argument("--train-h5", default=None)
+    ap.add_argument("--val-h5", default=None)
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--resume", default=None,
+                    help="checkpoint path or 'auto' for the latest")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--no-focal", action="store_true",
+                    help="plain L2 loss (the reference's L2 curriculum stage)")
+    ap.add_argument("--swa", action="store_true",
+                    help="SWA fine-tuning with cyclic LR and frozen BN "
+                         "(reference: train_distributed_SWA.py)")
+    ap.add_argument("--swa-freq", type=int, default=5)
+    ap.add_argument("--print-freq", type=int, default=10)
+    # multi-host (jax.distributed)
+    ap.add_argument("--coordinator", default=None)
+    ap.add_argument("--num-processes", type=int, default=1)
+    ap.add_argument("--process-id", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.data import CocoPoseDataset, batches
+    from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.parallel import (
+        initialize_distributed, make_mesh, replicated)
+    from improved_body_parts_tpu.train import (
+        create_train_state, cyclic_swa_schedule, fit, latest_checkpoint,
+        make_eval_step, make_optimizer, make_train_step, restore_checkpoint,
+        start_swa, step_decay_schedule, swap_swa_params, update_swa)
+
+    initialize_distributed(args.coordinator, args.num_processes,
+                           args.process_id)
+    cfg = get_config(args.config)
+    if args.checkpoint_dir:
+        import dataclasses
+        cfg = cfg.replace(train=dataclasses.replace(
+            cfg.train, checkpoint_dir=args.checkpoint_dir))
+
+    train_h5 = args.train_h5 or cfg.train.hdf5_train_data
+    val_h5 = args.val_h5 or cfg.train.hdf5_val_data
+    ds = CocoPoseDataset(train_h5, cfg, augment=True)
+    val_ds = (CocoPoseDataset(val_h5, cfg, augment=False)
+              if os.path.exists(val_h5) else None)
+
+    mesh = make_mesh()
+    n_dev = int(mesh.devices.size)  # devices across ALL processes
+    global_batch = cfg.train.batch_size_per_device * n_dev
+    # each host loads only its slice; shard_batch assembles the global array
+    host_batch = global_batch // args.num_processes
+    steps_per_epoch = max(len(ds) // global_batch, 1)
+    print(f"devices={n_dev} global_batch={global_batch} "
+          f"host_batch={host_batch} steps/epoch={steps_per_epoch}")
+
+    model = build_model(cfg)
+    if args.swa:
+        schedule = cyclic_swa_schedule(steps_per_epoch, args.swa_freq)
+    else:
+        schedule = step_decay_schedule(cfg.train, steps_per_epoch,
+                                       world_size=n_dev * args.num_processes,
+                                       use_warmup=not args.no_warmup)
+    optimizer = make_optimizer(cfg, schedule)
+    sample = jnp.zeros((global_batch, cfg.skeleton.height,
+                        cfg.skeleton.width, 3))
+    state = create_train_state(model, cfg, optimizer, jax.random.PRNGKey(0),
+                               sample)
+    state = jax.device_put(state, replicated(mesh))
+
+    start_epoch = 0
+    resumed_swa = False
+    if args.resume:
+        path = (latest_checkpoint(cfg.train.checkpoint_dir)
+                if args.resume == "auto" else args.resume)
+        if path:
+            state, meta = restore_checkpoint(path, state)
+            start_epoch = meta["epoch"] + 1
+            resumed_swa = state.swa_count is not None
+            print(f"resumed from {path} (epoch {meta['epoch']})")
+
+    use_focal = not args.no_focal
+    # SWA freezes BatchNorm (train_distributed_SWA.py:219-221)
+    train_step = make_train_step(model, cfg, optimizer, use_focal=use_focal,
+                                 freeze_bn=args.swa)
+    eval_step = make_eval_step(model, cfg, use_focal=use_focal)
+    is_lead = args.process_id == 0
+
+    def make_train_batches(epoch):
+        return batches(ds, host_batch, epoch, args.process_id,
+                       args.num_processes, num_workers=args.workers)
+
+    make_eval_batches = None
+    if val_ds is not None:
+        def make_eval_batches(epoch):
+            return batches(val_ds, host_batch, 0, args.process_id,
+                           args.num_processes, num_workers=args.workers)
+
+    epochs = args.epochs or cfg.train.epochs
+    if not args.swa:
+        fit(state, train_step, cfg, make_train_batches, epochs,
+            start_epoch=start_epoch, mesh=mesh, eval_step=eval_step,
+            make_eval_batches=make_eval_batches, is_lead_host=is_lead)
+        return
+
+    # SWA fine-tune: average params every swa_freq epochs, swap averaged
+    # params in for the checkpoint (reference: train_distributed_SWA.py:403-435)
+    from improved_body_parts_tpu.train import checkpoint as ckpt
+    from improved_body_parts_tpu.train.loop import train_epoch
+
+    if resumed_swa:
+        # SWA checkpoints are saved swapped (params=averaged,
+        # swa_params=live SGD weights); swap back to continue training from
+        # the live weights while keeping the running average intact.
+        state = swap_swa_params(state)
+    else:
+        state = start_swa(state)
+    for epoch in range(start_epoch, start_epoch + epochs):
+        state, train_loss = train_epoch(
+            state, train_step, make_train_batches(epoch), cfg, epoch,
+            mesh=mesh, is_lead_host=is_lead)
+        if (epoch - start_epoch + 1) % args.swa_freq == 0:
+            state = update_swa(state)
+            if is_lead:
+                swapped = swap_swa_params(state)
+                ckpt.save_checkpoint(cfg.train.checkpoint_dir, swapped, epoch,
+                                     train_loss, train_loss)
+                print(f"epoch {epoch}: SWA checkpoint saved")
+
+
+if __name__ == "__main__":
+    main()
