@@ -221,6 +221,9 @@ class ModelConfig:
     use_bn: bool = True
     se_reduction: int = 16
     leaky_slope: float = 0.01
+    # rematerialize each hourglass stack in the backward pass (memory for
+    # FLOPs) — enables big per-chip batches at 512²
+    remat: bool = False
 
 
 @dataclass(frozen=True)
@@ -357,12 +360,25 @@ def _tiny() -> Config:
     )
 
 
+def _ae() -> Config:
+    """Associative-Embedding-style classic hourglass (reference:
+    models/ae_pose.py, kept for ablation): ONE full-resolution output per
+    stack, so the loss runs with a single scale weight.  (The reference never
+    shipped a config for it — its 5-scale loss cannot consume ae outputs.)"""
+    return Config(
+        name="ae",
+        model=ModelConfig(variant="ae"),
+        train=TrainConfig(scale_weight=(1.0,)),
+    )
+
+
 _REGISTRY = {
     "canonical": _canonical,
     "three_stack_384": _three_stack_384,
     "dense_384": _dense_384,
     "final_384": _final_384,
     "tiny": _tiny,
+    "ae": _ae,
 }
 
 

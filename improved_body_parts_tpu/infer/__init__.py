@@ -1,15 +1,20 @@
 from .decode import (
+    assemble,
     decode,
     find_connections,
     find_peaks,
     find_people,
     subsets_to_keypoints,
 )
+from .demo import draw_skeletons, limb_flow_bgr, run_demo
+from .evaluate import format_results, process_image, validation
 from .native import native_available
-from .predict import Predictor, pad_right_down
+from .oks import evaluate_oks, oks
+from .predict import Predictor, center_pad, pad_right_down
 
 __all__ = [
-    "decode", "find_connections", "find_peaks", "find_people",
-    "subsets_to_keypoints", "native_available", "Predictor",
-    "pad_right_down",
+    "assemble", "decode", "find_connections", "find_peaks", "find_people",
+    "subsets_to_keypoints", "draw_skeletons", "limb_flow_bgr", "run_demo",
+    "format_results", "process_image", "validation", "native_available",
+    "evaluate_oks", "oks", "Predictor", "center_pad", "pad_right_down",
 ]

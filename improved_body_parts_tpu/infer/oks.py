@@ -1,0 +1,146 @@
+"""Dependency-free COCO-style OKS keypoint evaluation.
+
+The canonical evaluation path uses pycocotools' COCOeval
+(infer/evaluate.py, reference: evaluate.py:616-621); this module provides the
+same AP/AR protocol — greedy OKS matching per image at thresholds
+0.50:0.05:0.95 with 101-point interpolated precision — in pure NumPy, so AP
+smoke tests run in environments without pycocotools (its C extension is a
+host-side dependency, SURVEY.md §2.9).
+
+Formats:
+- ground truth: per image, list of dicts {"keypoints": (17, 3) array in COCO
+  order with v flags, "area": float}
+- detections: per image, list of (coco_keypoints [17 x (x, y) | None], score)
+  — exactly what ``decode`` returns.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# per-keypoint falloff constants (k = 2*sigma) from the COCO keypoint task
+COCO_SIGMAS = np.array([
+    0.026, 0.025, 0.025, 0.035, 0.035, 0.079, 0.079, 0.072, 0.072,
+    0.062, 0.062, 0.107, 0.107, 0.087, 0.087, 0.089, 0.089])
+
+OKS_THRESHOLDS = np.arange(0.5, 0.95 + 1e-9, 0.05)
+
+
+def oks(det_xy: np.ndarray, gt: np.ndarray, area: float) -> float:
+    """Object keypoint similarity between one detection and one GT person.
+
+    :param det_xy: (17, 2) detected coordinates (0,0 = missing)
+    :param gt: (17, 3) GT with visibility flags (v > 0 = labeled)
+    :param area: GT segment area (scale normalizer)
+    """
+    vis = gt[:, 2] > 0
+    if not vis.any():
+        return 0.0
+    d2 = ((det_xy[vis] - gt[vis, :2]) ** 2).sum(axis=1)
+    k2 = (2 * COCO_SIGMAS[vis]) ** 2
+    e = d2 / (2.0 * max(area, 1e-9) * k2)
+    return float(np.exp(-e).mean())
+
+
+def _oks_matrix(gts: Sequence[Dict], dts: Sequence[Tuple]) -> np.ndarray:
+    """(n_det, n_gt) OKS similarities — computed ONCE per image and reused
+    across all thresholds (the COCOeval computeOks/accumulate split)."""
+    mat = np.zeros((len(dts), len(gts)))
+    for di, (coords, _) in enumerate(dts):
+        det_xy = np.array([(0.0, 0.0) if c is None else c for c in coords])
+        for gi, gt in enumerate(gts):
+            mat[di, gi] = oks(
+                det_xy, np.asarray(gt["keypoints"], dtype=np.float64),
+                gt["area"])
+    return mat
+
+
+def _match_image(oks_mat: np.ndarray, det_scores: np.ndarray, thr: float
+                 ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Greedy matching for one image at one threshold (COCOeval order:
+    detections by descending score, each takes its best unmatched GT).
+
+    Returns (scores, is_tp flags, number of GT).
+    """
+    n_det, n_gt = oks_mat.shape
+    order = np.argsort(-det_scores, kind="stable")
+    matched = np.zeros(n_gt, dtype=bool)
+    scores, tps = [], []
+    for di in order:
+        best_oks, best_gi = thr, -1
+        for gi in range(n_gt):
+            if matched[gi]:
+                continue
+            if oks_mat[di, gi] >= best_oks:
+                best_oks, best_gi = oks_mat[di, gi], gi
+        scores.append(det_scores[di])
+        tps.append(best_gi >= 0)
+        if best_gi >= 0:
+            matched[best_gi] = True
+    return np.asarray(scores), np.asarray(tps, dtype=bool), n_gt
+
+
+def average_precision(scores: np.ndarray, tps: np.ndarray, n_gt: int
+                      ) -> float:
+    """101-point interpolated AP (the COCOeval accumulate protocol)."""
+    if n_gt == 0:
+        return np.nan
+    if scores.size == 0:
+        return 0.0
+    order = np.argsort(-scores, kind="stable")
+    tp = np.cumsum(tps[order])
+    fp = np.cumsum(~tps[order])
+    recall = tp / n_gt
+    precision = tp / np.maximum(tp + fp, 1)
+    # make precision monotonically decreasing from the right
+    precision = np.maximum.accumulate(precision[::-1])[::-1]
+    recall_points = np.linspace(0, 1, 101)
+    idx = np.searchsorted(recall, recall_points, side="left")
+    prec_at = np.where(idx < precision.size, precision[np.minimum(
+        idx, precision.size - 1)], 0.0)
+    return float(prec_at.mean())
+
+
+def evaluate_oks(ground_truth: Dict[int, Sequence[Dict]],
+                 detections: Dict[int, Sequence[Tuple]]
+                 ) -> Dict[str, float]:
+    """AP / AP50 / AP75 / AR over all images.
+
+    :param ground_truth: image_id -> list of GT person dicts
+    :param detections: image_id -> list of (coords, score) from ``decode``
+    """
+    per_image = {}
+    for image_id, gts in ground_truth.items():
+        dts = detections.get(image_id, [])
+        per_image[image_id] = (
+            _oks_matrix(gts, dts),
+            np.asarray([score for _, score in dts], dtype=np.float64))
+
+    aps = []
+    recalls = []
+    for thr in OKS_THRESHOLDS:
+        all_scores, all_tps, total_gt = [], [], 0
+        for image_id, (mat, det_scores) in per_image.items():
+            s, t, n = _match_image(mat, det_scores, thr)
+            all_scores.append(s)
+            all_tps.append(t)
+            total_gt += n
+        scores = np.concatenate(all_scores) if all_scores else np.zeros(0)
+        tps = (np.concatenate(all_tps) if all_tps
+               else np.zeros(0, dtype=bool))
+        aps.append(average_precision(scores, tps, total_gt))
+        recalls.append(tps.sum() / total_gt if total_gt else np.nan)
+
+    aps = np.asarray(aps)
+    recalls = np.asarray(recalls)
+
+    def mean_or_nan(x):
+        return float(np.nanmean(x)) if not np.isnan(x).all() else float("nan")
+
+    return {
+        "AP": mean_or_nan(aps),
+        "AP50": float(aps[0]),
+        "AP75": float(aps[5]),
+        "AR": mean_or_nan(recalls),
+    }

@@ -1,7 +1,25 @@
-from .layers import Backbone, ConvBlock, Hourglass, Residual, SELayer
-from .posenet import Features, PoseNet, PoseNetLight, build_model
+from .layers import (
+    Backbone,
+    BackboneSimple,
+    ConvBlock,
+    Hourglass,
+    HourglassAE,
+    HourglassFinal,
+    Residual,
+    SELayer,
+)
+from .posenet import (
+    Features,
+    PoseNet,
+    PoseNetAE,
+    PoseNetFinal,
+    PoseNetLight,
+    build_model,
+)
 
 __all__ = [
-    "Backbone", "ConvBlock", "Hourglass", "Residual", "SELayer",
-    "Features", "PoseNet", "PoseNetLight", "build_model",
+    "Backbone", "BackboneSimple", "ConvBlock", "Hourglass", "HourglassAE",
+    "HourglassFinal", "Residual", "SELayer",
+    "Features", "PoseNet", "PoseNetAE", "PoseNetFinal", "PoseNetLight",
+    "build_model",
 ]

@@ -162,6 +162,24 @@ class Backbone(nn.Module):
         return jnp.concatenate([x, y], axis=-1)
 
 
+class BackboneSimple(nn.Module):
+    """Stride-4 stem without the dilated branch: conv7/2 → Residual(128) →
+    pool → Residual(128) → Residual(nFeat)
+    (reference: layers_transposed_final.py:82-107)."""
+    features: int = 256
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = ConvBlock(64, kernel_size=7, stride=2, **kw)(x, train)
+        x = Residual(128, **kw)(x, train)
+        x = max_pool_2x2(x)
+        x = Residual(128, **kw)(x, train)
+        return Residual(self.features, **kw)(x, train)
+
+
 class Hourglass(nn.Module):
     """5-scale hourglass, written iteratively (reference recursion:
     layers_transposed.py:197-282).
@@ -203,3 +221,73 @@ class Hourglass(nn.Module):
             y = skips[i] + refined
             scales.append(y)
         return scales[::-1]  # largest scale first
+
+
+class HourglassFinal(nn.Module):
+    """The 'final' hourglass cell: all-Conv blocks, a skip branch without its
+    activation, TWO refine convs after the upsample (the second without
+    activation), and LeakyReLU applied after the residual add
+    (reference: layers_transposed_final.py:111-199)."""
+    depth: int = 4
+    features: int = 256
+    increase: int = 128
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+
+        def ch(i):
+            return self.features + self.increase * i
+
+        skips = []
+        for i in range(self.depth):
+            skips.append(ConvBlock(ch(i), kernel_size=3, relu=False,
+                                   **kw)(x, train))
+            x = max_pool_2x2(x)
+            x = ConvBlock(ch(i + 1), kernel_size=3, **kw)(x, train)
+        y = ConvBlock(ch(self.depth), kernel_size=3, **kw)(x, train)
+
+        scales = [y]
+        for i in reversed(range(self.depth)):
+            low3 = ConvBlock(ch(i), kernel_size=3, **kw)(y, train)
+            up2 = upsample_nearest_2x(low3)
+            refined = ConvBlock(ch(i), kernel_size=3, **kw)(up2, train)
+            refined = ConvBlock(ch(i), kernel_size=3, relu=False,
+                                **kw)(refined, train)
+            y = leaky_relu(skips[i] + refined)
+            scales.append(y)
+        return scales[::-1]
+
+
+class HourglassAE(nn.Module):
+    """Classic single-output hourglass from the Associative Embedding
+    lineage: plain convs with bias, ReLU, nearest upsample, one merged output
+    (reference: models/ae_layer.py:68-91)."""
+    depth: int = 4
+    features: int = 256
+    increase: int = 128
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        f = self.features
+        nf = f + self.increase
+
+        def conv(feat, y, relu=True):
+            y = nn.Conv(feat, (3, 3), padding="SAME", use_bias=True,
+                        kernel_init=conv_init, dtype=self.dtype,
+                        param_dtype=jnp.float32)(y)
+            return nn.relu(y) if relu else y
+
+        up1 = conv(f, x)
+        low1 = conv(nf, max_pool_2x2(x))
+        if self.depth > 1:
+            low2 = HourglassAE(depth=self.depth - 1, features=nf,
+                               increase=self.increase, dtype=self.dtype
+                               )(low1, train)
+        else:
+            low2 = conv(nf, low1)
+        low3 = conv(f, low2)
+        return up1 + upsample_nearest_2x(low3)

@@ -25,7 +25,17 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..config import Config
-from .layers import Backbone, ConvBlock, Hourglass, Residual, SELayer, max_pool_2x2
+from .layers import (
+    Backbone,
+    BackboneSimple,
+    ConvBlock,
+    Hourglass,
+    HourglassAE,
+    HourglassFinal,
+    Residual,
+    SELayer,
+    max_pool_2x2,
+)
 
 
 class Features(nn.Module):
@@ -48,8 +58,41 @@ class Features(nn.Module):
         return out
 
 
+def _regress_and_merge(feats, x, cache, is_last, inp_dim, increase, oup_dim,
+                       kw, dtype, train, merge_bn=True):
+    """Shared per-scale tail: 1x1 output head; on non-final stacks, merge
+    prediction + features back to the scale's width, feed scale-0 into the
+    next stack input, refresh the cross-stack cache
+    (reference: posenet.py:102-114; the reference evaluates the scale-0 merge
+    twice — same values, computed once here).  Must run inside nn.compact.
+    """
+    preds_instack = []
+    for j, f in enumerate(feats):
+        pred = ConvBlock(oup_dim, kernel_size=1, use_bn=False,
+                         relu=False, dtype=dtype)(f, train)
+        preds_instack.append(pred.astype(jnp.float32))
+        if not is_last:
+            width = inp_dim + j * increase
+            mkw = kw if merge_bn else {**kw, "use_bn": False}
+            merged = (ConvBlock(width, kernel_size=1, relu=False, **mkw)(
+                          pred.astype(dtype), train)
+                      + ConvBlock(width, kernel_size=1, relu=False, **mkw)(
+                          f, train))
+            if j == 0:
+                x = x + merged
+            cache[j] = merged
+    return preds_instack, x
+
+
 class PoseNet(nn.Module):
-    """Stacked IMHN (reference: models/posenet.py:43-117)."""
+    """Stacked IMHN (reference: models/posenet.py:43-117).
+
+    ``remat=True`` wraps each hourglass in ``nn.remat`` (rematerialisation):
+    activations inside a stack are recomputed in the backward pass instead of
+    stored, trading ~⅓ extra FLOPs for a large memory cut — how the 4-stack
+    model trains with big per-chip batches at 512² (the reference has no
+    equivalent; Apex O1 only halves activation width).
+    """
     nstack: int = 4
     inp_dim: int = 256
     oup_dim: int = 50
@@ -57,6 +100,7 @@ class PoseNet(nn.Module):
     hourglass_depth: int = 4
     cross_stack_residual: bool = True  # False = posenet_independent ablation
     se_reduction: int = 16
+    remat: bool = False
     dtype: Any = jnp.float32
     bn_axis_name: Optional[str] = None
 
@@ -67,11 +111,13 @@ class PoseNet(nn.Module):
         x = images.astype(self.dtype)
         x = Backbone(features=self.inp_dim, **kw)(x, train)
 
+        hourglass_cls = (nn.remat(Hourglass, static_argnums=(2,))
+                         if self.remat else Hourglass)
         nscale = self.hourglass_depth + 1
         preds: List[List[jnp.ndarray]] = []
         cache: List[Optional[jnp.ndarray]] = [None] * nscale
         for i in range(self.nstack):
-            feats = Hourglass(
+            feats = hourglass_cls(
                 depth=self.hourglass_depth, features=self.inp_dim,
                 increase=self.increase, **kw)(x, train)
             if self.cross_stack_residual and i > 0:
@@ -79,25 +125,9 @@ class PoseNet(nn.Module):
             feats = Features(self.inp_dim, se_reduction=self.se_reduction,
                              **kw)(feats, train)
 
-            preds_instack = []
-            for j in range(nscale):
-                pred = ConvBlock(self.oup_dim, kernel_size=1, use_bn=False,
-                                 relu=False, dtype=self.dtype)(feats[j], train)
-                preds_instack.append(pred.astype(jnp.float32))
-                if i != self.nstack - 1:
-                    # Merge prediction + features back to the scale's width for
-                    # the next stack (reference: posenet.py:102-114; the
-                    # reference evaluates merge twice for scale 0 — same values,
-                    # we compute once).
-                    width = self.inp_dim + j * self.increase
-                    merged = (
-                        ConvBlock(width, kernel_size=1, relu=False, **kw)(
-                            pred.astype(self.dtype), train)
-                        + ConvBlock(width, kernel_size=1, relu=False, **kw)(
-                            feats[j], train))
-                    if j == 0:
-                        x = x + merged
-                    cache[j] = merged
+            preds_instack, x = _regress_and_merge(
+                feats, x, cache, i == self.nstack - 1, self.inp_dim,
+                self.increase, self.oup_dim, kw, self.dtype, train)
             preds.append(preds_instack)
         return preds
 
@@ -135,22 +165,104 @@ class PoseNetLight(nn.Module):
                 feats = [f + c for f, c in zip(feats, cache)]
             feats = [ConvBlock(self.inp_dim, kernel_size=3, **kw)(f, train)
                      for f in feats]
-            preds_instack = []
-            for j in range(nscale):
-                pred = ConvBlock(self.oup_dim, kernel_size=1, use_bn=False,
-                                 relu=False, dtype=self.dtype)(feats[j], train)
-                preds_instack.append(pred.astype(jnp.float32))
-                if i != self.nstack - 1:
-                    width = self.inp_dim + j * self.increase
-                    merged = (
-                        ConvBlock(width, kernel_size=1, relu=False, **kw)(
-                            pred.astype(self.dtype), train)
-                        + ConvBlock(width, kernel_size=1, relu=False, **kw)(
-                            feats[j], train))
-                    if j == 0:
-                        x = x + merged
-                    cache[j] = merged
+            preds_instack, x = _regress_and_merge(
+                feats, x, cache, i == self.nstack - 1, self.inp_dim,
+                self.increase, self.oup_dim, kw, self.dtype, train)
             preds.append(preds_instack)
+        return preds
+
+
+class PoseNetFinal(nn.Module):
+    """The 'final' higher-res IMHN variant (reference: models/posenet_final.py):
+    simple (non-dilated) backbone, all-Conv hourglass with two refine convs,
+    full-width SE attention applied to hourglass features BEFORE the
+    cross-stack cache add (posenet_final.py:104-113), and Features heads that
+    1x1-compress the scale width first (posenet_final.py:37-43)."""
+    nstack: int = 4
+    inp_dim: int = 256
+    oup_dim: int = 50
+    increase: int = 128
+    hourglass_depth: int = 4
+    se_reduction: int = 16
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = images.astype(self.dtype)
+        x = BackboneSimple(features=self.inp_dim, **kw)(x, train)
+
+        nscale = self.hourglass_depth + 1
+        preds: List[List[jnp.ndarray]] = []
+        cache: List[Optional[jnp.ndarray]] = [None] * nscale
+        for i in range(self.nstack):
+            feats = HourglassFinal(
+                depth=self.hourglass_depth, features=self.inp_dim,
+                increase=self.increase, **kw)(x, train)
+            attended = [
+                SELayer(reduction=self.se_reduction, dtype=self.dtype)(f)
+                for f in feats]
+            if i > 0:
+                feats = [a + c for a, c in zip(attended, cache)]
+            else:
+                feats = attended
+            # compress-first Features head
+            head = []
+            for f in feats:
+                f = ConvBlock(self.inp_dim, kernel_size=1, **kw)(f, train)
+                f = ConvBlock(self.inp_dim, kernel_size=3, **kw)(f, train)
+                f = ConvBlock(self.inp_dim, kernel_size=3, **kw)(f, train)
+                head.append(f)
+
+            preds_instack, x = _regress_and_merge(
+                head, x, cache, i == self.nstack - 1, self.inp_dim,
+                self.increase, self.oup_dim, kw, self.dtype, train)
+            preds.append(preds_instack)
+        return preds
+
+
+class PoseNetAE(nn.Module):
+    """Classic Associative-Embedding-style stacked hourglass: conv stem,
+    ONE full-resolution output per stack, pred+feature merge into the next
+    stack (reference: models/ae_pose.py:22-58)."""
+    nstack: int = 4
+    inp_dim: int = 256
+    oup_dim: int = 50
+    increase: int = 128
+    hourglass_depth: int = 4
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = images.astype(self.dtype)
+        x = ConvBlock(64, kernel_size=7, stride=2, **kw)(x, train)
+        x = ConvBlock(128, kernel_size=3, **kw)(x, train)
+        x = max_pool_2x2(x)
+        x = ConvBlock(128, kernel_size=3, **kw)(x, train)
+        x = ConvBlock(self.inp_dim, kernel_size=3, **kw)(x, train)
+
+        preds: List[List[jnp.ndarray]] = []
+        for i in range(self.nstack):
+            f = HourglassAE(depth=self.hourglass_depth,
+                            features=self.inp_dim, increase=self.increase,
+                            dtype=self.dtype)(x, train)
+            f = ConvBlock(self.inp_dim, kernel_size=3, use_bn=False,
+                          dtype=self.dtype)(f, train)
+            f = ConvBlock(self.inp_dim, kernel_size=3, use_bn=False,
+                          dtype=self.dtype)(f, train)
+            pred = ConvBlock(self.oup_dim, kernel_size=1, use_bn=False,
+                             relu=False, dtype=self.dtype)(f, train)
+            preds.append([pred.astype(jnp.float32)])
+            if i != self.nstack - 1:
+                x = (x
+                     + ConvBlock(self.inp_dim, kernel_size=1, relu=False,
+                                 use_bn=False, dtype=self.dtype)(
+                         pred.astype(self.dtype), train)
+                     + ConvBlock(self.inp_dim, kernel_size=1, relu=False,
+                                 use_bn=False, dtype=self.dtype)(f, train))
         return preds
 
 
@@ -163,14 +275,16 @@ def build_model(config: Config, dtype=None) -> nn.Module:
     common = dict(nstack=m.nstack, inp_dim=m.inp_dim, oup_dim=oup,
                   increase=m.increase, hourglass_depth=m.hourglass_depth,
                   dtype=dtype)
-    if m.variant in ("imhn", "imhn_final"):
-        # imhn_final's structural deltas (compressed Features, pre-cache SE)
-        # are modelled by the same module for now; tracked as a TODO variant.
-        return PoseNet(cross_stack_residual=True,
+    if m.variant == "imhn":
+        return PoseNet(cross_stack_residual=True, remat=m.remat,
                        se_reduction=m.se_reduction, **common)
+    if m.variant == "imhn_final":
+        return PoseNetFinal(se_reduction=m.se_reduction, **common)
     if m.variant == "imhn_independent":
-        return PoseNet(cross_stack_residual=False,
+        return PoseNet(cross_stack_residual=False, remat=m.remat,
                        se_reduction=m.se_reduction, **common)
     if m.variant == "imhn_light":
         return PoseNetLight(**common)
+    if m.variant == "ae":
+        return PoseNetAE(**common)
     raise ValueError(f"unknown model variant '{m.variant}'")

@@ -1,0 +1,204 @@
+"""Tests: OKS evaluator + the final/AE model variants + end-to-end AP smoke.
+
+The AP smoke test is the unit-level analogue of the reference's COCOeval
+integration check (evaluate.py:616-621): decode GT-derived heatmaps of
+planted people and demand AP == 1.0 against their annotations.
+"""
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import default_inference_params, get_config
+from improved_body_parts_tpu.infer.decode import decode
+from improved_body_parts_tpu.infer.oks import (
+    average_precision,
+    evaluate_oks,
+    oks,
+)
+
+CFG = get_config("canonical")
+SK = CFG.skeleton
+PARAMS, _ = default_inference_params()
+
+
+class TestOKS:
+    def test_perfect_match(self):
+        gt = np.zeros((17, 3))
+        gt[:, 0] = np.arange(17) * 10
+        gt[:, 1] = 50
+        gt[:, 2] = 2
+        det = gt[:, :2].copy()
+        assert oks(det, gt, area=5000.0) == pytest.approx(1.0)
+
+    def test_distance_decay(self):
+        gt = np.zeros((17, 3))
+        gt[:, 2] = 2
+        near = np.full((17, 2), 5.0)
+        far = np.full((17, 2), 50.0)
+        assert oks(near, gt, 1000.0) > oks(far, gt, 1000.0)
+
+    def test_unlabeled_ignored(self):
+        gt = np.zeros((17, 3))
+        gt[0] = [10, 10, 2]  # only the nose is labeled
+        det = np.full((17, 2), 500.0)
+        det[0] = [10, 10]
+        assert oks(det, gt, 1000.0) == pytest.approx(1.0)
+
+    def test_average_precision_extremes(self):
+        # all detections true, covering all GT → AP 1
+        s = np.array([0.9, 0.8, 0.7])
+        assert average_precision(s, np.array([True] * 3), 3) == pytest.approx(
+            1.0, abs=0.01)
+        # all false → AP 0
+        assert average_precision(s, np.array([False] * 3), 3) == 0.0
+
+    def test_evaluate_oks_perfect(self):
+        gt = np.zeros((17, 3))
+        gt[:, 0] = np.arange(17) * 5 + 20
+        gt[:, 1] = 60
+        gt[:, 2] = 2
+        gts = {1: [{"keypoints": gt, "area": 3000.0}]}
+        dts = {1: [([tuple(p) for p in gt[:, :2]], 0.9)]}
+        res = evaluate_oks(gts, dts)
+        assert res["AP"] == pytest.approx(1.0, abs=0.01)
+        assert res["AR"] == pytest.approx(1.0, abs=0.01)
+
+    def test_evaluate_oks_false_positive_lowers_ap(self):
+        gt = np.zeros((17, 3))
+        gt[:, 0] = np.arange(17) * 5 + 20
+        gt[:, 1] = 60
+        gt[:, 2] = 2
+        gts = {1: [{"keypoints": gt, "area": 3000.0}]}
+        fp = [(float(x), 500.0) for x in range(17)]
+        dts = {1: [([tuple(p) for p in gt[:, :2]], 0.5), (fp, 0.9)]}
+        res = evaluate_oks(gts, dts)
+        assert res["AP"] < 1.0
+
+
+class TestEndToEndAP:
+    def test_decode_of_planted_people_reaches_ap_1(self):
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_decode import synth_maps, synth_person_joints
+
+        from improved_body_parts_tpu.config import COCO_PARTS
+
+        people = [synth_person_joints(60, 80, 300),
+                  synth_person_joints(300, 120, 260)]
+        heat, paf = synth_maps(people)
+        results = decode(heat, paf, PARAMS, SK, use_native=False)
+        assert len(results) == 2
+
+        gts = []
+        for p in people:
+            kp = np.zeros((17, 3))
+            for ci, part in enumerate(COCO_PARTS):
+                gi = SK.parts_dict[part]
+                kp[ci] = [p[0, gi, 0], p[0, gi, 1], 2]
+            xs, ys = kp[:, 0], kp[:, 1]
+            area = (xs.max() - xs.min()) * (ys.max() - ys.min())
+            gts.append({"keypoints": kp, "area": area})
+        res = evaluate_oks({1: gts}, {1: results})
+        assert res["AP"] == pytest.approx(1.0, abs=0.01), res
+        assert res["AR"] == pytest.approx(1.0, abs=0.01)
+
+
+class TestVariants:
+    def test_final_variant_forward(self):
+        import jax
+        import jax.numpy as jnp
+
+        from improved_body_parts_tpu.models import PoseNetFinal
+
+        model = PoseNetFinal(nstack=2, inp_dim=16, oup_dim=8, increase=8,
+                             hourglass_depth=2, se_reduction=4,
+                             dtype=jnp.float32)
+        imgs = jnp.zeros((1, 32, 32, 3))
+        v = model.init(jax.random.PRNGKey(0), imgs, train=False)
+        preds = model.apply(v, imgs, train=False)
+        assert len(preds) == 2 and len(preds[0]) == 3
+        assert preds[0][0].shape == (1, 8, 8, 8)
+
+    def test_ae_variant_forward(self):
+        import jax
+        import jax.numpy as jnp
+
+        from improved_body_parts_tpu.models import PoseNetAE
+
+        model = PoseNetAE(nstack=2, inp_dim=16, oup_dim=8, increase=8,
+                          hourglass_depth=2, dtype=jnp.float32)
+        imgs = jnp.zeros((1, 32, 32, 3))
+        v = model.init(jax.random.PRNGKey(0), imgs, train=False)
+        preds = model.apply(v, imgs, train=False)
+        # single full-resolution output per stack (ae_pose.py:50-56)
+        assert len(preds) == 2 and len(preds[0]) == 1
+        assert preds[0][0].shape == (1, 8, 8, 8)
+
+    def test_ae_config_is_trainable(self):
+        """The 'ae' registry config pairs the single-scale model with a
+        single-entry scale_weight so the loss consumes its outputs."""
+        import jax
+        import jax.numpy as jnp
+
+        from improved_body_parts_tpu.models import build_model
+        from improved_body_parts_tpu.ops import multi_task_loss
+
+        cfg = get_config("ae")
+        assert cfg.train.scale_weight == (1.0,)
+        cfg = cfg.replace(model=cfg.model.__class__(
+            nstack=2, inp_dim=16, increase=8, hourglass_depth=2,
+            variant="ae"))
+        model = build_model(cfg, dtype=jnp.float32)
+        imgs = jnp.zeros((1, 32, 32, 3))
+        v = model.init(jax.random.PRNGKey(0), imgs, train=False)
+        preds = model.apply(v, imgs, train=False)
+        gt = jnp.zeros((1, 8, 8, cfg.skeleton.num_layers))
+        mask = jnp.ones((1, 8, 8, 1))
+        loss = multi_task_loss(preds, gt, mask, cfg)
+        assert np.isfinite(float(loss))
+
+    def test_remat_via_config(self):
+        import jax
+        import jax.numpy as jnp
+
+        from improved_body_parts_tpu.models import build_model
+
+        cfg = get_config("tiny")
+        cfg = cfg.replace(model=cfg.model.__class__(
+            nstack=2, inp_dim=16, increase=8, hourglass_depth=2,
+            se_reduction=4, remat=True))
+        model = build_model(cfg, dtype=jnp.float32)
+        assert model.remat is True
+        imgs = jax.random.uniform(jax.random.PRNGKey(0), (1, 32, 32, 3))
+        v = model.init(jax.random.PRNGKey(0), imgs, train=False)
+
+        def f(params):
+            preds = model.apply(
+                {"params": params, "batch_stats": v["batch_stats"]},
+                imgs, train=False)
+            return sum(jnp.sum(p ** 2) for s in preds for p in s)
+
+        g = jax.grad(f)(v["params"])
+        assert max(float(jnp.abs(x).max()) for x in jax.tree.leaves(g)) > 0
+
+    def test_build_model_dispatches_all_variants(self):
+        import jax
+        import jax.numpy as jnp
+
+        from improved_body_parts_tpu.models import build_model
+
+        cfg = get_config("tiny")
+        for variant in ("imhn", "imhn_final", "imhn_independent",
+                        "imhn_light", "ae"):
+            c = cfg.replace(model=cfg.model.__class__(
+                nstack=1, inp_dim=16, increase=8, hourglass_depth=2,
+                se_reduction=4, variant=variant))
+            model = build_model(c, dtype=jnp.float32)
+            shapes = jax.eval_shape(
+                lambda k, m=model: m.init(k, jnp.zeros((1, 32, 32, 3)),
+                                          train=False),
+                jax.random.PRNGKey(0))
+            assert shapes["params"]
+        with pytest.raises(ValueError):
+            bad = cfg.replace(model=cfg.model.__class__(variant="nope"))
+            build_model(bad)
