@@ -143,11 +143,15 @@ class TestVariants:
         from improved_body_parts_tpu.models import build_model
         from improved_body_parts_tpu.ops import multi_task_loss
 
+        import dataclasses
+
         cfg = get_config("ae")
         assert cfg.train.scale_weight == (1.0,)
-        cfg = cfg.replace(model=cfg.model.__class__(
-            nstack=2, inp_dim=16, increase=8, hourglass_depth=2,
-            variant="ae"))
+        cfg = cfg.replace(
+            model=cfg.model.__class__(
+                nstack=2, inp_dim=16, increase=8, hourglass_depth=2,
+                variant="ae"),
+            train=dataclasses.replace(cfg.train, nstack_weight=(1.0, 1.0)))
         model = build_model(cfg, dtype=jnp.float32)
         imgs = jnp.zeros((1, 32, 32, 3))
         v = model.init(jax.random.PRNGKey(0), imgs, train=False)
