@@ -28,7 +28,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..config import InferenceParams, SkeletonConfig
-from ..ops.nms import keypoint_nms, refine_peaks
+from ..ops.nms import peak_mask_np, refine_peaks
 
 
 def find_peaks(heatmap: np.ndarray, params: InferenceParams,
@@ -38,19 +38,21 @@ def find_peaks(heatmap: np.ndarray, params: InferenceParams,
     :param heatmap: (H, W, >=num_parts) averaged keypoint maps
     :returns: per part, (n_i, 4) array [x, y, score, global id]
     """
-    import jax.numpy as jnp
+    heat32 = np.ascontiguousarray(heatmap[:, :, :num_parts], dtype=np.float32)
+    mask = peak_mask_np(heat32, thre=params.thre1)
 
-    suppressed = np.asarray(keypoint_nms(
-        jnp.asarray(heatmap[:, :, :num_parts], jnp.float32),
-        kernel=3, thre=params.thre1))
+    # one pass over the boolean volume in part-major order (the per-channel
+    # nonzero loop over float maps was the decode hot spot)
+    cs_all, ys_all, xs_all = np.nonzero(mask.transpose(2, 0, 1))
+    bounds = np.searchsorted(cs_all, np.arange(num_parts + 1))
 
     all_peaks: List[np.ndarray] = []
     peak_counter = 0
     for part in range(num_parts):
-        ys, xs = np.nonzero(suppressed[:, :, part])
+        lo, hi = bounds[part], bounds[part + 1]
+        xs, ys = xs_all[lo:hi], ys_all[lo:hi]
         x_ref, y_ref, score = refine_peaks(
-            heatmap[:, :, part].astype(np.float64), xs, ys,
-            params.offset_radius)
+            heat32[:, :, part], xs, ys, params.offset_radius)
         n = xs.shape[0]
         ids = np.arange(peak_counter, peak_counter + n, dtype=np.float64)
         all_peaks.append(

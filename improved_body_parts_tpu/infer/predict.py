@@ -120,8 +120,8 @@ class Predictor:
         """
         sk, prm, mp = self.skeleton, self.params, self.model_params
         oh, ow = image_bgr.shape[:2]
-        heat_avg = np.zeros((oh, ow, sk.heat_layers + 2), np.float64)
-        paf_avg = np.zeros((oh, ow, sk.paf_layers), np.float64)
+        heat_avg = np.zeros((oh, ow, sk.heat_layers + 2), np.float32)
+        paf_avg = np.zeros((oh, ow, sk.paf_layers), np.float32)
 
         multipliers = [s * mp.boxsize / oh for s in prm.scale_search]
         grid = [(s, a) for s in multipliers for a in prm.rotation_search]
@@ -141,7 +141,7 @@ class Predictor:
             img = padded.astype(np.float32) / 255.0
             maps = np.asarray(
                 self._ensemble_fn(img.shape[:2])(self.variables, img),
-                dtype=np.float64)
+                dtype=np.float32)
             maps = maps[:rh, :rw]  # unpad
             if angle != 0:
                 maps = cv2.warpAffine(maps, rot_back, (0, 0))

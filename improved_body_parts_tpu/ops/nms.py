@@ -30,6 +30,23 @@ def keypoint_nms(heat: jnp.ndarray, kernel: int = 3, thre: float = 0.1
     return jnp.where(keep, heat, 0.0)
 
 
+def peak_mask_np(heat: np.ndarray, thre: float = 0.1) -> np.ndarray:
+    """Boolean 3x3-NMS peak mask (reflect padding), NumPy host path — the
+    maps are already on the host after prediction, so a device round-trip
+    just for NMS would cost more than the op."""
+    padded = np.pad(heat, ((1, 1), (1, 1), (0, 0)), mode="reflect")
+    hmax = heat.copy()
+    for dy in range(3):
+        for dx in range(3):
+            if dy == 1 and dx == 1:
+                continue
+            np.maximum(hmax, padded[dy:dy + heat.shape[0],
+                                    dx:dx + heat.shape[1]], out=hmax)
+    return (hmax == heat) & (heat >= thre)
+
+
+
+
 @partial(jax.jit, static_argnames=("kernel_size",))
 def gaussian_blur(maps: jnp.ndarray, kernel_size: int = 5,
                   sigma: float = 3.0) -> jnp.ndarray:

@@ -25,6 +25,7 @@ import pytest  # noqa: E402
 
 
 def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running integration test")
     import jax
 
     # The env assignment above is too late when sitecustomize has already

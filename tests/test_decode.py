@@ -64,6 +64,19 @@ def two_people_maps():
     return synth_maps([p1, p2]), (p1, p2)
 
 
+def test_device_and_host_nms_agree(two_people_maps):
+    """The jitted (device-side) NMS and the host peak mask must not drift."""
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.ops.nms import keypoint_nms, peak_mask_np
+
+    (heat, _), _ = two_people_maps
+    heat32 = heat[:, :, :18].astype(np.float32)
+    device = np.asarray(keypoint_nms(jnp.asarray(heat32), kernel=3, thre=0.1))
+    host = np.where(peak_mask_np(heat32, thre=0.1), heat32, 0.0)
+    np.testing.assert_array_equal(device, host)
+
+
 class TestFindPeaks:
     def test_recovers_planted_keypoints(self, two_people_maps):
         (heat, _), (p1, p2) = two_people_maps
