@@ -5,32 +5,88 @@ Mirrors the reference's pure-network FPS benchmark
 README.md:67) on the flagship 4-stack IMHN with bf16 compute.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Self-protecting: backend bring-up runs under a watchdog (a wedged TPU claim
+hangs ``jax.devices()`` forever); on timeout the benchmark re-executes itself
+on the CPU backend so the driver still gets a result line (flagged in the
+unit string).
 """
 import json
+import os
 import sys
+import threading
 import time
 
 BASELINE_FPS = 38.5
+BACKEND_TIMEOUT_S = 300
+TOTAL_TIMEOUT_S = 1800
+
+
+def _watchdog(seconds, message):
+    def fire():
+        print(json.dumps({
+            "metric": "single_image_512x512_inference_fps",
+            "value": 0.0,
+            "unit": f"imgs/sec ({message})",
+            "vs_baseline": 0.0,
+        }), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _backend_ready(timeout_s):
+    """True if jax.devices() returns within timeout_s (it hangs forever when
+    the TPU claim is held by a dead client)."""
+    import jax
+
+    result = {}
+
+    def probe():
+        try:
+            result["devices"] = jax.devices()
+        except Exception as e:  # noqa: BLE001
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return "devices" in result
 
 
 def main():
+    total = _watchdog(TOTAL_TIMEOUT_S, "timeout")
+
+    fallback = os.environ.get("IBP_BENCH_CPU_FALLBACK") == "1"
+    if not fallback and not _backend_ready(BACKEND_TIMEOUT_S):
+        # re-exec on CPU; the stuck backend thread dies with this process
+        env = dict(os.environ)
+        env["IBP_BENCH_CPU_FALLBACK"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
+                  env)
+
     import jax
 
-    sys.path.insert(0, ".")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from __graft_entry__ import entry
 
     forward, (variables, imgs) = entry()
     fn = jax.jit(forward)
 
-    out = fn(variables, imgs)  # compile
+    out = fn(variables, imgs)  # compile (also the warmup on the slow path)
     jax.block_until_ready(out)
 
-    # warmup
-    for _ in range(5):
+    warmup = 1 if fallback else 5
+    for _ in range(warmup):
         out = fn(variables, imgs)
     jax.block_until_ready(out)
 
-    iters = 50
+    iters = 3 if fallback else 50
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(variables, imgs)
@@ -38,10 +94,12 @@ def main():
     dt = time.perf_counter() - t0
 
     fps = iters / dt
+    unit = "imgs/sec (cpu-fallback)" if fallback else "imgs/sec"
+    total.cancel()
     print(json.dumps({
         "metric": "single_image_512x512_inference_fps",
         "value": round(fps, 2),
-        "unit": "imgs/sec",
+        "unit": unit,
         "vs_baseline": round(fps / BASELINE_FPS, 3),
     }))
 

@@ -254,6 +254,9 @@ class TrainConfig:
     # semantics, loss_model.py:39); False = caller divides (parallel twin).
     normalize_by_global_batch: bool = True
     bf16_compute: bool = True
+    # route the focal loss through the Pallas kernel (ops/pallas_focal.py);
+    # off by default — the XLA path is the validated production path
+    use_pallas_loss: bool = False
 
 
 @dataclass(frozen=True)

@@ -49,16 +49,25 @@ def downsample_mask(mask: jnp.ndarray, size) -> jnp.ndarray:
     return jnp.where(mask < 0.5, 0.0, mask)
 
 
+def _chan_scale(num_layers: int, heat_start: int, bkg_start: int,
+                multi_task_weight: float, keypoint_task_weight: float,
+                dtype=jnp.float32) -> jnp.ndarray:
+    """Per-channel task weights (loss_model.py:146-149): person-mask channel
+    × multi_task_weight, keypoint channels × keypoint_task_weight."""
+    chan = jnp.ones((num_layers,), dtype=dtype)
+    chan = chan.at[heat_start:bkg_start].mul(keypoint_task_weight)
+    chan = chan.at[bkg_start].mul(multi_task_weight)
+    return chan
+
+
 def _modulated_mask(mask: jnp.ndarray, num_layers: int, heat_start: int,
                     bkg_start: int, multi_task_weight: float,
                     keypoint_task_weight: float) -> jnp.ndarray:
-    """Broadcast the (N,H,W,1) miss mask over channels and scale task groups
-    (loss_model.py:146-149): person-mask channel × multi_task_weight,
-    keypoint channels × keypoint_task_weight."""
-    chan_scale = jnp.ones((num_layers,), dtype=mask.dtype)
-    chan_scale = chan_scale.at[heat_start:bkg_start].mul(keypoint_task_weight)
-    chan_scale = chan_scale.at[bkg_start].mul(multi_task_weight)
-    return mask * chan_scale  # (N,H,W,1)*(C,) → (N,H,W,C)
+    """Broadcast the (N,H,W,1) miss mask over channels and apply the task
+    weights: (N,H,W,1)*(C,) → (N,H,W,C)."""
+    chan = _chan_scale(num_layers, heat_start, bkg_start, multi_task_weight,
+                       keypoint_task_weight, mask.dtype)
+    return mask * chan
 
 
 def focal_l2(pred: jnp.ndarray, gt: jnp.ndarray, mask: jnp.ndarray,
@@ -82,7 +91,8 @@ def l2(pred: jnp.ndarray, gt: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
 
 def multi_task_loss(preds: Sequence[Sequence[jnp.ndarray]], gt: jnp.ndarray,
                     mask_miss: jnp.ndarray, config: Config,
-                    use_focal: bool = True) -> jnp.ndarray:
+                    use_focal: bool = True,
+                    use_pallas: bool = False) -> jnp.ndarray:
     """Total training loss over nstack stacks × 5 scales.
 
     :param preds: [nstack][5] NHWC tensors from the model (fp32)
@@ -99,17 +109,30 @@ def multi_task_loss(preds: Sequence[Sequence[jnp.ndarray]], gt: jnp.ndarray,
     scale_w = list(tr.scale_weight)
     assert len(scale_w) == nscale and nstack_w.shape[0] == nstack
 
+    use_pallas = use_pallas and use_focal
+    if use_pallas:
+        # hand-scheduled fused kernel (ops/pallas_focal.py); channel
+        # modulation passed as a vector instead of a materialized mask
+        from .pallas_focal import focal_l2_pallas
+
+        chan = _chan_scale(sk.num_layers, sk.heat_start, sk.bkg_start,
+                           tr.multi_task_weight, tr.keypoint_task_weight)
+        interpret = jax.default_backend() == "cpu"
+
     loss_fn = focal_l2 if use_focal else l2
     total = 0.0
     for s in range(nscale):
         pred_s = jnp.stack([preds[i][s] for i in range(nstack)], axis=0)
         size = pred_s.shape[2:4]
-        gt_s = avg_pool_to(gt, size)[None]
+        gt_s = avg_pool_to(gt, size)
         mask_s = downsample_mask(mask_miss, size)
-        mask_s = _modulated_mask(
-            mask_s, sk.num_layers, sk.heat_start, sk.bkg_start,
-            tr.multi_task_weight, tr.keypoint_task_weight)[None]
-        per_stack = loss_fn(pred_s, gt_s, mask_s)
+        if use_pallas:
+            per_stack = focal_l2_pallas(pred_s, gt_s, mask_s, chan, interpret)
+        else:
+            mod = _modulated_mask(
+                mask_s, sk.num_layers, sk.heat_start, sk.bkg_start,
+                tr.multi_task_weight, tr.keypoint_task_weight)
+            per_stack = loss_fn(pred_s, gt_s[None], mod[None])
         total = total + (per_stack * nstack_w).sum() / nstack_w.sum() * scale_w[s]
 
     total = total / sum(scale_w)

@@ -45,15 +45,17 @@ def make_train_step(model, config: Config,
                 preds = model.apply(
                     {"params": params, "batch_stats": state.batch_stats},
                     images, train=False)
-                return (multi_task_loss(preds, gt, mask_miss, config,
-                                        use_focal=use_focal),
+                return (multi_task_loss(
+                    preds, gt, mask_miss, config, use_focal=use_focal,
+                    use_pallas=config.train.use_pallas_loss),
                         state.batch_stats)
             outputs = model.apply(
                 {"params": params, "batch_stats": state.batch_stats},
                 images, train=True, mutable=["batch_stats"])
             preds, mutated = outputs
             loss = multi_task_loss(preds, gt, mask_miss, config,
-                                   use_focal=use_focal)
+                                   use_focal=use_focal,
+                                   use_pallas=config.train.use_pallas_loss)
             return loss, mutated["batch_stats"]
 
         (loss, new_bs), grads = jax.value_and_grad(
@@ -87,6 +89,7 @@ def make_eval_step(model, config: Config, use_focal: bool = True) -> Callable:
             {"params": state.params, "batch_stats": state.batch_stats},
             images, train=False)
         return multi_task_loss(preds, gt, mask_miss, config,
-                               use_focal=use_focal)
+                               use_focal=use_focal,
+                               use_pallas=config.train.use_pallas_loss)
 
     return jax.jit(eval_step)
