@@ -6,11 +6,11 @@ from .dataset import (
     host_shard,
 )
 from .fixture import build_fixture
-from .heatmapper import Heatmapper
+from .heatmapper import Heatmapper, OffsetMapper
 from .transformer import AugmentParams, Transformer
 
 __all__ = [
     "CocoPoseDataset", "batches", "convert_joints", "epoch_permutation",
-    "host_shard", "build_fixture", "Heatmapper", "AugmentParams",
+    "host_shard", "build_fixture", "Heatmapper", "OffsetMapper", "AugmentParams",
     "Transformer",
 ]

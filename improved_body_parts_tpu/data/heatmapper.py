@@ -19,6 +19,7 @@ keypoint channels at bkg_start+1.
 from __future__ import annotations
 
 from math import ceil, log, sqrt
+from typing import Tuple
 
 import cv2
 import numpy as np
@@ -165,6 +166,54 @@ class Heatmapper:
 
         nz = count > 0  # average overlapping limb instances by count
         acc[nz] /= count[nz]
+
+
+class OffsetMapper:
+    """Sub-pixel offset ground truth (reference: py_data_heatmapper.py:242-299
+    ``put_offset`` — dormant in the reference's final path, kept for the
+    offset-regression experiments of posenet_final/config_final).
+
+    All keypoints share one (x, y) offset channel pair; offsets are normalized
+    by (offset_size * stride), averaged where windows overlap, and the mask
+    marks touched cells.
+    """
+
+    def __init__(self, config: SkeletonConfig):
+        self.config = config
+        hm = Heatmapper(config)
+        self.offset_size = hm.gaussian_size // 2 + 1
+        self.grid_x = hm.grid_x
+        self.grid_y = hm.grid_y
+
+    def create_offsets(self, joints: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """(H, W, 2) offset vectors + (H, W, 2) mask, channel-last."""
+        cfg = self.config
+        h, w = cfg.grid_shape
+        offsets = np.zeros((h, w, 2), dtype=np.float32)
+        counts = np.zeros((h, w, 2), dtype=np.float32)
+        g = self.offset_size // 2
+        norm = self.offset_size * cfg.stride
+
+        vis = joints[:, :, 2] < 2
+        pi, ki = np.nonzero(vis)
+        for x, y in zip(joints[pi, ki, 0], joints[pi, ki, 1]):
+            cx = int(round(x / cfg.stride))
+            cy = int(round(y / cfg.stride))
+            x0, x1 = max(cx - g, 0), min(cx + g + 1, w)
+            y0, y1 = max(cy - g, 0), min(cy + g + 1, h)
+            if x1 <= 0 or y1 <= 0 or x0 >= w or y0 >= h:
+                continue
+            ox = (self.grid_x[x0:x1] - x) / norm
+            oy = (self.grid_y[y0:y1] - y) / norm
+            offsets[y0:y1, x0:x1, 0] += ox[None, :]
+            offsets[y0:y1, x0:x1, 1] += oy[:, None]
+            counts[y0:y1, x0:x1, :] += 1.0
+
+        nz = counts > 0
+        offsets[nz] /= counts[nz]
+        mask = nz.astype(np.float32)
+        return offsets, mask
 
 
 def limb_response(X, Y, sigma, x1, y1, x2, y2, thresh=0.01):

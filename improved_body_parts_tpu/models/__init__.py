@@ -14,6 +14,7 @@ from .posenet import (
     PoseNetAE,
     PoseNetFinal,
     PoseNetLight,
+    PoseNetWide,
     build_model,
 )
 
@@ -21,5 +22,6 @@ __all__ = [
     "Backbone", "BackboneSimple", "ConvBlock", "Hourglass", "HourglassAE",
     "HourglassFinal", "Residual", "SELayer",
     "Features", "PoseNet", "PoseNetAE", "PoseNetFinal", "PoseNetLight",
+    "PoseNetWide",
     "build_model",
 ]

@@ -222,6 +222,53 @@ class PoseNetFinal(nn.Module):
         return preds
 
 
+class PoseNetWide(nn.Module):
+    """3-stage wide IMHN (reference: models/posenet2.py): dilated backbone,
+    full-width SE attention applied before the cache add, Features and output
+    heads kept at the full per-scale width (inp_dim + j*increase) instead of
+    compressing to inp_dim, merges without BN (posenet2.py:65-75)."""
+    nstack: int = 3
+    inp_dim: int = 256
+    oup_dim: int = 50
+    increase: int = 128
+    hourglass_depth: int = 4
+    se_reduction: int = 16
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        x = images.astype(self.dtype)
+        x = Backbone(features=self.inp_dim, **kw)(x, train)
+
+        nscale = self.hourglass_depth + 1
+        preds: List[List[jnp.ndarray]] = []
+        cache: List[Optional[jnp.ndarray]] = [None] * nscale
+        for i in range(self.nstack):
+            feats = Hourglass(
+                depth=self.hourglass_depth, features=self.inp_dim,
+                increase=self.increase, **kw)(x, train)
+            attended = [
+                SELayer(reduction=self.se_reduction, dtype=self.dtype)(f)
+                for f in feats]
+            feats = (attended if i == 0 else
+                     [a + c for a, c in zip(attended, cache)])
+            # full-width per-scale heads: 2x Conv3x3 at inp_dim + j*increase
+            head = []
+            for f in feats:
+                width = f.shape[-1]
+                f = ConvBlock(width, kernel_size=3, **kw)(f, train)
+                f = ConvBlock(width, kernel_size=3, **kw)(f, train)
+                head.append(f)
+            preds_instack, x = _regress_and_merge(
+                head, x, cache, i == self.nstack - 1, self.inp_dim,
+                self.increase, self.oup_dim, kw, self.dtype, train,
+                merge_bn=False)
+            preds.append(preds_instack)
+        return preds
+
+
 class PoseNetAE(nn.Module):
     """Classic Associative-Embedding-style stacked hourglass: conv stem,
     ONE full-resolution output per stack, pred+feature merge into the next
@@ -285,6 +332,8 @@ def build_model(config: Config, dtype=None) -> nn.Module:
                        se_reduction=m.se_reduction, **common)
     if m.variant == "imhn_light":
         return PoseNetLight(**common)
+    if m.variant == "imhn_wide":
+        return PoseNetWide(se_reduction=m.se_reduction, **common)
     if m.variant == "ae":
         return PoseNetAE(**common)
     raise ValueError(f"unknown model variant '{m.variant}'")

@@ -1,4 +1,6 @@
-from .losses import avg_pool_to, downsample_mask, focal_l2, l2, multi_task_loss
+from .losses import avg_pool_to, downsample_mask, focal_l2, l1, l2, multi_task_loss
+from .nms import gaussian_blur, keypoint_nms, peak_mask_np, refine_peaks
 
-__all__ = ["avg_pool_to", "downsample_mask", "focal_l2", "l2",
-           "multi_task_loss"]
+__all__ = ["avg_pool_to", "downsample_mask", "focal_l2", "l1", "l2",
+           "multi_task_loss", "gaussian_blur", "keypoint_nms",
+           "peak_mask_np", "refine_peaks"]

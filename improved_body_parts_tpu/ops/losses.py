@@ -89,6 +89,12 @@ def l2(pred: jnp.ndarray, gt: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     return ((pred - gt) ** 2 * mask).sum(axis=(1, 2, 3, 4))
 
 
+def l1(pred: jnp.ndarray, gt: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Masked L1 for offset regression (loss_model.py:83-100); per-stack sums
+    over (nstack, N, H, W, C)."""
+    return (jnp.abs(pred - gt) * mask).sum(axis=(1, 2, 3, 4))
+
+
 def multi_task_loss(preds: Sequence[Sequence[jnp.ndarray]], gt: jnp.ndarray,
                     mask_miss: jnp.ndarray, config: Config,
                     use_focal: bool = True,
@@ -117,7 +123,9 @@ def multi_task_loss(preds: Sequence[Sequence[jnp.ndarray]], gt: jnp.ndarray,
 
         chan = _chan_scale(sk.num_layers, sk.heat_start, sk.bkg_start,
                            tr.multi_task_weight, tr.keypoint_task_weight)
-        interpret = jax.default_backend() == "cpu"
+        # the kernel is written for the TPU Mosaic pipeline; interpret
+        # everywhere else so the flag degrades gracefully off-TPU
+        interpret = jax.default_backend() != "tpu"
 
     loss_fn = focal_l2 if use_focal else l2
     total = 0.0

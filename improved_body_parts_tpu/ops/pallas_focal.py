@@ -18,12 +18,10 @@ mask stays (N, H, W, 1).
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 
 def _fwd_kernel(pred_ref, gt_ref, mask_ref, chan_ref, out_ref):

@@ -1,0 +1,87 @@
+"""Tests: offset-map GT synthesis, masked L1 loss, the wide IMHN variant."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import get_config
+from improved_body_parts_tpu.data import OffsetMapper
+from improved_body_parts_tpu.ops import l1
+
+CFG = get_config("canonical")
+SK = CFG.skeleton
+
+
+class TestOffsetMapper:
+    def setup_method(self):
+        self.om = OffsetMapper(SK)
+
+    def _joints(self, coords):
+        joints = np.zeros((1, SK.num_parts, 3), np.float32)
+        joints[:, :, 2] = 2
+        for part, x, y in coords:
+            joints[0, part] = [x, y, 1]
+        return joints
+
+    def test_offset_at_exact_center_is_zero(self):
+        # joint exactly on a stride-center → zero offset at that cell
+        gx, gy = 40, 60
+        x = gx * SK.stride + SK.stride / 2 - 0.5
+        y = gy * SK.stride + SK.stride / 2 - 0.5
+        off, mask = self.om.create_offsets(self._joints([(0, x, y)]))
+        assert off.shape == (*SK.grid_shape, 2)
+        assert mask[gy, gx, 0] == 1.0 and mask[gy, gx, 1] == 1.0
+        assert off[gy, gx, 0] == pytest.approx(0.0, abs=1e-6)
+        assert off[gy, gx, 1] == pytest.approx(0.0, abs=1e-6)
+        # neighbour cell: offset = stride / (offset_size * stride)
+        expect = SK.stride / (self.om.offset_size * SK.stride)
+        assert off[gy, gx + 1, 0] == pytest.approx(expect, abs=1e-6)
+        assert off[gy, gx + 1, 1] == pytest.approx(0.0, abs=1e-6)
+
+    def test_overlapping_windows_average(self):
+        x = 40 * SK.stride + SK.stride / 2 - 0.5
+        y = 60 * SK.stride + SK.stride / 2 - 0.5
+        joints = self._joints([(0, x, y), (1, x, y)])  # two joints, same spot
+        off, mask = self.om.create_offsets(joints)
+        single, _ = self.om.create_offsets(self._joints([(0, x, y)]))
+        np.testing.assert_allclose(off, single, atol=1e-6)
+
+    def test_untouched_cells_masked_out(self):
+        off, mask = self.om.create_offsets(self._joints([(0, 100.0, 100.0)]))
+        assert mask[0, 0, 0] == 0.0 and off[0, 0, 0] == 0.0
+        assert mask.sum() > 0
+
+    def test_offscreen_joint_skipped(self):
+        off, mask = self.om.create_offsets(
+            self._joints([(0, -900.0, -900.0)]))
+        assert mask.sum() == 0.0
+
+
+def test_l1_manual_value():
+    pred = jnp.full((1, 1, 2, 2, 2), 0.5)
+    gt = jnp.zeros((1, 1, 2, 2, 2))
+    mask = jnp.ones_like(gt).at[0, 0, 0].set(0.0)
+    # 2 cells × 2 channels masked out of 4 cells → 4 remaining × |0.5|
+    assert float(l1(pred, gt, mask)[0]) == pytest.approx(0.5 * 4)
+
+
+def test_wide_variant_forward_and_dispatch():
+    from improved_body_parts_tpu.models import PoseNetWide, build_model
+
+    model = PoseNetWide(nstack=2, inp_dim=16, oup_dim=8, increase=8,
+                        hourglass_depth=2, se_reduction=4, dtype=jnp.float32)
+    imgs = jnp.zeros((1, 32, 32, 3))
+    v = model.init(jax.random.PRNGKey(0), imgs, train=False)
+    preds = model.apply(v, imgs, train=False)
+    assert len(preds) == 2 and len(preds[0]) == 3
+    assert preds[0][0].shape == (1, 8, 8, 8)
+
+    cfg = get_config("tiny")
+    cfg = cfg.replace(model=cfg.model.__class__(
+        nstack=1, inp_dim=16, increase=8, hourglass_depth=2,
+        se_reduction=4, variant="imhn_wide"))
+    shapes = jax.eval_shape(
+        lambda k: build_model(cfg, dtype=jnp.float32).init(
+            k, jnp.zeros((1, 32, 32, 3)), train=False),
+        jax.random.PRNGKey(0))
+    assert shapes["params"]
