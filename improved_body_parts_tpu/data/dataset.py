@@ -143,8 +143,10 @@ def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
             ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Yield batched (images, mask_miss, labels) for one epoch.
 
-    ``num_workers > 0`` generates samples in a process pool (the reference's
-    DataLoader workers, train_distributed.py:205-213); 0 is synchronous.
+    ``num_workers > 0`` generates samples in a spawn-based process pool (the
+    reference's DataLoader workers, train_distributed.py:205-213); 0 is
+    synchronous.  Spawn requires an importable ``__main__`` — from a REPL or
+    stdin script use ``num_workers=0``.
     """
     perm = epoch_permutation(len(dataset), epoch, dataset.seed)
     shard = host_shard(perm, process_index, process_count, batch_size)
@@ -161,7 +163,10 @@ def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
 
     import multiprocessing as mp
 
-    ctx = mp.get_context("fork")
+    # spawn, not fork: the parent is JAX-multithreaded and fork from a
+    # multithreaded process is a deadlock hazard (py3.12 warns); workers
+    # rebuild their state from pickled initargs anyway
+    ctx = mp.get_context("spawn")
     with ctx.Pool(num_workers, initializer=_worker_init,
                   initargs=(dataset.h5_path, dataset.config, dataset.augment,
                             dataset.seed)) as pool:
