@@ -32,14 +32,18 @@ from ..ops.nms import peak_mask_np, refine_peaks
 
 
 def find_peaks(heatmap: np.ndarray, params: InferenceParams,
-               num_parts: int = 18) -> List[np.ndarray]:
+               num_parts: int = 18,
+               peak_mask: Optional[np.ndarray] = None) -> List[np.ndarray]:
     """Peak lists per keypoint channel (reference: evaluate.py:169-203).
 
     :param heatmap: (H, W, >=num_parts) averaged keypoint maps
+    :param peak_mask: optional precomputed boolean NMS mask (the on-device
+        fast path, Predictor.predict_fast); computed on the host otherwise
     :returns: per part, (n_i, 4) array [x, y, score, global id]
     """
     heat32 = np.ascontiguousarray(heatmap[:, :, :num_parts], dtype=np.float32)
-    mask = peak_mask_np(heat32, thre=params.thre1)
+    mask = (peak_mask[:, :, :num_parts] if peak_mask is not None
+            else peak_mask_np(heat32, thre=params.thre1))
 
     # one pass over the boolean volume in part-major order (the per-channel
     # nonzero loop over float maps was the decode hot spot)
@@ -313,11 +317,12 @@ def subsets_to_keypoints(subset: np.ndarray, candidate: np.ndarray,
 
 
 def assemble(heatmap: np.ndarray, paf: np.ndarray, params: InferenceParams,
-             skeleton: SkeletonConfig, use_native: bool = True
+             skeleton: SkeletonConfig, use_native: bool = True,
+             peak_mask: Optional[np.ndarray] = None
              ) -> Tuple[np.ndarray, np.ndarray]:
     """(heat, paf) maps → (subset, candidate): peaks + connection scoring +
     greedy assembly, dispatched to the native C++ path when built."""
-    all_peaks = find_peaks(heatmap, params, skeleton.num_parts)
+    all_peaks = find_peaks(heatmap, params, skeleton.num_parts, peak_mask)
     image_size = heatmap.shape[0]
     if use_native:
         from .native import native_available, native_find_connections_people
@@ -332,8 +337,19 @@ def assemble(heatmap: np.ndarray, paf: np.ndarray, params: InferenceParams,
 
 
 def decode(heatmap: np.ndarray, paf: np.ndarray, params: InferenceParams,
-           skeleton: SkeletonConfig, use_native: bool = True):
+           skeleton: SkeletonConfig, use_native: bool = True,
+           peak_mask: Optional[np.ndarray] = None,
+           coord_scale: Optional[Tuple[float, float]] = None):
     """Full decode: (H,W,heat+bkg) + (H,W,paf) maps → list of
-    (coco keypoints, score) (reference: evaluate.py:501-543 ``process``)."""
-    subset, candidate = assemble(heatmap, paf, params, skeleton, use_native)
+    (coco keypoints, score) (reference: evaluate.py:501-543 ``process``).
+
+    ``coord_scale`` maps decoded coordinates back to original-image space
+    when decoding at network-input resolution (Predictor.predict_fast).
+    """
+    subset, candidate = assemble(heatmap, paf, params, skeleton, use_native,
+                                 peak_mask)
+    if coord_scale is not None and len(candidate):
+        candidate = candidate.copy()
+        candidate[:, 0] *= coord_scale[0]
+        candidate[:, 1] *= coord_scale[1]
     return subsets_to_keypoints(subset, candidate, skeleton)

@@ -27,13 +27,27 @@ from .predict import Predictor
 
 def process_image(predictor: Predictor, image_bgr: np.ndarray,
                   params: InferenceParams, use_native: bool = True,
-                  timer: Optional[AverageMeter] = None):
+                  timer: Optional[AverageMeter] = None,
+                  fast: bool = False):
     """predict + decode one image → [(coco keypoints, score)]
-    (reference: evaluate.py:501-543)."""
-    heat, paf = predictor.predict(image_bgr)
-    t0 = time.perf_counter()
-    results = decode(heat, paf, params, predictor.skeleton,
-                     use_native=use_native)
+    (reference: evaluate.py:501-543).
+
+    ``fast=True`` (single-scale protocol only) keeps NMS on-device and
+    decodes at network-input resolution, rescaling coordinates back
+    (Predictor.predict_fast) — the TPU-optimized path.
+    """
+    if fast:
+        heat, paf, peak_mask, coord_scale = predictor.predict_fast(
+            image_bgr, thre1=params.thre1)
+        t0 = time.perf_counter()
+        results = decode(heat, paf, params, predictor.skeleton,
+                         use_native=use_native, peak_mask=peak_mask,
+                         coord_scale=coord_scale)
+    else:
+        heat, paf = predictor.predict(image_bgr)
+        t0 = time.perf_counter()
+        results = decode(heat, paf, params, predictor.skeleton,
+                         use_native=use_native)
     if timer is not None:
         timer.update(time.perf_counter() - t0)
     return results
@@ -60,7 +74,8 @@ def validation(predictor: Predictor, anno_file: str, images_dir: str,
                dump_name: str = "tpu", validation_ids: Optional[Sequence[int]]
                = None, max_images: int = 500,
                params: Optional[InferenceParams] = None,
-               use_native: bool = True, results_dir: str = "results"):
+               use_native: bool = True, results_dir: str = "results",
+               fast: bool = False):
     """Run COCOeval on ``validation_ids`` (default: first ``max_images`` val
     ids — the reference's first-500 protocol, evaluate.py:597-598).
 
@@ -83,7 +98,8 @@ def validation(predictor: Predictor, anno_file: str, images_dir: str,
         if image is None:
             raise IOError(f"missing image {name}")
         keypoints[image_id] = process_image(predictor, image, params,
-                                            use_native, decode_timer)
+                                            use_native, decode_timer,
+                                            fast=fast)
 
     res_file = os.path.join(results_dir, f"person_keypoints_{dump_name}.json")
     format_results(keypoints, res_file)

@@ -73,23 +73,36 @@ class Predictor:
         self.params = params or d_params
         self.model_params = model_params or d_model_params
         self.bucket = max(bucket, self.model_params.max_downsample)
-        self._fns: Dict[Tuple[int, int], object] = {}
+        # jitted program cache keyed by (padded shape, with_peaks, thre1)
+        self._fns: Dict[Tuple[Tuple[int, int], bool, Optional[float]],
+                        object] = {}
 
     # ------------------------------------------------------------------ #
-    def _ensemble_fn(self, shape: Tuple[int, int]):
-        """Jitted: (H, W, 3) float image → (H, W, C) ensembled maps."""
-        if shape in self._fns:
-            return self._fns[shape]
+    def _ensemble_fn(self, shape: Tuple[int, int], with_peaks: bool = False,
+                     thre1: Optional[float] = None):
+        """Jitted: (H, W, 3) float image → (H, W, C) ensembled maps
+        (+ boolean keypoint peak mask when ``with_peaks`` — the on-device NMS
+        for the single-scale protocol, saving the host-side pass).
+
+        With ``with_peaks`` the function also takes (valid_h, valid_w)
+        scalars: responses beyond the valid (un-padded) region are excluded
+        from the NMS so pad-region activations can't suppress edge peaks.
+        """
+        key = (shape, with_peaks, thre1)
+        if key in self._fns:
+            return self._fns[key]
 
         import jax
         import jax.numpy as jnp
+
+        from ..ops.nms import keypoint_nms
 
         sk = self.skeleton
         flip_paf = jnp.asarray(sk.flip_paf_ord)
         flip_heat = jnp.asarray(sk.flip_heat_ord)
         stride = sk.stride
 
-        def fn(variables, img):
+        def ensemble(variables, img):
             both = jnp.stack([img, img[:, ::-1, :]], axis=0)
             preds = self.model.apply(variables, both, train=False)
             out = preds[-1][0]  # last stack, scale 0: (2, H/4, W/4, C)
@@ -101,12 +114,24 @@ class Predictor:
                     ) / 2
             maps = jnp.concatenate([paf, heat], axis=-1)
             h, w = maps.shape[0] * stride, maps.shape[1] * stride
-            maps = jax.image.resize(maps, (h, w, maps.shape[-1]),
+            return jax.image.resize(maps, (h, w, maps.shape[-1]),
                                     method="cubic")
-            return maps
+
+        if not with_peaks:
+            fn = ensemble
+        else:
+            def fn(variables, img, valid_h, valid_w):
+                maps = ensemble(variables, img)
+                kp = maps[..., sk.paf_layers:sk.paf_layers + sk.num_parts]
+                h, w = kp.shape[:2]
+                valid = ((jnp.arange(h)[:, None, None] < valid_h)
+                         & (jnp.arange(w)[None, :, None] < valid_w))
+                kp = jnp.where(valid, kp, -1e9)
+                peaks = keypoint_nms(kp, kernel=3, thre=thre1) > 0
+                return maps, peaks
 
         jitted = jax.jit(fn)
-        self._fns[shape] = jitted
+        self._fns[key] = jitted
         return jitted
 
     # ------------------------------------------------------------------ #
@@ -126,26 +151,79 @@ class Predictor:
         multipliers = [s * mp.boxsize / oh for s in prm.scale_search]
         grid = [(s, a) for s in multipliers for a in prm.rotation_search]
         for scale, angle in grid:
-            if scale * oh > mp.max_height or scale * ow > mp.max_width:
-                scale = min(mp.max_height / oh, mp.max_width / ow)
-            resized = cv2.resize(image_bgr, (0, 0), fx=scale, fy=scale,
-                                 interpolation=cv2.INTER_CUBIC)
+            rot_back = None
             if angle != 0:
+                scale = self._clamp_scale(scale, oh, ow)
+                resized = cv2.resize(image_bgr, (0, 0), fx=scale, fy=scale,
+                                     interpolation=cv2.INTER_CUBIC)
                 rc = (resized.shape[0] / 2, resized.shape[1] / 2)
                 rot = cv2.getRotationMatrix2D(rc, angle, 1)
                 rot_back = cv2.getRotationMatrix2D(rc, -angle, 1)
                 resized = cv2.warpAffine(resized, rot, (0, 0))
-            rh, rw = resized.shape[:2]
-            padded, _ = pad_right_down(resized, self.bucket, mp.pad_value)
-
-            img = padded.astype(np.float32) / 255.0
+                rh, rw = resized.shape[:2]
+                padded, _ = pad_right_down(resized, self.bucket, mp.pad_value)
+                img = padded.astype(np.float32) / 255.0
+            else:
+                img, (rh, rw) = self._prepare_input(image_bgr, scale)
             maps = np.asarray(
                 self._ensemble_fn(img.shape[:2])(self.variables, img),
                 dtype=np.float32)
             maps = maps[:rh, :rw]  # unpad
-            if angle != 0:
+            if rot_back is not None:
                 maps = cv2.warpAffine(maps, rot_back, (0, 0))
             maps = cv2.resize(maps, (ow, oh), interpolation=cv2.INTER_CUBIC)
             paf_avg += maps[..., :sk.paf_layers] / len(grid)
             heat_avg += maps[..., sk.paf_layers:] / len(grid)
         return heat_avg, paf_avg
+
+    # ------------------------------------------------------------------ #
+    def predict_fast(self, image_bgr: np.ndarray,
+                     thre1: Optional[float] = None):
+        """Single-scale fast path: ensemble + upsample + peak NMS all in one
+        on-device program; decode happens at network-input resolution and
+        coordinates are mapped back by the returned scale.
+
+        Only valid for a 1-entry scale/rotation grid (the default protocol,
+        utils/config scale_search=1).  Documented deviation from the
+        reference: the maps are not resized back to the original image size
+        before decoding — peak coordinates are rescaled instead.
+
+        :returns: (heat, paf, peak_mask, (sx, sy)) — maps at the scaled
+            resolution; multiply decoded (x, y) by (sx, sy) to land in
+            original-image coordinates.
+        """
+        sk, prm, mp = self.skeleton, self.params, self.model_params
+        if len(prm.scale_search) != 1 or tuple(prm.rotation_search) != (0.0,):
+            raise ValueError(
+                "predict_fast requires a single-entry scale/rotation grid")
+        if thre1 is None:
+            thre1 = prm.thre1
+        oh, ow = image_bgr.shape[:2]
+        scale = prm.scale_search[0] * mp.boxsize / oh
+        img, (rh, rw) = self._prepare_input(image_bgr, scale)
+        maps_d, peaks_d = self._ensemble_fn(
+            img.shape[:2], with_peaks=True, thre1=thre1)(
+            self.variables, img, rh, rw)
+        maps = np.asarray(maps_d, dtype=np.float32)[:rh, :rw]
+        peak_mask = np.asarray(peaks_d)[:rh, :rw]
+        heat = maps[..., sk.paf_layers:]
+        paf = maps[..., :sk.paf_layers]
+        return heat, paf, peak_mask, (ow / rw, oh / rh)
+
+    def _clamp_scale(self, scale: float, oh: int, ow: int) -> float:
+        mp = self.model_params
+        if scale * oh > mp.max_height or scale * ow > mp.max_width:
+            scale = min(mp.max_height / oh, mp.max_width / ow)
+        return scale
+
+    def _prepare_input(self, image_bgr: np.ndarray, scale: float):
+        """Shared preprocessing: clamp scale, cubic resize, bucket pad,
+        normalize to [0,1]; returns (image, (valid_h, valid_w))."""
+        oh, ow = image_bgr.shape[:2]
+        scale = self._clamp_scale(scale, oh, ow)
+        resized = cv2.resize(image_bgr, (0, 0), fx=scale, fy=scale,
+                             interpolation=cv2.INTER_CUBIC)
+        rh, rw = resized.shape[:2]
+        padded, _ = pad_right_down(resized, self.bucket,
+                                   self.model_params.pad_value)
+        return padded.astype(np.float32) / 255.0, (rh, rw)

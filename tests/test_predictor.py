@@ -124,6 +124,66 @@ def test_symmetric_person_decodes_through_full_predictor():
     assert abs(nose[0] - cx) < 4 and abs(nose[1] - 40) < 4
 
 
+def test_fast_path_matches_regular_decode():
+    """predict_fast (on-device NMS, scaled-resolution decode + coordinate
+    rescale) must land the same person within a couple of pixels of the
+    regular path."""
+    import dataclasses
+
+    from improved_body_parts_tpu.data.heatmapper import Heatmapper
+    from improved_body_parts_tpu.infer import decode
+
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_decode import synth_person_joints
+
+    h = w = 256
+    rng = np.random.default_rng(3)
+    joints = synth_person_joints(70, 40, 180).astype(np.float32)
+    small = dataclasses.replace(SK, width=w, height=h)
+    maps = Heatmapper(small).create_heatmaps(
+        joints, np.ones(small.grid_shape, np.float32))
+    maps = (maps + rng.uniform(0, 1e-6, maps.shape)).astype(np.float32)
+
+    pred = _stub_predictor(maps, boxsize=h)
+    img = np.zeros((h, w, 3), np.uint8)
+    params, _ = default_inference_params()
+
+    heat, paf = pred.predict(img)
+    regular = decode(heat.astype(np.float32), paf.astype(np.float32),
+                     params, SK)
+    fh, fp, mask, scale = pred.predict_fast(img)
+    assert mask.dtype == bool and mask.shape[:2] == fh.shape[:2]
+    fast = decode(fh, fp, params, SK, peak_mask=mask, coord_scale=scale)
+
+    # the invariant: the fast path reproduces the regular path (synthetic
+    # upsampled GT can split plateau peaks — both paths must agree on it)
+    assert len(regular) == len(fast) >= 1
+    best_r = max(regular, key=lambda r: r[1])
+    best_f = max(fast, key=lambda r: r[1])
+    matched = 0
+    for pa, pb in zip(best_r[0], best_f[0]):
+        if pa is None or pb is None or pa == (0.0, 0.0) or pb == (0.0, 0.0):
+            continue
+        assert abs(pa[0] - pb[0]) < 2.5 and abs(pa[1] - pb[1]) < 2.5, (pa, pb)
+        matched += 1
+    assert matched >= 10
+
+
+def test_fast_path_rejects_multi_scale_grid():
+    from improved_body_parts_tpu.config import InferenceParams
+    from improved_body_parts_tpu.infer import Predictor
+
+    rng = np.random.default_rng(0)
+    maps = rng.uniform(0, 1, (16, 16, SK.num_layers)).astype(np.float32)
+    params = InferenceParams(scale_search=(0.5, 1.0))
+    model_params = InferenceModelParams(boxsize=64)
+    pred = Predictor(StubModel(maps), {}, SK, params, model_params, bucket=64)
+    with pytest.raises(ValueError, match="single-entry"):
+        pred.predict_fast(np.zeros((64, 64, 3), np.uint8))
+
+
 def test_bucketing_reuses_programs():
     rng = np.random.default_rng(2)
     maps = rng.uniform(0, 1, (64, 64, SK.num_layers)).astype(np.float32)

@@ -40,6 +40,9 @@ def main():
     ap.add_argument("--dump-name", default="tpu")
     ap.add_argument("--no-native", action="store_true",
                     help="use the NumPy decoder instead of the C++ one")
+    ap.add_argument("--fast", action="store_true",
+                    help="single-scale fast path: on-device NMS, decode at "
+                         "network resolution")
     args = ap.parse_args()
 
     from improved_body_parts_tpu.infer.evaluate import validation
@@ -48,7 +51,8 @@ def main():
     coco_eval = validation(predictor, args.anno, args.images,
                            dump_name=args.dump_name,
                            max_images=args.max_images,
-                           use_native=not args.no_native)
+                           use_native=not args.no_native,
+                           fast=args.fast)
     print("AP:", coco_eval.stats[0])
 
 
