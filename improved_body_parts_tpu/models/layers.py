@@ -25,8 +25,11 @@ from flax import linen as nn
 
 # Weight init matching the reference (models/posenet.py:119-139):
 # conv N(0, 0.001), SE-dense N(0, 0.01), biases zero, BN (1, 0).
+# The AE lineage initializes convs at N(0, 0.01) (ae_pose.py weight init) —
+# without BN the smaller stddev collapses activations and gradients vanish.
 conv_init = nn.initializers.normal(stddev=0.001)
 dense_init = nn.initializers.normal(stddev=0.01)
+ae_conv_init = nn.initializers.normal(stddev=0.01)
 
 LEAKY_SLOPE = 0.01
 
@@ -59,6 +62,9 @@ class ConvBlock(nn.Module):
     use_bn: bool = True
     relu: bool = True
     dilation: int = 1
+    kernel_init: Any = conv_init
+    # activation; the AE lineage uses plain ReLU (ae_layer.py:53-54)
+    activation: Any = None  # None → LeakyReLU(0.01)
     dtype: Any = jnp.float32
     bn_axis_name: Optional[str] = None
 
@@ -70,7 +76,7 @@ class ConvBlock(nn.Module):
             kernel_dilation=(self.dilation, self.dilation),
             padding="SAME",
             use_bias=not self.use_bn,
-            kernel_init=conv_init,
+            kernel_init=self.kernel_init,
             dtype=self.dtype, param_dtype=jnp.float32)(x)
         if self.use_bn:
             x = nn.BatchNorm(
@@ -78,7 +84,7 @@ class ConvBlock(nn.Module):
                 axis_name=self.bn_axis_name,
                 dtype=self.dtype, param_dtype=jnp.float32)(x)
         if self.relu:
-            x = leaky_relu(x)
+            x = (self.activation or leaky_relu)(x)
         return x
 
 
@@ -277,7 +283,7 @@ class HourglassAE(nn.Module):
 
         def conv(feat, y, relu=True):
             y = nn.Conv(feat, (3, 3), padding="SAME", use_bias=True,
-                        kernel_init=conv_init, dtype=self.dtype,
+                        kernel_init=ae_conv_init, dtype=self.dtype,
                         param_dtype=jnp.float32)(y)
             return nn.relu(y) if relu else y
 

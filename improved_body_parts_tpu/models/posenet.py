@@ -34,6 +34,7 @@ from .layers import (
     HourglassFinal,
     Residual,
     SELayer,
+    ae_conv_init,
     max_pool_2x2,
 )
 
@@ -133,13 +134,16 @@ class PoseNet(nn.Module):
 
 
 class PoseNetLight(nn.Module):
-    """Light IMHN: plain conv stem and single-conv Features
-    (reference: models/posenet3.py:34-62)."""
+    """Light 4-stage IMHN (reference: models/posenet3.py): plain conv stem
+    (posenet3.py:56-62), full-width SE attention applied before the cache
+    add, single-conv full-width Features (posenet3.py:34-37), full-width
+    output heads and merges."""
     nstack: int = 4
     inp_dim: int = 256
     oup_dim: int = 50
     increase: int = 128
     hourglass_depth: int = 4
+    se_reduction: int = 16
     dtype: Any = jnp.float32
     bn_axis_name: Optional[str] = None
 
@@ -147,12 +151,11 @@ class PoseNetLight(nn.Module):
     def __call__(self, images, train: bool = False):
         kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
         x = images.astype(self.dtype)
-        # stem: 7x7/2 conv → res → pool → res → res (posenet3.py:56-62)
         x = ConvBlock(64, kernel_size=7, stride=2, **kw)(x, train)
-        x = Residual(128, **kw)(x, train)
+        x = ConvBlock(128, kernel_size=3, **kw)(x, train)
         x = max_pool_2x2(x)
-        x = Residual(128, **kw)(x, train)
-        x = Residual(self.inp_dim, **kw)(x, train)
+        x = ConvBlock(128, kernel_size=3, **kw)(x, train)
+        x = ConvBlock(self.inp_dim, kernel_size=3, **kw)(x, train)
 
         nscale = self.hourglass_depth + 1
         preds: List[List[jnp.ndarray]] = []
@@ -161,9 +164,12 @@ class PoseNetLight(nn.Module):
             feats = Hourglass(
                 depth=self.hourglass_depth, features=self.inp_dim,
                 increase=self.increase, **kw)(x, train)
-            if i > 0:
-                feats = [f + c for f, c in zip(feats, cache)]
-            feats = [ConvBlock(self.inp_dim, kernel_size=3, **kw)(f, train)
+            attended = [
+                SELayer(reduction=self.se_reduction, dtype=self.dtype)(f)
+                for f in feats]
+            feats = (attended if i == 0 else
+                     [a + c for a, c in zip(attended, cache)])
+            feats = [ConvBlock(f.shape[-1], kernel_size=3, **kw)(f, train)
                      for f in feats]
             preds_instack, x = _regress_and_merge(
                 feats, x, cache, i == self.nstack - 1, self.inp_dim,
@@ -279,11 +285,15 @@ class PoseNetAE(nn.Module):
     increase: int = 128
     hourglass_depth: int = 4
     dtype: Any = jnp.float32
-    bn_axis_name: Optional[str] = None
+    # note: no bn_axis_name — the AE lineage is BN-free by design
 
     @nn.compact
     def __call__(self, images, train: bool = False):
-        kw = dict(dtype=self.dtype, bn_axis_name=self.bn_axis_name)
+        # the reference AE network runs without BN (ae_pose.py Network
+        # default bn=False; its conv blocks always carry a bias), with plain
+        # ReLU (ae_layer.py:53-54) and N(0, 0.01) conv init
+        kw = dict(dtype=self.dtype, use_bn=False, kernel_init=ae_conv_init,
+                  activation=nn.relu)
         x = images.astype(self.dtype)
         x = ConvBlock(64, kernel_size=7, stride=2, **kw)(x, train)
         x = ConvBlock(128, kernel_size=3, **kw)(x, train)
@@ -296,20 +306,17 @@ class PoseNetAE(nn.Module):
             f = HourglassAE(depth=self.hourglass_depth,
                             features=self.inp_dim, increase=self.increase,
                             dtype=self.dtype)(x, train)
-            f = ConvBlock(self.inp_dim, kernel_size=3, use_bn=False,
-                          dtype=self.dtype)(f, train)
-            f = ConvBlock(self.inp_dim, kernel_size=3, use_bn=False,
-                          dtype=self.dtype)(f, train)
-            pred = ConvBlock(self.oup_dim, kernel_size=1, use_bn=False,
-                             relu=False, dtype=self.dtype)(f, train)
+            f = ConvBlock(self.inp_dim, kernel_size=3, **kw)(f, train)
+            f = ConvBlock(self.inp_dim, kernel_size=3, **kw)(f, train)
+            pred = ConvBlock(self.oup_dim, kernel_size=1, relu=False,
+                             **kw)(f, train)
             preds.append([pred.astype(jnp.float32)])
             if i != self.nstack - 1:
                 x = (x
                      + ConvBlock(self.inp_dim, kernel_size=1, relu=False,
-                                 use_bn=False, dtype=self.dtype)(
-                         pred.astype(self.dtype), train)
+                                 **kw)(pred.astype(self.dtype), train)
                      + ConvBlock(self.inp_dim, kernel_size=1, relu=False,
-                                 use_bn=False, dtype=self.dtype)(f, train))
+                                 **kw)(f, train))
         return preds
 
 
@@ -331,7 +338,7 @@ def build_model(config: Config, dtype=None) -> nn.Module:
         return PoseNet(cross_stack_residual=False, remat=m.remat,
                        se_reduction=m.se_reduction, **common)
     if m.variant == "imhn_light":
-        return PoseNetLight(**common)
+        return PoseNetLight(se_reduction=m.se_reduction, **common)
     if m.variant == "imhn_wide":
         return PoseNetWide(se_reduction=m.se_reduction, **common)
     if m.variant == "ae":

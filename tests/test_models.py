@@ -20,6 +20,15 @@ from improved_body_parts_tpu.models.layers import (
 
 REF_PARAM_COUNT = 128_998_760
 REF_BN_STATS = 207_744
+# measured on the reference variant networks (same ctor args, bn=True except
+# ae which runs bn=False): posenet_final.py, posenet2.py, ae_pose.py,
+# posenet3.py
+REF_VARIANT_COUNTS = {
+    "final": 227_066_536,
+    "wide": 152_156_430,
+    "ae": 138_861_512,
+    "light": 149_504_936,
+}
 
 
 def tiny_model(**kw):
@@ -42,6 +51,32 @@ def test_param_count_matches_reference():
              for p in jax.tree.leaves(shapes["batch_stats"]))
     assert n == REF_PARAM_COUNT
     assert nb == REF_BN_STATS
+
+
+def test_variant_param_counts_match_reference():
+    from improved_body_parts_tpu.models import (
+        PoseNetAE,
+        PoseNetFinal,
+        PoseNetLight,
+        PoseNetWide,
+    )
+
+    ctors = {
+        "final": (PoseNetFinal, dict(nstack=4)),
+        "wide": (PoseNetWide, dict(nstack=3)),
+        "ae": (PoseNetAE, dict(nstack=4)),
+        "light": (PoseNetLight, dict(nstack=4)),
+    }
+    imgs = jnp.zeros((1, 128, 128, 3))
+    for name, (ctor, kw) in ctors.items():
+        model = ctor(inp_dim=256, oup_dim=50, increase=128,
+                     dtype=jnp.float32, **kw)
+        shapes = jax.eval_shape(
+            lambda k, m=model: m.init(k, imgs, train=False),
+            jax.random.PRNGKey(0))
+        n = sum(int(np.prod(p.shape))
+                for p in jax.tree.leaves(shapes["params"]))
+        assert n == REF_VARIANT_COUNTS[name], (name, n)
 
 
 def test_full_model_output_shapes_via_eval_shape():
@@ -133,7 +168,7 @@ def test_light_variant_builds():
     cfg = get_config("canonical")
     cfg = cfg.replace(model=cfg.model.__class__(
         nstack=1, inp_dim=16, increase=8, hourglass_depth=2,
-        variant="imhn_light"))
+        se_reduction=4, variant="imhn_light"))
     model = build_model(cfg, dtype=jnp.float32)
     vars_ = model.init(jax.random.PRNGKey(0), TINY_IMGS, train=False)
     preds = model.apply(vars_, TINY_IMGS, train=False)
