@@ -1,6 +1,7 @@
 from .losses import avg_pool_to, downsample_mask, focal_l2, l1, l2, multi_task_loss
+from .gt_device import make_gt_synthesizer
 from .nms import gaussian_blur, keypoint_nms, peak_mask_np, refine_peaks
 
 __all__ = ["avg_pool_to", "downsample_mask", "focal_l2", "l1", "l2",
            "multi_task_loss", "gaussian_blur", "keypoint_nms",
-           "peak_mask_np", "refine_peaks"]
+           "peak_mask_np", "refine_peaks", "make_gt_synthesizer"]

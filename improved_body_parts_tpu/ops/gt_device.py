@@ -1,0 +1,124 @@
+"""On-device ground-truth heatmap synthesis (jitted).
+
+TPU-native alternative to the host-side ``data.heatmapper.Heatmapper``: the
+whole label tensor is generated on device from raw joint coordinates, so when
+host CPUs are the input-pipeline bottleneck feeding a pod slice (SURVEY.md §7
+hard part f), only (people, parts, 3) joint arrays and the two masks cross the
+host→device boundary instead of (H/4, W/4, 50) float maps — a ~500× transfer
+reduction per sample.
+
+Semantics match the host heatmapper exactly (parity-tested):
+- keypoint Gaussians evaluated at stride-center coordinates, combined by max,
+  restricted to the reference's square window (py_data_heatmapper.py:111-131);
+- limb maps: Gaussian of distance-to-segment-line inside the segment bbox
+  padded by paf_thre, floored at 0.01, count-averaged across instances
+  (py_data_heatmapper.py:163-240);
+- background channels: 3x3-eroded person mask and the max over keypoint
+  channels (py_data_heatmapper.py:73-80).
+
+People are padded to a static ``max_people`` (mark padding joints with
+visibility 2) so the program compiles once.
+"""
+from __future__ import annotations
+
+
+
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SkeletonConfig
+
+
+def make_gt_synthesizer(config: SkeletonConfig):
+    """Build the jitted (joints, mask_all) -> (H, W, num_layers) function.
+
+    :param joints: (max_people, num_parts, 3) float32, visibility < 2 =
+        annotated (pad with visibility 2)
+    :param mask_all: (H, W) float in [0, 1] on the stride-4 grid
+    """
+    from ..data.heatmapper import Heatmapper
+
+    # share the host heatmapper's derived constants so the two GT paths
+    # cannot drift (same window half-extent and stride-center grid)
+    hm = Heatmapper(config)
+    tp = config.transform_params
+    sigma2x2 = hm.double_sigma2
+    paf_sigma2x2 = 2.0 * tp.paf_sigma * tp.paf_sigma
+    g = hm.gaussian_size // 2
+    limb_thre = tp.limb_gaussian_thre
+    paf_thre = config.paf_thre
+    stride = config.stride
+    h, w = config.grid_shape
+    gx, gy = jnp.asarray(hm.grid_x), jnp.asarray(hm.grid_y)
+    limb_from = jnp.asarray([f for f, _ in config.limbs_conn])
+    limb_to = jnp.asarray([t for _, t in config.limbs_conn])
+
+    def keypoint_channel(xs, ys, vis):
+        """(P,) joint coords of one part -> (H, W) channel (max-combined)."""
+        cx = jnp.round(xs / stride)
+        cy = jnp.round(ys / stride)
+        ix = jnp.arange(w, dtype=jnp.float32)
+        iy = jnp.arange(h, dtype=jnp.float32)
+        in_x = jnp.abs(ix[None, :] - cx[:, None]) <= g      # (P, W)
+        in_y = jnp.abs(iy[None, :] - cy[:, None]) <= g      # (P, H)
+        ex = jnp.exp(-((gx[None, :] - xs[:, None]) ** 2) / sigma2x2)
+        ey = jnp.exp(-((gy[None, :] - ys[:, None]) ** 2) / sigma2x2)
+        resp = (ey * in_y)[:, :, None] * (ex * in_x)[:, None, :]  # (P, H, W)
+        resp = jnp.where(vis[:, None, None] < 2, resp, 0.0)
+        return resp.max(axis=0)
+
+    def limb_channel(x1, y1, x2, y2, vis):
+        """(P,) endpoint coords of one limb -> (H, W) count-averaged map."""
+        dx, dy = x2 - x1, y2 - y1
+        norm = jnp.sqrt(dx * dx + dy * dy)
+        ok = (vis < 2) & (norm > 0)
+        # reference bbox window rounded at stride resolution
+        min_sx = jnp.round((jnp.minimum(x1, x2) - paf_thre) / stride)
+        max_sx = jnp.round((jnp.maximum(x1, x2) + paf_thre) / stride)
+        min_sy = jnp.round((jnp.minimum(y1, y2) - paf_thre) / stride)
+        max_sy = jnp.round((jnp.maximum(y1, y2) + paf_thre) / stride)
+        ix = jnp.arange(w, dtype=jnp.float32)
+        iy = jnp.arange(h, dtype=jnp.float32)
+        in_x = (ix[None, :] >= min_sx[:, None]) & (ix[None, :] <= max_sx[:, None])
+        in_y = (iy[None, :] >= min_sy[:, None]) & (iy[None, :] <= max_sy[:, None])
+        window = in_y[:, :, None] & in_x[:, None, :]          # (P, H, W)
+        window = window & ok[:, None, None]
+
+        dist = jnp.abs(
+            dx[:, None, None] * (y1[:, None, None] - gy[None, :, None])
+            - (x1[:, None, None] - gx[None, None, :]) * dy[:, None, None]
+        ) / (norm[:, None, None] + 1e-6)
+        resp = jnp.exp(-(dist ** 2) / paf_sigma2x2)
+        resp = jnp.where(resp <= limb_thre, 0.01, resp)       # reference floor
+        acc = (resp * window).sum(axis=0)
+        count = window.sum(axis=0)
+        return jnp.where(count > 0, acc / jnp.maximum(count, 1), 0.0)
+
+    @jax.jit
+    def synthesize(joints, mask_all):
+        joints = joints.astype(jnp.float32)
+        xs, ys, vis = joints[..., 0], joints[..., 1], joints[..., 2]
+
+        heat = jax.vmap(keypoint_channel, in_axes=(1, 1, 1), out_axes=2)(
+            xs, ys, vis)                                       # (H, W, parts)
+
+        x1 = xs[:, limb_from].T  # (L, P) — vmap over limbs
+        y1 = ys[:, limb_from].T
+        x2 = xs[:, limb_to].T
+        y2 = ys[:, limb_to].T
+        lvis = jnp.maximum(vis[:, limb_from], vis[:, limb_to]).T
+        paf = jax.vmap(limb_channel, in_axes=(0, 0, 0, 0, 0), out_axes=2)(
+            x1, y1, x2, y2, lvis)                              # (H, W, limbs)
+
+        # eroded person mask (3x3 min = erosion of a [0,1] mask)
+        eroded = -jax.lax.reduce_window(
+            -jnp.pad(mask_all.astype(jnp.float32), 1, mode="edge"),
+            -jnp.inf, jax.lax.max, (3, 3), (1, 1), "VALID")
+        reverse = heat.max(axis=2)
+
+        full = jnp.concatenate(
+            [paf, heat, eroded[..., None], reverse[..., None]], axis=-1)
+        return jnp.clip(full, 0.0, 1.0)
+
+    return synthesize

@@ -1,0 +1,50 @@
+"""Parity: on-device (jitted) GT synthesis vs the host heatmapper."""
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import get_config
+from improved_body_parts_tpu.data.heatmapper import Heatmapper
+from improved_body_parts_tpu.ops.gt_device import make_gt_synthesizer
+
+CFG = get_config("canonical")
+SK = CFG.skeleton
+
+
+@pytest.fixture(scope="module")
+def synthesize():
+    return make_gt_synthesizer(SK)
+
+
+def _random_case(seed, n_people, max_people=8):
+    rng = np.random.default_rng(seed)
+    joints = np.zeros((max_people, SK.num_parts, 3), np.float32)
+    joints[:, :, 2] = 2  # padding rows: absent
+    joints[:n_people, :, 0] = rng.uniform(-40, 552, (n_people, SK.num_parts))
+    joints[:n_people, :, 1] = rng.uniform(-40, 552, (n_people, SK.num_parts))
+    joints[:n_people, :, 2] = rng.choice([0, 1, 2], (n_people, SK.num_parts))
+    mask_all = (rng.uniform(size=SK.grid_shape) > 0.3).astype(np.float32)
+    return joints, mask_all
+
+
+@pytest.mark.parametrize("seed,n_people", [(0, 1), (1, 3), (2, 5)])
+def test_device_matches_host(synthesize, seed, n_people):
+    joints, mask_all = _random_case(seed, n_people)
+    host = Heatmapper(SK).create_heatmaps(joints.copy(), mask_all.copy())
+    device = np.asarray(synthesize(joints, mask_all))
+    assert device.shape == host.shape
+    # interior must match to float tolerance; the border row/col may differ
+    # by erosion border handling (cv2 constant-inf vs edge pad)
+    diff = np.abs(host - device)
+    assert diff[1:-1, 1:-1, :].max() < 1e-4, diff[1:-1, 1:-1, :].max()
+    # border: only the eroded-mask channel may deviate
+    non_bkg = np.concatenate(
+        [diff[..., :SK.bkg_start], diff[..., SK.bkg_start + 1:]], axis=-1)
+    assert non_bkg.max() < 1e-4, non_bkg.max()
+
+
+def test_empty_people(synthesize):
+    joints = np.zeros((8, SK.num_parts, 3), np.float32)
+    joints[:, :, 2] = 2
+    out = np.asarray(synthesize(joints, np.ones(SK.grid_shape, np.float32)))
+    assert out[..., :SK.bkg_start].max() == 0.0
+    assert out[..., SK.bkg_start].min() == 1.0  # full mask survives erosion
