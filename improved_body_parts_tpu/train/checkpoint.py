@@ -63,7 +63,13 @@ def restore_checkpoint(path: str, state: Optional[TrainState] = None
         Leaf correspondence holds because orbax preserves each container's
         key/field layout (namedtuples round-trip as dicts keyed by field
         name, whose serialization order jax also uses when flattening).
+
+        Checkpoints without optimizer state (imported reference weights,
+        tools/import_torch_checkpoint.py) keep the template's freshly
+        initialized opt_state.
         """
+        if restored is None:
+            return template
         leaves = jax.tree.leaves(restored)
         treedef = jax.tree.structure(template)
         assert treedef.num_leaves == len(leaves), (

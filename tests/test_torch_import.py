@@ -1,0 +1,90 @@
+"""Torch→Flax weight import, verified by FORWARD-OUTPUT parity.
+
+Builds the reference PoseNet (torch, random weights), converts its state_dict
+with tools.import_torch_checkpoint, and compares every stack/scale output of
+the two frameworks on the same input — the strongest architecture-fidelity
+check available: identical numerics, not just identical parameter counts.
+"""
+import sys
+import types
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def reference_posenet():
+    sys.path.insert(0, "/root/reference")
+    # the reference imports torchvision.densenet but never uses it
+    if "torchvision" not in sys.modules:
+        tv = types.ModuleType("torchvision")
+        tvm = types.ModuleType("torchvision.models")
+        tvm.densenet = None
+        tv.models = tvm
+        sys.modules["torchvision"] = tv
+        sys.modules["torchvision.models"] = tvm
+    from models.posenet import PoseNet as TorchPoseNet
+
+    return TorchPoseNet
+
+
+def test_forward_parity_small(reference_posenet):
+    import jax
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.models import PoseNet
+    from tools.import_torch_checkpoint import convert_posenet_state_dict
+
+    # the reference Backbone hardcodes its 256-channel output, so parity must
+    # run at the real width; two stacks exercise the cross-stack merge mapping
+    nstack, inp_dim, oup_dim, increase = 2, 256, 50, 128
+    tmodel = reference_posenet(nstack, inp_dim, oup_dim, bn=True,
+                               increase=increase, init_weights=False)
+    # randomize beyond the default init so parity is non-trivial
+    gen = torch.Generator().manual_seed(0)
+    with torch.no_grad():
+        for p in tmodel.parameters():
+            p.copy_(torch.randn(p.shape, generator=gen) * 0.05)
+        for name, b in tmodel.named_buffers():
+            if name.endswith("running_mean"):
+                b.copy_(torch.randn(b.shape, generator=gen) * 0.01)
+            elif name.endswith("running_var"):
+                b.copy_(1.0 + 0.1 * torch.rand(b.shape, generator=gen))
+    tmodel.eval()
+
+    params, stats = convert_posenet_state_dict(tmodel.state_dict(), nstack)
+
+    fmodel = PoseNet(nstack=nstack, inp_dim=inp_dim, oup_dim=oup_dim,
+                     increase=increase, hourglass_depth=4, se_reduction=16,
+                     dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    img = rng.uniform(0, 1, (1, 64, 64, 3)).astype(np.float32)
+
+    with torch.no_grad():
+        t_out = tmodel(torch.from_numpy(img))
+    f_out = fmodel.apply({"params": params, "batch_stats": stats},
+                         jnp.asarray(img), train=False)
+
+    assert len(t_out) == len(f_out) == nstack
+    for i in range(nstack):
+        assert len(t_out[i]) == len(f_out[i]) == 5
+        for j in range(5):
+            want = t_out[i][j].numpy().transpose(0, 2, 3, 1)  # NCHW → NHWC
+            got = np.asarray(f_out[i][j])
+            assert got.shape == want.shape, (i, j)
+            np.testing.assert_allclose(
+                got, want, atol=2e-4,
+                err_msg=f"stack {i} scale {j}")
+
+
+def test_converter_rejects_incomplete_state_dict(reference_posenet):
+    from tools.import_torch_checkpoint import convert_posenet_state_dict
+
+    tmodel = reference_posenet(1, 32, 10, bn=True, increase=16,
+                               init_weights=False)
+    sd = tmodel.state_dict()
+    sd["bogus.extra.weight"] = torch.zeros(1)
+    with pytest.raises(AssertionError, match="unmapped"):
+        convert_posenet_state_dict(sd, 1)

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Import reference PyTorch checkpoints into the Flax IMHN.
+
+Maps the reference ``PoseNet`` state_dict (models/posenet.py; checkpoints
+saved as {'weights': state_dict, ...}, train.py:149-162) onto this
+framework's parameter tree, so published weights (e.g. PoseNet_52_epoch.pth,
+config/config.py:23) can seed evaluation without retraining.
+
+Layout transforms: conv (O,I,kh,kw) → (kh,kw,I,O); linear (O,I) → (I,O);
+BN weight/bias/running_mean/running_var → scale/bias + batch_stats mean/var.
+
+Verified by forward-output parity between the torch reference network and the
+converted Flax model (tests/test_torch_import.py).
+
+    python tools/import_torch_checkpoint.py --pth PoseNet_52_epoch.pth \
+        --out checkpoints/imported --config canonical
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _conv(w) -> np.ndarray:
+    return np.asarray(w).transpose(2, 3, 1, 0)
+
+
+def _linear(w) -> np.ndarray:
+    return np.asarray(w).transpose(1, 0)
+
+
+class _Mapper:
+    def __init__(self, sd: Dict):
+        self.sd = {k: np.asarray(v) for k, v in sd.items()}
+        self.params: Dict[str, np.ndarray] = {}
+        self.stats: Dict[str, np.ndarray] = {}
+        self.used = set()
+
+    def conv(self, tname: str, fpath: str, bias: bool = False):
+        self.params[f"{fpath}/kernel"] = _conv(self.sd[f"{tname}.weight"])
+        self.used.add(f"{tname}.weight")
+        if bias:
+            self.params[f"{fpath}/bias"] = self.sd[f"{tname}.bias"]
+            self.used.add(f"{tname}.bias")
+
+    def bn(self, tname: str, fpath: str):
+        self.params[f"{fpath}/scale"] = self.sd[f"{tname}.weight"]
+        self.params[f"{fpath}/bias"] = self.sd[f"{tname}.bias"]
+        self.stats[f"{fpath}/mean"] = self.sd[f"{tname}.running_mean"]
+        self.stats[f"{fpath}/var"] = self.sd[f"{tname}.running_var"]
+        for suffix in ("weight", "bias", "running_mean", "running_var",
+                       "num_batches_tracked"):
+            self.used.add(f"{tname}.{suffix}")
+
+    def conv_block(self, tname: str, fpath: str):
+        """reference Conv/DilatedConv with bn=True → ConvBlock."""
+        self.conv(f"{tname}.conv", f"{fpath}/Conv_0")
+        self.bn(f"{tname}.bn", f"{fpath}/BatchNorm_0")
+
+    def residual(self, tname: str, fpath: str):
+        """reference Residual → our Residual (conv/bn interleaved)."""
+        for i, (ci, bi) in enumerate([(0, 1), (3, 4), (6, 7)]):
+            self.conv(f"{tname}.convBlock.{ci}", f"{fpath}/Conv_{i}")
+            self.bn(f"{tname}.convBlock.{bi}", f"{fpath}/BatchNorm_{i}")
+        if f"{tname}.skipConv.0.weight" in self.sd:
+            self.conv(f"{tname}.skipConv.0", f"{fpath}/Conv_3")
+            self.bn(f"{tname}.skipConv.1", f"{fpath}/BatchNorm_3")
+
+    def se(self, tname: str, fpath: str):
+        for ti, fi in ((0, 0), (2, 1)):
+            self.params[f"{fpath}/Dense_{fi}/kernel"] = _linear(
+                self.sd[f"{tname}.fc.{ti}.weight"])
+            self.params[f"{fpath}/Dense_{fi}/bias"] = \
+                self.sd[f"{tname}.fc.{ti}.bias"]
+            self.used |= {f"{tname}.fc.{ti}.weight", f"{tname}.fc.{ti}.bias"}
+
+
+def convert_posenet_state_dict(sd: Dict, nstack: int = 4, depth: int = 4
+                               ) -> Tuple[Dict, Dict]:
+    """Reference PoseNet state_dict → (params, batch_stats) nested dicts
+    for ``models.PoseNet`` (the canonical IMHN)."""
+    m = _Mapper(sd)
+    nscale = depth + 1
+
+    # Backbone (layers_transposed.py:158-194): conv1+bn1, res1, res2, dilation
+    m.conv("pre.conv1", "Backbone_0/ConvBlock_0/Conv_0")
+    m.bn("pre.bn1", "Backbone_0/ConvBlock_0/BatchNorm_0")
+    m.residual("pre.res1", "Backbone_0/Residual_0")
+    m.residual("pre.res2", "Backbone_0/Residual_1")
+    for k in range(6):
+        m.conv_block(f"pre.dilation.{k}", f"Backbone_0/ConvBlock_{k + 1}")
+
+    # Hourglasses: our creation order is down-path (skip, down) per depth,
+    # innermost, then up-path (low3 residual + refine conv) deepest-first
+    for i in range(nstack):
+        f = f"Hourglass_{i}"
+        t = f"hourglass.{i}.hg"
+        for d in range(depth):
+            m.residual(f"{t}.{d}.0", f"{f}/Residual_{2 * d}")       # skip
+            m.residual(f"{t}.{d}.1", f"{f}/Residual_{2 * d + 1}")   # down
+        m.residual(f"{t}.{depth - 1}.4", f"{f}/Residual_{2 * depth}")
+        for up, d in enumerate(reversed(range(depth))):
+            m.residual(f"{t}.{d}.2", f"{f}/Residual_{2 * depth + 1 + up}")
+            m.conv_block(f"{t}.{d}.3", f"{f}/ConvBlock_{up}")
+
+    # Features heads: per scale 2 ConvBlocks + SE
+    for i in range(nstack):
+        for j in range(nscale):
+            t = f"features.{i}.before_regress.{j}"
+            m.conv_block(f"{t}.0", f"Features_{i}/ConvBlock_{2 * j}")
+            m.conv_block(f"{t}.1", f"Features_{i}/ConvBlock_{2 * j + 1}")
+            m.se(f"{t}.2", f"Features_{i}/SELayer_{j}")
+
+    # outs + merges, created interleaved per stack/scale in _regress_and_merge
+    n = 0
+    for i in range(nstack):
+        for j in range(nscale):
+            m.conv(f"outs.{i}.{j}.conv", f"ConvBlock_{n}/Conv_0", bias=True)
+            n += 1
+            if i != nstack - 1:
+                m.conv_block(f"merge_preds.{i}.{j}.conv", f"ConvBlock_{n}")
+                n += 1
+                m.conv_block(f"merge_features.{i}.{j}.conv",
+                             f"ConvBlock_{n}")
+                n += 1
+
+    unused = set(m.sd) - m.used
+    unused = {k for k in unused if not k.endswith("num_batches_tracked")}
+    assert not unused, f"unmapped reference weights: {sorted(unused)[:8]}"
+
+    from flax.traverse_util import unflatten_dict
+
+    def nest(flat: Dict[str, np.ndarray]) -> Dict:
+        return unflatten_dict(flat, sep="/")
+
+    return nest(m.params), nest(m.stats)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="import a reference .pth checkpoint")
+    ap.add_argument("--pth", required=True)
+    ap.add_argument("--out", required=True, help="orbax checkpoint dir")
+    ap.add_argument("--config", default="canonical")
+    args = ap.parse_args()
+
+    import torch
+
+    from improved_body_parts_tpu.config import get_config
+
+    payload = torch.load(args.pth, map_location="cpu")
+    sd = payload.get("weights", payload)
+    # strip DistributedDataParallel prefixes and the reference's Network
+    # wrapper prefix (posenet.*)
+    sd = {k.replace("module.", "").replace("posenet.", ""): v
+          for k, v in sd.items()}
+    cfg = get_config(args.config)
+    if cfg.model.variant not in ("imhn", "imhn_independent"):
+        raise SystemExit(
+            f"config '{args.config}' selects variant '{cfg.model.variant}'; "
+            "the reference .pth layout maps onto the canonical IMHN only "
+            "(variants imhn / imhn_independent)")
+    params, stats = convert_posenet_state_dict(sd, cfg.model.nstack,
+                                               cfg.model.hourglass_depth)
+
+    import orbax.checkpoint as ocp
+
+    ocp.PyTreeCheckpointer().save(
+        os.path.abspath(args.out),
+        {"params": params, "batch_stats": stats, "opt_state": None,
+         "step": 0, "swa_params": None, "swa_count": None,
+         "epoch": int(payload.get("epoch", 0)),
+         "train_loss": float(payload.get("train_loss", 0.0)),
+         "best_loss": float(payload.get("train_loss", 0.0))},
+        force=True)
+    print(f"imported {args.pth} -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()
