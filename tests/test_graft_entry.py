@@ -1,0 +1,34 @@
+"""Pins the driver contracts: entry() structure and dryrun_multichip.
+
+The driver compile-checks ``entry()`` on one chip and runs
+``dryrun_multichip`` on N virtual CPU devices; a regression here would fail
+silently until the round ends, so the suite exercises both.
+"""
+import pytest
+
+
+@pytest.fixture(scope="module")
+def graft_entry():
+    # repo root is already on sys.path via conftest
+    import __graft_entry__
+
+    return __graft_entry__
+
+
+def test_entry_is_jittable(graft_entry):
+    import jax
+
+    forward, (variables, imgs) = graft_entry.entry()
+    assert imgs.shape == (1, 512, 512, 3)
+    # abstract evaluation proves the function traces and type-checks without
+    # paying the full 4-stack compile in the suite
+    out = jax.eval_shape(forward, variables, imgs)
+    assert tuple(out.shape) == (1, 128, 128, 50)
+
+
+def test_dryrun_multichip_8(graft_entry, eight_devices):
+    graft_entry.dryrun_multichip(8)  # raises on any failure
+
+
+def test_dryrun_multichip_2(graft_entry):
+    graft_entry.dryrun_multichip(2)
