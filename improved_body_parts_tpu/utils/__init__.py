@@ -1,6 +1,8 @@
 from .meters import AverageMeter, StepTimer
+from .platform import apply_platform_env
 from .profiling import profile_trace, timed
 from .visualize import colorize_jet, export_stablehlo, param_table
 
-__all__ = ["AverageMeter", "StepTimer", "profile_trace", "timed",
+__all__ = ["AverageMeter", "StepTimer", "apply_platform_env",
+           "profile_trace", "timed",
            "colorize_jet", "export_stablehlo", "param_table"]

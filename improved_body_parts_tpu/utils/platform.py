@@ -1,0 +1,72 @@
+"""Make ``JAX_PLATFORMS`` from the environment actually effective.
+
+In deployments where a ``sitecustomize`` registers an accelerator PJRT
+plugin at interpreter start (e.g. an exclusively-claimed TPU behind a
+relay), the env var alone can be read too late: ``jax.devices()`` then
+initializes every registered backend, claiming — or hanging on — a device
+the process was never meant to touch.  An explicit ``jax.config`` update
+before first backend use makes the selection stick (same trick as
+tests/conftest.py and ``__graft_entry__._force_cpu_platform``).
+
+Every CLI entry point calls :func:`apply_platform_env` right after importing
+jax, so ``JAX_PLATFORMS=cpu python tools/train.py ...`` reliably stays off
+the accelerator.
+"""
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    _pin_platform(platforms)
+
+    import jax
+
+    requested = {p.strip().lower() for p in platforms.split(",") if p.strip()}
+    active = jax.devices()[0].platform.lower()
+    if active not in requested:
+        raise RuntimeError(
+            f"JAX_PLATFORMS={platforms} was requested but the active "
+            f"platform is '{active}' — a backend was initialized before "
+            "the selection could take effect (call apply_platform_env "
+            "earlier, before any jax.devices()/jit use)")
+
+
+def _pin_platform(platforms: str) -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platforms)
+    except Exception:
+        # backend already initialized — the selection (whatever it was)
+        # has been made; verification is the caller's job
+        pass
+
+
+def force_cpu(min_devices: int = 1) -> None:
+    """Pin this process to the host (CPU) platform with at least
+    ``min_devices`` virtual devices, before any JAX backend is initialized.
+
+    Raises AssertionError if a backend was already initialized on another
+    platform or with too few devices (the flags cannot take effect then).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={min_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    _pin_platform("cpu")
+
+    import jax
+
+    devices = jax.devices()
+    assert devices[0].platform == "cpu", (
+        f"expected the CPU platform, got {devices[0].platform}")
+    assert len(devices) >= min_devices, (
+        f"need {min_devices} virtual CPU devices, have {len(devices)} "
+        "(backend was initialized before the device-count flag took effect)")

@@ -30,9 +30,11 @@ def pytest_configure(config):
 
     # The env assignment above is too late when sitecustomize has already
     # imported jax (it does in the TPU-tunnel environment, with
-    # JAX_PLATFORMS=axon); jax.config still honours an update made before
-    # first backend use.
-    jax.config.update("jax_platforms", "cpu")
+    # JAX_PLATFORMS=axon); force_cpu re-pins via jax.config (honoured before
+    # first backend use) and asserts the pin actually took effect.
+    from improved_body_parts_tpu.utils.platform import force_cpu
+
+    force_cpu(8)
 
     # Persistent compilation cache makes repeated CPU test runs fast.
     cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")

@@ -15,6 +15,9 @@ def load_predictor(config_name: str, checkpoint: str, bucket: int = 128):
     import jax
     import jax.numpy as jnp
 
+    from improved_body_parts_tpu.utils import apply_platform_env
+    apply_platform_env()  # honour JAX_PLATFORMS even under a sitecustomize
+
     from improved_body_parts_tpu.config import get_config
     from improved_body_parts_tpu.infer import Predictor
     from improved_body_parts_tpu.models import build_model

@@ -25,6 +25,9 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    from improved_body_parts_tpu.utils import apply_platform_env
+    apply_platform_env()  # honour JAX_PLATFORMS even under a sitecustomize
+
     from improved_body_parts_tpu.config import get_config
     from improved_body_parts_tpu.models import build_model
 
