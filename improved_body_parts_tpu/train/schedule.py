@@ -39,15 +39,23 @@ def step_decay_schedule(cfg: TrainConfig, steps_per_epoch: int,
 
 
 def cyclic_swa_schedule(steps_per_epoch: int, swa_freq: int = 5,
-                        lr_max: float = 4e-5, lr_min: float = 2e-5):
+                        lr_max: float = 1e-5, lr_min: float = 1e-6,
+                        start_step: int = 0):
     """Sawtooth LR for SWA fine-tuning: decays lr_max→lr_min over each
-    ``swa_freq``-epoch cycle (train_distributed_SWA.py:365-371)."""
+    ``swa_freq``-epoch cycle (train_distributed_SWA.py:365-369
+    ``adjust_learning_rate_cyclic`` — defaults lr_max=1e-5, lr_min=1e-6).
+
+    The cycle phase is anchored to ``start_step`` (the global step at which
+    the SWA stage began), matching the reference's
+    ``epoch = current_epoch - start_epoch`` convention so a resumed SWA run
+    keeps the same sawtooth.
+    """
 
     if swa_freq <= 1:  # degenerate cycle: constant lr_max
         return lambda step: jnp.asarray(lr_max, jnp.float32)
 
     def schedule(step):
-        epoch = jnp.asarray(step) // steps_per_epoch
+        epoch = (jnp.asarray(step) - start_step) // steps_per_epoch
         phase = epoch - (epoch // swa_freq) * swa_freq
         return lr_max - (lr_max - lr_min) / (swa_freq - 1) * phase.astype(
             jnp.float32)

@@ -73,6 +73,41 @@ class TestSchedules:
         assert vals[5] == pytest.approx(4e-5)  # cycle restarts
         assert all(vals[i] > vals[i + 1] for i in range(4))
 
+    def test_cyclic_swa_reference_defaults(self):
+        """Defaults must match the SWA script's adjust_learning_rate_cyclic
+        (train_distributed_SWA.py:365: lr_max=1e-5, lr_min=1e-6), not the
+        unused copy in train_distributed.py:403."""
+        sched = cyclic_swa_schedule(steps_per_epoch=10)
+        assert float(sched(0)) == pytest.approx(1e-5)
+        assert float(sched(4 * 10)) == pytest.approx(1e-6)
+
+    def test_cyclic_swa_start_step_anchor(self):
+        """Phase follows (epoch - start_epoch): resuming into SWA at epoch 90
+        starts the sawtooth at lr_max (train_distributed_SWA.py:366)."""
+        spe = 10
+        sched = cyclic_swa_schedule(steps_per_epoch=spe, start_step=90 * spe)
+        for e in range(5):
+            expect = 1e-5 - (1e-5 - 1e-6) / 4 * e
+            assert float(sched((90 + e) * spe)) == pytest.approx(expect), e
+
+    def test_step_decay_world_size_is_global_device_count(self):
+        """Multi-host LR scaling: the reference multiplies base LR by
+        world_size exactly once (train_distributed.py:388).  tools/train.py
+        must pass the GLOBAL device count, with no extra process factor."""
+        import ast
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                            "train.py")
+        tree = ast.parse(open(path).read())
+        calls = [n for n in ast.walk(tree) if isinstance(n, ast.Call)
+                 and getattr(n.func, "id", "") == "step_decay_schedule"]
+        assert calls, "tools/train.py no longer calls step_decay_schedule"
+        for call in calls:
+            ws = [k.value for k in call.keywords if k.arg == "world_size"]
+            assert ws and isinstance(ws[0], ast.Name) and ws[0].id == "n_dev", (
+                "world_size must be the global device count n_dev alone")
+
 
 class TestSWA:
     def test_running_average(self):

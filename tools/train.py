@@ -38,6 +38,9 @@ def main():
                     help="SWA fine-tuning with cyclic LR and frozen BN "
                          "(reference: train_distributed_SWA.py)")
     ap.add_argument("--swa-freq", type=int, default=5)
+    ap.add_argument("--swa-lr-max", type=float, default=1e-5,
+                    help="cyclic LR peak (train_distributed_SWA.py:365)")
+    ap.add_argument("--swa-lr-min", type=float, default=1e-6)
     ap.add_argument("--print-freq", type=int, default=10)
     # multi-host (jax.distributed)
     ap.add_argument("--coordinator", default=None)
@@ -47,6 +50,9 @@ def main():
 
     import jax
     import jax.numpy as jnp
+
+    from improved_body_parts_tpu.utils import apply_platform_env
+    apply_platform_env()  # honour JAX_PLATFORMS even under a sitecustomize
 
     from improved_body_parts_tpu.config import get_config
     from improved_body_parts_tpu.data import CocoPoseDataset, batches
@@ -82,11 +88,24 @@ def main():
           f"host_batch={host_batch} steps/epoch={steps_per_epoch}")
 
     model = build_model(cfg)
+
+    def swa_schedule(start_step=0):
+        return cyclic_swa_schedule(steps_per_epoch, args.swa_freq,
+                                   lr_max=args.swa_lr_max,
+                                   lr_min=args.swa_lr_min,
+                                   start_step=start_step)
+
     if args.swa:
-        schedule = cyclic_swa_schedule(steps_per_epoch, args.swa_freq)
+        # provisional (start anchor unknown until resume resolves); rebuilt
+        # below once start_epoch is known — opt_state structure is identical
+        schedule = swa_schedule()
     else:
+        # n_dev already counts devices across ALL processes (jax.devices()
+        # is global under jax.distributed), so it IS the reference's
+        # world_size LR multiplier (train_distributed.py:388) — no extra
+        # num_processes factor.
         schedule = step_decay_schedule(cfg.train, steps_per_epoch,
-                                       world_size=n_dev * args.num_processes,
+                                       world_size=n_dev,
                                        use_warmup=not args.no_warmup)
     optimizer = make_optimizer(cfg, schedule)
     sample = jnp.zeros((global_batch, cfg.skeleton.height,
@@ -105,6 +124,14 @@ def main():
             start_epoch = meta["epoch"] + 1
             resumed_swa = state.swa_count is not None
             print(f"resumed from {path} (epoch {meta['epoch']})")
+    if args.swa and int(state.step):
+        # Anchor the cyclic-LR sawtooth to the step SWA starts at
+        # (reference: epoch - start_epoch, train_distributed_SWA.py:365-366).
+        # state.step mirrors the optax schedule count in every resume case:
+        # a full checkpoint restores both together, and an imported reference
+        # checkpoint (no opt_state) keeps both at 0 — anchoring on
+        # start_epoch*steps_per_epoch would shift the phase for imports.
+        optimizer = make_optimizer(cfg, swa_schedule(int(state.step)))
 
     use_focal = not args.no_focal
     # SWA freezes BatchNorm (train_distributed_SWA.py:219-221)
