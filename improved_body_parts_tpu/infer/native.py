@@ -28,11 +28,37 @@ _LIB_PATHS = (
 )
 
 
+def _maybe_build() -> None:
+    """Build (or rebuild, when decoder.cpp is newer) the shared library when
+    the source tree is present — the .so is not checked into git, and a
+    silent fall-back to the slow NumPy path on a fresh checkout would defeat
+    the native decoder's purpose."""
+    import subprocess
+
+    native_dir = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "..", "native"))
+    src = os.path.join(native_dir, "decoder.cpp")
+    so = os.path.join(native_dir, "libposedecoder.so")
+    if not os.path.exists(src):
+        return  # installed without sources; nothing to build from
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return
+    try:
+        subprocess.run(["make", "-C", native_dir], check=True,
+                       capture_output=True)
+    except Exception as e:  # noqa: BLE001 — surface below via the warning
+        import warnings
+
+        warnings.warn(f"native decoder build failed ({e}); decoding will "
+                      "use the slower NumPy path", RuntimeWarning)
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _LIB, _LIB_TRIED
     if _LIB_TRIED:
         return _LIB
     _LIB_TRIED = True
+    _maybe_build()
     for path in _LIB_PATHS:
         path = os.path.abspath(path)
         if os.path.exists(path):

@@ -20,6 +20,12 @@
 
 namespace {
 
+// Round-half-to-even, matching np.round on the NumPy twin (decode.py:85-118).
+// std::lrint honours the FP environment's rounding mode, which defaults to
+// FE_TONEAREST (ties-to-even) — half-integer sample coords pick the same
+// pixel as the Python path.
+inline long round_even(double v) { return std::lrint(v); }
+
 struct Connection {
   double id_a, id_b;   // global peak ids
   double score;        // distance-prior score
@@ -55,7 +61,7 @@ std::vector<Connection> find_connections_for_limb(
       const double dx = bx - ax, dy = by - ay;
       const double norm = std::sqrt(dx * dx + dy * dy);
       if (norm == 0.0) continue;  // overlapping parts (evaluate.py:228)
-      int m = static_cast<int>(std::lround(norm + 1.0));
+      int m = static_cast<int>(round_even(norm + 1.0));
       if (m > mid_num) m = mid_num;
       if (m < 1) m = 1;
       // sample linspace(A, B, m) inclusive on the limb channel
@@ -63,8 +69,8 @@ std::vector<Connection> find_connections_for_limb(
       int above = 0;
       for (int s = 0; s < m; ++s) {
         const double t = (m == 1) ? 0.0 : static_cast<double>(s) / (m - 1);
-        int x = static_cast<int>(std::lround(ax + t * dx));
-        int y = static_cast<int>(std::lround(ay + t * dy));
+        int x = static_cast<int>(round_even(ax + t * dx));
+        int y = static_cast<int>(round_even(ay + t * dy));
         x = std::min(std::max(x, 0), W - 1);
         y = std::min(std::max(y, 0), H - 1);
         const double v = paf[(static_cast<size_t>(y) * W + x) * C + limb_channel];

@@ -4,7 +4,6 @@ The two implement identical semantics (reference: evaluate.py:206-498); this
 pins them against each other on synthetic multi-person heatmaps, including the
 assembled subsets' peak ids, confidences, counts and total scores.
 """
-import subprocess
 import sys
 
 import numpy as np
@@ -26,9 +25,29 @@ CFG = get_config("canonical")
 SK = CFG.skeleton
 PARAMS, _ = default_inference_params()
 
-pytestmark = pytest.mark.skipif(
-    not native_available(), reason="native decoder not built "
-    "(python tools/build_native.py)")
+def _skip_reason() -> str:
+    """native_available() builds the .so on demand (infer/native.py); the
+    extra staleness check here refuses to run parity against an outdated
+    binary when that rebuild failed — confusing mismatches are worse than a
+    loud skip."""
+    import os
+
+    available = native_available()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    so = os.path.join(root, "native", "libposedecoder.so")
+    src = os.path.join(root, "native", "decoder.cpp")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        return ("native decoder build failed: libposedecoder.so is missing "
+                "or older than decoder.cpp (python tools/build_native.py)")
+    if not available:
+        return "native decoder not loadable (python tools/build_native.py)"
+    return ""
+
+
+_reason = _skip_reason()
+
+pytestmark = pytest.mark.skipif(bool(_reason), reason=_reason)
 
 
 def _maps(seed, n_people=3):

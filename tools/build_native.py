@@ -13,10 +13,10 @@ NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 
 
 def build(verbose: bool = True) -> str:
-    src = os.path.join(NATIVE_DIR, "decoder.cpp")
+    """Delegate to ``make -C native`` so the compiler flags live in exactly
+    one place (native/Makefile)."""
     out = os.path.join(NATIVE_DIR, "libposedecoder.so")
-    cmd = ["g++", "-O3", "-march=native", "-fPIC", "-std=c++17", "-Wall",
-           "-Wextra", "-shared", "-o", out, src]
+    cmd = ["make", "-C", NATIVE_DIR]
     if verbose:
         print(" ".join(cmd))
     subprocess.run(cmd, check=True)

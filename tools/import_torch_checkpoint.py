@@ -148,13 +148,21 @@ def main():
     ap.add_argument("--pth", required=True)
     ap.add_argument("--out", required=True, help="orbax checkpoint dir")
     ap.add_argument("--config", default="canonical")
+    ap.add_argument("--unsafe-load", action="store_true",
+                    help="allow full pickle deserialization for legacy "
+                         "checkpoints that are not plain state dicts "
+                         "(runs arbitrary code from the file — only for "
+                         "trusted checkpoints)")
     args = ap.parse_args()
 
     import torch
 
     from improved_body_parts_tpu.config import get_config
 
-    payload = torch.load(args.pth, map_location="cpu")
+    # weights_only=True keeps torch.load to tensor payloads; a downloaded
+    # .pth is untrusted input and the full pickle machinery executes code.
+    payload = torch.load(args.pth, map_location="cpu",
+                         weights_only=not args.unsafe_load)
     sd = payload.get("weights", payload)
     # strip DistributedDataParallel prefixes and the reference's Network
     # wrapper prefix (posenet.*)
