@@ -184,6 +184,60 @@ def test_fast_path_rejects_multi_scale_grid():
         pred.predict_fast(np.zeros((64, 64, 3), np.uint8))
 
 
+class ImageFollowingStub:
+    """Every map channel mirrors the stride-4-downsampled green channel of
+    the input, so map content tracks the image through the rotation grid —
+    a constant stub cannot exercise the rotate → forward → rotate-back path
+    (reference: evaluate.py:89-90, 108-112, 139-161)."""
+
+    def apply(self, variables, imgs, train=False):
+        import jax.numpy as jnp
+
+        n, h, w, _ = imgs.shape
+        g = imgs[..., 1]
+        g4 = g.reshape(n, h // SK.stride, SK.stride,
+                       w // SK.stride, SK.stride).mean(axis=(2, 4))
+        maps = jnp.repeat(g4[..., None], SK.num_layers, axis=-1)
+        return [[maps]]
+
+
+@pytest.mark.parametrize("shape", [(256, 256), (192, 256)])
+def test_rotation_grid_returns_maps_to_original_orientation(shape):
+    """With rotation_search=(0, ±40), each rotated pass must be warped back
+    so the averaged maps peak where the (unrotated) image feature is; a bug
+    in the inverse warp would smear the peak along the rotation arc."""
+    from improved_body_parts_tpu.infer import Predictor
+
+    h, w = shape
+    x0, y0 = int(w * 0.64), int(h * 0.33)  # within the rotation footprint
+    # a Gaussian blob, not a filled disc: cubic upsampling overshoots at
+    # plateau edges, which would move the argmax off the planted centre
+    yy, xx = np.mgrid[:h, :w]
+    g = np.exp(-((xx - x0) ** 2 + (yy - y0) ** 2) / (2 * 6.0 ** 2))
+    img = np.zeros((h, w, 3), np.uint8)
+    img[..., 1] = (255 * g).astype(np.uint8)
+
+    params = InferenceParams(scale_search=(1.0,),
+                             rotation_search=(0.0, 40.0, -40.0))
+    model_params = InferenceModelParams(boxsize=h, max_downsample=64)
+    pred = Predictor(ImageFollowingStub(), {}, SK, params, model_params,
+                     bucket=64)
+    heat, paf = pred.predict(img)
+    assert heat.shape == (h, w, SK.heat_layers + 2)
+
+    py, px = np.unravel_index(np.argmax(heat[..., 0]), (h, w))
+    assert abs(px - x0) <= 3 and abs(py - y0) <= 3, (px, py, x0, y0)
+
+    # every grid entry saw the blob, so the rotation passes must contribute
+    # comparable mass at the blob — not just the angle-0 pass
+    no_rot = Predictor(ImageFollowingStub(), {}, SK,
+                       InferenceParams(scale_search=(1.0,)),
+                       model_params, bucket=64)
+    heat0, _ = no_rot.predict(img)
+    peak = heat[py, px, 0]
+    assert peak > 0.6 * heat0[..., 0].max(), (peak, heat0[..., 0].max())
+
+
 def test_bucketing_reuses_programs():
     rng = np.random.default_rng(2)
     maps = rng.uniform(0, 1, (64, 64, SK.num_layers)).astype(np.float32)
