@@ -7,9 +7,24 @@ same AP/AR protocol — greedy OKS matching per image at thresholds
 smoke tests run in environments without pycocotools (its C extension is a
 host-side dependency, SURVEY.md §2.9).
 
+Fidelity to COCOeval (pycocotools cocoeval.py) includes the discriminating
+edge cases, each pinned by analytic goldens in tests/test_oks_and_variants.py:
+- greedy per-image matching, detections by descending score, each taking the
+  best still-unmatched GT above the threshold;
+- **ignore regions**: a GT with no labeled keypoints (crowd regions and
+  un-annotated people) never counts toward recall, and detections matched to
+  it are dropped rather than counted as false positives — COCOeval's
+  gtIg/dtIg logic;
+- the **crowd OKS fallback**: for a GT without labeled keypoints, similarity
+  is computed from each detected keypoint's distance OUTSIDE the doubly
+  expanded GT bbox (computeOks' ``k1 == 0`` branch), so detections inside a
+  crowd region are absorbed by it;
+- **maxDets = 20** detections per image (the COCO keypoint protocol).
+
 Formats:
 - ground truth: per image, list of dicts {"keypoints": (17, 3) array in COCO
-  order with v flags, "area": float}
+  order with v flags, "area": float, optional "bbox": (x, y, w, h),
+  optional "ignore": bool}
 - detections: per image, list of (coco_keypoints [17 x (x, y) | None], score)
   — exactly what ``decode`` returns.
 """
@@ -26,21 +41,46 @@ COCO_SIGMAS = np.array([
 
 OKS_THRESHOLDS = np.arange(0.5, 0.95 + 1e-9, 0.05)
 
+MAX_DETS = 20  # COCO keypoint protocol (COCOeval Params.maxDets)
 
-def oks(det_xy: np.ndarray, gt: np.ndarray, area: float) -> float:
+
+def oks(det_xy: np.ndarray, gt: np.ndarray, area: float,
+        bbox: Optional[Sequence[float]] = None) -> float:
     """Object keypoint similarity between one detection and one GT person.
 
     :param det_xy: (17, 2) detected coordinates (0,0 = missing)
     :param gt: (17, 3) GT with visibility flags (v > 0 = labeled)
     :param area: GT segment area (scale normalizer)
+    :param bbox: GT (x, y, w, h); used only for the no-labeled-keypoints
+        crowd fallback (COCOeval computeOks ``k1 == 0``)
     """
     vis = gt[:, 2] > 0
-    if not vis.any():
+    k2 = (2 * COCO_SIGMAS) ** 2
+    if vis.any():
+        d2 = ((det_xy[vis] - gt[vis, :2]) ** 2).sum(axis=1)
+        e = d2 / (2.0 * max(area, 1e-9) * k2[vis])
+    elif bbox is not None:
+        # distance outside the doubly-expanded bbox, over ALL keypoints
+        x, y, w, h = bbox
+        x0, x1 = x - w, x + 2 * w
+        y0, y1 = y - h, y + 2 * h
+        dx = (np.maximum(0.0, x0 - det_xy[:, 0])
+              + np.maximum(0.0, det_xy[:, 0] - x1))
+        dy = (np.maximum(0.0, y0 - det_xy[:, 1])
+              + np.maximum(0.0, det_xy[:, 1] - y1))
+        e = (dx ** 2 + dy ** 2) / (2.0 * max(area, 1e-9) * k2)
+    else:
         return 0.0
-    d2 = ((det_xy[vis] - gt[vis, :2]) ** 2).sum(axis=1)
-    k2 = (2 * COCO_SIGMAS[vis]) ** 2
-    e = d2 / (2.0 * max(area, 1e-9) * k2)
     return float(np.exp(-e).mean())
+
+
+def _gt_ignore(gt: Dict) -> bool:
+    """COCOeval keypoint _prepare: ignore a GT if flagged, crowd, or without
+    a single labeled keypoint."""
+    if gt.get("ignore") or gt.get("iscrowd"):
+        return True
+    kpts = np.asarray(gt["keypoints"], dtype=np.float64)
+    return not (kpts[:, 2] > 0).any()
 
 
 def _oks_matrix(gts: Sequence[Dict], dts: Sequence[Tuple]) -> np.ndarray:
@@ -52,33 +92,45 @@ def _oks_matrix(gts: Sequence[Dict], dts: Sequence[Tuple]) -> np.ndarray:
         for gi, gt in enumerate(gts):
             mat[di, gi] = oks(
                 det_xy, np.asarray(gt["keypoints"], dtype=np.float64),
-                gt["area"])
+                gt["area"], bbox=gt.get("bbox"))
     return mat
 
 
-def _match_image(oks_mat: np.ndarray, det_scores: np.ndarray, thr: float
-                 ) -> Tuple[np.ndarray, np.ndarray, int]:
-    """Greedy matching for one image at one threshold (COCOeval order:
-    detections by descending score, each takes its best unmatched GT).
+def _match_image(oks_mat: np.ndarray, det_scores: np.ndarray,
+                 gt_ignored: np.ndarray, gt_crowd: np.ndarray, thr: float
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Greedy matching for one image at one threshold (COCOeval evaluateImg):
+    detections by descending score, each takes its best available GT; crowd
+    GTs stay available after matching; a detection that lands on an ignored
+    GT is itself ignored (neither TP nor FP).
 
-    Returns (scores, is_tp flags, number of GT).
+    GT columns must be ordered non-ignored first (COCOeval's gtind sort).
+
+    Returns (scores, is_tp, det_ignored, number of non-ignored GT).
     """
     n_det, n_gt = oks_mat.shape
     order = np.argsort(-det_scores, kind="stable")
     matched = np.zeros(n_gt, dtype=bool)
-    scores, tps = [], []
-    for di in order:
+    scores = np.empty(n_det)
+    tps = np.zeros(n_det, dtype=bool)
+    ignored = np.zeros(n_det, dtype=bool)
+    for oi, di in enumerate(order):
         best_oks, best_gi = thr, -1
         for gi in range(n_gt):
-            if matched[gi]:
+            if matched[gi] and not gt_crowd[gi]:
                 continue
+            # already matched to a real GT and reached the (trailing)
+            # ignored section — a real match never downgrades to ignore
+            if best_gi > -1 and not gt_ignored[best_gi] and gt_ignored[gi]:
+                break
             if oks_mat[di, gi] >= best_oks:
                 best_oks, best_gi = oks_mat[di, gi], gi
-        scores.append(det_scores[di])
-        tps.append(best_gi >= 0)
+        scores[oi] = det_scores[di]
         if best_gi >= 0:
             matched[best_gi] = True
-    return np.asarray(scores), np.asarray(tps, dtype=bool), n_gt
+            ignored[oi] = gt_ignored[best_gi]
+            tps[oi] = not ignored[oi]
+    return scores, tps, ignored, int((~gt_ignored).sum())
 
 
 def average_precision(scores: np.ndarray, tps: np.ndarray, n_gt: int
@@ -112,19 +164,28 @@ def evaluate_oks(ground_truth: Dict[int, Sequence[Dict]],
     """
     per_image = {}
     for image_id, gts in ground_truth.items():
-        dts = detections.get(image_id, [])
+        dts = sorted(detections.get(image_id, []),
+                     key=lambda d: -d[1])[:MAX_DETS]
+        # non-ignored GTs first (COCOeval's gtind sort), so the matching
+        # loop's early break on the ignored tail is valid
+        ignore = np.asarray([_gt_ignore(g) for g in gts], dtype=bool)
+        gt_order = np.argsort(ignore, kind="stable")
+        gts = [gts[i] for i in gt_order]
         per_image[image_id] = (
             _oks_matrix(gts, dts),
-            np.asarray([score for _, score in dts], dtype=np.float64))
+            np.asarray([score for _, score in dts], dtype=np.float64),
+            ignore[gt_order],
+            np.asarray([bool(g.get("iscrowd")) for g in gts], dtype=bool))
 
     aps = []
     recalls = []
     for thr in OKS_THRESHOLDS:
         all_scores, all_tps, total_gt = [], [], 0
-        for image_id, (mat, det_scores) in per_image.items():
-            s, t, n = _match_image(mat, det_scores, thr)
-            all_scores.append(s)
-            all_tps.append(t)
+        for image_id, (mat, det_scores, g_ign, g_crowd) in per_image.items():
+            s, t, d_ign, n = _match_image(mat, det_scores, g_ign, g_crowd,
+                                          thr)
+            all_scores.append(s[~d_ign])
+            all_tps.append(t[~d_ign])
             total_gt += n
         scores = np.concatenate(all_scores) if all_scores else np.zeros(0)
         tps = (np.concatenate(all_tps) if all_tps

@@ -74,6 +74,111 @@ class TestOKS:
         assert res["AP"] < 1.0
 
 
+def _nose_gt(x, y, area=1000.0):
+    """GT with only the nose labeled: OKS = exp(-d² / (2·area·(2σ_nose)²)),
+    exactly invertible for analytic goldens."""
+    gt = np.zeros((17, 3))
+    gt[0] = [x, y, 2]
+    return {"keypoints": gt, "area": area}
+
+
+def _nose_det(x, y, score, target_oks=None, area=1000.0):
+    """Detection displaced so oks() against _nose_gt(x, y) equals
+    ``target_oks`` exactly (None = perfect)."""
+    from improved_body_parts_tpu.infer.oks import COCO_SIGMAS
+
+    if target_oks is not None:
+        d = np.sqrt(-2.0 * area * (2 * COCO_SIGMAS[0]) ** 2
+                    * np.log(target_oks))
+        x = x + d
+    coords = [None] * 17
+    coords[0] = (float(x), float(y))
+    return (coords, score)
+
+
+class TestCOCOevalSemantics:
+    """Analytic goldens for the discriminating COCOeval behaviours: the
+    values below are derived by hand from the 101-point protocol, not from
+    running this implementation (no pycocotools in this environment —
+    see APCHECK.md)."""
+
+    def test_imperfect_detections_analytic_ap(self):
+        """2 GT; det1 OKS .72 (score .9), det2 OKS .92 (score .8), det3 FP
+        (score .7).  Thr ≤ .70 (5 thrs): AP 1.  Thr .75-.90 (4 thrs):
+        order is FP, TP, FP → PR (0, 1/2, 1/3) → interp 0.5 up to recall .5
+        → AP = 51/101 · 0.5.  Thr .95: AP 0.
+        """
+        g1, g2 = _nose_gt(100, 100), _nose_gt(4000, 4000)
+        dts = [
+            _nose_det(100, 100, score=0.9, target_oks=0.72),
+            _nose_det(4000, 4000, score=0.8, target_oks=0.92),
+            _nose_det(9000, 9000, score=0.7),  # matches nothing
+        ]
+        res = evaluate_oks({1: [g1, g2]}, {1: dts})
+        ap75 = 51 / 101 * 0.5
+        assert res["AP50"] == pytest.approx(1.0)
+        assert res["AP75"] == pytest.approx(ap75)
+        assert res["AP"] == pytest.approx((5 * 1.0 + 4 * ap75) / 10)
+        assert res["AR"] == pytest.approx((5 * 1.0 + 4 * 0.5) / 10)
+
+    def test_crowd_region_absorbs_detections(self):
+        """Detections inside a crowd GT's (doubly expanded) bbox are ignored
+        — neither TP nor FP — and the crowd stays matchable for several
+        detections; the crowd GT never counts toward recall."""
+        crowd_kpts = np.zeros((17, 3))  # no labeled keypoints
+        crowd = {"keypoints": crowd_kpts, "area": 4000.0, "iscrowd": 1,
+                 "bbox": (500.0, 500.0, 100.0, 100.0)}
+        real = _nose_gt(100, 100)
+        # all 17 keypoints inside the crowd box: a missing keypoint encodes
+        # as (0, 0), which lies OUTSIDE the expanded box and would dilute
+        # the fallback OKS — same as handing pycocotools zero-filled slots
+        in_crowd = [(550.0, 550.0)] * 17
+        in_crowd2 = [(540.0, 560.0)] * 17
+        dts = [
+            (in_crowd, 0.95),            # would be the top-scored FP
+            (in_crowd2, 0.9),            # crowd must absorb this one too
+            _nose_det(100, 100, 0.8),    # perfect on the real GT
+        ]
+        res = evaluate_oks({1: [real, crowd]}, {1: dts})
+        assert res["AP"] == pytest.approx(1.0)
+        assert res["AR"] == pytest.approx(1.0)
+
+    def test_ignored_gt_excluded_from_recall(self):
+        gts = [_nose_gt(100, 100), dict(_nose_gt(4000, 4000), ignore=True)]
+        dts = [_nose_det(100, 100, 0.9)]
+        res = evaluate_oks({1: gts}, {1: dts})
+        assert res["AP"] == pytest.approx(1.0)
+        assert res["AR"] == pytest.approx(1.0)
+
+    def test_max_dets_cap(self):
+        """COCO keypoints keeps only the 20 highest-scored detections per
+        image; a true positive ranked 21st must not count."""
+        gts = [_nose_gt(100, 100)]
+        dts = [_nose_det(5000 + 100 * i, 5000, 0.9 - 0.001 * i)
+               for i in range(20)]
+        dts.append(_nose_det(100, 100, 0.1))  # rank 21: dropped
+        res = evaluate_oks({1: gts}, {1: dts})
+        assert res["AP"] == 0.0
+        assert res["AR"] == 0.0
+
+    def test_oks_crowd_fallback_formula(self):
+        """Inside the expanded box → distance 0 → OKS 1; outside decays by
+        the distance past the border (COCOeval computeOks k1==0 branch)."""
+        from improved_body_parts_tpu.infer.oks import oks
+
+        crowd = np.zeros((17, 3))
+        bbox = (0.0, 0.0, 100.0, 100.0)
+        inside = np.full((17, 2), 150.0)   # within [−100, 200]
+        assert oks(inside, crowd, 4000.0, bbox=bbox) == pytest.approx(1.0)
+        outside = np.full((17, 2), 250.0)  # 50 px past both borders
+        d2 = 50.0 ** 2 + 50.0 ** 2
+        from improved_body_parts_tpu.infer.oks import COCO_SIGMAS
+
+        expect = np.exp(-d2 / (2 * 4000.0 * (2 * COCO_SIGMAS) ** 2)).mean()
+        assert oks(outside, crowd, 4000.0, bbox=bbox) == pytest.approx(
+            float(expect))
+
+
 class TestEndToEndAP:
     def test_decode_of_planted_people_reaches_ap_1(self):
         import sys
