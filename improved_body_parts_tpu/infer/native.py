@@ -28,29 +28,52 @@ _LIB_PATHS = (
 )
 
 
-def _maybe_build() -> None:
-    """Build (or rebuild, when decoder.cpp is newer) the shared library when
-    the source tree is present — the .so is not checked into git, and a
-    silent fall-back to the slow NumPy path on a fresh checkout would defeat
-    the native decoder's purpose."""
+def ensure_built() -> str:
+    """Build (or rebuild, when decoder.cpp or the Makefile is newer) the
+    shared library when the source tree is present — the .so is not checked
+    into git, and a silent fall-back to the slow NumPy path on a fresh
+    checkout would defeat the native decoder's purpose.
+
+    The single staleness/build authority: the loader and the parity test
+    suite both call this.  Returns '' when an up-to-date .so exists, else a
+    human-readable reason.
+    """
     import subprocess
 
     native_dir = os.path.abspath(
         os.path.join(os.path.dirname(__file__), "..", "..", "native"))
-    src = os.path.join(native_dir, "decoder.cpp")
     so = os.path.join(native_dir, "libposedecoder.so")
-    if not os.path.exists(src):
-        return  # installed without sources; nothing to build from
-    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
-        return
+
+    def stale():
+        deps = [os.path.join(native_dir, n) for n in ("decoder.cpp",
+                                                      "Makefile")]
+        if not os.path.exists(so):
+            return True
+        return any(os.path.exists(d)
+                   and os.path.getmtime(so) < os.path.getmtime(d)
+                   for d in deps)
+
+    if not os.path.exists(os.path.join(native_dir, "decoder.cpp")):
+        # installed without sources: usable iff some prebuilt .so exists
+        return "" if any(os.path.exists(p) for p in _LIB_PATHS) else (
+            "no libposedecoder.so and no sources to build it from")
+    if not stale():
+        return ""
     try:
         subprocess.run(["make", "-C", native_dir], check=True,
                        capture_output=True)
-    except Exception as e:  # noqa: BLE001 — surface below via the warning
+    except Exception as e:  # noqa: BLE001 — surfaced via the warning below
         import warnings
 
-        warnings.warn(f"native decoder build failed ({e}); decoding will "
-                      "use the slower NumPy path", RuntimeWarning)
+        stderr = getattr(e, "stderr", b"")
+        detail = (stderr.decode(errors="replace")[-500:]
+                  if stderr else str(e))
+        warnings.warn("native decoder build failed; decoding will use the "
+                      f"slower NumPy path:\n{detail}", RuntimeWarning)
+    if stale():
+        return ("native decoder build failed: libposedecoder.so is missing "
+                "or older than its sources (python tools/build_native.py)")
+    return ""
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -58,11 +81,18 @@ def _load() -> Optional[ctypes.CDLL]:
     if _LIB_TRIED:
         return _LIB
     _LIB_TRIED = True
-    _maybe_build()
+    ensure_built()
     for path in _LIB_PATHS:
         path = os.path.abspath(path)
         if os.path.exists(path):
-            lib = ctypes.CDLL(path)
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError as e:
+                import warnings
+
+                warnings.warn(f"could not load {path} ({e}); trying next "
+                              "candidate / NumPy fallback", RuntimeWarning)
+                continue
             lib.decode_people.restype = ctypes.c_int
             lib.decode_people.argtypes = [
                 ctypes.POINTER(ctypes.c_double), ctypes.c_int,   # peaks, n

@@ -26,21 +26,15 @@ SK = CFG.skeleton
 PARAMS, _ = default_inference_params()
 
 def _skip_reason() -> str:
-    """native_available() builds the .so on demand (infer/native.py); the
-    extra staleness check here refuses to run parity against an outdated
-    binary when that rebuild failed — confusing mismatches are worse than a
-    loud skip."""
-    import os
+    """ensure_built() (the single staleness/build authority in
+    infer/native.py) builds the .so on demand; skip loudly rather than run
+    parity against a stale or unloadable binary."""
+    from improved_body_parts_tpu.infer.native import ensure_built
 
-    available = native_available()
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    so = os.path.join(root, "native", "libposedecoder.so")
-    src = os.path.join(root, "native", "decoder.cpp")
-    if (not os.path.exists(so)
-            or os.path.getmtime(so) < os.path.getmtime(src)):
-        return ("native decoder build failed: libposedecoder.so is missing "
-                "or older than decoder.cpp (python tools/build_native.py)")
-    if not available:
+    reason = ensure_built()
+    if reason:
+        return reason
+    if not native_available():
         return "native decoder not loadable (python tools/build_native.py)"
     return ""
 

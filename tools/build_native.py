@@ -1,8 +1,8 @@
 #!/usr/bin/env python
-"""Build the native C++ decoder (native/libposedecoder.so) with g++.
+"""Build the native C++ decoder (native/libposedecoder.so).
 
-Equivalent to ``make -C native``; kept as a Python entry point so the build
-works without make.
+Thin Python entry point over ``make -C native`` — the Makefile is the single
+source of truth for compiler flags.
 """
 import os
 import subprocess
