@@ -139,7 +139,7 @@ def host_shard(indices: np.ndarray, process_index: int, process_count: int,
 
 def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
             process_index: int = 0, process_count: int = 1,
-            num_workers: int = 0
+            num_workers: int = 0, prefetch: int = 2
             ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Yield batched (images, mask_miss, labels) for one epoch.
 
@@ -147,6 +147,12 @@ def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
     reference's DataLoader workers, train_distributed.py:205-213); 0 is
     synchronous.  Spawn requires an importable ``__main__`` — from a REPL or
     stdin script use ``num_workers=0``.
+
+    ``prefetch`` batches are in flight in the pool ahead of the consumer, so
+    sample synthesis overlaps the device step instead of blocking between
+    steps (the reference gets this from DataLoader's worker prefetch).
+    Samples are deterministic in (seed, epoch, index), so the overlap cannot
+    change results.
     """
     perm = epoch_permutation(len(dataset), epoch, dataset.seed)
     shard = host_shard(perm, process_index, process_count, batch_size)
@@ -162,6 +168,7 @@ def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
         return
 
     import multiprocessing as mp
+    from collections import deque
 
     # spawn, not fork: the parent is JAX-multithreaded and fork from a
     # multithreaded process is a deadlock hazard (py3.12 warns); workers
@@ -170,9 +177,22 @@ def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
     with ctx.Pool(num_workers, initializer=_worker_init,
                   initargs=(dataset.h5_path, dataset.config, dataset.augment,
                             dataset.seed)) as pool:
-        for start in range(0, len(shard), batch_size):
-            idxs = [(int(i), epoch) for i in shard[start: start + batch_size]]
-            yield collate(pool.starmap(_worker_sample, idxs))
+        starts = iter(range(0, len(shard), batch_size))
+        window: deque = deque()
+
+        def submit() -> None:
+            start = next(starts, None)
+            if start is not None:
+                idxs = [(int(i), epoch)
+                        for i in shard[start: start + batch_size]]
+                window.append(pool.starmap_async(_worker_sample, idxs))
+
+        for _ in range(max(1, prefetch)):
+            submit()
+        while window:
+            samples = window.popleft().get()
+            submit()  # keep the window full before handing control back
+            yield collate(samples)
 
 
 _WORKER_DATASET: Optional[CocoPoseDataset] = None

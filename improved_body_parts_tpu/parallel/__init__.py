@@ -6,8 +6,9 @@ from .mesh import (
     replicated,
     shard_batch,
 )
+from .prefetch import device_prefetch
 
 __all__ = [
-    "batch_sharding", "batch_spec", "initialize_distributed", "make_mesh",
-    "replicated", "shard_batch",
+    "batch_sharding", "batch_spec", "device_prefetch",
+    "initialize_distributed", "make_mesh", "replicated", "shard_batch",
 ]

@@ -5,9 +5,12 @@ The framework equivalent of the reference entry scripts' train()/test()
 loop over a host data source, device placement with batch sharding, throttled
 metric readback, append-only epoch log, per-epoch checkpointing.
 
-Host→device: batches are placed with ``shard_batch`` (data-parallel over the
-mesh); metric readback happens every ``print_freq`` steps only — the TPU
-analogue of the reference's throttled all-reduce + cuda.synchronize
+Host→device: batches are placed with ``shard_batch`` via ``device_prefetch``
+(a background thread keeps ``prefetch_depth`` sharded batches in flight, so
+transfer overlaps the asynchronously dispatched device step — the TPU
+analogue of DataLoader prefetch + .cuda(non_blocking), README.md:34); metric
+readback happens every ``print_freq`` steps only — the TPU analogue of the
+reference's throttled all-reduce + cuda.synchronize
 (train_distributed.py:272-298).
 """
 from __future__ import annotations
@@ -19,7 +22,7 @@ import jax
 import numpy as np
 
 from ..config import Config
-from ..parallel import make_mesh, replicated, shard_batch
+from ..parallel.prefetch import device_prefetch
 from ..utils import AverageMeter, StepTimer
 from . import checkpoint as ckpt
 from .state import TrainState
@@ -36,7 +39,8 @@ def train_epoch(state: TrainState, train_step: Callable,
                 batches: Iterable, config: Config, epoch: int,
                 mesh=None, print_freq: Optional[int] = None,
                 is_lead_host: bool = True,
-                log_fn: Callable[[str], None] = print
+                log_fn: Callable[[str], None] = print,
+                prefetch_depth: int = 2
                 ) -> Tuple[TrainState, float]:
     """Run one epoch; returns (state, mean loss).
 
@@ -48,10 +52,10 @@ def train_epoch(state: TrainState, train_step: Callable,
     timer = StepTimer()
     pending = []  # device losses not yet read back
 
+    if mesh is not None:
+        batches = device_prefetch(batches, mesh, depth=prefetch_depth)
     global_batch = None
     for step_idx, batch in enumerate(batches):
-        if mesh is not None:
-            batch = shard_batch(batch, mesh)
         images, mask_miss, labels = batch
         global_batch = images.shape[0]
         state, loss = train_step(state, images, mask_miss, labels)
@@ -76,11 +80,11 @@ def train_epoch(state: TrainState, train_step: Callable,
 
 
 def eval_epoch(state: TrainState, eval_step: Callable, batches: Iterable,
-               mesh=None) -> float:
+               mesh=None, prefetch_depth: int = 2) -> float:
     losses = AverageMeter()
+    if mesh is not None:
+        batches = device_prefetch(batches, mesh, depth=prefetch_depth)
     for batch in batches:
-        if mesh is not None:
-            batch = shard_batch(batch, mesh)
         images, mask_miss, labels = batch
         loss = eval_step(state, images, mask_miss, labels)
         losses.update(float(loss), images.shape[0])

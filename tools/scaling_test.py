@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Weak-scaling measurement: steps/s at increasing device counts with a
+fixed per-device batch (north star: linear data-parallel scaling,
+BASELINE.md:25).
+
+On real multi-chip hardware this reports weak-scaling efficiency directly.
+On a virtual CPU mesh (``JAX_PLATFORMS=cpu`` with
+``--xla_force_host_platform_device_count=N``) the numbers measure
+*correct compilation and execution*, not speedup — all virtual devices
+timeshare the host's cores, so efficiency trends toward 1/N there; use
+tests/test_scaling.py for the cross-mesh equivalence proof instead.
+
+Example:
+    python tools/scaling_test.py --config tiny --devices 1 2 4 8 --steps 20
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description="weak-scaling steps/s")
+    ap.add_argument("--config", default="tiny")
+    ap.add_argument("--devices", type=int, nargs="+", default=[1, 2, 4, 8])
+    ap.add_argument("--batch-per-device", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--image-size", type=int, default=None,
+                    help="override H=W (default: the config's input size)")
+    args = ap.parse_args()
+
+    import jax
+
+    from improved_body_parts_tpu.utils import apply_platform_env
+    apply_platform_env()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.parallel import (
+        make_mesh, replicated, shard_batch)
+    from improved_body_parts_tpu.train import (
+        create_train_state, make_optimizer, make_train_step,
+        step_decay_schedule)
+
+    cfg = get_config(args.config)
+    size = args.image_size or cfg.skeleton.height
+    label = size // cfg.skeleton.stride
+    model = build_model(cfg)
+    rng = np.random.default_rng(0)
+
+    n_avail = len(jax.devices())
+    print(f"platform={jax.devices()[0].platform} devices={n_avail}")
+    base = None
+    for n in args.devices:
+        if n > n_avail:
+            print(f"n={n}: skipped (only {n_avail} devices)")
+            continue
+        mesh = make_mesh(data=n, model=1, devices=jax.devices()[:n])
+        gb = args.batch_per_device * n
+        images = np.asarray(rng.uniform(0, 1, (gb, size, size, 3)),
+                            np.float32)
+        labels = np.asarray(
+            rng.uniform(0, 1, (gb, label, label, cfg.skeleton.num_layers)),
+            np.float32)
+        mask = np.ones((gb, label, label, 1), np.float32)
+
+        sched = step_decay_schedule(cfg.train, steps_per_epoch=100)
+        opt = make_optimizer(cfg, sched)
+        state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
+                                   jnp.zeros((gb, size, size, 3)))
+        state = jax.device_put(state, replicated(mesh))
+        batch = shard_batch((images, mask, labels), mesh)
+        step = make_train_step(model, cfg, opt, donate=False)
+
+        state, loss = step(state, *batch)  # compile + warm
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            state, loss = step(state, *batch)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        sps = args.steps / dt
+        ips = sps * gb
+        if base is None:
+            base = ips / n
+        eff = ips / (base * n)
+        print(f"n={n}: {sps:6.2f} steps/s  {ips:7.2f} imgs/s  "
+              f"weak-scaling eff {eff:5.1%}")
+
+
+if __name__ == "__main__":
+    main()
