@@ -55,10 +55,17 @@ def device_prefetch(batches: Iterable, mesh, depth: int = 2,
         except BaseException as e:  # noqa: BLE001 — re-raised by consumer
             error.append(e)
         finally:
-            try:
-                q.put_nowait(sentinel)
-            except queue.Full:
-                pass  # consumer is gone and will drain anyway
+            # The sentinel must BLOCK until the (possibly slow) consumer
+            # makes room — a full queue here usually means the consumer is
+            # still working through earlier batches, and dropping the
+            # sentinel would strand it in q.get() forever.  stop is the
+            # only abandon signal.
+            while not stop.is_set():
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     thread = threading.Thread(target=producer, daemon=True,
                               name="device-prefetch")

@@ -46,6 +46,29 @@ class TestDevicePrefetch:
         with pytest.raises(RuntimeError, match="boom in producer"):
             list(it)
 
+    def test_slow_consumer_sees_end_of_stream(self, eight_devices):
+        """Regression: when the producer finishes while the queue is still
+        full (consumer slower than producer — the normal state on a fast
+        input pipeline), the end-of-stream sentinel must not be dropped;
+        dropping it strands the consumer in q.get() forever (observed as a
+        mid-epoch deadlock in tools/train.py)."""
+        import threading
+
+        mesh = make_mesh()
+        n = 6
+        got = []
+
+        def consume():
+            for b in device_prefetch(_host_batches(n), mesh, depth=1):
+                time.sleep(0.05)  # slower than the producer
+                got.append(b)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        t.join(timeout=20.0)
+        assert not t.is_alive(), "consumer deadlocked waiting for sentinel"
+        assert len(got) == n
+
     def test_early_abandon_stops_producer(self, eight_devices):
         """Closing the generator mid-stream (step error, Ctrl-C) must stop
         the producer thread and drain queued device buffers instead of
