@@ -1,8 +1,15 @@
 from .meters import AverageMeter, StepTimer
 from .platform import apply_platform_env
 from .profiling import profile_trace, timed
-from .visualize import colorize_jet, export_stablehlo, param_table
+from .visualize import (
+    colorize_jet,
+    export_stablehlo,
+    param_table,
+    save_batch_overlays,
+    train_batch_overlay,
+)
 
 __all__ = ["AverageMeter", "StepTimer", "apply_platform_env",
            "profile_trace", "timed",
-           "colorize_jet", "export_stablehlo", "param_table"]
+           "colorize_jet", "export_stablehlo", "param_table",
+           "save_batch_overlays", "train_batch_overlay"]

@@ -43,6 +43,43 @@ def export_stablehlo(model, variables, sample_images) -> str:
     return lowered.as_text()
 
 
+def train_batch_overlay(image: np.ndarray, maps: np.ndarray,
+                        channel: int, alpha: float = 0.5) -> np.ndarray:
+    """Debug overlay of one train sample: the input image resized to the
+    label grid with a jet-colorized map channel alpha-blended on top
+    (reference: train.py:188-200 show_image block / loss_model.py:61-70 —
+    the matplotlib imshow(img) + imshow(output[..., c], alpha=0.5) debug
+    display, rendered headlessly to a BGR uint8 array).
+
+    :param image: (H, W, 3) float [0,1] or uint8, BGR (pipeline order)
+    :param maps: (h, w, C) GT labels or predictions at stride resolution
+    :param channel: which map channel to overlay (e.g. bkg_start for the
+        person mask, heat_start+k for a keypoint)
+    """
+    import cv2
+
+    h, w = maps.shape[:2]
+    img = image.astype(np.float32)
+    if img.max() > 1.5:  # uint8 range
+        img = img / 255.0
+    img = cv2.resize(img, (w, h), interpolation=cv2.INTER_CUBIC)
+    heat = colorize_jet(np.asarray(maps[..., channel], np.float32)) / 255.0
+    out = (1 - alpha) * np.clip(img, 0, 1) + alpha * heat
+    return (np.clip(out, 0, 1) * 255).astype(np.uint8)
+
+
+def save_batch_overlays(path: str, images: np.ndarray, maps: np.ndarray,
+                        channels, alpha: float = 0.5) -> str:
+    """Tile ``len(channels)`` overlays of the first batch element side by
+    side and write a PNG; returns the path."""
+    import cv2
+
+    tiles = [train_batch_overlay(images[0], maps[0], c, alpha)
+             for c in channels]
+    cv2.imwrite(path, np.concatenate(tiles, axis=1))
+    return path
+
+
 def colorize_jet(gray: np.ndarray) -> np.ndarray:
     """Jet colormap (values in [0,1]) → float BGR array in [0,255]
     (reference: utils/util.py:12-41, vectorized)."""

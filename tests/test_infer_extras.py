@@ -81,6 +81,38 @@ def test_colorize_jet_endpoints():
     assert out[2, 2] > 0 and out[2, 0] == 0
 
 
+def test_train_batch_overlay_and_save(tmp_path):
+    """The headless twin of the reference's show_image debug display
+    (train.py:188-200): image resized to the label grid with a jet-blended
+    channel; the saver tiles channels and writes a PNG."""
+    import cv2
+
+    from improved_body_parts_tpu.utils import (
+        save_batch_overlays, train_batch_overlay)
+
+    rng = np.random.default_rng(0)
+    img = rng.uniform(0, 1, (128, 128, 3)).astype(np.float32)
+    maps = np.zeros((32, 32, 50), np.float32)
+    maps[10:20, 10:20, 48] = 1.0  # a hot patch on the bkg channel
+
+    out = train_batch_overlay(img, maps, channel=48, alpha=0.5)
+    assert out.shape == (32, 32, 3) and out.dtype == np.uint8
+    # the hot patch blends toward jet(1.0) (red-dominant in BGR)
+    hot, cold = out[15, 15], out[0, 0]
+    assert int(hot[2]) > int(cold[2])
+
+    # uint8 input takes the /255 path
+    out8 = train_batch_overlay((img * 255).astype(np.uint8), maps, 48)
+    assert out8.shape == (32, 32, 3)
+
+    path = str(tmp_path / "overlay.png")
+    images = img[None]
+    ret = save_batch_overlays(path, images, maps[None], channels=(48, 30))
+    assert ret == path
+    written = cv2.imread(path)
+    assert written is not None and written.shape == (32, 64, 3)
+
+
 def test_param_table():
     import jax
     import jax.numpy as jnp

@@ -42,6 +42,11 @@ def main():
                     help="cyclic LR peak (train_distributed_SWA.py:365)")
     ap.add_argument("--swa-lr-min", type=float, default=1e-6)
     ap.add_argument("--print-freq", type=int, default=10)
+    ap.add_argument("--debug-overlays", action="store_true",
+                    help="save a GT heatmap overlay of the first batch each "
+                         "epoch under <checkpoint_dir>/overlays (the "
+                         "reference's show_image debug display, "
+                         "train.py:188-200)")
     # multi-host (jax.distributed)
     ap.add_argument("--coordinator", default=None)
     ap.add_argument("--num-processes", type=int, default=1)
@@ -141,8 +146,26 @@ def main():
     is_lead = args.process_id == 0
 
     def make_train_batches(epoch):
-        return batches(ds, host_batch, epoch, args.process_id,
-                       args.num_processes, num_workers=args.workers)
+        it = batches(ds, host_batch, epoch, args.process_id,
+                     args.num_processes, num_workers=args.workers)
+        if not (args.debug_overlays and is_lead):
+            return it
+
+        def with_overlay():
+            from improved_body_parts_tpu.utils import save_batch_overlays
+
+            overlay_dir = os.path.join(cfg.train.checkpoint_dir, "overlays")
+            os.makedirs(overlay_dir, exist_ok=True)
+            for i, (images, mask, labels) in enumerate(it):
+                if i == 0:
+                    sk = cfg.skeleton
+                    save_batch_overlays(
+                        os.path.join(overlay_dir, f"epoch_{epoch}.png"),
+                        images, labels,
+                        channels=(sk.bkg_start, sk.heat_start))
+                yield images, mask, labels
+
+        return with_overlay()
 
     make_eval_batches = None
     if val_ds is not None:
