@@ -10,11 +10,13 @@ from .demo import draw_skeletons, limb_flow_bgr, run_demo
 from .evaluate import format_results, process_image, validation
 from .native import native_available
 from .oks import evaluate_oks, oks
+from .pipeline import pipelined_inference
 from .predict import Predictor, center_pad, pad_right_down
 
 __all__ = [
     "assemble", "decode", "find_connections", "find_peaks", "find_people",
     "subsets_to_keypoints", "draw_skeletons", "limb_flow_bgr", "run_demo",
     "format_results", "process_image", "validation", "native_available",
-    "evaluate_oks", "oks", "Predictor", "center_pad", "pad_right_down",
+    "evaluate_oks", "oks", "pipelined_inference", "Predictor", "center_pad",
+    "pad_right_down",
 ]

@@ -1,0 +1,56 @@
+"""Pipelined end-to-end inference: forward(N+1) on the device overlaps
+decode(N) on the host.
+
+The reference runs strictly serially — forward, transfer, then the CPU
+decode that dominates end-to-end time (5.2 FPS keypoint assignment,
+reference: README.md:68, evaluate.py:501-543).  Here the jitted ensemble for
+the next image is dispatched *before* the previous image's maps are read
+back and decoded, and decoding itself can fan out over a thread pool (the
+native C++ decoder releases the GIL during the ctypes call), so the chip
+never waits for the host.
+
+Results are yielded strictly in input order.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..config import InferenceParams, SkeletonConfig
+from .decode import decode
+
+
+def pipelined_inference(predictor, images: Iterable[np.ndarray],
+                        params: Optional[InferenceParams] = None,
+                        skeleton: Optional[SkeletonConfig] = None,
+                        use_native: bool = True,
+                        decode_workers: int = 2) -> Iterator[list]:
+    """Run the fast path over a stream of BGR images, overlapping stages.
+
+    Yields ``decode`` results (list of (coco_keypoints, score) per image) in
+    input order.  ``decode_workers`` decodes run concurrently; with the
+    native decoder the GIL is released so they truly parallelize.
+    """
+    params = params or predictor.params
+    skeleton = skeleton or predictor.skeleton
+
+    def run_decode(resolve: Callable):
+        heat, paf, mask, scale = resolve()
+        return decode(heat, paf, params, skeleton, peak_mask=mask,
+                      coord_scale=scale, use_native=use_native)
+
+    with ThreadPoolExecutor(max_workers=max(1, decode_workers)) as pool:
+        futures = []
+        window = max(1, decode_workers)
+        for image in images:
+            # dispatch forward; thre1 from the caller's params must reach
+            # the on-device NMS, same as the sequential fast path
+            resolve = predictor.predict_fast_async(image, thre1=params.thre1)
+            futures.append(pool.submit(run_decode, resolve))
+            # bound the number of in-flight images; yield the oldest
+            while len(futures) > window:
+                yield futures.pop(0).result()
+        for fut in futures:
+            yield fut.result()

@@ -192,6 +192,19 @@ class Predictor:
             resolution; multiply decoded (x, y) by (sx, sy) to land in
             original-image coordinates.
         """
+        return self.predict_fast_async(image_bgr, thre1)()
+
+    def predict_fast_async(self, image_bgr: np.ndarray,
+                           thre1: Optional[float] = None):
+        """Dispatch the fast-path ensemble for one image and return a
+        ``resolve()`` closure instead of blocking on the result.
+
+        JAX dispatch is asynchronous: the jitted program runs on the device
+        while the host goes on to decode the PREVIOUS image (or prepare the
+        next one).  ``resolve()`` blocks on this image's device→host
+        transfer and returns exactly what :meth:`predict_fast` returns.
+        Used by ``infer.pipeline.pipelined_inference``.
+        """
         sk, prm, mp = self.skeleton, self.params, self.model_params
         if len(prm.scale_search) != 1 or tuple(prm.rotation_search) != (0.0,):
             raise ValueError(
@@ -204,11 +217,15 @@ class Predictor:
         maps_d, peaks_d = self._ensemble_fn(
             img.shape[:2], with_peaks=True, thre1=thre1)(
             self.variables, img, rh, rw)
-        maps = np.asarray(maps_d, dtype=np.float32)[:rh, :rw]
-        peak_mask = np.asarray(peaks_d)[:rh, :rw]
-        heat = maps[..., sk.paf_layers:]
-        paf = maps[..., :sk.paf_layers]
-        return heat, paf, peak_mask, (ow / rw, oh / rh)
+
+        def resolve():
+            maps = np.asarray(maps_d, dtype=np.float32)[:rh, :rw]
+            peak_mask = np.asarray(peaks_d)[:rh, :rw]
+            heat = maps[..., sk.paf_layers:]
+            paf = maps[..., :sk.paf_layers]
+            return heat, paf, peak_mask, (ow / rw, oh / rh)
+
+        return resolve
 
     def _clamp_scale(self, scale: float, oh: int, ow: int) -> float:
         mp = self.model_params

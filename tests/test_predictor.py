@@ -238,6 +238,51 @@ def test_rotation_grid_returns_maps_to_original_orientation(shape):
     assert peak > 0.6 * heat0[..., 0].max(), (peak, heat0[..., 0].max())
 
 
+def test_pipelined_inference_matches_sequential():
+    """pipelined_inference (forward N+1 overlaps decode N, threaded decode)
+    must yield exactly the sequential predict_fast→decode results, in input
+    order — including across images of different sizes."""
+    import dataclasses
+
+    from improved_body_parts_tpu.data.heatmapper import Heatmapper
+    from improved_body_parts_tpu.infer import decode, pipelined_inference
+
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_decode import synth_person_joints
+
+    h = w = 256
+    rng = np.random.default_rng(4)
+    joints = synth_person_joints(70, 40, 180).astype(np.float32)
+    small = dataclasses.replace(SK, width=w, height=h)
+    maps = Heatmapper(small).create_heatmaps(
+        joints, np.ones(small.grid_shape, np.float32))
+    maps = (maps + rng.uniform(0, 1e-6, maps.shape)).astype(np.float32)
+
+    pred = _stub_predictor(maps, boxsize=h)
+    params, _ = default_inference_params()
+    # different sizes exercise ordering (different buckets + coord scales)
+    images = [np.zeros((h, w, 3), np.uint8),
+              np.zeros((192, 256, 3), np.uint8),
+              np.zeros((h, w, 3), np.uint8),
+              np.zeros((h, w, 3), np.uint8)]
+
+    sequential = []
+    for img in images:
+        fh, fp, mask, scale = pred.predict_fast(img)
+        sequential.append(decode(fh, fp, params, SK, peak_mask=mask,
+                                 coord_scale=scale))
+
+    piped = list(pipelined_inference(pred, images, decode_workers=2))
+    assert len(piped) == len(sequential) == 4
+    for seq, pipe in zip(sequential, piped):
+        assert len(seq) == len(pipe)
+        for (ca, sa), (cb, sb) in zip(seq, pipe):
+            assert sa == pytest.approx(sb, abs=1e-6)
+            assert ca == cb
+
+
 def test_bucketing_reuses_programs():
     rng = np.random.default_rng(2)
     maps = rng.uniform(0, 1, (64, 64, SK.num_layers)).astype(np.float32)

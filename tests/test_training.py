@@ -221,3 +221,27 @@ class TestTrainStep:
         mask = np.ones((8, 8, 8, 1), np.float32)
         new_state, loss = step(restored, images, mask, labels)
         assert np.isfinite(float(loss))
+
+    def test_curriculum_resolution_resume(self, tmp_path):
+        """The reference's 384→512 curriculum (checkpoints/log): a
+        checkpoint trained at one input resolution restores into a state
+        built at a larger one — conv params and BN stats are
+        size-independent — and a step at the new resolution runs."""
+        cfg, model, opt, state = _tiny_setup()
+        path = save_checkpoint(str(tmp_path), state, epoch=0,
+                               train_loss=2.0, best_loss=2.0)
+
+        big = jnp.zeros((8, 64, 64, 3))  # double the trained resolution
+        state512 = create_train_state(model, cfg, opt, jax.random.PRNGKey(1),
+                                      big)
+        restored, meta = restore_checkpoint(path, state512)
+        rng = np.random.default_rng(2)
+        images = np.asarray(rng.uniform(0, 1, (8, 64, 64, 3)), np.float32)
+        labels = np.asarray(
+            rng.uniform(0, 1, (8, 16, 16, cfg.skeleton.num_layers)),
+            np.float32)
+        mask = np.ones((8, 16, 16, 1), np.float32)
+        step = make_train_step(model, cfg, opt, donate=False)
+        new_state, loss = step(restored, images, mask, labels)
+        assert np.isfinite(float(loss))
+        assert int(new_state.step) == int(state.step) + 1
