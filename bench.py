@@ -42,22 +42,16 @@ def _watchdog(seconds, message):
 
 
 def _backend_ready(timeout_s):
-    """True if jax.devices() returns within timeout_s (it hangs forever when
-    the TPU claim is held by a dead client)."""
-    import jax
+    """True if jax.devices() returns within timeout_s (it can hang or error
+    for many minutes when the TPU claim is held by a dead client)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from improved_body_parts_tpu.utils import devices_with_timeout
 
-    result = {}
-
-    def probe():
-        try:
-            result["devices"] = jax.devices()
-        except Exception as e:  # noqa: BLE001
-            result["error"] = e
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    return "devices" in result
+    try:
+        devices_with_timeout(timeout_s)
+        return True
+    except (RuntimeError, TimeoutError):
+        return False
 
 
 def main():
@@ -74,6 +68,15 @@ def main():
                   env)
 
     import jax
+
+    if fallback:
+        # belt-and-braces: the env vars set by the re-exec are not always
+        # honoured once a sitecustomize has registered an accelerator
+        # plugin; the config update is what actually sticks
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — backend already initialized
+            pass
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from __graft_entry__ import entry

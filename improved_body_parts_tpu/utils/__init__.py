@@ -1,5 +1,5 @@
 from .meters import AverageMeter, StepTimer
-from .platform import apply_platform_env
+from .platform import apply_platform_env, devices_with_timeout, force_cpu
 from .profiling import profile_trace, timed
 from .visualize import (
     colorize_jet,
@@ -10,6 +10,7 @@ from .visualize import (
 )
 
 __all__ = ["AverageMeter", "StepTimer", "apply_platform_env",
+           "devices_with_timeout", "force_cpu",
            "profile_trace", "timed",
            "colorize_jet", "export_stablehlo", "param_table",
            "save_batch_overlays", "train_batch_overlay"]

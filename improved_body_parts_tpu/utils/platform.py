@@ -15,6 +15,7 @@ the accelerator.
 from __future__ import annotations
 
 import os
+import threading
 
 
 def apply_platform_env() -> None:
@@ -44,6 +45,38 @@ def _pin_platform(platforms: str) -> None:
         # backend already initialized — the selection (whatever it was)
         # has been made; verification is the caller's job
         pass
+
+
+def devices_with_timeout(timeout_s: float = 600.0):
+    """``jax.devices()`` under a daemon-thread watchdog.
+
+    On an exclusively-claimed accelerator (the axon relay), backend
+    bring-up can sit in the claim bind loop for many minutes when the claim
+    is wedged by a dead client; every CLI that touches the chip goes
+    through here so a wedge surfaces as a clean error, not a silent hang.
+
+    Returns the device list; raises RuntimeError when the backend errored,
+    TimeoutError when bring-up exceeded ``timeout_s``.
+    """
+    import jax
+
+    result: dict = {}
+
+    def probe():
+        try:
+            result["devices"] = jax.devices()
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devices" in result:
+        return result["devices"]
+    if "error" in result:
+        raise RuntimeError(f"backend unavailable: {result['error']}")
+    raise TimeoutError(
+        f"backend bring-up exceeded {timeout_s:.0f}s (wedged claim?)")
 
 
 def force_cpu(min_devices: int = 1) -> None:

@@ -35,7 +35,8 @@ def main():
 
     import jax
 
-    from improved_body_parts_tpu.utils import apply_platform_env
+    from improved_body_parts_tpu.utils import (
+        apply_platform_env, devices_with_timeout)
     apply_platform_env()
 
     import jax.numpy as jnp
@@ -44,7 +45,10 @@ def main():
     from improved_body_parts_tpu.ops.losses import focal_l2
     from improved_body_parts_tpu.ops.pallas_focal import focal_l2_pallas
 
-    platform = jax.devices()[0].platform
+    try:
+        platform = devices_with_timeout(600)[0].platform
+    except (RuntimeError, TimeoutError) as e:
+        raise SystemExit(str(e))
     print(f"platform={platform} interpret={args.interpret}")
 
     S, N, H, C = args.stacks, args.batch, args.hw, args.channels

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""One-process TPU measurement session.
+
+The chip behind the axon relay is claimed EXCLUSIVELY at first device use
+and a dead claimant can wedge the pool — so when a chip is available, run
+everything in ONE process, sequentially, and exit cleanly:
+
+1. single-image 512x512 flip-model forward FPS (the bench.py headline,
+   reference: test_inference_speed.py:90-120, baseline 38.5);
+2. batch sweep (throughput mode — TPUs amortize per-dispatch overhead);
+3. Pallas focal kernel parity + timing vs the XLA loss (Mosaic lowering);
+4. optional profiler trace for the single-image program.
+
+Writes a JSON summary to --out (default TPURUN.json) and prints progress.
+
+    python tools/tpu_session.py            # full session on the active chip
+    JAX_PLATFORMS=cpu python tools/tpu_session.py --quick   # smoke on CPU
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BACKEND_TIMEOUT_S = 900
+
+
+def main():
+    ap = argparse.ArgumentParser(description="one-process TPU session")
+    ap.add_argument("--out", default="TPURUN.json")
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--batches", type=int, nargs="+", default=[1, 2, 4, 8])
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes / few iters (CPU smoke)")
+    ap.add_argument("--skip-pallas", action="store_true")
+    ap.add_argument("--profile-dir", default=None,
+                    help="write a jax.profiler trace here")
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.utils import (
+        apply_platform_env, devices_with_timeout)
+    apply_platform_env()
+
+    import jax
+
+    try:
+        devices = devices_with_timeout(60 if args.quick
+                                       else BACKEND_TIMEOUT_S)
+    except (RuntimeError, TimeoutError) as e:
+        raise SystemExit(str(e))
+    platform = devices[0].platform
+    print(f"platform={platform} devices={len(devices)}", flush=True)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.models import build_model
+
+    summary = {"platform": platform, "baseline_fps": 38.5}
+
+    def flush_summary():
+        # the chip session is scarce: persist after EVERY section so a late
+        # failure never discards earlier measurements
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2)
+    size = 128 if args.quick else 512
+    iters = 3 if args.quick else args.iters
+    cfg = get_config("tiny" if args.quick else "canonical")
+    model = build_model(cfg)
+
+    def timed(fn, *a, n=iters, warmup=2):
+        out = fn(*a)
+        jax.block_until_ready(out)
+        for _ in range(warmup):
+            out = fn(*a)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*a)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / n
+
+    # --- 1. single-image forward (the headline) --------------------------
+    imgs = jnp.zeros((1, size, size, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), imgs, train=False)
+    fwd = jax.jit(lambda v, x: model.apply(v, x, train=False)[-1][0])
+    print("compiling single-image forward...", flush=True)
+    dt = timed(fwd, variables, imgs)
+    fps = 1.0 / dt
+    summary["single_image_fps"] = round(fps, 2)
+    summary["vs_baseline"] = round(fps / 38.5, 3)
+    flush_summary()
+    print(f"single-image {size}x{size}: {fps:.2f} imgs/s "
+          f"({dt * 1e3:.2f} ms)", flush=True)
+
+    # --- 2. batch sweep --------------------------------------------------
+    sweep = {}
+    for b in args.batches:
+        bi = jnp.zeros((b, size, size, 3), jnp.float32)
+        dt = timed(fwd, variables, bi)
+        sweep[b] = round(b / dt, 2)
+        print(f"batch {b}: {sweep[b]:.2f} imgs/s", flush=True)
+    summary["batch_sweep_fps"] = sweep
+    flush_summary()
+
+    # --- 3. pallas kernel ------------------------------------------------
+    if not args.skip_pallas:
+        from improved_body_parts_tpu.ops.losses import focal_l2
+        from improved_body_parts_tpu.ops.pallas_focal import focal_l2_pallas
+
+        S, N, H, C = (2, 2, 32, 50) if args.quick else (4, 4, 128, 50)
+        rng = np.random.default_rng(0)
+        pred = jnp.asarray(rng.uniform(-0.2, 1.2, (S, N, H, H, C)),
+                           jnp.float32)
+        gt = jnp.asarray(
+            (rng.uniform(0, 1, (N, H, H, C)) > 0.7)
+            * rng.uniform(0, 1, (N, H, H, C)), jnp.float32)
+        mask = jnp.ones((N, H, H, 1), jnp.float32)
+        chan = jnp.ones((C,), jnp.float32)
+        interpret = platform == "cpu"
+        p_fn = jax.jit(lambda p: focal_l2_pallas(p, gt, mask, chan,
+                                                 interpret))
+        x_fn = jax.jit(lambda p: focal_l2(p, gt[None], mask[None]))
+        # the custom-VJP backward is a SECOND pallas program — it must also
+        # survive real lowering before use_pallas_loss can be trusted
+        gp_fn = jax.jit(jax.grad(lambda p: p_fn(p).sum()))
+        gx_fn = jax.jit(jax.grad(lambda p: x_fn(p).sum()))
+        try:
+            err = float(jnp.abs(p_fn(pred) - x_fn(pred)).max()
+                        / jnp.abs(x_fn(pred)).max())
+            gerr = float(jnp.abs(gp_fn(pred) - gx_fn(pred)).max()
+                         / (jnp.abs(gx_fn(pred)).max() + 1e-12))
+            tp, tx = timed(p_fn, pred), timed(x_fn, pred)
+            tgp, tgx = timed(gp_fn, pred), timed(gx_fn, pred)
+            summary["pallas"] = {
+                "rel_err": err, "grad_rel_err": gerr,
+                "pallas_ms": round(tp * 1e3, 3),
+                "xla_ms": round(tx * 1e3, 3),
+                "pallas_grad_ms": round(tgp * 1e3, 3),
+                "xla_grad_ms": round(tgx * 1e3, 3),
+                "parity_ok": err < 1e-4 and gerr < 1e-4,
+                "pallas_wins": tp < tx and tgp < tgx,
+            }
+            print(f"pallas: rel_err {err:.2e} grad {gerr:.2e}  "
+                  f"fwd {tp * 1e3:.3f}/{tx * 1e3:.3f} ms  "
+                  f"grad {tgp * 1e3:.3f}/{tgx * 1e3:.3f} ms", flush=True)
+        except Exception as e:  # noqa: BLE001 — Mosaic may reject the kernel
+            summary["pallas"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"pallas FAILED under real lowering: {e}", flush=True)
+        flush_summary()
+
+    # --- 4. optional profile trace --------------------------------------
+    if args.profile_dir:
+        try:
+            with jax.profiler.trace(args.profile_dir):
+                for _ in range(5):
+                    out = fwd(variables, imgs)
+                jax.block_until_ready(out)
+            summary["profile_dir"] = args.profile_dir
+            print(f"trace written to {args.profile_dir}", flush=True)
+        except Exception as e:  # noqa: BLE001 — never lose the session
+            summary["profile_error"] = f"{type(e).__name__}: {e}"
+            print(f"profiling failed (session results kept): {e}",
+                  flush=True)
+
+    flush_summary()
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()
