@@ -58,21 +58,41 @@ def center_pad(img: np.ndarray, multiple: int, pad_value: int
 
 
 class Predictor:
-    """Holds the jitted ensemble forward, cached per padded input shape."""
+    """Holds the jitted ensemble forward, cached per padded input shape.
+
+    ``mesh`` (optional, a ('data','model') ``jax.sharding.Mesh``) spreads
+    one image's inference across chips: the 2 flip-ensemble lanes shard
+    over 'data' and the image height over 'model' (GSPMD inserts the conv
+    halo exchanges) — the spatial-partitioning path for inputs too large
+    for one chip's HBM.  Results are identical to the single-device path
+    (pinned by tests/test_scaling.py-style equality in
+    tests/test_predictor.py).
+    """
 
     def __init__(self, model, variables, skeleton: SkeletonConfig,
                  params: Optional[InferenceParams] = None,
                  model_params: Optional[InferenceModelParams] = None,
-                 bucket: int = 128):
+                 bucket: int = 128, mesh=None):
         from ..config import default_inference_params
 
         d_params, d_model_params = default_inference_params()
         self.model = model
-        self.variables = variables
         self.skeleton = skeleton
         self.params = params or d_params
         self.model_params = model_params or d_model_params
         self.bucket = max(bucket, self.model_params.max_downsample)
+        self.mesh = mesh
+        if mesh is not None:
+            import jax
+
+            from ..parallel import replicated
+
+            if mesh.shape.get("data", 1) not in (1, 2):
+                raise ValueError(
+                    "the ensemble batch is 2 (image + flip): the mesh "
+                    f"'data' axis must be 1 or 2, got {mesh.shape}")
+            variables = jax.device_put(variables, replicated(mesh))
+        self.variables = variables
         # jitted program cache keyed by (padded shape, with_peaks, thre1)
         self._fns: Dict[Tuple[Tuple[int, int], bool, Optional[float]],
                         object] = {}
@@ -102,8 +122,19 @@ class Predictor:
         flip_heat = jnp.asarray(sk.flip_heat_ord)
         stride = sk.stride
 
+        if self.mesh is not None:
+            from ..parallel import batch_sharding
+
+            lane_spatial = batch_sharding(self.mesh, spatial_shard=True)
+        else:
+            lane_spatial = None
+
         def ensemble(variables, img):
             both = jnp.stack([img, img[:, ::-1, :]], axis=0)
+            if lane_spatial is not None:
+                # flip lanes over 'data', height over 'model' — GSPMD
+                # inserts the conv halo exchanges
+                both = jax.lax.with_sharding_constraint(both, lane_spatial)
             preds = self.model.apply(variables, both, train=False)
             out = preds[-1][0]  # last stack, scale 0: (2, H/4, W/4, C)
             straight, mirrored = out[0], out[1][:, ::-1, :]
