@@ -99,17 +99,36 @@ class CocoPoseDataset:
         return (img, mask_miss, mask_all, joints,
                 tuple(meta["objpos"][0]), float(meta["scale_provided"][0]))
 
+    def _augmented(self, index: int, epoch: int):
+        img, mask_miss, mask_all, joints, objpos, scale = self.read_raw(index)
+        rng = np.random.default_rng((self.seed, epoch, index))
+        aug = None if self.augment else AugmentParams.identity()
+        return self.transformer.transform(
+            img, mask_miss, mask_all, joints, objpos, scale, aug=aug, rng=rng)
+
     def sample(self, index: int, epoch: int = 0
                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Generate one training sample deterministically from
         (seed, epoch, index)."""
-        img, mask_miss, mask_all, joints, objpos, scale = self.read_raw(index)
-        rng = np.random.default_rng((self.seed, epoch, index))
-        aug = None if self.augment else AugmentParams.identity()
-        img, mask_miss, mask_all, joints = self.transformer.transform(
-            img, mask_miss, mask_all, joints, objpos, scale, aug=aug, rng=rng)
+        img, mask_miss, mask_all, joints = self._augmented(index, epoch)
         labels = self.heatmapper.create_heatmaps(joints, mask_all)
         return img, mask_miss[..., None], labels
+
+    def sample_raw(self, index: int, epoch: int = 0, max_people: int = 16
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Device-GT variant of :meth:`sample`: same deterministic
+        augmentation, but returns (image, mask_miss, padded joints,
+        mask_all) — labels are synthesized on device inside the train step
+        (ops.make_gt_synthesizer).  Padding rows carry visibility 2
+        ("absent"); people beyond ``max_people`` are dropped (rare on COCO;
+        raise ``max_people`` if the corpus is denser)."""
+        img, mask_miss, mask_all, joints = self._augmented(index, epoch)
+        padded = np.zeros((max_people, joints.shape[1], 3), np.float32)
+        padded[:, :, 2] = 2.0
+        n = min(len(joints), max_people)
+        padded[:n] = joints[:n]
+        return (img, mask_miss[..., None], padded,
+                mask_all.astype(np.float32)[..., None])
 
     def close(self):
         if self._file is not None:
@@ -139,8 +158,8 @@ def host_shard(indices: np.ndarray, process_index: int, process_count: int,
 
 def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
             process_index: int = 0, process_count: int = 1,
-            num_workers: int = 0, prefetch: int = 2
-            ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+            num_workers: int = 0, prefetch: int = 2, raw_gt: int = 0
+            ) -> Iterator[Tuple[np.ndarray, ...]]:
     """Yield batched (images, mask_miss, labels) for one epoch.
 
     ``num_workers > 0`` generates samples in a spawn-based process pool (the
@@ -153,18 +172,26 @@ def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
     steps (the reference gets this from DataLoader's worker prefetch).
     Samples are deterministic in (seed, epoch, index), so the overlap cannot
     change results.
+
+    ``raw_gt > 0``: yield (images, mask_miss, joints, mask_all) batches for
+    on-device GT synthesis instead of host labels; the value is the
+    ``max_people`` padding (``CocoPoseDataset.sample_raw``).
     """
     perm = epoch_permutation(len(dataset), epoch, dataset.seed)
     shard = host_shard(perm, process_index, process_count, batch_size)
 
+    def gen(i):
+        if raw_gt > 0:
+            return dataset.sample_raw(int(i), epoch, max_people=raw_gt)
+        return dataset.sample(int(i), epoch)
+
     def collate(samples):
-        imgs, masks, labels = zip(*samples)
-        return (np.stack(imgs), np.stack(masks), np.stack(labels))
+        return tuple(np.stack(col) for col in zip(*samples))
 
     if num_workers <= 0:
         for start in range(0, len(shard), batch_size):
             idxs = shard[start: start + batch_size]
-            yield collate([dataset.sample(int(i), epoch) for i in idxs])
+            yield collate([gen(i) for i in idxs])
         return
 
     import multiprocessing as mp
@@ -179,13 +206,16 @@ def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
                             dataset.seed)) as pool:
         starts = iter(range(0, len(shard), batch_size))
         window: deque = deque()
+        # one mode switch: worker fn and its extra args are selected together
+        worker_fn, extra = ((_worker_sample_raw, (raw_gt,)) if raw_gt > 0
+                            else (_worker_sample, ()))
 
         def submit() -> None:
             start = next(starts, None)
             if start is not None:
-                idxs = [(int(i), epoch)
+                idxs = [(int(i), epoch, *extra)
                         for i in shard[start: start + batch_size]]
-                window.append(pool.starmap_async(_worker_sample, idxs))
+                window.append(pool.starmap_async(worker_fn, idxs))
 
         for _ in range(max(1, prefetch)):
             submit()
@@ -206,3 +236,7 @@ def _worker_init(h5_path, config, augment, seed):
 
 def _worker_sample(index, epoch):
     return _WORKER_DATASET.sample(index, epoch)
+
+
+def _worker_sample_raw(index, epoch, max_people):
+    return _WORKER_DATASET.sample_raw(index, epoch, max_people=max_people)

@@ -44,8 +44,10 @@ def train_epoch(state: TrainState, train_step: Callable,
                 ) -> Tuple[TrainState, float]:
     """Run one epoch; returns (state, mean loss).
 
-    ``batches`` yields (images, mask_miss, labels) host arrays — this host's
-    shard of the global batch when running multi-host.
+    ``batches`` yields (images, mask_miss, labels) host arrays — or
+    (images, mask_miss, joints, mask_all) when ``train_step`` was built
+    with ``device_gt=True`` — this host's shard of the global batch when
+    running multi-host.
     """
     print_freq = print_freq or config.train.print_freq
     losses = AverageMeter()
@@ -56,9 +58,10 @@ def train_epoch(state: TrainState, train_step: Callable,
         batches = device_prefetch(batches, mesh, depth=prefetch_depth)
     global_batch = None
     for step_idx, batch in enumerate(batches):
-        images, mask_miss, labels = batch
-        global_batch = images.shape[0]
-        state, loss = train_step(state, images, mask_miss, labels)
+        # batch is (images, mask_miss, labels) — or (images, mask_miss,
+        # joints, mask_all) when the step synthesizes GT on device
+        global_batch = batch[0].shape[0]
+        state, loss = train_step(state, *batch)
         pending.append(loss)
 
         if (step_idx + 1) % print_freq == 0:
@@ -85,9 +88,8 @@ def eval_epoch(state: TrainState, eval_step: Callable, batches: Iterable,
     if mesh is not None:
         batches = device_prefetch(batches, mesh, depth=prefetch_depth)
     for batch in batches:
-        images, mask_miss, labels = batch
-        loss = eval_step(state, images, mask_miss, labels)
-        losses.update(float(loss), images.shape[0])
+        loss = eval_step(state, *batch)
+        losses.update(float(loss), batch[0].shape[0])
     return losses.avg
 
 

@@ -30,16 +30,33 @@ def make_train_step(model, config: Config,
                     optimizer: optax.GradientTransformation,
                     use_focal: bool = True,
                     donate: bool = True,
-                    freeze_bn: bool = False) -> Callable:
+                    freeze_bn: bool = False,
+                    device_gt: bool = False) -> Callable:
     """Build the jitted (state, images, mask_miss, gt) -> (state, loss) step.
 
     ``freeze_bn=True`` runs BatchNorm on its running averages without
     updating them — the SWA fine-tuning mode (reference:
     train_distributed_SWA.py:219-221, utils/util.py:214-223).
-    """
 
-    def train_step(state: TrainState, images, mask_miss, gt
+    ``device_gt=True`` changes the step signature to
+    (state, images, mask_miss, joints, mask_all): the GT label tensor is
+    synthesized ON DEVICE inside the step (ops.make_gt_synthesizer) from
+    padded joint coordinates, so only (max_people, parts, 3) + masks cross
+    the host→device boundary instead of the (h, w, 50) maps — the
+    input-bottleneck path for feeding a pod slice (SURVEY.md §7f).
+    """
+    if device_gt:
+        from ..ops.gt_device import make_gt_synthesizer
+
+        synthesize = make_gt_synthesizer(config.skeleton)
+
+    def train_step(state: TrainState, images, mask_miss, *gt_args
                    ) -> Tuple[TrainState, jnp.ndarray]:
+        if device_gt:
+            joints, mask_all = gt_args
+            gt = jax.vmap(synthesize)(joints, mask_all[..., 0])
+        else:
+            (gt,) = gt_args
         def loss_fn(params):
             if freeze_bn:
                 preds = model.apply(

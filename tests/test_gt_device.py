@@ -48,3 +48,64 @@ def test_empty_people(synthesize):
     out = np.asarray(synthesize(joints, np.ones(SK.grid_shape, np.float32)))
     assert out[..., :SK.bkg_start].max() == 0.0
     assert out[..., SK.bkg_start].min() == 1.0  # full mask survives erosion
+
+
+class TestDeviceGTTrainStep:
+    def test_device_gt_step_matches_host_label_step(self, eight_devices):
+        """make_train_step(device_gt=True) consumes (joints, mask_all) and
+        must produce the same loss and update as the host-label step fed
+        the Heatmapper's output for the same batch."""
+        import sys
+
+        sys.path.insert(0, "tests")
+        import jax
+        import jax.numpy as jnp
+
+        from improved_body_parts_tpu.parallel import make_mesh, replicated, shard_batch
+        from improved_body_parts_tpu.train import make_train_step
+        from test_training import _tiny_setup
+
+        cfg, model, opt, state = _tiny_setup()
+        sk = cfg.skeleton
+        mesh = make_mesh(data=8, model=1)
+        state = jax.device_put(state, replicated(mesh))
+
+        n = 8
+        rng = np.random.default_rng(11)
+        images = np.asarray(rng.uniform(0, 1, (n, 32, 32, 3)), np.float32)
+        mask_miss = np.ones((n, *sk.grid_shape, 1), np.float32)
+        joints = np.zeros((n, 4, sk.num_parts, 3), np.float32)
+        joints[..., 2] = 2
+        for i in range(n):
+            j, _ = _random_case_small(rng, sk)
+            joints[i] = j
+        mask_all = np.ones((n, *sk.grid_shape, 1), np.float32)
+
+        hm = Heatmapper(sk)
+        labels = np.stack([
+            hm.create_heatmaps(joints[i].copy(), mask_all[i, ..., 0].copy())
+            for i in range(n)]).astype(np.float32)
+
+        host_step = make_train_step(model, cfg, opt, donate=False)
+        dev_step = make_train_step(model, cfg, opt, donate=False,
+                                   device_gt=True)
+        host_batch = shard_batch((images, mask_miss, labels), mesh)
+        dev_batch = shard_batch((images, mask_miss, joints, mask_all), mesh)
+
+        s_host, loss_host = host_step(state, *host_batch)
+        s_dev, loss_dev = dev_step(state, *dev_batch)
+        assert float(loss_dev) == pytest.approx(float(loss_host), rel=2e-3)
+        pa = jax.tree.leaves(s_host.params)[0]
+        pb = jax.tree.leaves(s_dev.params)[0]
+        np.testing.assert_allclose(np.asarray(pb), np.asarray(pa), atol=1e-4)
+
+
+def _random_case_small(rng, sk, max_people=4):
+    joints = np.zeros((max_people, sk.num_parts, 3), np.float32)
+    joints[:, :, 2] = 2
+    n = int(rng.integers(1, max_people))
+    joints[:n, :, 0] = rng.uniform(0, sk.width, (n, sk.num_parts))
+    joints[:n, :, 1] = rng.uniform(0, sk.height, (n, sk.num_parts))
+    joints[:n, :, 2] = rng.choice([0, 1], (n, sk.num_parts))
+    mask_all = np.ones(sk.grid_shape, np.float32)
+    return joints, mask_all

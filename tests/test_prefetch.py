@@ -133,3 +133,14 @@ class TestAsyncWorkerPool:
         for (a, b) in zip(sync, pooled):
             for x, y in zip(a, b):
                 np.testing.assert_array_equal(x, y)
+
+        # the raw-GT (device-synthesis) batches go through the same pool
+        # machinery: 4-tuples with padded joints, bit-identical sync vs pool
+        sync_raw = list(batches(ds, 2, epoch=0, num_workers=0, raw_gt=6))
+        pooled_raw = list(batches(ds, 2, epoch=0, num_workers=2, prefetch=3,
+                                  raw_gt=6))
+        for (a, b) in zip(sync_raw, pooled_raw):
+            assert len(a) == len(b) == 4
+            assert a[2].shape[1] == 6  # max_people padding
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)

@@ -42,6 +42,10 @@ def main():
                     help="cyclic LR peak (train_distributed_SWA.py:365)")
     ap.add_argument("--swa-lr-min", type=float, default=1e-6)
     ap.add_argument("--print-freq", type=int, default=10)
+    ap.add_argument("--device-gt", type=int, default=0, metavar="MAX_PEOPLE",
+                    help="synthesize GT heatmaps ON DEVICE inside the train "
+                         "step from padded joints (value = max people per "
+                         "sample); ~500x less host->device label traffic")
     ap.add_argument("--debug-overlays", action="store_true",
                     help="save a GT heatmap overlay of the first batch each "
                          "epoch under <checkpoint_dir>/overlays (the "
@@ -138,17 +142,22 @@ def main():
         # start_epoch*steps_per_epoch would shift the phase for imports.
         optimizer = make_optimizer(cfg, swa_schedule(int(state.step)))
 
+    if args.debug_overlays and args.device_gt:
+        print("--debug-overlays needs host-side labels; "
+              "skipped under --device-gt")
     use_focal = not args.no_focal
     # SWA freezes BatchNorm (train_distributed_SWA.py:219-221)
     train_step = make_train_step(model, cfg, optimizer, use_focal=use_focal,
-                                 freeze_bn=args.swa)
+                                 freeze_bn=args.swa,
+                                 device_gt=args.device_gt > 0)
     eval_step = make_eval_step(model, cfg, use_focal=use_focal)
     is_lead = args.process_id == 0
 
     def make_train_batches(epoch):
         it = batches(ds, host_batch, epoch, args.process_id,
-                     args.num_processes, num_workers=args.workers)
-        if not (args.debug_overlays and is_lead):
+                     args.num_processes, num_workers=args.workers,
+                     raw_gt=args.device_gt)
+        if not (args.debug_overlays and is_lead) or args.device_gt:
             return it
 
         def with_overlay():
