@@ -3,6 +3,7 @@ from .platform import apply_platform_env, devices_with_timeout, force_cpu
 from .profiling import profile_trace, timed
 from .visualize import (
     colorize_jet,
+    export_serialized,
     export_stablehlo,
     param_table,
     save_batch_overlays,
@@ -12,5 +13,6 @@ from .visualize import (
 __all__ = ["AverageMeter", "StepTimer", "apply_platform_env",
            "devices_with_timeout", "force_cpu",
            "profile_trace", "timed",
-           "colorize_jet", "export_stablehlo", "param_table",
+           "colorize_jet", "export_serialized", "export_stablehlo",
+           "param_table",
            "save_batch_overlays", "train_batch_overlay"]

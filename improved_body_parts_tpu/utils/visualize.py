@@ -43,6 +43,32 @@ def export_stablehlo(model, variables, sample_images) -> str:
     return lowered.as_text()
 
 
+def export_serialized(model, variables, sample_images, path: str,
+                      platforms=("cpu", "tpu")) -> str:
+    """Serialize the jitted forward with ``jax.export`` — the XLA-world
+    saved-model: StableHLO bytes + calling convention, reloadable with
+    ``jax.export.deserialize`` and callable WITHOUT the model code
+    (reference analogue: the ONNX export in visulizatoin/draw_net.py:89-93,
+    which ships the graph rather than the python).
+
+    ``platforms`` defaults to ('cpu', 'tpu') so an artifact exported on a
+    CPU box (the standard workflow when the chip is busy) still runs on the
+    TPU server that deserializes it.
+    """
+    import jax
+    from jax import export as jexport
+
+    def forward(variables, imgs):
+        return model.apply(variables, imgs, train=False)[-1][0]
+
+    exported = jexport.export(jax.jit(forward),
+                              platforms=list(platforms))(
+        variables, sample_images)
+    with open(path, "wb") as f:
+        f.write(exported.serialize())
+    return path
+
+
 def train_batch_overlay(image: np.ndarray, maps: np.ndarray,
                         channel: int, alpha: float = 0.5) -> np.ndarray:
     """Debug overlay of one train sample: the input image resized to the

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Export the jitted forward as a serialized jax.export artifact.
+
+The artifact contains the StableHLO program + calling convention; a server
+reloads it with ``jax.export.deserialize(blob).call(variables, images)``
+without importing this package's model code.
+
+    python tools/export_model.py --config canonical \
+        --checkpoint checkpoints/epoch_99 --out posenet.jaxexport
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description="serialize the jitted forward")
+    ap.add_argument("--config", default="canonical")
+    ap.add_argument("--checkpoint", default=None,
+                    help="orbax checkpoint dir (omit: fresh init — useful "
+                         "for shape/ABI checks)")
+    ap.add_argument("--size", type=int, default=None,
+                    help="input H=W (default: the config's)")
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    import jax
+
+    from improved_body_parts_tpu.utils import (
+        apply_platform_env, export_serialized)
+    apply_platform_env()
+
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.models import build_model
+
+    cfg = get_config(args.config)
+    size = args.size or cfg.skeleton.height
+    model = build_model(cfg)
+    imgs = jnp.zeros((1, size, size, 3), jnp.float32)
+    if args.checkpoint:
+        from improved_body_parts_tpu.train.checkpoint import (
+            restore_checkpoint)
+
+        payload = restore_checkpoint(args.checkpoint)
+        variables = {"params": payload["params"],
+                     "batch_stats": payload["batch_stats"]}
+    else:
+        variables = model.init(jax.random.PRNGKey(0), imgs, train=False)
+    path = export_serialized(model, variables, imgs, args.out)
+    print(f"exported {args.config} @{size}px -> {path} "
+          f"({os.path.getsize(path):,} bytes)")
+
+
+if __name__ == "__main__":
+    main()
