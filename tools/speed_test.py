@@ -20,6 +20,9 @@ def main():
     ap.add_argument("--size", type=int, default=512)
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--fp32", action="store_true", help="disable bf16 compute")
+    ap.add_argument("--bf16-params", action="store_true",
+                    help="store params in bf16 (halves weight HBM traffic "
+                         "per pass; inference only)")
     args = ap.parse_args()
 
     import jax
@@ -35,6 +38,11 @@ def main():
     model = build_model(cfg, dtype=jnp.float32 if args.fp32 else None)
     imgs = jnp.zeros((args.batch, args.size, args.size, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), imgs, train=False)
+    if args.bf16_params:
+        variables = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if hasattr(x, "dtype") and x.dtype == jnp.float32 else x,
+            variables)
 
     @jax.jit
     def forward(variables, imgs):

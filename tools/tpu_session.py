@@ -96,6 +96,17 @@ def main():
     print(f"single-image {size}x{size}: {fps:.2f} imgs/s "
           f"({dt * 1e3:.2f} ms)", flush=True)
 
+    # --- 1b. bf16 param storage (HBM-traffic lever: fp32 params are
+    # ~516 MB/pass of the ~5.7 GB the forward reads; casting storage to
+    # bf16 halves weight traffic — measure, don't assume)
+    bf16_vars = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, variables)
+    dt16 = timed(fwd, bf16_vars, imgs)
+    summary["single_image_fps_bf16_params"] = round(1.0 / dt16, 2)
+    flush_summary()
+    print(f"bf16-param storage: {1.0 / dt16:.2f} imgs/s", flush=True)
+
     # --- 2. batch sweep --------------------------------------------------
     sweep = {}
     for b in args.batches:
