@@ -114,3 +114,66 @@ def _focal_bwd(interpret, res, ct):
 
 
 focal_l2_pallas.defvjp(_focal_fwd, _focal_bwd)
+
+
+def parity_benchmark(stacks: int = 4, batch: int = 4, hw: int = 128,
+                     channels: int = 50, iters: int = 30,
+                     interpret: bool = False) -> dict:
+    """Fwd + grad parity and timing of the Pallas kernel vs the ACTUAL
+    training loss (ops.losses.focal_l2) on the active platform.
+
+    The single check used by both tools/pallas_check.py and
+    tools/tpu_session.py (one implementation — results cannot drift).  The
+    case reproduces the training regime: sparse GT, a partly-zero miss
+    mask, and the reference channel modulation (keypoints ×3, person-mask
+    ×0.1, loss_model.py:146-149).
+    """
+    import time
+
+    import numpy as np
+
+    from .losses import focal_l2
+
+    S, N, H, C = stacks, batch, hw, channels
+    rng = np.random.default_rng(0)
+    pred = jnp.asarray(rng.uniform(-0.2, 1.2, (S, N, H, H, C)), jnp.float32)
+    gt = jnp.asarray(rng.uniform(0, 1, (N, H, H, C))
+                     * (rng.uniform(0, 1, (N, H, H, C)) > 0.7), jnp.float32)
+    mask = jnp.asarray(rng.uniform(0, 1, (N, H, H, 1)) > 0.1, jnp.float32)
+    chan = np.ones((C,), np.float32)
+    if C == 50:  # canonical layout: 30 paf + 18 heat + 2 bkg
+        chan[-2] = 0.1
+        chan[30:48] = 3.0
+    chan = jnp.asarray(chan)
+
+    p_fn = jax.jit(lambda p: focal_l2_pallas(p, gt, mask, chan, interpret))
+    # the same math through the real loss: modulation folds into the mask
+    x_fn = jax.jit(lambda p: focal_l2(p, gt[None], (mask * chan)[None]))
+    gp_fn = jax.jit(jax.grad(lambda p: p_fn(p).sum()))
+    gx_fn = jax.jit(jax.grad(lambda p: x_fn(p).sum()))
+
+    def timed(fn, *a):
+        out = fn(*a)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*a)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    err = float(jnp.abs(p_fn(pred) - x_fn(pred)).max()
+                / jnp.abs(x_fn(pred)).max())
+    gerr = float(jnp.abs(gp_fn(pred) - gx_fn(pred)).max()
+                 / (jnp.abs(gx_fn(pred)).max() + 1e-12))
+    tp, tx = timed(p_fn, pred), timed(x_fn, pred)
+    tgp, tgx = timed(gp_fn, pred), timed(gx_fn, pred)
+    return {
+        "rel_err": err, "grad_rel_err": gerr,
+        "pallas_ms": round(tp, 3), "xla_ms": round(tx, 3),
+        "pallas_grad_ms": round(tgp, 3), "xla_grad_ms": round(tgx, 3),
+        # fp32 sums over ~100k terms differ by reduction order between the
+        # per-tile accumulation and XLA's tree reduction; 1e-4 relative is
+        # numerical noise, not a semantic mismatch
+        "parity_ok": err < 1e-4 and gerr < 1e-4,
+        "pallas_wins": tp < tx and tgp < tgx,
+    }

@@ -34,6 +34,8 @@ def save_checkpoint(directory: str, state: TrainState, epoch: int,
                        if state.swa_params is not None else None),
         "swa_count": (int(state.swa_count)
                       if state.swa_count is not None else None),
+        "swa_start_step": (int(state.swa_start_step)
+                           if state.swa_start_step is not None else None),
         "epoch": epoch,
         "train_loss": float(train_loss),
         "best_loss": float(best_loss),
@@ -85,6 +87,9 @@ def restore_checkpoint(path: str, state: Optional[TrainState] = None
         swa_params=payload.get("swa_params"),
         swa_count=(np.asarray(payload["swa_count"], np.int32)
                    if payload.get("swa_count") is not None else None),
+        swa_start_step=(np.asarray(payload["swa_start_step"], np.int32)
+                        if payload.get("swa_start_step") is not None
+                        else None),
     )
     meta = {k: payload[k] for k in ("epoch", "train_loss", "best_loss")}
     return restored, meta

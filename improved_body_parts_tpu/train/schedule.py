@@ -45,10 +45,10 @@ def cyclic_swa_schedule(steps_per_epoch: int, swa_freq: int = 5,
     ``swa_freq``-epoch cycle (train_distributed_SWA.py:365-369
     ``adjust_learning_rate_cyclic`` — defaults lr_max=1e-5, lr_min=1e-6).
 
-    The cycle phase is anchored to ``start_step`` (the global step at which
-    the SWA stage began), matching the reference's
-    ``epoch = current_epoch - start_epoch`` convention so a resumed SWA run
-    keeps the same sawtooth.
+    The cycle phase is anchored to ``start_step`` — the global step at
+    which the SWA stage began, persisted as ``TrainState.swa_start_step``
+    so even a mid-cycle interrupt/resume keeps the same sawtooth
+    (the reference's ``epoch = current_epoch - start_epoch`` convention).
     """
 
     if swa_freq <= 1:  # degenerate cycle: constant lr_max

@@ -29,6 +29,10 @@ class TrainState:
     # SWA running average (None until SWA starts)
     swa_params: Any = None
     swa_count: Any = None
+    # the global step at which SWA began — the cyclic-LR sawtooth anchor
+    # (reference: current_epoch - start_epoch, train_distributed_SWA.py:366);
+    # persisted so an interrupted SWA run resumes mid-cycle in phase
+    swa_start_step: Any = None
 
 
 def make_optimizer(config: Config, schedule: Callable) -> optax.GradientTransformation:
@@ -57,8 +61,11 @@ def create_train_state(model, config: Config, optimizer, rng,
 
 def start_swa(state: TrainState) -> TrainState:
     """Begin stochastic weight averaging from the current params."""
+    # jnp.copy, not asarray: the anchor must be its OWN buffer — aliasing
+    # state.step would donate the same buffer twice in the jitted step
     return state.replace(swa_params=jax.tree.map(jnp.copy, state.params),
-                         swa_count=jnp.ones((), jnp.int32))
+                         swa_count=jnp.ones((), jnp.int32),
+                         swa_start_step=jnp.copy(state.step).astype(jnp.int32))
 
 
 def update_swa(state: TrainState) -> TrainState:

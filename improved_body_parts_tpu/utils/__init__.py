@@ -1,5 +1,6 @@
 from .meters import AverageMeter, StepTimer
 from .platform import apply_platform_env, devices_with_timeout, force_cpu
+from .precision import bf16_params
 from .profiling import profile_trace, timed
 from .visualize import (
     colorize_jet,
@@ -11,7 +12,7 @@ from .visualize import (
 )
 
 __all__ = ["AverageMeter", "StepTimer", "apply_platform_env",
-           "devices_with_timeout", "force_cpu",
+           "bf16_params", "devices_with_timeout", "force_cpu",
            "profile_trace", "timed",
            "colorize_jet", "export_serialized", "export_stablehlo",
            "param_table",

@@ -24,10 +24,24 @@ def apply_platform_env() -> None:
         return
     _pin_platform(platforms)
 
+    # Verify WITHOUT initializing a backend: calling jax.devices() here
+    # would (a) hang with no watchdog on a wedged exclusive claim and
+    # (b) break jax.distributed.initialize for callers that pin the
+    # platform before multi-host bring-up.  If no backend exists yet, the
+    # config update above is guaranteed to take effect at first use;
+    # only an already-initialized backend can defy it.
     import jax
 
+    try:
+        from jax._src import xla_bridge as _xb
+
+        initialized = _xb.backends_are_initialized()
+    except Exception:  # jax internals moved — skip the extra verification
+        return
+    if not initialized:
+        return
     requested = {p.strip().lower() for p in platforms.split(",") if p.strip()}
-    active = jax.devices()[0].platform.lower()
+    active = jax.devices()[0].platform.lower()  # cached — returns instantly
     if active not in requested:
         raise RuntimeError(
             f"JAX_PLATFORMS={platforms} was requested but the active "

@@ -1,0 +1,14 @@
+"""Parameter-precision helpers."""
+from __future__ import annotations
+
+
+def bf16_params(tree):
+    """Cast every fp32 leaf to bf16 (inference-time weight storage: halves
+    per-pass weight HBM traffic; compute already runs bf16).  Training
+    keeps fp32 params — don't use this on a TrainState."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, tree)

@@ -222,6 +222,22 @@ class TestTrainStep:
         new_state, loss = step(restored, images, mask, labels)
         assert np.isfinite(float(loss))
 
+    def test_swa_start_step_survives_checkpoint(self, tmp_path):
+        """The cyclic-LR anchor (the step SWA began at) must persist across
+        an interrupt/resume so the sawtooth keeps phase mid-cycle."""
+        import jax.numpy as jnp
+
+        cfg, model, opt, state = _tiny_setup()
+        state = state.replace(step=jnp.asarray(730, jnp.int32))
+        state = start_swa(state)
+        assert int(state.swa_start_step) == 730
+        # interrupted 3 epochs later
+        state = state.replace(step=jnp.asarray(760, jnp.int32))
+        path = save_checkpoint(str(tmp_path), state, epoch=76,
+                               train_loss=1.0, best_loss=1.0)
+        restored, _ = restore_checkpoint(path, state)
+        assert int(restored.swa_start_step) == 730  # NOT 760
+
     def test_curriculum_resolution_resume(self, tmp_path):
         """The reference's 384→512 curriculum (checkpoints/log): a
         checkpoint trained at one input resolution restores into a state

@@ -15,7 +15,6 @@ Flip ``use_pallas_loss`` default only if the kernel wins on hardware.
 import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -33,17 +32,11 @@ def main():
     if args.iters < 1:
         ap.error("--iters must be >= 1")
 
-    import jax
-
     from improved_body_parts_tpu.utils import (
         apply_platform_env, devices_with_timeout)
     apply_platform_env()
 
-    import jax.numpy as jnp
-    import numpy as np
-
-    from improved_body_parts_tpu.ops.losses import focal_l2
-    from improved_body_parts_tpu.ops.pallas_focal import focal_l2_pallas
+    from improved_body_parts_tpu.ops.pallas_focal import parity_benchmark
 
     try:
         platform = devices_with_timeout(600)[0].platform
@@ -51,63 +44,19 @@ def main():
         raise SystemExit(str(e))
     print(f"platform={platform} interpret={args.interpret}")
 
-    S, N, H, C = args.stacks, args.batch, args.hw, args.channels
-    rng = np.random.default_rng(0)
-    pred = jnp.asarray(rng.uniform(-0.2, 1.2, (S, N, H, H, C)), jnp.float32)
-    gt = jnp.asarray(rng.uniform(0, 1, (N, H, H, C)) *
-                     (rng.uniform(0, 1, (N, H, H, C)) > 0.7), jnp.float32)
-    mask = jnp.asarray(rng.uniform(0, 1, (N, H, H, 1)) > 0.1, jnp.float32)
-    chan = np.ones((C,), np.float32)
-    chan[-2] = 0.1   # person-mask channel ×multi_task_weight
-    chan[30:48] = 3  # keypoint channels ×keypoint_task_weight
-    chan = jnp.asarray(chan)
-
-    # XLA reference: the ACTUAL training loss (ops.losses.focal_l2) with the
-    # channel modulation folded into the mask — validating against the real
-    # thing, not a frozen copy of its math
-    def xla_focal(pred, gt, mask, chan):
-        return focal_l2(pred, gt[None], (mask * chan)[None])
-
-    pallas_fn = jax.jit(
-        lambda p, g, m, c: focal_l2_pallas(p, g, m, c, args.interpret))
-    xla_fn = jax.jit(xla_focal)
-
-    out_p = jax.block_until_ready(pallas_fn(pred, gt, mask, chan))
-    out_x = jax.block_until_ready(xla_fn(pred, gt, mask, chan))
-    fwd_err = float(jnp.abs(out_p - out_x).max() / jnp.abs(out_x).max())
-    print(f"forward rel err: {fwd_err:.2e}")
-
-    g_p = jax.jit(jax.grad(lambda p: pallas_fn(p, gt, mask, chan).sum()))
-    g_x = jax.jit(jax.grad(lambda p: xla_fn(p, gt, mask, chan).sum()))
-    gp = jax.block_until_ready(g_p(pred))
-    gx = jax.block_until_ready(g_x(pred))
-    grad_err = float(jnp.abs(gp - gx).max() / (jnp.abs(gx).max() + 1e-12))
-    print(f"grad rel err:    {grad_err:.2e}")
-
-    def bench(fn, *a):
-        jax.block_until_ready(fn(*a))
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            out = fn(*a)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / args.iters * 1e3
-
-    t_p = bench(pallas_fn, pred, gt, mask, chan)
-    t_x = bench(xla_fn, pred, gt, mask, chan)
-    t_gp = bench(g_p, pred)
-    t_gx = bench(g_x, pred)
-    print(f"forward: pallas {t_p:7.3f} ms   xla {t_x:7.3f} ms   "
-          f"({t_x / t_p:.2f}x)")
-    print(f"grad:    pallas {t_gp:7.3f} ms   xla {t_gx:7.3f} ms   "
-          f"({t_gx / t_gp:.2f}x)")
-    verdict = "PALLAS WINS" if (t_p < t_x and t_gp < t_gx) else "XLA wins"
-    # fp32 sums over ~100k terms differ by reduction order between the
-    # per-tile accumulation and XLA's tree reduction; 1e-4 relative is the
-    # numerical-noise band, not a semantic mismatch
-    ok = fwd_err < 1e-4 and grad_err < 1e-4
-    print(f"parity {'OK' if ok else 'FAIL'}; {verdict} "
+    r = parity_benchmark(stacks=args.stacks, batch=args.batch, hw=args.hw,
+                         channels=args.channels, iters=args.iters,
+                         interpret=args.interpret)
+    print(f"forward rel err: {r['rel_err']:.2e}")
+    print(f"grad rel err:    {r['grad_rel_err']:.2e}")
+    print(f"forward: pallas {r['pallas_ms']:7.3f} ms   "
+          f"xla {r['xla_ms']:7.3f} ms")
+    print(f"grad:    pallas {r['pallas_grad_ms']:7.3f} ms   "
+          f"xla {r['xla_grad_ms']:7.3f} ms")
+    verdict = "PALLAS WINS" if r["pallas_wins"] else "XLA wins"
+    print(f"parity {'OK' if r['parity_ok'] else 'FAIL'}; {verdict} "
           f"(flip use_pallas_loss only if pallas wins on TPU)")
-    sys.exit(0 if ok else 1)
+    sys.exit(0 if r["parity_ok"] else 1)
 
 
 if __name__ == "__main__":

@@ -39,10 +39,9 @@ def main():
     imgs = jnp.zeros((args.batch, args.size, args.size, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), imgs, train=False)
     if args.bf16_params:
-        variables = jax.tree.map(
-            lambda x: x.astype(jnp.bfloat16)
-            if hasattr(x, "dtype") and x.dtype == jnp.float32 else x,
-            variables)
+        from improved_body_parts_tpu.utils import bf16_params
+
+        variables = bf16_params(variables)
 
     @jax.jit
     def forward(variables, imgs):

@@ -99,9 +99,9 @@ def main():
     # --- 1b. bf16 param storage (HBM-traffic lever: fp32 params are
     # ~516 MB/pass of the ~5.7 GB the forward reads; casting storage to
     # bf16 halves weight traffic — measure, don't assume)
-    bf16_vars = jax.tree.map(
-        lambda x: x.astype(jnp.bfloat16)
-        if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, variables)
+    from improved_body_parts_tpu.utils import bf16_params
+
+    bf16_vars = bf16_params(variables)
     dt16 = timed(fwd, bf16_vars, imgs)
     summary["single_image_fps_bf16_params"] = round(1.0 / dt16, 2)
     flush_summary()
@@ -117,47 +117,17 @@ def main():
     summary["batch_sweep_fps"] = sweep
     flush_summary()
 
-    # --- 3. pallas kernel ------------------------------------------------
+    # --- 3. pallas kernel (fwd + grad: the custom-VJP backward is a second
+    # pallas program and must also survive real Mosaic lowering) ----------
     if not args.skip_pallas:
-        from improved_body_parts_tpu.ops.losses import focal_l2
-        from improved_body_parts_tpu.ops.pallas_focal import focal_l2_pallas
+        from improved_body_parts_tpu.ops.pallas_focal import parity_benchmark
 
         S, N, H, C = (2, 2, 32, 50) if args.quick else (4, 4, 128, 50)
-        rng = np.random.default_rng(0)
-        pred = jnp.asarray(rng.uniform(-0.2, 1.2, (S, N, H, H, C)),
-                           jnp.float32)
-        gt = jnp.asarray(
-            (rng.uniform(0, 1, (N, H, H, C)) > 0.7)
-            * rng.uniform(0, 1, (N, H, H, C)), jnp.float32)
-        mask = jnp.ones((N, H, H, 1), jnp.float32)
-        chan = jnp.ones((C,), jnp.float32)
-        interpret = platform == "cpu"
-        p_fn = jax.jit(lambda p: focal_l2_pallas(p, gt, mask, chan,
-                                                 interpret))
-        x_fn = jax.jit(lambda p: focal_l2(p, gt[None], mask[None]))
-        # the custom-VJP backward is a SECOND pallas program — it must also
-        # survive real lowering before use_pallas_loss can be trusted
-        gp_fn = jax.jit(jax.grad(lambda p: p_fn(p).sum()))
-        gx_fn = jax.jit(jax.grad(lambda p: x_fn(p).sum()))
         try:
-            err = float(jnp.abs(p_fn(pred) - x_fn(pred)).max()
-                        / jnp.abs(x_fn(pred)).max())
-            gerr = float(jnp.abs(gp_fn(pred) - gx_fn(pred)).max()
-                         / (jnp.abs(gx_fn(pred)).max() + 1e-12))
-            tp, tx = timed(p_fn, pred), timed(x_fn, pred)
-            tgp, tgx = timed(gp_fn, pred), timed(gx_fn, pred)
-            summary["pallas"] = {
-                "rel_err": err, "grad_rel_err": gerr,
-                "pallas_ms": round(tp * 1e3, 3),
-                "xla_ms": round(tx * 1e3, 3),
-                "pallas_grad_ms": round(tgp * 1e3, 3),
-                "xla_grad_ms": round(tgx * 1e3, 3),
-                "parity_ok": err < 1e-4 and gerr < 1e-4,
-                "pallas_wins": tp < tx and tgp < tgx,
-            }
-            print(f"pallas: rel_err {err:.2e} grad {gerr:.2e}  "
-                  f"fwd {tp * 1e3:.3f}/{tx * 1e3:.3f} ms  "
-                  f"grad {tgp * 1e3:.3f}/{tgx * 1e3:.3f} ms", flush=True)
+            summary["pallas"] = parity_benchmark(
+                stacks=S, batch=N, hw=H, channels=C, iters=iters,
+                interpret=platform == "cpu")
+            print(f"pallas: {summary['pallas']}", flush=True)
         except Exception as e:  # noqa: BLE001 — Mosaic may reject the kernel
             summary["pallas"] = {"error": f"{type(e).__name__}: {e}"}
             print(f"pallas FAILED under real lowering: {e}", flush=True)

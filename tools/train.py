@@ -133,14 +133,21 @@ def main():
             start_epoch = meta["epoch"] + 1
             resumed_swa = state.swa_count is not None
             print(f"resumed from {path} (epoch {meta['epoch']})")
-    if args.swa and int(state.step):
-        # Anchor the cyclic-LR sawtooth to the step SWA starts at
-        # (reference: epoch - start_epoch, train_distributed_SWA.py:365-366).
-        # state.step mirrors the optax schedule count in every resume case:
-        # a full checkpoint restores both together, and an imported reference
-        # checkpoint (no opt_state) keeps both at 0 — anchoring on
-        # start_epoch*steps_per_epoch would shift the phase for imports.
-        optimizer = make_optimizer(cfg, swa_schedule(int(state.step)))
+    if args.swa:
+        if not resumed_swa:
+            # entering SWA from a plain checkpoint (or scratch): record the
+            # anchor now; a resumed SWA checkpoint already carries it
+            state = start_swa(state)
+        # Anchor the cyclic-LR sawtooth to the step SWA STARTED at
+        # (reference: epoch - start_epoch, train_distributed_SWA.py:365-366)
+        # — persisted in the state, so an interrupted SWA run resumes
+        # mid-cycle in phase.  state.step mirrors the optax schedule count
+        # in every resume case (full checkpoints restore both together;
+        # imported reference weights keep both at 0).
+        anchor = (int(state.swa_start_step)
+                  if state.swa_start_step is not None else int(state.step))
+        if anchor:
+            optimizer = make_optimizer(cfg, swa_schedule(anchor))
 
     if args.debug_overlays and args.device_gt:
         print("--debug-overlays needs host-side labels; "
@@ -198,9 +205,8 @@ def main():
         # SWA checkpoints are saved swapped (params=averaged,
         # swa_params=live SGD weights); swap back to continue training from
         # the live weights while keeping the running average intact.
+        # (start_swa already ran above when entering SWA fresh.)
         state = swap_swa_params(state)
-    else:
-        state = start_swa(state)
     for epoch in range(start_epoch, start_epoch + epochs):
         state, train_loss = train_epoch(
             state, train_step, make_train_batches(epoch), cfg, epoch,
