@@ -23,7 +23,12 @@ def _to_host(tree):
 
 def save_checkpoint(directory: str, state: TrainState, epoch: int,
                     train_loss: float, best_loss: float) -> str:
-    """Write checkpoint ``<directory>/epoch_<N>`` and return its path."""
+    """Write checkpoint ``<directory>/epoch_<N>`` and return its path.
+
+    COLLECTIVE under multi-process JAX: orbax synchronizes all processes
+    during save (and writes once, from the primary host) — every process
+    must call this, not just rank 0, or the barrier never completes and
+    the checkpoint is lost (observed on a 2-process Gloo run)."""
     path = os.path.abspath(os.path.join(directory, f"epoch_{epoch}"))
     payload = {
         "params": _to_host(state.params),

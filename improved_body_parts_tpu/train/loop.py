@@ -100,16 +100,17 @@ def fit(state: TrainState, train_step: Callable, config: Config,
         make_eval_batches: Optional[Callable[[int], Iterable]] = None,
         is_lead_host: bool = True,
         checkpoint_dir: Optional[str] = None,
-        log_fn: Callable[[str], None] = print) -> TrainState:
+        log_fn: Callable[[str], None] = print,
+        best_loss: float = float("inf")) -> TrainState:
     """Multi-epoch driver with per-epoch rank-0 checkpoint + log
     (reference: train_distributed.py:300-324, 441-444).
 
     ``make_batches(epoch)`` returns that epoch's (shuffled) batch iterable —
     the epoch-seeded permutation replaces DistributedSampler.set_epoch
-    (train_distributed.py:231-232).
+    (train_distributed.py:231-232).  Pass the restored checkpoint's
+    ``best_loss`` on resume so the metadata keeps tracking the true best.
     """
     checkpoint_dir = checkpoint_dir or config.train.checkpoint_dir
-    best_loss = float("inf")
     for epoch in range(start_epoch, start_epoch + epochs):
         state, train_loss = train_epoch(
             state, train_step, make_batches(epoch), config, epoch, mesh=mesh,
@@ -117,9 +118,12 @@ def fit(state: TrainState, train_step: Callable, config: Config,
         if is_lead_host:
             _log_line(checkpoint_dir,
                       f"\nEpoch {epoch}\ttrain_loss: {train_loss}")
-            best_loss = min(best_loss, train_loss)
-            ckpt.save_checkpoint(checkpoint_dir, state, epoch, train_loss,
-                                 best_loss)
+        best_loss = min(best_loss, train_loss)
+        # collective: orbax barriers across processes and writes once from
+        # the primary host — every process participates (see
+        # checkpoint.save_checkpoint)
+        ckpt.save_checkpoint(checkpoint_dir, state, epoch, train_loss,
+                             best_loss)
         if eval_step is not None and make_eval_batches is not None:
             val_loss = eval_epoch(state, eval_step, make_eval_batches(epoch),
                                   mesh=mesh)

@@ -84,6 +84,12 @@ def main():
     train_h5 = args.train_h5 or cfg.train.hdf5_train_data
     val_h5 = args.val_h5 or cfg.train.hdf5_val_data
     ds = CocoPoseDataset(train_h5, cfg, augment=True)
+    if args.num_processes > 1 and args.val_h5 and not os.path.exists(val_h5):
+        # eval is a collective: a host silently skipping it while others
+        # enter eval_epoch leaves the job in mismatched collectives forever
+        raise SystemExit(
+            f"--val-h5 {val_h5} missing on this host; every host needs the "
+            "file in a multi-process run (or drop --val-h5)")
     val_ds = (CocoPoseDataset(val_h5, cfg, augment=False)
               if os.path.exists(val_h5) else None)
 
@@ -125,12 +131,14 @@ def main():
 
     start_epoch = 0
     resumed_swa = False
+    best_loss = float("inf")
     if args.resume:
         path = (latest_checkpoint(cfg.train.checkpoint_dir)
                 if args.resume == "auto" else args.resume)
         if path:
             state, meta = restore_checkpoint(path, state)
             start_epoch = meta["epoch"] + 1
+            best_loss = float(meta.get("best_loss", float("inf")))
             resumed_swa = state.swa_count is not None
             print(f"resumed from {path} (epoch {meta['epoch']})")
     if args.swa:
@@ -189,11 +197,17 @@ def main():
             return batches(val_ds, host_batch, 0, args.process_id,
                            args.num_processes, num_workers=args.workers)
 
+    def shutdown():
+        if args.num_processes > 1:
+            jax.distributed.shutdown()  # aligned exit across processes
+
     epochs = args.epochs or cfg.train.epochs
     if not args.swa:
         fit(state, train_step, cfg, make_train_batches, epochs,
             start_epoch=start_epoch, mesh=mesh, eval_step=eval_step,
-            make_eval_batches=make_eval_batches, is_lead_host=is_lead)
+            make_eval_batches=make_eval_batches, is_lead_host=is_lead,
+            best_loss=best_loss)
+        shutdown()
         return
 
     # SWA fine-tune: average params every swa_freq epochs, swap averaged
@@ -213,11 +227,13 @@ def main():
             mesh=mesh, is_lead_host=is_lead)
         if (epoch - start_epoch + 1) % args.swa_freq == 0:
             state = update_swa(state)
+            # collective save (orbax barriers across processes)
+            swapped = swap_swa_params(state)
+            ckpt.save_checkpoint(cfg.train.checkpoint_dir, swapped, epoch,
+                                 train_loss, train_loss)
             if is_lead:
-                swapped = swap_swa_params(state)
-                ckpt.save_checkpoint(cfg.train.checkpoint_dir, swapped, epoch,
-                                     train_loss, train_loss)
                 print(f"epoch {epoch}: SWA checkpoint saved")
+    shutdown()
 
 
 if __name__ == "__main__":
