@@ -1,0 +1,42 @@
+"""Every CLI must at least parse --help in a bare subprocess (no
+accelerator claim, no heavy imports at module scope) — the cheapest
+regression net over the tools/ surface."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CLIS = [
+    "train.py", "evaluate.py", "demo.py", "speed_test.py",
+    "scaling_test.py", "pallas_check.py", "tpu_session.py",
+    "export_model.py", "import_torch_checkpoint.py", "make_corpus.py",
+    "build_native.py", "list_coco.py",
+]
+
+
+@pytest.mark.parametrize("cli", CLIS)
+def test_cli_help(cli):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", cli), "--help"],
+        capture_output=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr.decode()[-500:]
+
+
+def test_list_coco_without_pycocotools():
+    """Graceful exit (not a traceback) when the host-side dep is absent."""
+    try:
+        import pycocotools  # noqa: F401
+
+        pytest.skip("pycocotools installed; nothing to check")
+    except ImportError:
+        pass
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "list_coco.py"),
+         "--anno", "/nonexistent.json"],
+        capture_output=True, timeout=120)
+    assert r.returncode != 0
+    assert b"pycocotools is not installed" in r.stdout + r.stderr

@@ -24,6 +24,10 @@ def build(verbose: bool = True) -> str:
 
 
 if __name__ == "__main__":
+    import argparse
+
+    argparse.ArgumentParser(
+        description="build the native C++ decoder via make").parse_args()
     path = build()
     print("built", path)
     sys.exit(0)

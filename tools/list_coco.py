@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""List COCO categories / supercategories of an annotation file.
+
+The debugging aid the reference keeps at data/dataset/see_coco_data.py
+(hard-coded path removed; pass --anno).
+
+    python tools/list_coco.py --anno annotations/instances_val2017.json
+"""
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser(description="COCO category lister")
+    ap.add_argument("--anno", required=True, help="instances_*.json path")
+    args = ap.parse_args()
+
+    try:
+        from pycocotools.coco import COCO
+    except ImportError:
+        raise SystemExit("pycocotools is not installed (host-side "
+                         "dependency; see SURVEY.md §2.9)")
+
+    coco = COCO(args.anno)
+    cats = coco.loadCats(coco.getCatIds())
+    print("COCO categories:\n" + " ".join(c["name"] for c in cats) + "\n")
+    print("COCO supercategories:\n"
+          + " ".join(sorted({c["supercategory"] for c in cats})))
+
+
+if __name__ == "__main__":
+    main()

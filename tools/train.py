@@ -84,7 +84,7 @@ def main():
     train_h5 = args.train_h5 or cfg.train.hdf5_train_data
     val_h5 = args.val_h5 or cfg.train.hdf5_val_data
     ds = CocoPoseDataset(train_h5, cfg, augment=True)
-    if args.num_processes > 1 and args.val_h5 and not os.path.exists(val_h5):
+    if args.num_processes > 1 and val_h5 and not os.path.exists(val_h5):
         # eval is a collective: a host silently skipping it while others
         # enter eval_epoch leaves the job in mismatched collectives forever
         raise SystemExit(
