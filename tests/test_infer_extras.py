@@ -113,6 +113,27 @@ def test_train_batch_overlay_and_save(tmp_path):
     assert written is not None and written.shape == (32, 64, 3)
 
 
+def test_profile_trace_and_timed(tmp_path, capsys):
+    """profile_trace captures an xprof trace directory and timed() reports
+    a wall-clock line — never exercised before (VERDICT r1 §5 note)."""
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.utils import AverageMeter
+    from improved_body_parts_tpu.utils.profiling import profile_trace, timed
+
+    log_dir = str(tmp_path / "trace")
+    with profile_trace(log_dir):
+        y = (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+    files = [os.path.join(r, f) for r, _, fs in os.walk(log_dir) for f in fs]
+    assert files, "no trace artifacts written"
+
+    meter = AverageMeter()
+    with timed("matmul", meter, sync_value=y):
+        _ = y.sum()
+    assert meter.count == 1 and meter.val > 0
+    assert "[matmul]" in capsys.readouterr().out
+
+
 def test_export_serialized_roundtrip(tmp_path):
     """jax.export artifact: serialize the jitted forward, reload WITHOUT the
     model object, call it, match the direct apply (the saved-model story;
