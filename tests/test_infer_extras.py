@@ -127,11 +127,16 @@ def test_profile_trace_and_timed(tmp_path, capsys):
     files = [os.path.join(r, f) for r, _, fs in os.walk(log_dir) for f in fs]
     assert files, "no trace artifacts written"
 
+    # the sync path blocks on in-flight async work (cuda.synchronize
+    # analogue): dispatch a fresh computation and time a block that syncs
+    # on it — the measured time must cover its completion
     meter = AverageMeter()
-    with timed("matmul", meter, sync_value=y):
-        _ = y.sum()
-    assert meter.count == 1 and meter.val > 0
-    assert "[matmul]" in capsys.readouterr().out
+    z = jnp.ones((256, 256)) @ jnp.ones((256, 256))  # async dispatch
+    with timed("sync", meter, sync_value=z):
+        pass
+    assert z.is_ready()  # the block's exit forced completion
+    assert meter.count == 1 and meter.val >= 0
+    assert "[sync]" in capsys.readouterr().out
 
 
 def test_export_serialized_roundtrip(tmp_path):
