@@ -127,15 +127,23 @@ def test_profile_trace_and_timed(tmp_path, capsys):
     files = [os.path.join(r, f) for r, _, fs in os.walk(log_dir) for f in fs]
     assert files, "no trace artifacts written"
 
-    # the sync path blocks on in-flight async work (cuda.synchronize
-    # analogue): dispatch a fresh computation and time a block that syncs
-    # on it — the measured time must cover its completion
+    # the sync path must call block_until_ready on sync_value (the
+    # cuda.synchronize analogue) — asserted via interception, since CPU
+    # matmuls finish too fast for a timing-based check to discriminate
+    import jax
+
     meter = AverageMeter()
     z = jnp.ones((256, 256)) @ jnp.ones((256, 256))  # async dispatch
-    with timed("sync", meter, sync_value=z):
-        pass
-    assert z.is_ready()  # the block's exit forced completion
-    assert meter.count == 1 and meter.val >= 0
+    synced = []
+    orig = jax.block_until_ready
+    jax.block_until_ready = lambda v: (synced.append(v), orig(v))[1]
+    try:
+        with timed("sync", meter, sync_value=z):
+            pass
+    finally:
+        jax.block_until_ready = orig
+    assert any(s is z for s in synced), "timed() never synced on sync_value"
+    assert meter.count == 1 and meter.val > 0
     assert "[sync]" in capsys.readouterr().out
 
 
