@@ -157,6 +157,38 @@ def test_config_tables_match_reference_live(ref_module, ours_name):
     assert list(sk.draw_limbs) == list(theirs.draw_list)
 
 
+def test_refine_centroid_deviation_pinned():
+    """The reference's refine_centroid swaps its offset grids
+    (np.mgrid's first output varies along ROWS but is applied to x,
+    utils/util.py:205-207); we apply each offset to its own axis.  This
+    pins the exact relationship: our refinement equals the reference's
+    with the x/y offsets exchanged, and the scores agree."""
+    import ast
+
+    src = open(os.path.join(REF_ROOT, "utils", "util.py")).read()
+    tree = ast.parse(src)
+    fn = next(n for n in tree.body if isinstance(n, ast.FunctionDef)
+              and n.name == "refine_centroid")
+    ns = {"np": np}
+    exec(compile(ast.Module(body=[fn], type_ignores=[]), "ref_util",
+                 "exec"), ns)  # noqa: S102 — read-only reference code
+    ref_refine = ns["refine_centroid"]
+
+    from improved_body_parts_tpu.ops.nms import refine_peaks
+
+    rng = np.random.default_rng(0)
+    score = rng.uniform(0, 1, (40, 40))
+    xs = np.asarray([17])
+    ys = np.asarray([23])
+    (rx, ry), rscore = (lambda t: (t[:2], t[2]))(
+        ref_refine(score, (17, 23), radius=2))
+    ox, oy, oscore = refine_peaks(score, xs, ys, radius=2)
+    # the reference's x offset is our y offset and vice versa
+    assert float(ox[0]) - 17 == pytest.approx(ry - 23, abs=1e-12)
+    assert float(oy[0]) - 23 == pytest.approx(rx - 17, abs=1e-12)
+    assert float(oscore[0]) == pytest.approx(float(rscore), abs=1e-12)
+
+
 @pytest.mark.parametrize("use_focal", [True, False])
 def test_loss_matches_reference_torch(ref, use_focal):
     """Reference focal_l2_loss / l2_loss (torch, NCHW, channel-modulated
