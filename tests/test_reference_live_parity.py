@@ -157,6 +157,43 @@ def test_config_tables_match_reference_live(ref_module, ours_name):
     assert list(sk.draw_limbs) == list(theirs.draw_list)
 
 
+@pytest.mark.parametrize("shape", [(250, 330), (256, 256), (255, 321)])
+def test_padding_matches_reference(shape):
+    """pad_right_down / center_pad vs the reference's helpers
+    (utils/util.py:44-100) — same padded pixels, same pad bookkeeping.
+    The reference builds pads via constant-value tiles, so our constant
+    border is value-identical."""
+    import ast
+
+    src = open(os.path.join(REF_ROOT, "utils", "util.py")).read()
+    tree = ast.parse(src)
+    fns = [n for n in tree.body if isinstance(n, ast.FunctionDef)
+           and n.name in ("padRightDownCorner", "center_pad")]
+    ns = {"np": np}
+    exec(compile(ast.Module(body=fns, type_ignores=[]), "ref_util",
+                 "exec"), ns)  # noqa: S102 — read-only reference code
+
+    from improved_body_parts_tpu.infer.predict import (
+        center_pad as our_center_pad, pad_right_down)
+
+    rng = np.random.default_rng(1)
+    img = rng.integers(0, 255, (*shape, 3), dtype=np.uint8)
+    stride, pad_value = 64, 128
+
+    ref_img, ref_pad = ns["padRightDownCorner"](img.copy(), stride,
+                                                pad_value)
+    our_img, (ph, pw) = pad_right_down(img.copy(), stride, pad_value)
+    np.testing.assert_array_equal(our_img, ref_img)
+    assert (ph, pw) == (ref_pad[2], ref_pad[3])
+
+    ref_img, ref_pad = ns["center_pad"](img.copy(), stride, pad_value)
+    our_img, (top, left, bottom, right) = our_center_pad(img.copy(), stride,
+                                                         pad_value)
+    np.testing.assert_array_equal(our_img, ref_img)
+    assert [top, left, bottom, right] == [ref_pad[0], ref_pad[1],
+                                          ref_pad[2], ref_pad[3]]
+
+
 def test_refine_centroid_deviation_pinned():
     """The reference's refine_centroid swaps its offset grids
     (np.mgrid's first output varies along ROWS but is applied to x,
