@@ -194,6 +194,43 @@ def test_padding_matches_reference(shape):
                                           ref_pad[2], ref_pad[3]]
 
 
+def test_keypoint_nms_matches_reference_torch():
+    """Our jitted NMS and host peak mask vs the reference's torch
+    max-pool NMS (utils/util.py:177-183 — device-agnostic, runs on CPU
+    torch) on the same maps: identical surviving peaks."""
+    import ast
+
+    import torch
+    import torch.nn.functional as F
+
+    src = open(os.path.join(REF_ROOT, "utils", "util.py")).read()
+    tree = ast.parse(src)
+    fn = next(n for n in tree.body if isinstance(n, ast.FunctionDef)
+              and n.name == "keypoint_heatmap_nms")
+    ns = {"F": F, "torch": torch}
+    exec(compile(ast.Module(body=[fn], type_ignores=[]), "ref_util",
+                 "exec"), ns)  # noqa: S102 — read-only reference code
+
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.ops.nms import keypoint_nms, peak_mask_np
+
+    rng = np.random.default_rng(2)
+    heat = rng.uniform(0, 1, (64, 64, 18)).astype(np.float32)
+    heat += rng.uniform(0, 1e-6, heat.shape).astype(np.float32)  # break ties
+
+    # reference: NCHW torch
+    t = torch.from_numpy(np.moveaxis(heat, -1, 0))[None]
+    theirs = ns["keypoint_heatmap_nms"](t, kernel=3, thre=0.1)
+    theirs = np.moveaxis(theirs[0].numpy(), 0, -1)
+
+    ours_dev = np.asarray(keypoint_nms(jnp.asarray(heat), kernel=3, thre=0.1))
+    np.testing.assert_allclose(ours_dev, theirs, atol=1e-7)
+
+    mask = peak_mask_np(heat, thre=0.1)
+    np.testing.assert_array_equal(mask, theirs > 0)
+
+
 def test_refine_centroid_deviation_pinned():
     """The reference's refine_centroid swaps its offset grids
     (np.mgrid's first output varies along ROWS but is applied to x,
