@@ -18,6 +18,24 @@ from improved_body_parts_tpu.data.transformer import (
 CFG = get_config("canonical").skeleton
 
 
+@pytest.mark.parametrize("name", ["canonical", "three_stack_384",
+                                  "dense_384", "final_384"])
+def test_all_variant_skeletons_synthesize(name):
+    """Every config variant's skeleton (24/30/49-limb sets, 384/512 grids)
+    must drive the heatmapper to a valid full-channel GT tensor."""
+    sk = get_config(name).skeleton
+    rng = np.random.default_rng(0)
+    joints = np.zeros((2, sk.num_parts, 3), np.float32)
+    joints[:, :, 0] = rng.uniform(0, sk.width, (2, sk.num_parts))
+    joints[:, :, 1] = rng.uniform(0, sk.height, (2, sk.num_parts))
+    joints[:, :, 2] = 1
+    maps = Heatmapper(sk).create_heatmaps(
+        joints, np.ones(sk.grid_shape, np.float32))
+    assert maps.shape == (*sk.grid_shape, sk.num_layers)
+    assert maps[..., sk.paf_layers:].max() > 0.9  # keypoint peaks present
+    assert 0.0 <= maps.min() and maps.max() <= 1.0
+
+
 def _neutral_scale():
     # scale_provided that makes the composed scale factor exactly 1
     return CFG.transform_params.target_dist * (CFG.height - 1) / CFG.height
