@@ -7,7 +7,13 @@ from .decode import (
     subsets_to_keypoints,
 )
 from .demo import draw_skeletons, limb_flow_bgr, run_demo
-from .evaluate import format_results, process_image, validation
+from .evaluate import (
+    format_results,
+    load_coco_ground_truth,
+    process_image,
+    validation,
+    validation_oks,
+)
 from .native import native_available
 from .oks import evaluate_oks, oks
 from .pipeline import pipelined_inference
@@ -16,7 +22,8 @@ from .predict import Predictor, center_pad, pad_right_down
 __all__ = [
     "assemble", "decode", "find_connections", "find_peaks", "find_people",
     "subsets_to_keypoints", "draw_skeletons", "limb_flow_bgr", "run_demo",
-    "format_results", "process_image", "validation", "native_available",
+    "format_results", "load_coco_ground_truth", "process_image",
+    "validation", "validation_oks", "native_available",
     "evaluate_oks", "oks", "pipelined_inference", "Predictor", "center_pad",
     "pad_right_down",
 ]

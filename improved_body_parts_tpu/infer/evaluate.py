@@ -90,35 +90,11 @@ def validation(predictor: Predictor, anno_file: str, images_dir: str,
         validation_ids = coco_gt.getImgIds()[:max_images]
     assert not set(validation_ids).difference(set(coco_gt.getImgIds()))
 
-    def load(image_id):
-        name = coco_gt.imgs[image_id]["file_name"]
-        image = cv2.imread(os.path.join(images_dir, name))
-        if image is None:
-            raise IOError(f"missing image {name}")
-        return image
-
     decode_timer = AverageMeter()
-    keypoints: Dict[int, list] = {}
-    if fast:
-        # forward(N+1) overlaps decode(N) (infer.pipeline); end-to-end FPS
-        # is the meaningful number here, decode no longer sits on the
-        # critical path
-        from .pipeline import pipelined_inference
-
-        t0 = time.perf_counter()
-        results_iter = pipelined_inference(
-            predictor, (load(i) for i in validation_ids), params,
-            use_native=use_native)
-        for image_id, results in zip(validation_ids, results_iter):
-            keypoints[image_id] = results
-        dt = time.perf_counter() - t0
-        print(f"end-to-end (pipelined): "
-              f"{len(validation_ids) / max(dt, 1e-9):.1f} FPS")
-    else:
-        for image_id in validation_ids:
-            keypoints[image_id] = process_image(predictor, load(image_id),
-                                                params, use_native,
-                                                decode_timer, fast=False)
+    keypoints = _collect_detections(
+        predictor, {i: coco_gt.imgs[i]["file_name"] for i in validation_ids},
+        images_dir, list(validation_ids), params, use_native, fast,
+        decode_timer)
 
     res_file = os.path.join(results_dir, f"person_keypoints_{dump_name}.json")
     format_results(keypoints, res_file)
@@ -132,3 +108,99 @@ def validation(predictor: Predictor, anno_file: str, images_dir: str,
         print(f"keypoint assignment: {1.0 / max(decode_timer.avg, 1e-9):.1f} "
               f"FPS (avg {decode_timer.avg * 1000:.1f} ms)")
     return coco_eval
+
+
+def _collect_detections(predictor: Predictor, id_to_name: Dict[int, str],
+                        images_dir: str, ids: Sequence[int],
+                        params: InferenceParams, use_native: bool,
+                        fast: bool,
+                        decode_timer: Optional[AverageMeter] = None
+                        ) -> Dict[int, list]:
+    """Run inference over ``ids`` — the one detection-collection loop shared
+    by the COCOeval and OKS-proxy protocols.  ``fast`` uses the pipelined
+    single-scale path (forward N+1 overlaps threaded decode N)."""
+
+    def load(image_id):
+        image = cv2.imread(os.path.join(images_dir, id_to_name[image_id]))
+        if image is None:
+            raise IOError(f"missing image {id_to_name[image_id]}")
+        return image
+
+    keypoints: Dict[int, list] = {}
+    if fast:
+        from .pipeline import pipelined_inference
+
+        t0 = time.perf_counter()
+        results_iter = pipelined_inference(
+            predictor, (load(i) for i in ids), params,
+            use_native=use_native)
+        for image_id, results in zip(ids, results_iter):
+            keypoints[image_id] = results
+        dt = time.perf_counter() - t0
+        print(f"end-to-end (pipelined): {len(ids) / max(dt, 1e-9):.1f} FPS")
+    else:
+        for image_id in ids:
+            keypoints[image_id] = process_image(predictor, load(image_id),
+                                                params, use_native,
+                                                decode_timer, fast=False)
+    return keypoints
+
+
+def load_coco_ground_truth(anno_file: str):
+    """Parse a person_keypoints_*.json with the stdlib (no pycocotools):
+    returns (image_id -> file_name, image_id -> list of GT dicts in the
+    ``infer.oks`` format)."""
+    with open(anno_file) as f:
+        data = json.load(f)
+    person_ids = {c["id"] for c in data.get("categories", [])
+                  if c.get("name") == "person"} or {1}
+    images = {im["id"]: im["file_name"] for im in data["images"]}
+    gts: Dict[int, list] = {i: [] for i in images}
+    for ann in data.get("annotations", []):
+        if ann.get("category_id", 1) not in person_ids:
+            continue
+        kp = np.asarray(ann.get("keypoints", [0] * 51),
+                        np.float64).reshape(-1, 3)
+        bbox = ann.get("bbox")
+        gts.setdefault(ann["image_id"], []).append({
+            "keypoints": kp,
+            "area": float(ann.get("area") or
+                          (bbox[2] * bbox[3] if bbox else 1.0)),
+            "bbox": tuple(bbox) if bbox else None,
+            "iscrowd": int(ann.get("iscrowd", 0)),
+        })
+    return images, gts
+
+
+def validation_oks(predictor: Predictor, anno_file: str, images_dir: str,
+                   validation_ids: Optional[Sequence[int]] = None,
+                   max_images: int = 500,
+                   params: Optional[InferenceParams] = None,
+                   use_native: bool = True, fast: bool = False,
+                   dump_name: str = "tpu", results_dir: str = "results"):
+    """The first-500 protocol evaluated with the dependency-free OKS
+    evaluator (COCOeval ignore/crowd/maxDets semantics, see APCHECK.md) —
+    runs in environments without pycocotools.  Defaults (including
+    ``fast``) match :func:`validation` so the two protocols stay
+    comparable; the detections JSON is still written, so it can be
+    re-scored with pycocotools elsewhere.  Returns the metrics dict
+    {AP, AP50, AP75, AR}."""
+    from .oks import evaluate_oks
+
+    params = params or default_inference_params()[0]
+    images, gts = load_coco_ground_truth(anno_file)
+    if validation_ids is None:
+        ids = list(images)[:max_images]
+    else:
+        ids = list(validation_ids)
+        missing = set(ids) - set(images)
+        assert not missing, f"ids not in {anno_file}: {sorted(missing)[:8]}"
+
+    detections = _collect_detections(predictor, images, images_dir, ids,
+                                     params, use_native, fast)
+    res_file = os.path.join(results_dir, f"person_keypoints_{dump_name}.json")
+    format_results(detections, res_file)
+
+    metrics = evaluate_oks({i: gts.get(i, []) for i in ids}, detections)
+    print("  ".join(f"{k}={v:.4f}" for k, v in metrics.items()))
+    return metrics

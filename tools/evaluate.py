@@ -46,17 +46,40 @@ def main():
     ap.add_argument("--fast", action="store_true",
                     help="single-scale fast path: on-device NMS, decode at "
                          "network resolution")
+    ap.add_argument("--oks-proxy", action="store_true",
+                    help="evaluate with the dependency-free OKS evaluator "
+                         "(COCOeval ignore/crowd/maxDets semantics, "
+                         "APCHECK.md) instead of pycocotools")
     args = ap.parse_args()
 
-    from improved_body_parts_tpu.infer.evaluate import validation
+    from improved_body_parts_tpu.infer.evaluate import (
+        validation, validation_oks)
+
+    use_proxy = args.oks_proxy
+    if not use_proxy:
+        try:
+            # probe the compiled modules validation actually needs, not the
+            # (possibly empty/broken) top-level package
+            from pycocotools.cocoeval import COCOeval  # noqa: F401
+        except ImportError:
+            print("pycocotools not usable — falling back to the OKS "
+                  "proxy evaluator (--oks-proxy)")
+            use_proxy = True
 
     predictor = load_predictor(args.config, args.checkpoint)
-    coco_eval = validation(predictor, args.anno, args.images,
-                           dump_name=args.dump_name,
-                           max_images=args.max_images,
-                           use_native=not args.no_native,
-                           fast=args.fast)
-    print("AP:", coco_eval.stats[0])
+    if use_proxy:
+        metrics = validation_oks(predictor, args.anno, args.images,
+                                 max_images=args.max_images,
+                                 use_native=not args.no_native,
+                                 fast=args.fast, dump_name=args.dump_name)
+        print("AP:", metrics["AP"])
+    else:
+        coco_eval = validation(predictor, args.anno, args.images,
+                               dump_name=args.dump_name,
+                               max_images=args.max_images,
+                               use_native=not args.no_native,
+                               fast=args.fast)
+        print("AP:", coco_eval.stats[0])
 
 
 if __name__ == "__main__":
