@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Audited inference timing: every FPS claim cross-checked against the
+compiled executable's own cost analysis.
+
+The quick benchmarks (bench.py, tools/speed_test.py) time a Python dispatch
+loop; on a relay-attached chip that can under- or over-state the device rate
+(dispatch pipelining, host contention, power ramp). This tool is the careful
+version used to *audit* those numbers:
+
+- XLA's ``compiled.cost_analysis()`` FLOP/byte counts for each batch size —
+  the implied TFLOP/s and GB/s are printed next to each timing so a
+  physically impossible number (above peak) is flagged instead of recorded;
+- random (not constant-foldable, not all-zero) inputs, output checksum
+  asserted finite;
+- R independent repeats of N iterations; median and best repeats reported;
+- a chained-latency variant (iteration i+1 consumes a scalar derived from
+  iteration i) that defeats dispatch pipelining and measures true
+  end-to-end step latency.
+
+Reference headline being audited: 38.5 imgs/s single-image 512x512 on a
+2080 Ti (reference: test_inference_speed.py:90-120, README.md:67).
+
+    python tools/perf_audit.py --batches 1 2 4 8 --out PERF_AUDIT.json
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# v5e public peak: ~197 TFLOP/s bf16, ~819 GB/s HBM. Used only to FLAG
+# impossible numbers, never to scale them.
+PEAK_TFLOPS = {"tpu": 197.0, "cpu": 1.0}
+PEAK_GBPS = {"tpu": 819.0, "cpu": 50.0}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", default="canonical")
+    ap.add_argument("--size", type=int, default=512)
+    ap.add_argument("--batches", type=int, nargs="+", default=[1, 2, 4, 8])
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--bf16-params", action="store_true")
+    ap.add_argument("--out", default="PERF_AUDIT.json")
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.utils import (
+        apply_platform_env, devices_with_timeout)
+    apply_platform_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devices = devices_with_timeout(900)
+    platform = devices[0].platform
+    print(f"platform={platform}", flush=True)
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.models import build_model
+
+    cfg = get_config(args.config)
+    model = build_model(cfg)
+    rng = np.random.default_rng(0)
+
+    init_img = jnp.zeros((1, args.size, args.size, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), init_img, train=False)
+    if args.bf16_params:
+        from improved_body_parts_tpu.utils import bf16_params
+        variables = bf16_params(variables)
+
+    def forward(v, x):
+        return model.apply(v, x, train=False)[-1][0]
+
+    report = {"platform": platform, "config": args.config, "size": args.size,
+              "iters": args.iters, "repeats": args.repeats,
+              "bf16_params": args.bf16_params, "batches": {}}
+
+    def flush():
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+
+    for b in args.batches:
+        x = jnp.asarray(
+            rng.uniform(0, 1, (b, args.size, args.size, 3)), jnp.float32)
+        lowered = jax.jit(forward).lower(variables, x)
+        compiled = lowered.compile()
+        ca = compiled.cost_analysis() or {}
+        gflops = float(ca.get("flops", 0.0)) / 1e9
+        gbytes = float(ca.get("bytes accessed", 0.0)) / 1e9
+
+        out = compiled(variables, x)
+        jax.block_until_ready(out)
+        assert np.isfinite(np.asarray(out, np.float32)).all(), \
+            f"non-finite outputs at batch {b}"
+
+        # throughput: R repeats of N dispatches, block at each repeat end
+        reps = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = compiled(variables, x)
+            jax.block_until_ready(out)
+            reps.append((time.perf_counter() - t0) / args.iters)
+        med = statistics.median(reps)
+        best = min(reps)
+
+        # latency: defeat pipelining — each next input depends on the
+        # previous output through a scalar, so steps serialize
+        def chained(v, x, prev_out):
+            dep = jnp.sum(prev_out[..., :1, :1, :1]) * 0.0
+            return forward(v, x + dep)
+
+        cfn = jax.jit(chained)
+        cout = cfn(variables, x, out)
+        jax.block_until_ready(cout)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            cout = cfn(variables, x, cout)
+        jax.block_until_ready(cout)
+        lat = (time.perf_counter() - t0) / args.iters
+
+        fps_med, fps_best = b / med, b / best
+        tflops = gflops / 1e3 / med if gflops else None
+        gbps = gbytes / med if gbytes else None
+        flags = []
+        if tflops and tflops > PEAK_TFLOPS.get(platform, 1e9):
+            flags.append(f"IMPLIED {tflops:.0f} TFLOP/s EXCEEDS PEAK")
+        if gbps and gbps > PEAK_GBPS.get(platform, 1e9):
+            flags.append(f"IMPLIED {gbps:.0f} GB/s EXCEEDS PEAK HBM BW")
+        entry = {
+            "hlo_gflops_per_step": round(gflops, 1),
+            "hlo_gbytes_per_step": round(gbytes, 3),
+            "throughput_fps_median": round(fps_med, 2),
+            "throughput_fps_best": round(fps_best, 2),
+            "repeat_spread_ms": [round(r * 1e3, 3) for r in sorted(reps)],
+            "chained_latency_ms": round(lat * 1e3, 3),
+            "chained_fps": round(b / lat, 2),
+            "implied_tflops": round(tflops, 1) if tflops else None,
+            "implied_hbm_gbps": round(gbps, 1) if gbps else None,
+            "flags": flags,
+        }
+        report["batches"][b] = entry
+        flush()
+        print(f"batch {b}: {fps_med:.1f} fps med ({fps_best:.1f} best, "
+              f"{b / lat:.1f} chained) | {gflops:.0f} GFLOP/step -> "
+              f"{tflops or 0:.1f} TFLOP/s, {gbps or 0:.0f} GB/s {flags}",
+              flush=True)
+
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()
