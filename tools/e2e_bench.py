@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""End-to-end inference FPS: network forward + full decode to skeletons.
+
+The reference's end-to-end rate is dominated by its pure-Python keypoint
+assignment (5.2 FPS on a Xeon, reference: README.md:68); a 3rd-party C++
+rebuild of the post-processing reached 7-8 FPS end-to-end single-scale+flip
+(reference: README.md:121). This tool measures ours on the active platform,
+three ways:
+
+1. full ensemble path (``Predictor.predict`` -> host decode) — the
+   evaluate.py-equivalent protocol, single scale + flip;
+2. fast path (``predict_fast``: on-device NMS, scaled-res decode);
+3. pipelined fast path (``pipelined_inference``: forward(N+1) overlaps
+   threaded decode(N)).
+
+Caveat: with randomly initialized weights the network's maps (and thus the
+decode workload) do not reflect trained behavior — near-zero maps give the
+decoder almost nothing to assemble. The numbers here bound the
+forward+transfer pipeline; for a decode-stage workload benchmark see the
+planted-map parity tests (tests/test_decode.py) and the C++ decoder timing
+in PARITY.md. With an imported reference checkpoint
+(tools/import_torch_checkpoint.py) this tool measures the real thing.
+
+    python tools/e2e_bench.py --images 30 --out E2E_BENCH.json
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def synth_images(n, size, rng):
+    """BGR uint8 images with mild structure (blobs + gradient)."""
+    import numpy as np
+
+    imgs = []
+    for _ in range(n):
+        img = rng.integers(0, 60, (size, size, 3)).astype(np.uint8)
+        yy, xx = np.mgrid[0:size, 0:size]
+        for _ in range(rng.integers(2, 5)):
+            cx, cy = rng.integers(size // 8, 7 * size // 8, 2)
+            r = rng.integers(size // 16, size // 6)
+            blob = np.exp(-((xx - cx) ** 2 + (yy - cy) ** 2) / (2 * r * r))
+            img = np.clip(img + (blob[..., None] * 180), 0, 255
+                          ).astype(np.uint8)
+        imgs.append(img)
+    return imgs
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", default="canonical")
+    ap.add_argument("--size", type=int, default=512)
+    ap.add_argument("--images", type=int, default=30)
+    ap.add_argument("--decode-workers", type=int, default=2)
+    ap.add_argument("--out", default="E2E_BENCH.json")
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.utils import (
+        apply_platform_env, devices_with_timeout)
+    apply_platform_env()
+
+    import jax
+    import numpy as np
+
+    devices = devices_with_timeout(900)
+    platform = devices[0].platform
+    print(f"platform={platform}", flush=True)
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.infer.decode import decode
+    from improved_body_parts_tpu.infer.pipeline import pipelined_inference
+    from improved_body_parts_tpu.infer.predict import Predictor
+    from improved_body_parts_tpu.models import build_model
+
+    cfg = get_config(args.config)
+    model = build_model(cfg)
+    rng = np.random.default_rng(0)
+    imgs = synth_images(args.images, args.size, rng)
+
+    import jax.numpy as jnp
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, args.size, args.size, 3)),
+                           train=False)
+    pred = Predictor(model, variables, cfg.skeleton)
+
+    report = {"platform": platform, "config": args.config,
+              "size": args.size, "images": args.images,
+              "reference_fps": {"python_assignment": 5.2,
+                                "cpp_rebuild_e2e": "7-8"}}
+
+    def flush():
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+
+    # --- 1. full ensemble (single scale + flip) + host decode -----------
+    heat, paf = pred.predict(imgs[0])  # compile
+    n_dec = 0
+    t0 = time.perf_counter()
+    for im in imgs:
+        heat, paf = pred.predict(im)
+        people = decode(heat, paf, pred.params, cfg.skeleton)
+        n_dec += len(people)
+    dt = (time.perf_counter() - t0) / len(imgs)
+    report["full_path_fps"] = round(1.0 / dt, 2)
+    report["full_path_ms"] = round(dt * 1e3, 1)
+    flush()
+    print(f"full ensemble+decode: {1.0 / dt:.2f} FPS "
+          f"({dt * 1e3:.0f} ms/img, {n_dec} detections)", flush=True)
+
+    # --- 2. fast path ----------------------------------------------------
+    out = pred.predict_fast(imgs[0])  # compile
+    t0 = time.perf_counter()
+    for im in imgs:
+        heat, paf, mask, scale = pred.predict_fast(im)
+        decode(heat, paf, pred.params, cfg.skeleton, peak_mask=mask,
+               coord_scale=scale)
+    dt = (time.perf_counter() - t0) / len(imgs)
+    report["fast_path_fps"] = round(1.0 / dt, 2)
+    flush()
+    print(f"fast path: {1.0 / dt:.2f} FPS", flush=True)
+
+    # --- 3. pipelined fast path ------------------------------------------
+    t0 = time.perf_counter()
+    n = sum(1 for _ in pipelined_inference(
+        pred, imgs, decode_workers=args.decode_workers))
+    dt = (time.perf_counter() - t0) / n
+    report["pipelined_fps"] = round(1.0 / dt, 2)
+    report["decode_workers"] = args.decode_workers
+    flush()
+    print(f"pipelined: {1.0 / dt:.2f} FPS", flush=True)
+
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

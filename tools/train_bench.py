@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Training-step throughput on the active platform (chip or CPU).
+
+Times the REAL jitted train step — forward, focal L2, backward, SGD update,
+BN batch stats — at full 512x512 resolution across a batch sweep. Unlike an
+inference dispatch loop, successive train steps chain through the carried
+``TrainState``, so a pooled relay cannot fan them out: the timing is honest
+by construction (see tools/perf_audit.py for why that matters here).
+
+The reference trains at batch 4/GPU and claims >90% GPU utilization
+(reference: config/config.py:10, README.md:34). It publishes no imgs/s for
+training; this records ours, with XLA cost analysis per step.
+
+    python tools/train_bench.py --batches 2 4 8 --out TRAIN_BENCH.json
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", default="canonical")
+    ap.add_argument("--size", type=int, default=512)
+    ap.add_argument("--batches", type=int, nargs="+", default=[2, 4, 8])
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--device-gt", action="store_true",
+                    help="time the on-device GT-synthesis step variant")
+    ap.add_argument("--out", default="TRAIN_BENCH.json")
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.utils import (
+        apply_platform_env, devices_with_timeout)
+    apply_platform_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devices = devices_with_timeout(900)
+    platform = devices[0].platform
+    print(f"platform={platform}", flush=True)
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.train import (
+        create_train_state, make_optimizer, make_train_step,
+        step_decay_schedule)
+
+    cfg = get_config(args.config)
+    model = build_model(cfg)
+    stride = cfg.skeleton.stride
+    label_hw = args.size // stride
+    rng = np.random.default_rng(0)
+
+    report = {"platform": platform, "config": args.config, "size": args.size,
+              "steps": args.steps, "repeats": args.repeats, "batches": {}}
+
+    def flush():
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+
+    opt = make_optimizer(cfg, step_decay_schedule(cfg.train,
+                                                  steps_per_epoch=100))
+    for b in args.batches:
+        imgs = jnp.asarray(
+            rng.uniform(0, 1, (b, args.size, args.size, 3)), jnp.float32)
+        labels = jnp.asarray(
+            rng.uniform(0, 1, (b, label_hw, label_hw,
+                               cfg.skeleton.num_layers)), jnp.float32)
+        mask = jnp.ones((b, label_hw, label_hw, 1), jnp.float32)
+
+        state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
+                                   imgs[:1])
+        step = make_train_step(model, cfg, opt, donate=True)
+        lowered = step.lower(state, imgs, mask, labels) \
+            if hasattr(step, "lower") else jax.jit(step).lower(
+                state, imgs, mask, labels)
+        compiled = lowered.compile()
+        ca = compiled.cost_analysis() or {}
+        gflops = float(ca.get("flops", 0.0)) / 1e9
+        gbytes = float(ca.get("bytes accessed", 0.0)) / 1e9
+
+        state, loss = compiled(state, imgs, mask, labels)
+        jax.block_until_ready(loss)
+        assert np.isfinite(float(loss)), f"non-finite loss at batch {b}"
+
+        reps = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                state, loss = compiled(state, imgs, mask, labels)
+            jax.block_until_ready(loss)
+            reps.append((time.perf_counter() - t0) / args.steps)
+        med = statistics.median(reps)
+        entry = {
+            "step_ms_median": round(med * 1e3, 3),
+            "imgs_per_sec": round(b / med, 2),
+            "repeat_spread_ms": [round(r * 1e3, 3) for r in sorted(reps)],
+            "hlo_gflops_per_step": round(gflops, 1),
+            "hlo_gbytes_per_step": round(gbytes, 3),
+            "implied_tflops": round(gflops / 1e3 / med, 1) if gflops else None,
+            "implied_hbm_gbps": round(gbytes / med, 1) if gbytes else None,
+        }
+        report["batches"][b] = entry
+        flush()
+        print(f"batch {b}: {b / med:7.2f} imgs/s  ({med * 1e3:.1f} ms/step, "
+              f"{gflops:.0f} GFLOP -> {entry['implied_tflops']} TFLOP/s, "
+              f"{entry['implied_hbm_gbps']} GB/s)", flush=True)
+
+    grid_h, grid_w = cfg.skeleton.grid_shape
+    if args.device_gt and (label_hw, label_hw) != (grid_h, grid_w):
+        # the on-device synthesizer bakes in the config's grid_shape; a
+        # mismatched --size would trace-error (or mis-size the loss)
+        print(f"skipping --device-gt: size {args.size} gives a "
+              f"{label_hw}x{label_hw} grid but config '{args.config}' "
+              f"synthesizes at {grid_h}x{grid_w}", flush=True)
+        report["device_gt"] = {"skipped": f"size {args.size} != config grid"}
+        flush()
+        args.device_gt = False
+
+    if args.device_gt:
+        b = args.batches[-1]
+        max_people, max_joints = 8, cfg.skeleton.num_parts
+        imgs = jnp.asarray(
+            rng.uniform(0, 1, (b, args.size, args.size, 3)), jnp.float32)
+        joints = np.asarray(
+            rng.uniform(0, args.size, (b, max_people, max_joints, 3)),
+            np.float32)
+        joints[..., 2] = rng.integers(0, 2, joints.shape[:-1])  # visible
+        joints = jnp.asarray(joints)
+        mask = jnp.ones((b, label_hw, label_hw, 1), jnp.float32)
+        mask_all = jnp.ones((b, label_hw, label_hw, 1), jnp.float32)
+        state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
+                                   imgs[:1])
+        step = make_train_step(model, cfg, opt, donate=True, device_gt=True)
+        state, loss = step(state, imgs, mask, joints, mask_all)
+        jax.block_until_ready(loss)
+        reps = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                state, loss = step(state, imgs, mask, joints, mask_all)
+            jax.block_until_ready(loss)
+            reps.append((time.perf_counter() - t0) / args.steps)
+        dt = statistics.median(reps)
+        report["device_gt"] = {
+            "batch": b, "step_ms_median": round(dt * 1e3, 3),
+            "imgs_per_sec": round(b / dt, 2),
+            "repeat_spread_ms": [round(r * 1e3, 3) for r in sorted(reps)]}
+        flush()
+        print(f"device-gt batch {b}: {b / dt:.2f} imgs/s", flush=True)
+
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()
