@@ -1,6 +1,9 @@
 from .decode import (
+    CompactOverflow,
+    CompactResult,
     assemble,
     decode,
+    decode_compact,
     find_connections,
     find_peaks,
     find_people,
@@ -20,7 +23,8 @@ from .pipeline import pipelined_inference
 from .predict import Predictor, center_pad, pad_right_down
 
 __all__ = [
-    "assemble", "decode", "find_connections", "find_peaks", "find_people",
+    "CompactOverflow", "CompactResult", "assemble", "decode",
+    "decode_compact", "find_connections", "find_peaks", "find_people",
     "subsets_to_keypoints", "draw_skeletons", "limb_flow_bgr", "run_demo",
     "format_results", "load_coco_ground_truth", "process_image",
     "validation", "validation_oks", "native_available",

@@ -23,7 +23,7 @@ the refinement "dose not affect the results").
 from __future__ import annotations
 
 import math
-from typing import List, Optional, Sequence, Tuple
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -123,34 +123,53 @@ def find_connections(all_peaks: Sequence[np.ndarray], paf: np.ndarray,
         mean_score = (scores * valid).sum(-1) / msum
         above = ((scores > params.thre2) & valid).sum(-1)
 
-        with np.errstate(divide="ignore", invalid="ignore"):
-            prior = mean_score + np.minimum(0.5 * image_size / norm - 1.0, 0.0)
-        ok = ((above >= params.connect_ration * m)
-              & (prior > 0) & (norm > 0))
-
-        ii, jj = np.nonzero(ok)
-        if ii.size == 0:
-            connection_all.append(np.zeros((0, 6)))
-            continue
-        sel_prior = prior[ii, jj]
-        rank = (0.5 * sel_prior + 0.25 * cand_a[ii, 2] + 0.25 * cand_b[jj, 2])
-        order = np.argsort(-rank, kind="stable")
-
-        used_a = np.zeros(na, bool)
-        used_b = np.zeros(nb, bool)
-        rows = []
-        limit = min(na, nb)
-        for o in order:
-            i, j = ii[o], jj[o]
-            if used_a[i] or used_b[j]:
-                continue
-            used_a[i] = used_b[j] = True
-            rows.append([cand_a[i, 3], cand_b[j, 3], sel_prior[o],
-                         float(i), float(j), norm[i, j]])
-            if len(rows) >= limit:
-                break
-        connection_all.append(np.asarray(rows, dtype=np.float64))
+        prior, ok = _acceptance(mean_score, above, m, norm, image_size,
+                                params)
+        connection_all.append(
+            _greedy_select(cand_a, cand_b, prior, ok, norm))
     return connection_all, special_k
+
+
+def _acceptance(mean_score: np.ndarray, above: np.ndarray, m: np.ndarray,
+                norm: np.ndarray, image_size: int, params: InferenceParams
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """The limb acceptance rule shared by the host and compact paths:
+    length-penalized prior + the ≥connect_ration-of-samples criterion
+    (reference: evaluate.py:241-251)."""
+    with np.errstate(divide="ignore", invalid="ignore"):
+        prior = mean_score + np.minimum(0.5 * image_size / norm - 1.0, 0.0)
+    ok = ((above >= params.connect_ration * m)
+          & (prior > 0) & (norm > 0))
+    return prior, ok
+
+
+def _greedy_select(cand_a: np.ndarray, cand_b: np.ndarray, prior: np.ndarray,
+                   ok: np.ndarray, norm: np.ndarray) -> np.ndarray:
+    """Greedy one-to-one limb selection over the (nA, nB) pair grid, sorted
+    by 0.5·prior + 0.25·(endpoint scores) (reference: evaluate.py:254-271).
+    """
+    na, nb = len(cand_a), len(cand_b)
+    ii, jj = np.nonzero(ok)
+    if ii.size == 0:
+        return np.zeros((0, 6))
+    sel_prior = prior[ii, jj]
+    rank = (0.5 * sel_prior + 0.25 * cand_a[ii, 2] + 0.25 * cand_b[jj, 2])
+    order = np.argsort(-rank, kind="stable")
+
+    used_a = np.zeros(na, bool)
+    used_b = np.zeros(nb, bool)
+    rows = []
+    limit = min(na, nb)
+    for o in order:
+        i, j = ii[o], jj[o]
+        if used_a[i] or used_b[j]:
+            continue
+        used_a[i] = used_b[j] = True
+        rows.append([cand_a[i, 3], cand_b[j, 3], sel_prior[o],
+                     float(i), float(j), norm[i, j]])
+        if len(rows) >= limit:
+            break
+    return np.asarray(rows, dtype=np.float64)
 
 
 def find_people(connection_all: Sequence[np.ndarray],
@@ -334,6 +353,87 @@ def assemble(heatmap: np.ndarray, paf: np.ndarray, params: InferenceParams,
         all_peaks, paf, image_size, params, skeleton.limbs_conn)
     return find_people(connection_all, special_k, all_peaks, params,
                        skeleton.limbs_conn, skeleton.num_parts)
+
+
+class CompactResult(NamedTuple):
+    """Host-side payload of the compact inference path
+    (``Predictor.predict_compact``): top-K peak records + dense limb pair
+    statistics, both computed on the device (``ops.peaks``)."""
+    peaks: object        # ops.peaks.TopKPeaks of numpy arrays, (C, K)
+    stats: object        # ops.peaks.PairStats of numpy arrays, (L, K, K)
+    image_size: int      # valid decoded-map height (the length-prior scale)
+    coord_scale: Tuple[float, float]
+
+
+class CompactOverflow(RuntimeError):
+    """A keypoint channel had more NMS peaks than the compact path's top-K
+    capacity; the caller should fall back to the full-map path."""
+
+
+def decode_compact(compact: CompactResult, params: InferenceParams,
+                   skeleton: SkeletonConfig):
+    """Decode from on-device peak records + pair statistics — no maps.
+
+    Equivalent to ``decode`` on the fast path's maps: peak lists are
+    rebuilt in the host path's row-major order, per-pair priors and the
+    acceptance rule are applied to the device-computed statistics, then the
+    greedy limb selection and person assembly run unchanged.
+
+    :raises CompactOverflow: when any channel's true NMS peak count exceeds
+        the top-K capacity (``Predictor(compact_topk=...)``).
+    """
+    pk, st = compact.peaks, compact.stats
+    num_parts = skeleton.num_parts
+    over = np.nonzero(pk.count > pk.valid.shape[1])[0]
+    if over.size:
+        raise CompactOverflow(
+            f"channels {over.tolist()} have {pk.count[over].tolist()} NMS "
+            f"peaks > top-K capacity {pk.valid.shape[1]}")
+
+    # rebuild per-part peak lists in the host path's order: row-major by
+    # raw integer coords (np.nonzero order), ids sequential across parts
+    all_peaks: List[np.ndarray] = []
+    perms: List[np.ndarray] = []
+    peak_counter = 0
+    for c in range(num_parts):
+        slots = np.nonzero(pk.valid[c])[0]
+        order = np.lexsort((pk.xs[c, slots], pk.ys[c, slots]))
+        slots = slots[order]
+        n = slots.size
+        ids = np.arange(peak_counter, peak_counter + n, dtype=np.float64)
+        all_peaks.append(
+            np.stack([pk.x_ref[c, slots].astype(np.float64),
+                      pk.y_ref[c, slots].astype(np.float64),
+                      pk.score[c, slots].astype(np.float64), ids], axis=1)
+            if n else np.zeros((0, 4)))
+        perms.append(slots)
+        peak_counter += n
+
+    connection_all: List[np.ndarray] = []
+    special_k: List[int] = []
+    for k, (ia, ib) in enumerate(skeleton.limbs_conn):
+        cand_a, cand_b = all_peaks[ia], all_peaks[ib]
+        if len(cand_a) == 0 or len(cand_b) == 0:
+            special_k.append(k)
+            connection_all.append(np.zeros((0, 6)))
+            continue
+        sel = np.ix_(perms[ia], perms[ib])
+        mean_score = st.mean_score[k][sel].astype(np.float64)
+        above = st.above[k][sel]
+        m = st.num_samples[k][sel]
+        norm = st.norm[k][sel].astype(np.float64)
+        prior, ok = _acceptance(mean_score, above, m, norm,
+                                compact.image_size, params)
+        connection_all.append(
+            _greedy_select(cand_a, cand_b, prior, ok, norm))
+
+    subset, candidate = find_people(connection_all, special_k, all_peaks,
+                                    params, skeleton.limbs_conn, num_parts)
+    if len(candidate):
+        candidate = candidate.copy()
+        candidate[:, 0] *= compact.coord_scale[0]
+        candidate[:, 1] *= compact.coord_scale[1]
+    return subsets_to_keypoints(subset, candidate, skeleton)
 
 
 def decode(heatmap: np.ndarray, paf: np.ndarray, params: InferenceParams,

@@ -28,14 +28,30 @@ from .predict import Predictor
 def process_image(predictor: Predictor, image_bgr: np.ndarray,
                   params: InferenceParams, use_native: bool = True,
                   timer: Optional[AverageMeter] = None,
-                  fast: bool = False):
+                  fast: bool = False, compact: bool = False):
     """predict + decode one image → [(coco keypoints, score)]
     (reference: evaluate.py:501-543).
 
     ``fast=True`` (single-scale protocol only) keeps NMS on-device and
     decodes at network-input resolution, rescaling coordinates back
     (Predictor.predict_fast) — the TPU-optimized path.
+
+    ``compact=True`` additionally keeps peak refinement and limb pair
+    scoring on-device (Predictor.predict_compact, ~1 MB/image transfer);
+    peak-count overflow falls back to the fast path transparently.
     """
+    if compact:
+        from .decode import CompactOverflow, decode_compact
+
+        try:
+            res = predictor.predict_compact(image_bgr, thre1=params.thre1)
+            t0 = time.perf_counter()
+            results = decode_compact(res, params, predictor.skeleton)
+            if timer is not None:
+                timer.update(time.perf_counter() - t0)
+            return results
+        except CompactOverflow:
+            fast = True
     if fast:
         heat, paf, peak_mask, coord_scale = predictor.predict_fast(
             image_bgr, thre1=params.thre1)
@@ -75,7 +91,7 @@ def validation(predictor: Predictor, anno_file: str, images_dir: str,
                = None, max_images: int = 500,
                params: Optional[InferenceParams] = None,
                use_native: bool = True, results_dir: str = "results",
-               fast: bool = False):
+               fast: bool = False, compact: bool = False):
     """Run COCOeval on ``validation_ids`` (default: first ``max_images`` val
     ids — the reference's first-500 protocol, evaluate.py:597-598).
 
@@ -94,7 +110,7 @@ def validation(predictor: Predictor, anno_file: str, images_dir: str,
     keypoints = _collect_detections(
         predictor, {i: coco_gt.imgs[i]["file_name"] for i in validation_ids},
         images_dir, list(validation_ids), params, use_native, fast,
-        decode_timer)
+        decode_timer, compact=compact)
 
     res_file = os.path.join(results_dir, f"person_keypoints_{dump_name}.json")
     format_results(keypoints, res_file)
@@ -114,11 +130,13 @@ def _collect_detections(predictor: Predictor, id_to_name: Dict[int, str],
                         images_dir: str, ids: Sequence[int],
                         params: InferenceParams, use_native: bool,
                         fast: bool,
-                        decode_timer: Optional[AverageMeter] = None
-                        ) -> Dict[int, list]:
+                        decode_timer: Optional[AverageMeter] = None,
+                        compact: bool = False) -> Dict[int, list]:
     """Run inference over ``ids`` — the one detection-collection loop shared
     by the COCOeval and OKS-proxy protocols.  ``fast`` uses the pipelined
-    single-scale path (forward N+1 overlaps threaded decode N)."""
+    single-scale path (forward N+1 overlaps threaded decode N);
+    ``compact`` additionally keeps peak extraction + pair scoring on the
+    device (minimal device→host transfer)."""
 
     def load(image_id):
         image = cv2.imread(os.path.join(images_dir, id_to_name[image_id]))
@@ -127,13 +145,13 @@ def _collect_detections(predictor: Predictor, id_to_name: Dict[int, str],
         return image
 
     keypoints: Dict[int, list] = {}
-    if fast:
+    if fast or compact:
         from .pipeline import pipelined_inference
 
         t0 = time.perf_counter()
         results_iter = pipelined_inference(
             predictor, (load(i) for i in ids), params,
-            use_native=use_native)
+            use_native=use_native, compact=compact)
         for image_id, results in zip(ids, results_iter):
             keypoints[image_id] = results
         dt = time.perf_counter() - t0
@@ -177,6 +195,7 @@ def validation_oks(predictor: Predictor, anno_file: str, images_dir: str,
                    max_images: int = 500,
                    params: Optional[InferenceParams] = None,
                    use_native: bool = True, fast: bool = False,
+                   compact: bool = False,
                    dump_name: str = "tpu", results_dir: str = "results"):
     """The first-500 protocol evaluated with the dependency-free OKS
     evaluator (COCOeval ignore/crowd/maxDets semantics, see APCHECK.md) —
@@ -197,7 +216,8 @@ def validation_oks(predictor: Predictor, anno_file: str, images_dir: str,
         assert not missing, f"ids not in {anno_file}: {sorted(missing)[:8]}"
 
     detections = _collect_detections(predictor, images, images_dir, ids,
-                                     params, use_native, fast)
+                                     params, use_native, fast,
+                                     compact=compact)
     res_file = os.path.join(results_dir, f"person_keypoints_{dump_name}.json")
     format_results(detections, res_file)
 

@@ -19,19 +19,25 @@ from typing import Callable, Iterable, Iterator, Optional, Tuple
 import numpy as np
 
 from ..config import InferenceParams, SkeletonConfig
-from .decode import decode
+from .decode import CompactOverflow, decode, decode_compact
 
 
 def pipelined_inference(predictor, images: Iterable[np.ndarray],
                         params: Optional[InferenceParams] = None,
                         skeleton: Optional[SkeletonConfig] = None,
                         use_native: bool = True,
-                        decode_workers: int = 2) -> Iterator[list]:
+                        decode_workers: int = 2,
+                        compact: bool = False) -> Iterator[list]:
     """Run the fast path over a stream of BGR images, overlapping stages.
 
     Yields ``decode`` results (list of (coco_keypoints, score) per image) in
     input order.  ``decode_workers`` decodes run concurrently; with the
     native decoder the GIL is released so they truly parallelize.
+
+    ``compact`` uses ``Predictor.predict_compact`` — peak extraction and
+    pair scoring stay on the device and only ~1 MB crosses the boundary per
+    image.  Images whose peak count overflows the top-K capacity fall back
+    to the full-map fast path transparently.
     """
     params = params or predictor.params
     skeleton = skeleton or predictor.skeleton
@@ -41,14 +47,28 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
         return decode(heat, paf, params, skeleton, peak_mask=mask,
                       coord_scale=scale, use_native=use_native)
 
+    def run_decode_compact(resolve: Callable, image: np.ndarray):
+        try:
+            return decode_compact(resolve(), params, skeleton)
+        except CompactOverflow:
+            return run_decode(
+                predictor.predict_fast_async(image, thre1=params.thre1))
+
     with ThreadPoolExecutor(max_workers=max(1, decode_workers)) as pool:
         futures = []
         window = max(1, decode_workers)
         for image in images:
             # dispatch forward; thre1 from the caller's params must reach
             # the on-device NMS, same as the sequential fast path
-            resolve = predictor.predict_fast_async(image, thre1=params.thre1)
-            futures.append(pool.submit(run_decode, resolve))
+            if compact:
+                resolve = predictor.predict_compact_async(
+                    image, thre1=params.thre1)
+                futures.append(
+                    pool.submit(run_decode_compact, resolve, image))
+            else:
+                resolve = predictor.predict_fast_async(
+                    image, thre1=params.thre1)
+                futures.append(pool.submit(run_decode, resolve))
             # bound the number of in-flight images; yield the oldest
             while len(futures) > window:
                 yield futures.pop(0).result()

@@ -72,7 +72,7 @@ class Predictor:
     def __init__(self, model, variables, skeleton: SkeletonConfig,
                  params: Optional[InferenceParams] = None,
                  model_params: Optional[InferenceModelParams] = None,
-                 bucket: int = 128, mesh=None):
+                 bucket: int = 128, mesh=None, compact_topk: int = 64):
         from ..config import default_inference_params
 
         d_params, d_model_params = default_inference_params()
@@ -93,22 +93,31 @@ class Predictor:
                     f"'data' axis must be 1 or 2, got {mesh.shape}")
             variables = jax.device_put(variables, replicated(mesh))
         self.variables = variables
-        # jitted program cache keyed by (padded shape, with_peaks, thre1)
-        self._fns: Dict[Tuple[Tuple[int, int], bool, Optional[float]],
+        # top-K peak capacity of the compact path, per keypoint channel;
+        # channels with more NMS peaks than this trigger the documented
+        # fallback to the full-map path (decode.CompactOverflow)
+        self.compact_topk = compact_topk
+        # jitted program cache keyed by (padded shape, mode, thre1)
+        self._fns: Dict[Tuple[Tuple[int, int], str, Optional[float]],
                         object] = {}
 
     # ------------------------------------------------------------------ #
-    def _ensemble_fn(self, shape: Tuple[int, int], with_peaks: bool = False,
+    def _ensemble_fn(self, shape: Tuple[int, int], mode: str = "maps",
                      thre1: Optional[float] = None):
-        """Jitted: (H, W, 3) float image → (H, W, C) ensembled maps
-        (+ boolean keypoint peak mask when ``with_peaks`` — the on-device NMS
-        for the single-scale protocol, saving the host-side pass).
+        """Jitted ensemble program, one of three modes:
 
-        With ``with_peaks`` the function also takes (valid_h, valid_w)
-        scalars: responses beyond the valid (un-padded) region are excluded
-        from the NMS so pad-region activations can't suppress edge peaks.
+        - ``"maps"``: (H, W, 3) float image → (H, W, C) ensembled maps.
+        - ``"peaks"``: also returns the boolean keypoint peak mask — the
+          on-device NMS for the single-scale protocol, saving the host-side
+          pass.  Takes extra (valid_h, valid_w) scalars: responses beyond
+          the valid (un-padded) region are excluded from the NMS so
+          pad-region activations can't suppress edge peaks.
+        - ``"compact"``: no map transfer at all — on-device top-K peak
+          extraction + sub-pixel refinement + dense limb pair statistics
+          (``ops.peaks``); returns (TopKPeaks, PairStats) only (~1 MB
+          instead of ~100 MB for a 512-class image).
         """
-        key = (shape, with_peaks, thre1)
+        key = (shape, mode, thre1)
         if key in self._fns:
             return self._fns[key]
 
@@ -116,6 +125,7 @@ class Predictor:
         import jax.numpy as jnp
 
         from ..ops.nms import keypoint_nms
+        from ..ops.peaks import limb_pair_stats, topk_peaks
 
         sk = self.skeleton
         flip_paf = jnp.asarray(sk.flip_paf_ord)
@@ -148,9 +158,9 @@ class Predictor:
             return jax.image.resize(maps, (h, w, maps.shape[-1]),
                                     method="cubic")
 
-        if not with_peaks:
+        if mode == "maps":
             fn = ensemble
-        else:
+        elif mode == "peaks":
             def fn(variables, img, valid_h, valid_w):
                 maps = ensemble(variables, img)
                 kp = maps[..., sk.paf_layers:sk.paf_layers + sk.num_parts]
@@ -160,6 +170,24 @@ class Predictor:
                 kp = jnp.where(valid, kp, -1e9)
                 peaks = keypoint_nms(kp, kernel=3, thre=thre1) > 0
                 return maps, peaks
+        elif mode == "compact":
+            prm = self.params
+            limbs_from = tuple(a for a, _ in sk.limbs_conn)
+            limbs_to = tuple(b for _, b in sk.limbs_conn)
+
+            def fn(variables, img, valid_h, valid_w):
+                maps = ensemble(variables, img)
+                kp = maps[..., sk.paf_layers:sk.paf_layers + sk.num_parts]
+                peaks = topk_peaks(
+                    kp, valid_h, valid_w, thre=thre1,
+                    k=self.compact_topk, radius=prm.offset_radius)
+                stats = limb_pair_stats(
+                    maps[..., :sk.paf_layers], peaks.x_ref, peaks.y_ref,
+                    limbs_from=limbs_from, limbs_to=limbs_to,
+                    num_samples=prm.mid_num, thre2=prm.thre2)
+                return peaks, stats
+        else:
+            raise ValueError(f"unknown ensemble mode {mode!r}")
 
         jitted = jax.jit(fn)
         self._fns[key] = jitted
@@ -246,7 +274,7 @@ class Predictor:
         scale = prm.scale_search[0] * mp.boxsize / oh
         img, (rh, rw) = self._prepare_input(image_bgr, scale)
         maps_d, peaks_d = self._ensemble_fn(
-            img.shape[:2], with_peaks=True, thre1=thre1)(
+            img.shape[:2], mode="peaks", thre1=thre1)(
             self.variables, img, rh, rw)
 
         def resolve():
@@ -255,6 +283,49 @@ class Predictor:
             heat = maps[..., sk.paf_layers:]
             paf = maps[..., :sk.paf_layers]
             return heat, paf, peak_mask, (ow / rw, oh / rh)
+
+        return resolve
+
+    def predict_compact(self, image_bgr: np.ndarray,
+                        thre1: Optional[float] = None):
+        """Single-scale compact path: everything up to the sequential decode
+        runs on the device; only peak records and pair statistics transfer.
+
+        :returns: an ``infer.decode.CompactResult`` — feed it to
+            ``infer.decode.decode_compact``.
+        """
+        return self.predict_compact_async(image_bgr, thre1)()
+
+    def predict_compact_async(self, image_bgr: np.ndarray,
+                              thre1: Optional[float] = None):
+        """Dispatch the compact-path program; returns a ``resolve()``
+        closure (see :meth:`predict_fast_async` for the overlap contract).
+
+        The device→host payload is O(K) peak records + (L, K, K) pair
+        statistics (~1 MB) instead of the full (H, W, C) maps (~100 MB at
+        512-class sizes) — the fix for the transfer-bound end-to-end path
+        measured in E2E_BENCH.json.
+        """
+        from .decode import CompactResult
+
+        prm, mp = self.params, self.model_params
+        if len(prm.scale_search) != 1 or tuple(prm.rotation_search) != (0.0,):
+            raise ValueError(
+                "predict_compact requires a single-entry scale/rotation grid")
+        if thre1 is None:
+            thre1 = prm.thre1
+        oh, ow = image_bgr.shape[:2]
+        scale = prm.scale_search[0] * mp.boxsize / oh
+        img, (rh, rw) = self._prepare_input(image_bgr, scale)
+        peaks_d, stats_d = self._ensemble_fn(
+            img.shape[:2], mode="compact", thre1=thre1)(
+            self.variables, img, rh, rw)
+
+        def resolve():
+            peaks = type(peaks_d)(*[np.asarray(a) for a in peaks_d])
+            stats = type(stats_d)(*[np.asarray(a) for a in stats_d])
+            return CompactResult(peaks=peaks, stats=stats,
+                                 image_size=rh, coord_scale=(ow / rw, oh / rh))
 
         return resolve
 

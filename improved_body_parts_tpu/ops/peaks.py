@@ -1,0 +1,159 @@
+"""On-device peak extraction + limb pair scoring (the compact decode path).
+
+The full-path Predictor ships (H, W, 50) fp32 maps to the host — ~100 MB
+per 512-class image after the ×stride upsample.  Over a remote-attached
+chip that transfer dominates end-to-end time (E2E_BENCH.json isolated it:
+forward ~7 ms, decode ~60 ms, transfer ~2 s).  The compact path keeps the
+maps on the device and runs, inside the same jitted ensemble program:
+
+- 3×3 max-pool NMS + per-channel top-K selection + weighted-centroid
+  sub-pixel refinement (reference: utils/util.py:177-211, evaluate.py:186);
+- the limb mid-point sampling and per-pair statistics of
+  ``find_connections`` (reference: evaluate.py:206-251) for ALL candidate
+  pairs of every limb at once — a dense (L, K, K, S) gather, which is a
+  batched lookup the TPU handles in-line with the forward pass.
+
+Only O(C·K) peak records and (L, K, K) pair statistics cross the device
+boundary (~1 MB), after which the host performs the tiny sequential parts:
+greedy per-limb selection and person assembly (``infer.decode``).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e9  # large finite "masked" value (matches Predictor's valid mask)
+
+
+class TopKPeaks(NamedTuple):
+    """Per-channel top-K NMS peaks, fixed shapes for jit.
+
+    All arrays are (C, K) except ``count`` (C,).  Slots beyond a channel's
+    real peak count carry ``valid=False`` and must be ignored; ``count`` is
+    the TRUE number of NMS peaks in the channel, so ``count > K`` signals
+    overflow (the caller should fall back to the full-map path).
+    """
+    xs: jnp.ndarray        # int32 raw column of each peak
+    ys: jnp.ndarray        # int32 raw row
+    x_ref: jnp.ndarray     # float32 sub-pixel-refined column
+    y_ref: jnp.ndarray     # float32 sub-pixel-refined row
+    score: jnp.ndarray     # float32 refined (window-mean) or raw score
+    valid: jnp.ndarray     # bool
+    count: jnp.ndarray     # int32 (C,)
+
+
+class PairStats(NamedTuple):
+    """Dense limb-pair statistics, (L, K, K) over candidate A×B peaks.
+
+    ``mean_score``/``above``/``num_samples`` match find_connections'
+    per-pair quantities (reference: evaluate.py:232-251); ``norm`` is the
+    A→B distance the length prior uses.  Entries for invalid peak slots are
+    garbage — the host indexes only valid rows/columns.
+    """
+    mean_score: jnp.ndarray  # float32
+    above: jnp.ndarray       # int32 — samples with response > thre2
+    num_samples: jnp.ndarray  # int32 — m = min(round(norm)+1, S)
+    norm: jnp.ndarray        # float32
+
+
+@partial(jax.jit, static_argnames=("thre", "k", "radius"))
+def topk_peaks(heat: jnp.ndarray, valid_h, valid_w, *, thre: float,
+               k: int, radius: int) -> TopKPeaks:
+    """NMS + top-K + sub-pixel refinement on (H, W, C) keypoint maps.
+
+    Semantics match the host pair ``ops.nms.peak_mask_np`` +
+    ``ops.nms.refine_peaks`` run on the maps sliced to the valid
+    (un-padded) (valid_h, valid_w) region: responses outside the region are
+    masked out before NMS, and the refinement's border check uses the valid
+    extent, so padded-region activations can neither create nor suppress
+    peaks.
+    """
+    h, w, c = heat.shape
+    region = ((jnp.arange(h)[:, None, None] < valid_h)
+              & (jnp.arange(w)[None, :, None] < valid_w))
+    masked = jnp.where(region, heat, _NEG)
+
+    padded = jnp.pad(masked, ((1, 1), (1, 1), (0, 0)), mode="reflect")
+    hmax = jax.lax.reduce_window(
+        padded, -jnp.inf, jax.lax.max,
+        window_dimensions=(3, 3, 1), window_strides=(1, 1, 1),
+        padding="VALID")
+    keep = (hmax == masked) & (masked >= thre)
+    count = keep.sum(axis=(0, 1), dtype=jnp.int32)
+
+    scores = jnp.where(keep, masked, _NEG)
+    flat = scores.reshape(h * w, c).T                       # (C, H*W)
+    vals, idx = jax.lax.top_k(flat, k)                      # (C, K)
+    ys = (idx // w).astype(jnp.int32)
+    xs = (idx % w).astype(jnp.int32)
+    valid = vals >= thre
+
+    # vectorized weighted-centroid refinement (reference: util.py:186-211);
+    # windows that cross the valid border keep raw coords and raw score
+    r = radius
+    offs = jnp.arange(-r, r + 1)
+    wy = jnp.clip(ys[:, :, None] + offs[None, None, :], 0, h - 1)
+    wx = jnp.clip(xs[:, :, None] + offs[None, None, :], 0, w - 1)
+    flat_idx = (wy[:, :, :, None] * w + wx[:, :, None, :]).reshape(c, -1)
+    heat_t = heat.transpose(2, 0, 1).reshape(c, h * w)
+    boxes = jnp.take_along_axis(heat_t, flat_idx, axis=1).reshape(
+        c, k, 2 * r + 1, 2 * r + 1)
+
+    total = boxes.sum(axis=(-1, -2))
+    total = jnp.where(total == 0, 1.0, total)
+    offs_f = offs.astype(boxes.dtype)
+    gx = (boxes * offs_f[None, None, None, :]).sum(axis=(-1, -2)) / total
+    gy = (boxes * offs_f[None, None, :, None]).sum(axis=(-1, -2)) / total
+    inside = ((xs - r >= 0) & (xs + r + 1 <= valid_w)
+              & (ys - r >= 0) & (ys + r + 1 <= valid_h))
+    x_ref = jnp.where(inside, xs + gx, xs.astype(gx.dtype))
+    y_ref = jnp.where(inside, ys + gy, ys.astype(gy.dtype))
+    score = jnp.where(inside, boxes.mean(axis=(-1, -2)), vals)
+    return TopKPeaks(xs, ys, x_ref, y_ref, score, valid, count)
+
+
+@partial(jax.jit, static_argnames=("limbs_from", "limbs_to", "num_samples",
+                                   "thre2"))
+def limb_pair_stats(paf: jnp.ndarray, x_ref: jnp.ndarray, y_ref: jnp.ndarray,
+                    *, limbs_from: Tuple[int, ...], limbs_to: Tuple[int, ...],
+                    num_samples: int, thre2: float) -> PairStats:
+    """Sample every limb channel along every candidate A→B segment.
+
+    Mirrors ``infer.decode._sample_limb_scores`` + the per-pair reductions
+    of ``find_connections`` (reference: evaluate.py:232-251): pair (i, j)
+    is sampled at m = min(round(norm+1), S) points evenly spaced over the
+    full segment, nearest-pixel (banker's rounding, like np.round).
+
+    :param paf: (H, W, L) full-resolution limb maps (one channel per limb)
+    :param x_ref, y_ref: (C, K) refined peak coordinates from *topk_peaks*
+    """
+    h, w, n_limbs = paf.shape
+    la = jnp.asarray(limbs_from)
+    lb = jnp.asarray(limbs_to)
+    ax, ay = x_ref[la], y_ref[la]                      # (L, K)
+    bx, by = x_ref[lb], y_ref[lb]
+    vx = bx[:, None, :] - ax[:, :, None]               # (L, K, K)
+    vy = by[:, None, :] - ay[:, :, None]
+    norm = jnp.sqrt(vx * vx + vy * vy)
+    m = jnp.minimum(jnp.round(norm + 1), num_samples).astype(jnp.int32)
+
+    s = jnp.arange(num_samples, dtype=norm.dtype)
+    denom = jnp.maximum(m - 1, 1).astype(norm.dtype)
+    t = jnp.minimum(s[None, None, None, :] / denom[..., None], 1.0)
+    px = ax[:, :, None, None] + t * vx[..., None]
+    py = ay[:, :, None, None] + t * vy[..., None]
+    xi = jnp.clip(jnp.round(px).astype(jnp.int32), 0, w - 1)
+    yi = jnp.clip(jnp.round(py).astype(jnp.int32), 0, h - 1)
+
+    paf_t = paf.transpose(2, 0, 1).reshape(n_limbs, h * w)
+    flat = (yi * w + xi).reshape(n_limbs, -1)
+    vals = jnp.take_along_axis(paf_t, flat, axis=1).reshape(px.shape)
+
+    in_seg = s[None, None, None, :] < m[..., None]
+    mean_score = (jnp.where(in_seg, vals, 0.0).sum(-1)
+                  / jnp.maximum(m, 1).astype(vals.dtype))
+    above = ((vals > thre2) & in_seg).sum(-1, dtype=jnp.int32)
+    return PairStats(mean_score, above, m, norm)

@@ -11,7 +11,10 @@ three ways:
    evaluate.py-equivalent protocol, single scale + flip;
 2. fast path (``predict_fast``: on-device NMS, scaled-res decode);
 3. pipelined fast path (``pipelined_inference``: forward(N+1) overlaps
-   threaded decode(N)).
+   threaded decode(N));
+4. compact path (``predict_compact``: on-device top-K peak extraction +
+   limb pair statistics; ~1 MB/image crosses the device boundary instead
+   of full maps), sequential and pipelined.
 
 Caveat: with randomly initialized weights the network's maps (and thus the
 decode workload) do not reflect trained behavior — near-zero maps give the
@@ -133,6 +136,37 @@ def main():
     report["decode_workers"] = args.decode_workers
     flush()
     print(f"pipelined: {1.0 / dt:.2f} FPS", flush=True)
+
+    # --- 4. compact path (on-device peaks + pair stats) ------------------
+    from improved_body_parts_tpu.infer.decode import (
+        CompactOverflow, decode_compact)
+
+    def run_compact(im):
+        # same transparent fallback as pipelined_inference / process_image
+        try:
+            decode_compact(pred.predict_compact(im), pred.params,
+                           cfg.skeleton)
+        except CompactOverflow:
+            heat, paf, mask, scale = pred.predict_fast(im)
+            decode(heat, paf, pred.params, cfg.skeleton, peak_mask=mask,
+                   coord_scale=scale)
+
+    run_compact(imgs[0])  # compile
+    t0 = time.perf_counter()
+    for im in imgs:
+        run_compact(im)
+    dt = (time.perf_counter() - t0) / len(imgs)
+    report["compact_fps"] = round(1.0 / dt, 2)
+    flush()
+    print(f"compact: {1.0 / dt:.2f} FPS", flush=True)
+
+    t0 = time.perf_counter()
+    n = sum(1 for _ in pipelined_inference(
+        pred, imgs, decode_workers=args.decode_workers, compact=True))
+    dt = (time.perf_counter() - t0) / n
+    report["compact_pipelined_fps"] = round(1.0 / dt, 2)
+    flush()
+    print(f"compact pipelined: {1.0 / dt:.2f} FPS", flush=True)
 
     print(json.dumps(report))
 

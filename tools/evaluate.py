@@ -46,6 +46,9 @@ def main():
     ap.add_argument("--fast", action="store_true",
                     help="single-scale fast path: on-device NMS, decode at "
                          "network resolution")
+    ap.add_argument("--compact", action="store_true",
+                    help="single-scale compact path: peak extraction + limb "
+                         "pair scoring on-device, ~1 MB/image transfer")
     ap.add_argument("--oks-proxy", action="store_true",
                     help="evaluate with the dependency-free OKS evaluator "
                          "(COCOeval ignore/crowd/maxDets semantics, "
@@ -71,14 +74,15 @@ def main():
         metrics = validation_oks(predictor, args.anno, args.images,
                                  max_images=args.max_images,
                                  use_native=not args.no_native,
-                                 fast=args.fast, dump_name=args.dump_name)
+                                 fast=args.fast, compact=args.compact,
+                                 dump_name=args.dump_name)
         print("AP:", metrics["AP"])
     else:
         coco_eval = validation(predictor, args.anno, args.images,
                                dump_name=args.dump_name,
                                max_images=args.max_images,
                                use_native=not args.no_native,
-                               fast=args.fast)
+                               fast=args.fast, compact=args.compact)
         print("AP:", coco_eval.stats[0])
 
 

@@ -79,10 +79,7 @@ def main():
         state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
                                    imgs[:1])
         step = make_train_step(model, cfg, opt, donate=True)
-        lowered = step.lower(state, imgs, mask, labels) \
-            if hasattr(step, "lower") else jax.jit(step).lower(
-                state, imgs, mask, labels)
-        compiled = lowered.compile()
+        compiled = step.lower(state, imgs, mask, labels).compile()
         ca = compiled.cost_analysis() or {}
         gflops = float(ca.get("flops", 0.0)) / 1e9
         gbytes = float(ca.get("bytes accessed", 0.0)) / 1e9
