@@ -371,13 +371,14 @@ class CompactOverflow(RuntimeError):
 
 
 def decode_compact(compact: CompactResult, params: InferenceParams,
-                   skeleton: SkeletonConfig):
+                   skeleton: SkeletonConfig, use_native: bool = True):
     """Decode from on-device peak records + pair statistics — no maps.
 
     Equivalent to ``decode`` on the fast path's maps: peak lists are
     rebuilt in the host path's row-major order, per-pair priors and the
     acceptance rule are applied to the device-computed statistics, then the
-    greedy limb selection and person assembly run unchanged.
+    greedy limb selection and person assembly run unchanged (the assembly
+    dispatches to the native C++ ``assemble_people`` when built).
 
     :raises CompactOverflow: when any channel's true NMS peak count exceeds
         the top-K capacity (``Predictor(compact_topk=...)``).
@@ -427,8 +428,17 @@ def decode_compact(compact: CompactResult, params: InferenceParams,
         connection_all.append(
             _greedy_select(cand_a, cand_b, prior, ok, norm))
 
-    subset, candidate = find_people(connection_all, special_k, all_peaks,
-                                    params, skeleton.limbs_conn, num_parts)
+    subset = candidate = None
+    if use_native:
+        from .native import native_assemble_available, native_assemble_people
+        if native_assemble_available():
+            subset, candidate = native_assemble_people(
+                connection_all, all_peaks, params, skeleton.limbs_conn,
+                num_parts)
+    if subset is None:
+        subset, candidate = find_people(connection_all, special_k, all_peaks,
+                                        params, skeleton.limbs_conn,
+                                        num_parts)
     if len(candidate):
         candidate = candidate.copy()
         candidate[:, 0] *= compact.coord_scale[0]

@@ -44,9 +44,11 @@ def process_image(predictor: Predictor, image_bgr: np.ndarray,
         from .decode import CompactOverflow, decode_compact
 
         try:
-            res = predictor.predict_compact(image_bgr, thre1=params.thre1)
+            res = predictor.predict_compact(image_bgr, thre1=params.thre1,
+                                            params=params)
             t0 = time.perf_counter()
-            results = decode_compact(res, params, predictor.skeleton)
+            results = decode_compact(res, params, predictor.skeleton,
+                                     use_native=use_native)
             if timer is not None:
                 timer.update(time.perf_counter() - t0)
             return results

@@ -20,6 +20,7 @@ from ..config import InferenceParams
 
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_TRIED = False
+_HAS_ASSEMBLE = False
 
 _LIB_PATHS = (
     os.path.join(os.path.dirname(__file__), "..", "..", "native",
@@ -77,7 +78,7 @@ def ensure_built() -> str:
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _LIB, _LIB_TRIED
+    global _LIB, _LIB_TRIED, _HAS_ASSEMBLE
     if _LIB_TRIED:
         return _LIB
     _LIB_TRIED = True
@@ -93,7 +94,14 @@ def _load() -> Optional[ctypes.CDLL]:
                 warnings.warn(f"could not load {path} ({e}); trying next "
                               "candidate / NumPy fallback", RuntimeWarning)
                 continue
-            lib.decode_people.restype = ctypes.c_int
+            try:
+                lib.decode_people.restype = ctypes.c_int
+            except AttributeError:
+                import warnings
+
+                warnings.warn(f"{path} lacks decode_people; trying next "
+                              "candidate / NumPy fallback", RuntimeWarning)
+                continue
             lib.decode_people.argtypes = [
                 ctypes.POINTER(ctypes.c_double), ctypes.c_int,   # peaks, n
                 ctypes.POINTER(ctypes.c_int),                    # peaks per part
@@ -106,6 +114,27 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_double),                 # out subsets
                 ctypes.c_int,                                    # max people
             ]
+            try:
+                lib.assemble_people.restype = ctypes.c_int
+                lib.assemble_people.argtypes = [
+                    ctypes.POINTER(ctypes.c_double), ctypes.c_int,  # peaks, n
+                    ctypes.POINTER(ctypes.c_double),                # conns
+                    ctypes.POINTER(ctypes.c_int),                   # conns/limb
+                    ctypes.c_int,                                   # num_parts
+                    ctypes.POINTER(ctypes.c_int), ctypes.c_int,     # limbs, n
+                    ctypes.POINTER(ctypes.c_double),                # params[8]
+                    ctypes.POINTER(ctypes.c_double),                # out
+                    ctypes.c_int,                                   # max people
+                ]
+                _HAS_ASSEMBLE = True
+            except AttributeError:
+                import warnings
+
+                # an older prebuilt .so (pre-assemble_people) must not kill
+                # the whole native path — decode_people still works
+                warnings.warn(f"{path} lacks assemble_people (stale build); "
+                              "compact-path assembly will use NumPy",
+                              RuntimeWarning)
             _LIB = lib
             break
     return _LIB
@@ -113,6 +142,12 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def native_assemble_available() -> bool:
+    """True when the loaded library exports ``assemble_people`` (older
+    prebuilt binaries may predate it)."""
+    return _load() is not None and _HAS_ASSEMBLE
 
 
 def native_find_connections_people(
@@ -156,4 +191,52 @@ def native_find_connections_people(
         max_people,
     )
     assert n_people >= 0, "native decoder failed"
+    return out[:n_people], candidate
+
+
+def native_assemble_people(
+        connection_all: Sequence[np.ndarray],
+        all_peaks: Sequence[np.ndarray], params: InferenceParams,
+        limbs_conn: Sequence[Tuple[int, int]],
+        num_parts: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Native greedy assembly from already-selected connections — the host
+    stage of the compact path (pair scoring ran on the device); same
+    semantics and layout as ``decode.find_people``."""
+    lib = _load()
+    assert lib is not None, "native decoder not built"
+
+    counts = np.asarray([len(p) for p in all_peaks], dtype=np.int32)
+    candidate = (np.concatenate([p for p in all_peaks], axis=0)
+                 if counts.sum() else np.zeros((0, 4)))
+    peaks_flat = np.ascontiguousarray(candidate, dtype=np.float64)
+    conns_per_limb = np.asarray([len(c) for c in connection_all],
+                                dtype=np.int32)
+    conns_flat = (np.ascontiguousarray(
+        np.concatenate([c.reshape(-1, 6) for c in connection_all], axis=0),
+        dtype=np.float64) if conns_per_limb.sum() else np.zeros((0, 6)))
+    limbs = np.ascontiguousarray(
+        np.asarray(limbs_conn, dtype=np.int32).reshape(-1))
+    p = np.asarray([
+        params.thre2, params.connect_ration, float(params.mid_num),
+        params.len_rate, params.connection_tole, float(params.remove_recon),
+        float(params.min_parts), params.min_mean_score,
+    ], dtype=np.float64)
+
+    max_people = max(int(counts.sum()), 1)
+    rows = num_parts + 2
+    out = np.full((max_people, rows, 2), -1.0, dtype=np.float64)
+
+    n_people = lib.assemble_people(
+        peaks_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        int(counts.sum()),
+        conns_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        conns_per_limb.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        num_parts,
+        limbs.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        len(limbs_conn),
+        p.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        max_people,
+    )
+    assert n_people >= 0, "native assembly failed"
     return out[:n_people], candidate

@@ -49,7 +49,8 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
 
     def run_decode_compact(resolve: Callable, image: np.ndarray):
         try:
-            return decode_compact(resolve(), params, skeleton)
+            return decode_compact(resolve(), params, skeleton,
+                                  use_native=use_native)
         except CompactOverflow:
             return run_decode(
                 predictor.predict_fast_async(image, thre1=params.thre1))
@@ -62,7 +63,7 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
             # the on-device NMS, same as the sequential fast path
             if compact:
                 resolve = predictor.predict_compact_async(
-                    image, thre1=params.thre1)
+                    image, thre1=params.thre1, params=params)
                 futures.append(
                     pool.submit(run_decode_compact, resolve, image))
             else:

@@ -103,7 +103,9 @@ class Predictor:
 
     # ------------------------------------------------------------------ #
     def _ensemble_fn(self, shape: Tuple[int, int], mode: str = "maps",
-                     thre1: Optional[float] = None):
+                     thre1: Optional[float] = None,
+                     compact_spec: Optional[Tuple[float, int, int, int]]
+                     = None):
         """Jitted ensemble program, one of three modes:
 
         - ``"maps"``: (H, W, 3) float image → (H, W, C) ensembled maps.
@@ -114,10 +116,14 @@ class Predictor:
           pad-region activations can't suppress edge peaks.
         - ``"compact"``: no map transfer at all — on-device top-K peak
           extraction + sub-pixel refinement + dense limb pair statistics
-          (``ops.peaks``); returns (TopKPeaks, PairStats) only (~1 MB
-          instead of ~100 MB for a 512-class image).
+          (``ops.peaks``), packed into one fp32 buffer (~1 MB instead of
+          ~100 MB for a 512-class image).  ``compact_spec`` =
+          (thre2, mid_num, offset_radius, top-K): every parameter the
+          compiled program bakes in is part of the cache key, so
+          caller-supplied params and post-construction mutations take
+          effect instead of silently reusing a stale program.
         """
-        key = (shape, mode, thre1)
+        key = (shape, mode, thre1, compact_spec)
         if key in self._fns:
             return self._fns[key]
 
@@ -171,7 +177,7 @@ class Predictor:
                 peaks = keypoint_nms(kp, kernel=3, thre=thre1) > 0
                 return maps, peaks
         elif mode == "compact":
-            prm = self.params
+            thre2, mid_num, radius, topk = compact_spec
             limbs_from = tuple(a for a, _ in sk.limbs_conn)
             limbs_to = tuple(b for _, b in sk.limbs_conn)
 
@@ -180,12 +186,17 @@ class Predictor:
                 kp = maps[..., sk.paf_layers:sk.paf_layers + sk.num_parts]
                 peaks = topk_peaks(
                     kp, valid_h, valid_w, thre=thre1,
-                    k=self.compact_topk, radius=prm.offset_radius)
+                    k=topk, radius=radius)
                 stats = limb_pair_stats(
                     maps[..., :sk.paf_layers], peaks.x_ref, peaks.y_ref,
                     limbs_from=limbs_from, limbs_to=limbs_to,
-                    num_samples=prm.mid_num, thre2=prm.thre2)
-                return peaks, stats
+                    num_samples=mid_num, thre2=thre2)
+                # pack into ONE fp32 buffer: a remote-attached chip pays a
+                # round trip PER fetched array, which dominated the compact
+                # path's latency (ints ≤2^24 are exact in fp32)
+                return jnp.concatenate(
+                    [a.astype(jnp.float32).ravel()
+                     for a in tuple(peaks) + tuple(stats)])
         else:
             raise ValueError(f"unknown ensemble mode {mode!r}")
 
@@ -287,44 +298,74 @@ class Predictor:
         return resolve
 
     def predict_compact(self, image_bgr: np.ndarray,
-                        thre1: Optional[float] = None):
+                        thre1: Optional[float] = None,
+                        params: Optional[InferenceParams] = None):
         """Single-scale compact path: everything up to the sequential decode
         runs on the device; only peak records and pair statistics transfer.
 
         :returns: an ``infer.decode.CompactResult`` — feed it to
             ``infer.decode.decode_compact``.
         """
-        return self.predict_compact_async(image_bgr, thre1)()
+        return self.predict_compact_async(image_bgr, thre1, params)()
 
     def predict_compact_async(self, image_bgr: np.ndarray,
-                              thre1: Optional[float] = None):
+                              thre1: Optional[float] = None,
+                              params: Optional[InferenceParams] = None):
         """Dispatch the compact-path program; returns a ``resolve()``
         closure (see :meth:`predict_fast_async` for the overlap contract).
 
         The device→host payload is O(K) peak records + (L, K, K) pair
-        statistics (~1 MB) instead of the full (H, W, C) maps (~100 MB at
-        512-class sizes) — the fix for the transfer-bound end-to-end path
-        measured in E2E_BENCH.json.
+        statistics packed into ONE fp32 buffer (~1 MB) instead of the full
+        (H, W, C) maps (~100 MB at 512-class sizes) — the fix for the
+        transfer-bound end-to-end path measured in E2E_BENCH.json.
+
+        ``params`` overrides the predictor's own inference params for the
+        device-side scoring (thre2 / mid_num / offset_radius) — pass the
+        same object the subsequent ``decode_compact`` call will use.
         """
         from .decode import CompactResult
 
-        prm, mp = self.params, self.model_params
+        prm = params or self.params
+        mp = self.model_params
         if len(prm.scale_search) != 1 or tuple(prm.rotation_search) != (0.0,):
             raise ValueError(
                 "predict_compact requires a single-entry scale/rotation grid")
         if thre1 is None:
             thre1 = prm.thre1
+        from ..ops.peaks import PairStats, TopKPeaks
+
         oh, ow = image_bgr.shape[:2]
         scale = prm.scale_search[0] * mp.boxsize / oh
         img, (rh, rw) = self._prepare_input(image_bgr, scale)
-        peaks_d, stats_d = self._ensemble_fn(
-            img.shape[:2], mode="compact", thre1=thre1)(
+        spec = (prm.thre2, prm.mid_num, prm.offset_radius, self.compact_topk)
+        packed_d = self._ensemble_fn(
+            img.shape[:2], mode="compact", thre1=thre1, compact_spec=spec)(
             self.variables, img, rh, rw)
 
+        c, k = self.skeleton.num_parts, spec[3]
+        n_limbs = len(self.skeleton.limbs_conn)
+
         def resolve():
-            peaks = type(peaks_d)(*[np.asarray(a) for a in peaks_d])
-            stats = type(stats_d)(*[np.asarray(a) for a in stats_d])
-            return CompactResult(peaks=peaks, stats=stats,
+            # ONE device→host fetch; split back into the typed records
+            buf = np.asarray(packed_d)
+            fields, pos = [], 0
+            for shape, dtype in (
+                    ((c, k), np.int32), ((c, k), np.int32),       # xs, ys
+                    ((c, k), np.float32), ((c, k), np.float32),   # x/y_ref
+                    ((c, k), np.float32),                         # score
+                    ((c, k), bool), ((c,), np.int32),             # valid, count
+                    ((n_limbs, k, k), np.float32),                # mean_score
+                    ((n_limbs, k, k), np.int32),                  # above
+                    ((n_limbs, k, k), np.int32),                  # num_samples
+                    ((n_limbs, k, k), np.float32)):               # norm
+                n = int(np.prod(shape))
+                chunk = buf[pos:pos + n].reshape(shape)
+                fields.append(chunk.astype(dtype) if dtype is not np.float32
+                              else chunk)
+                pos += n
+            assert pos == buf.size, (pos, buf.size)
+            return CompactResult(peaks=TopKPeaks(*fields[:7]),
+                                 stats=PairStats(*fields[7:]),
                                  image_size=rh, coord_scale=(ow / rw, oh / rh))
 
         return resolve

@@ -8,7 +8,10 @@
 // paths against each other (tests/test_native_decoder.py).
 //
 // Exposed as a C ABI for ctypes (no pybind11 dependency):
-//   int decode_people(...)  -> number of people written, or -1 on error.
+//   int decode_people(...)    -> number of people written, or -1 on error.
+//   int assemble_people(...)  -> assembly only, from pre-selected
+//       connections — the host stage of the compact inference path, where
+//       pair scoring already ran on the device (ops/peaks.py).
 //
 // Build: make -C native   (or python tools/build_native.py)
 
@@ -103,27 +106,16 @@ std::vector<Connection> find_connections_for_limb(
   return out;
 }
 
-}  // namespace
-
-extern "C" int decode_people(
-    const double* peaks, int total_peaks, const int* peaks_per_part,
-    int num_parts, const float* paf, int H, int W, int C, const int* limbs,
-    int n_limbs, int image_size, const double* params, double* out_subsets,
-    int max_people) {
-  const double thre2 = params[0];
-  const double connect_ration = params[1];
-  const int mid_num = static_cast<int>(params[2]);
-  const double len_rate = params[3];
-  const double connection_tole = params[4];
-  const bool remove_recon = params[5] > 0.0;
-  const double min_parts = params[6];
-  const double min_mean_score = params[7];
-
-  std::vector<int> part_offset(num_parts + 1, 0);
-  for (int p = 0; p < num_parts; ++p)
-    part_offset[p + 1] = part_offset[p] + peaks_per_part[p];
-  if (part_offset[num_parts] != total_peaks) return -1;
-
+// Greedy person assembly over per-limb connection lists
+// (evaluate.py:279-498); `get_conns(k)` yields limb k's selected
+// connections.  Shared by decode_people (host-scored connections) and
+// assemble_people (device-scored connections, the compact path).
+template <typename ConnsForLimb>
+int assemble_subsets(const double* peaks, int num_parts, const int* limbs,
+                     int n_limbs, double len_rate, double connection_tole,
+                     bool remove_recon, double min_parts,
+                     double min_mean_score, ConnsForLimb get_conns,
+                     double* out_subsets, int max_people) {
   const int rows = num_parts + 2;
   // subset rows: [part 0..num_parts-1][0]=peak id, [1]=confidence;
   // row -2 = total score; row -1 = (count, longest limb)
@@ -136,9 +128,7 @@ extern "C" int decode_people(
   for (int k = 0; k < n_limbs; ++k) {
     const int index_a = limbs[2 * k];
     const int index_b = limbs[2 * k + 1];
-    const auto conns = find_connections_for_limb(
-        peaks, part_offset.data(), index_a, index_b, paf, H, W, C, k,
-        image_size, thre2, connect_ration, mid_num);
+    const std::vector<Connection> conns = get_conns(k);
 
     for (const auto& conn : conns) {
       const double score = conn.score;
@@ -280,4 +270,62 @@ extern "C" int decode_people(
     ++n_out;
   }
   return n_out;
+}
+
+}  // namespace
+
+extern "C" int decode_people(
+    const double* peaks, int total_peaks, const int* peaks_per_part,
+    int num_parts, const float* paf, int H, int W, int C, const int* limbs,
+    int n_limbs, int image_size, const double* params, double* out_subsets,
+    int max_people) {
+  const double thre2 = params[0];
+  const double connect_ration = params[1];
+  const int mid_num = static_cast<int>(params[2]);
+
+  std::vector<int> part_offset(num_parts + 1, 0);
+  for (int p = 0; p < num_parts; ++p)
+    part_offset[p + 1] = part_offset[p] + peaks_per_part[p];
+  if (part_offset[num_parts] != total_peaks) return -1;
+
+  return assemble_subsets(
+      peaks, num_parts, limbs, n_limbs, params[3], params[4], params[5] > 0.0,
+      params[6], params[7],
+      [&](int k) {
+        return find_connections_for_limb(
+            peaks, part_offset.data(), limbs[2 * k], limbs[2 * k + 1], paf, H,
+            W, C, k, image_size, thre2, connect_ration, mid_num);
+      },
+      out_subsets, max_people);
+}
+
+// Assembly from pre-selected connections (the compact path's host stage).
+// `connections` is the per-limb concatenation of 6-double rows
+// [peak_id_a, peak_id_b, score, i, j, length] — the layout of
+// infer/decode.py's connection_all; `conns_per_limb[k]` rows belong to
+// limb k.  Only params[3..7] (len_rate, connection_tole, remove_recon,
+// min_parts, min_mean_score) are read.
+extern "C" int assemble_people(
+    const double* peaks, int total_peaks, const double* connections,
+    const int* conns_per_limb, int num_parts, const int* limbs, int n_limbs,
+    const double* params, double* out_subsets, int max_people) {
+  (void)total_peaks;
+  std::vector<int> conn_offset(n_limbs + 1, 0);
+  for (int k = 0; k < n_limbs; ++k)
+    conn_offset[k + 1] = conn_offset[k] + conns_per_limb[k];
+
+  return assemble_subsets(
+      peaks, num_parts, limbs, n_limbs, params[3], params[4], params[5] > 0.0,
+      params[6], params[7],
+      [&](int k) {
+        std::vector<Connection> out;
+        out.reserve(conns_per_limb[k]);
+        for (int r = conn_offset[k]; r < conn_offset[k + 1]; ++r) {
+          const double* row = connections + 6 * static_cast<size_t>(r);
+          out.push_back({row[0], row[1], row[2], static_cast<int>(row[3]),
+                         static_cast<int>(row[4]), row[5]});
+        }
+        return out;
+      },
+      out_subsets, max_people);
 }

@@ -126,3 +126,26 @@ def test_native_speedup():
                                        PARAMS, SK.limbs_conn, SK.num_parts)
     t_cc = (time.perf_counter() - t0) / 3
     assert t_cc < t_np, f"native {t_cc:.4f}s not faster than numpy {t_np:.4f}s"
+
+
+@pytest.mark.parametrize("seed,n_people", [(0, 2), (4, 4), (9, 5)])
+def test_native_assembly_matches_numpy(seed, n_people):
+    """assemble_people (the compact path's host stage: pre-selected
+    connections in, people out) must match find_people exactly."""
+    from improved_body_parts_tpu.infer.native import native_assemble_people
+
+    heat, paf = _maps(seed, n_people)
+    all_peaks = find_peaks(heat, PARAMS, SK.num_parts)
+    conns, special = find_connections(all_peaks, paf, heat.shape[0], PARAMS,
+                                      SK.limbs_conn)
+    subset_np, cand_np = find_people(conns, special, all_peaks, PARAMS,
+                                     SK.limbs_conn, SK.num_parts)
+    subset_cc, cand_cc = native_assemble_people(conns, all_peaks, PARAMS,
+                                                SK.limbs_conn, SK.num_parts)
+
+    np.testing.assert_array_equal(cand_np, cand_cc)
+    assert subset_np.shape == subset_cc.shape
+    np.testing.assert_array_equal(subset_np[:, :SK.num_parts, 0],
+                                  subset_cc[:, :SK.num_parts, 0])
+    # identical float inputs -> assembly arithmetic matches to fp tolerance
+    np.testing.assert_allclose(subset_np, subset_cc, atol=1e-9)

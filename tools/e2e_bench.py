@@ -60,7 +60,11 @@ def main():
     ap.add_argument("--images", type=int, default=30)
     ap.add_argument("--decode-workers", type=int, default=2)
     ap.add_argument("--out", default="E2E_BENCH.json")
+    ap.add_argument("--modes", default="full,fast,pipelined,compact,"
+                    "compact-pipelined",
+                    help="comma-separated subset of sections to run")
     args = ap.parse_args()
+    modes = set(args.modes.split(","))
 
     from improved_body_parts_tpu.utils import (
         apply_platform_env, devices_with_timeout)
@@ -101,6 +105,19 @@ def main():
             json.dump(report, f, indent=2)
 
     # --- 1. full ensemble (single scale + flip) + host decode -----------
+    if "full" in modes:
+        run_full(pred, imgs, decode, cfg, report, flush)
+    if "fast" in modes:
+        run_fast(pred, imgs, decode, cfg, report, flush)
+    if "pipelined" in modes:
+        run_pipelined(pred, imgs, pipelined_inference, args, report, flush)
+    if "compact" in modes or "compact-pipelined" in modes:
+        run_compact_modes(pred, imgs, decode, cfg, args, report, flush,
+                          modes, pipelined_inference)
+    print(json.dumps(report))
+
+
+def run_full(pred, imgs, decode, cfg, report, flush):
     heat, paf = pred.predict(imgs[0])  # compile
     n_dec = 0
     t0 = time.perf_counter()
@@ -115,8 +132,9 @@ def main():
     print(f"full ensemble+decode: {1.0 / dt:.2f} FPS "
           f"({dt * 1e3:.0f} ms/img, {n_dec} detections)", flush=True)
 
-    # --- 2. fast path ----------------------------------------------------
-    out = pred.predict_fast(imgs[0])  # compile
+
+def run_fast(pred, imgs, decode, cfg, report, flush):
+    pred.predict_fast(imgs[0])  # compile
     t0 = time.perf_counter()
     for im in imgs:
         heat, paf, mask, scale = pred.predict_fast(im)
@@ -127,7 +145,8 @@ def main():
     flush()
     print(f"fast path: {1.0 / dt:.2f} FPS", flush=True)
 
-    # --- 3. pipelined fast path ------------------------------------------
+
+def run_pipelined(pred, imgs, pipelined_inference, args, report, flush):
     t0 = time.perf_counter()
     n = sum(1 for _ in pipelined_inference(
         pred, imgs, decode_workers=args.decode_workers))
@@ -137,7 +156,9 @@ def main():
     flush()
     print(f"pipelined: {1.0 / dt:.2f} FPS", flush=True)
 
-    # --- 4. compact path (on-device peaks + pair stats) ------------------
+
+def run_compact_modes(pred, imgs, decode, cfg, args, report, flush, modes,
+                      pipelined_inference):
     from improved_body_parts_tpu.infer.decode import (
         CompactOverflow, decode_compact)
 
@@ -152,23 +173,24 @@ def main():
                    coord_scale=scale)
 
     run_compact(imgs[0])  # compile
-    t0 = time.perf_counter()
-    for im in imgs:
-        run_compact(im)
-    dt = (time.perf_counter() - t0) / len(imgs)
-    report["compact_fps"] = round(1.0 / dt, 2)
-    flush()
-    print(f"compact: {1.0 / dt:.2f} FPS", flush=True)
+    if "compact" in modes:
+        t0 = time.perf_counter()
+        for im in imgs:
+            run_compact(im)
+        dt = (time.perf_counter() - t0) / len(imgs)
+        report["compact_fps"] = round(1.0 / dt, 2)
+        flush()
+        print(f"compact: {1.0 / dt:.2f} FPS", flush=True)
 
-    t0 = time.perf_counter()
-    n = sum(1 for _ in pipelined_inference(
-        pred, imgs, decode_workers=args.decode_workers, compact=True))
-    dt = (time.perf_counter() - t0) / n
-    report["compact_pipelined_fps"] = round(1.0 / dt, 2)
-    flush()
-    print(f"compact pipelined: {1.0 / dt:.2f} FPS", flush=True)
-
-    print(json.dumps(report))
+    if "compact-pipelined" in modes:
+        t0 = time.perf_counter()
+        n = sum(1 for _ in pipelined_inference(
+            pred, imgs, decode_workers=args.decode_workers, compact=True))
+        dt = (time.perf_counter() - t0) / n
+        report["compact_pipelined_fps"] = round(1.0 / dt, 2)
+        report["decode_workers"] = args.decode_workers
+        flush()
+        print(f"compact pipelined: {1.0 / dt:.2f} FPS", flush=True)
 
 
 if __name__ == "__main__":
