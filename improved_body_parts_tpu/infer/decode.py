@@ -357,45 +357,56 @@ def assemble(heatmap: np.ndarray, paf: np.ndarray, params: InferenceParams,
 
 class CompactResult(NamedTuple):
     """Host-side payload of the compact inference path
-    (``Predictor.predict_compact``): top-K peak records + dense limb pair
-    statistics, both computed on the device (``ops.peaks``)."""
+    (``Predictor.predict_compact``): top-K peak records + rank-ordered
+    accepted limb candidates, both computed on the device (``ops.peaks``).
+    """
     peaks: object        # ops.peaks.TopKPeaks of numpy arrays, (C, K)
-    stats: object        # ops.peaks.PairStats of numpy arrays, (L, K, K)
+    stats: object        # ops.peaks.LimbCandidates of numpy arrays, (L, M)
     image_size: int      # valid decoded-map height (the length-prior scale)
     coord_scale: Tuple[float, float]
 
 
 class CompactOverflow(RuntimeError):
     """A keypoint channel had more NMS peaks than the compact path's top-K
-    capacity; the caller should fall back to the full-map path."""
+    capacity (or a limb more accepted pairs than its candidate cap); the
+    caller should fall back to the full-map path."""
 
 
 def decode_compact(compact: CompactResult, params: InferenceParams,
                    skeleton: SkeletonConfig, use_native: bool = True):
-    """Decode from on-device peak records + pair statistics — no maps.
+    """Decode from on-device peak records + accepted limb candidates.
 
     Equivalent to ``decode`` on the fast path's maps: peak lists are
-    rebuilt in the host path's row-major order, per-pair priors and the
-    acceptance rule are applied to the device-computed statistics, then the
-    greedy limb selection and person assembly run unchanged (the assembly
-    dispatches to the native C++ ``assemble_people`` when built).
+    rebuilt in the host path's row-major order; the device already applied
+    the acceptance rule and ranked the surviving pairs
+    (``ops.peaks.limb_topk_candidates``), so the host walks each limb's
+    candidates in rank order applying only the one-to-one used-peak filter
+    (reference: evaluate.py:260-271), then person assembly runs unchanged
+    (dispatching to the native C++ ``assemble_people`` when built).
 
-    :raises CompactOverflow: when any channel's true NMS peak count exceeds
-        the top-K capacity (``Predictor(compact_topk=...)``).
+    :raises CompactOverflow: when a channel's true NMS peak count exceeds
+        the top-K capacity (``Predictor(compact_topk=...)``) or a limb's
+        accepted-pair count exceeds the candidate cap.
     """
-    pk, st = compact.peaks, compact.stats
+    pk, cd = compact.peaks, compact.stats
     num_parts = skeleton.num_parts
     over = np.nonzero(pk.count > pk.valid.shape[1])[0]
     if over.size:
         raise CompactOverflow(
             f"channels {over.tolist()} have {pk.count[over].tolist()} NMS "
             f"peaks > top-K capacity {pk.valid.shape[1]}")
+    over = np.nonzero(cd.count > cd.valid.shape[1])[0]
+    if over.size:
+        raise CompactOverflow(
+            f"limbs {over.tolist()} have {cd.count[over].tolist()} accepted "
+            f"pairs > candidate capacity {cd.valid.shape[1]}")
 
     # rebuild per-part peak lists in the host path's order: row-major by
     # raw integer coords (np.nonzero order), ids sequential across parts
     all_peaks: List[np.ndarray] = []
-    perms: List[np.ndarray] = []
+    slot_pos: List[np.ndarray] = []   # top-K slot -> row-major index
     peak_counter = 0
+    k_cap = pk.valid.shape[1]
     for c in range(num_parts):
         slots = np.nonzero(pk.valid[c])[0]
         order = np.lexsort((pk.xs[c, slots], pk.ys[c, slots]))
@@ -407,26 +418,40 @@ def decode_compact(compact: CompactResult, params: InferenceParams,
                       pk.y_ref[c, slots].astype(np.float64),
                       pk.score[c, slots].astype(np.float64), ids], axis=1)
             if n else np.zeros((0, 4)))
-        perms.append(slots)
+        pos = np.full(k_cap, -1, np.int64)
+        pos[slots] = np.arange(n)
+        slot_pos.append(pos)
         peak_counter += n
 
     connection_all: List[np.ndarray] = []
     special_k: List[int] = []
     for k, (ia, ib) in enumerate(skeleton.limbs_conn):
         cand_a, cand_b = all_peaks[ia], all_peaks[ib]
-        if len(cand_a) == 0 or len(cand_b) == 0:
+        na, nb = len(cand_a), len(cand_b)
+        if na == 0 or nb == 0:
             special_k.append(k)
             connection_all.append(np.zeros((0, 6)))
             continue
-        sel = np.ix_(perms[ia], perms[ib])
-        mean_score = st.mean_score[k][sel].astype(np.float64)
-        above = st.above[k][sel]
-        m = st.num_samples[k][sel]
-        norm = st.norm[k][sel].astype(np.float64)
-        prior, ok = _acceptance(mean_score, above, m, norm,
-                                compact.image_size, params)
-        connection_all.append(
-            _greedy_select(cand_a, cand_b, prior, ok, norm))
+        # device candidates arrive acceptance-filtered and rank-sorted;
+        # apply the one-to-one greedy used filter in that order
+        used_a = np.zeros(na, bool)
+        used_b = np.zeros(nb, bool)
+        rows = []
+        limit = min(na, nb)
+        for slot in np.nonzero(cd.valid[k])[0]:
+            i = slot_pos[ia][cd.slot_a[k, slot]]
+            j = slot_pos[ib][cd.slot_b[k, slot]]
+            assert i >= 0 and j >= 0, "candidate references an invalid peak"
+            if used_a[i] or used_b[j]:
+                continue
+            used_a[i] = used_b[j] = True
+            rows.append([cand_a[i, 3], cand_b[j, 3],
+                         float(cd.prior[k, slot]), float(i), float(j),
+                         float(cd.norm[k, slot])])
+            if len(rows) >= limit:
+                break
+        connection_all.append(np.asarray(rows, dtype=np.float64)
+                              if rows else np.zeros((0, 6)))
 
     subset = candidate = None
     if use_native:

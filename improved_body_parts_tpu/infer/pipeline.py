@@ -27,7 +27,8 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
                         skeleton: Optional[SkeletonConfig] = None,
                         use_native: bool = True,
                         decode_workers: int = 2,
-                        compact: bool = False) -> Iterator[list]:
+                        compact: bool = False,
+                        compact_batch: int = 0) -> Iterator[list]:
     """Run the fast path over a stream of BGR images, overlapping stages.
 
     Yields ``decode`` results (list of (coco_keypoints, score) per image) in
@@ -38,6 +39,10 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
     pair scoring stay on the device and only ~1 MB crosses the boundary per
     image.  Images whose peak count overflows the top-K capacity fall back
     to the full-map fast path transparently.
+
+    ``compact_batch`` > 1 (throughput mode, implies ``compact``) chunks
+    the stream and runs ``predict_compact_batch`` — N images + mirrors in
+    one 2N-lane dispatch sharing one transfer round trip.
     """
     params = params or predictor.params
     skeleton = skeleton or predictor.skeleton
@@ -47,17 +52,57 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
         return decode(heat, paf, params, skeleton, peak_mask=mask,
                       coord_scale=scale, use_native=use_native)
 
-    def run_decode_compact(resolve: Callable, image: np.ndarray):
+    def decode_one_compact(compact_res, image: np.ndarray):
         try:
-            return decode_compact(resolve(), params, skeleton,
+            return decode_compact(compact_res, params, skeleton,
                                   use_native=use_native)
         except CompactOverflow:
             return run_decode(
                 predictor.predict_fast_async(image, thre1=params.thre1))
 
+    def run_decode_compact(resolve: Callable, image: np.ndarray):
+        return decode_one_compact(resolve(), image)
+
+    def run_decode_compact_batch(resolve: Callable, chunk: list):
+        return [decode_one_compact(res, im)
+                for res, im in zip(resolve(), chunk)]
+
     with ThreadPoolExecutor(max_workers=max(1, decode_workers)) as pool:
-        futures = []
+        futures = []        # (future, is_batch)
         window = max(1, decode_workers)
+
+        def drain(limit):
+            while len(futures) > limit:
+                fut, is_batch = futures.pop(0)
+                if is_batch:
+                    yield from fut.result()
+                else:
+                    yield fut.result()
+
+        if compact_batch > 1:
+            def dispatch(chunk):
+                # pad the tail chunk to the full batch size so it reuses
+                # the compiled N-lane program (a fresh compile costs
+                # minutes on a relay-attached chip); extras are discarded
+                padded = chunk + [chunk[-1]] * (compact_batch - len(chunk))
+                resolve = predictor.predict_compact_batch_async(
+                    padded, thre1=params.thre1, params=params)
+                futures.append((pool.submit(
+                    run_decode_compact_batch,
+                    lambda: resolve()[:len(chunk)], chunk), True))
+
+            chunk: list = []
+            for image in images:
+                chunk.append(image)
+                if len(chunk) == compact_batch:
+                    dispatch(chunk)
+                    chunk = []
+                    yield from drain(window)
+            if chunk:
+                dispatch(chunk)
+            yield from drain(0)
+            return
+
         for image in images:
             # dispatch forward; thre1 from the caller's params must reach
             # the on-device NMS, same as the sequential fast path
@@ -65,13 +110,11 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
                 resolve = predictor.predict_compact_async(
                     image, thre1=params.thre1, params=params)
                 futures.append(
-                    pool.submit(run_decode_compact, resolve, image))
+                    (pool.submit(run_decode_compact, resolve, image), False))
             else:
                 resolve = predictor.predict_fast_async(
                     image, thre1=params.thre1)
-                futures.append(pool.submit(run_decode, resolve))
+                futures.append((pool.submit(run_decode, resolve), False))
             # bound the number of in-flight images; yield the oldest
-            while len(futures) > window:
-                yield futures.pop(0).result()
-        for fut in futures:
-            yield fut.result()
+            yield from drain(window)
+        yield from drain(0)

@@ -27,6 +27,12 @@ from ..config import (
     SkeletonConfig,
 )
 
+# candidate cap of the compact payload, as a multiple of the peak top-K:
+# m_cap = COMPACT_M_FACTOR * compact_topk accepted pairs ship per limb.
+# Used by BOTH the device packing (_ensemble_fn) and the host unpacking
+# (_unpack_compact) — one constant so the layouts cannot drift apart.
+COMPACT_M_FACTOR = 2
+
 
 def pad_right_down(img: np.ndarray, multiple: int, pad_value: int
                    ) -> Tuple[np.ndarray, Tuple[int, int]]:
@@ -104,7 +110,7 @@ class Predictor:
     # ------------------------------------------------------------------ #
     def _ensemble_fn(self, shape: Tuple[int, int], mode: str = "maps",
                      thre1: Optional[float] = None,
-                     compact_spec: Optional[Tuple[float, int, int, int]]
+                     compact_spec: Optional[Tuple[float, int, int, int, float]]
                      = None):
         """Jitted ensemble program, one of three modes:
 
@@ -114,14 +120,15 @@ class Predictor:
           pass.  Takes extra (valid_h, valid_w) scalars: responses beyond
           the valid (un-padded) region are excluded from the NMS so
           pad-region activations can't suppress edge peaks.
-        - ``"compact"``: no map transfer at all — on-device top-K peak
-          extraction + sub-pixel refinement + dense limb pair statistics
-          (``ops.peaks``), packed into one fp32 buffer (~1 MB instead of
-          ~100 MB for a 512-class image).  ``compact_spec`` =
-          (thre2, mid_num, offset_radius, top-K): every parameter the
-          compiled program bakes in is part of the cache key, so
-          caller-supplied params and post-construction mutations take
-          effect instead of silently reusing a stale program.
+        - ``"compact"`` / ``"compact_batch"``: no map transfer at all —
+          on-device top-K peak extraction + sub-pixel refinement + limb
+          pair acceptance/ranking (``ops.peaks``), packed into one fp32
+          buffer (~100 KB instead of ~100 MB for a 512-class image).
+          ``compact_spec`` = (thre2, mid_num, offset_radius, top-K,
+          connect_ration): every parameter the compiled program bakes in
+          is part of the cache key, so caller-supplied params and
+          post-construction mutations take effect instead of silently
+          reusing a stale program.
         """
         key = (shape, mode, thre1, compact_spec)
         if key in self._fns:
@@ -131,7 +138,7 @@ class Predictor:
         import jax.numpy as jnp
 
         from ..ops.nms import keypoint_nms
-        from ..ops.peaks import limb_pair_stats, topk_peaks
+        from ..ops.peaks import limb_topk_candidates, topk_peaks
 
         sk = self.skeleton
         flip_paf = jnp.asarray(sk.flip_paf_ord)
@@ -176,33 +183,75 @@ class Predictor:
                 kp = jnp.where(valid, kp, -1e9)
                 peaks = keypoint_nms(kp, kernel=3, thre=thre1) > 0
                 return maps, peaks
-        elif mode == "compact":
-            thre2, mid_num, radius, topk = compact_spec
+        elif mode in ("compact", "compact_batch"):
+            # the compact payload: on-device NMS + top-K peaks + limb pair
+            # acceptance/ranking; only accepted candidates ship, packed
+            # into ONE fp32 buffer — a remote-attached chip pays a round
+            # trip PER fetched array and ~bytes for the rest, so both the
+            # array count (1) and the payload (~100 KB/img) are minimized
+            # (ints ≤2^24 are exact in fp32)
+            thre2, mid_num, radius, topk, connect_ration = compact_spec
             limbs_from = tuple(a for a, _ in sk.limbs_conn)
             limbs_to = tuple(b for _, b in sk.limbs_conn)
 
-            def fn(variables, img, valid_h, valid_w):
-                maps = ensemble(variables, img)
+            def one_image(maps, valid_h, valid_w):
                 kp = maps[..., sk.paf_layers:sk.paf_layers + sk.num_parts]
-                peaks = topk_peaks(
-                    kp, valid_h, valid_w, thre=thre1,
-                    k=topk, radius=radius)
-                stats = limb_pair_stats(
-                    maps[..., :sk.paf_layers], peaks.x_ref, peaks.y_ref,
+                peaks = topk_peaks(kp, valid_h, valid_w, thre=thre1,
+                                   k=topk, radius=radius)
+                cands = limb_topk_candidates(
+                    maps[..., :sk.paf_layers], peaks, valid_h,
                     limbs_from=limbs_from, limbs_to=limbs_to,
-                    num_samples=mid_num, thre2=thre2)
-                # pack into ONE fp32 buffer: a remote-attached chip pays a
-                # round trip PER fetched array, which dominated the compact
-                # path's latency (ints ≤2^24 are exact in fp32)
+                    num_samples=mid_num, thre2=thre2,
+                    connect_ration=connect_ration,
+                    m_cap=COMPACT_M_FACTOR * topk)
                 return jnp.concatenate(
                     [a.astype(jnp.float32).ravel()
-                     for a in tuple(peaks) + tuple(stats)])
+                     for a in tuple(peaks) + tuple(cands)])
+
+            if mode == "compact":
+                def fn(variables, img, valid_h, valid_w):
+                    maps = ensemble(variables, img)
+                    return one_image(maps, valid_h, valid_w)
+            else:
+                fn = self._compact_batch_fn(one_image)
         else:
             raise ValueError(f"unknown ensemble mode {mode!r}")
 
         jitted = jax.jit(fn)
         self._fns[key] = jitted
         return jitted
+
+    def _compact_batch_fn(self, one_image):
+        """Build the batched compact program: N images + N mirrors in one
+        2N-lane forward (runs at ~2x the single-image rate on the chip,
+        PERF_AUDIT_B.json), then the per-image compact extraction vmapped.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        sk = self.skeleton
+        flip_paf = jnp.asarray(sk.flip_paf_ord)
+        flip_heat = jnp.asarray(sk.flip_heat_ord)
+        stride = sk.stride
+
+        def fn(variables, imgs, valid_h, valid_w):
+            n = imgs.shape[0]
+            both = jnp.concatenate([imgs, imgs[:, :, ::-1, :]], axis=0)
+            preds = self.model.apply(variables, both, train=False)
+            out = preds[-1][0]                    # (2N, h/4, w/4, C)
+            straight, mirrored = out[:n], out[n:, :, ::-1, :]
+            paf = (straight[..., :sk.paf_layers]
+                   + mirrored[..., :sk.paf_layers][..., flip_paf]) / 2
+            heat = (straight[..., sk.heat_start:sk.num_layers]
+                    + mirrored[..., sk.heat_start:sk.num_layers]
+                    [..., flip_heat]) / 2
+            maps = jnp.concatenate([paf, heat], axis=-1)
+            h, w = maps.shape[1] * stride, maps.shape[2] * stride
+            maps = jax.vmap(lambda m: jax.image.resize(
+                m, (h, w, m.shape[-1]), method="cubic"))(maps)
+            return jax.vmap(one_image)(maps, valid_h, valid_w)
+
+        return fn
 
     # ------------------------------------------------------------------ #
     def predict(self, image_bgr: np.ndarray
@@ -314,17 +363,16 @@ class Predictor:
         """Dispatch the compact-path program; returns a ``resolve()``
         closure (see :meth:`predict_fast_async` for the overlap contract).
 
-        The device→host payload is O(K) peak records + (L, K, K) pair
-        statistics packed into ONE fp32 buffer (~1 MB) instead of the full
-        (H, W, C) maps (~100 MB at 512-class sizes) — the fix for the
-        transfer-bound end-to-end path measured in E2E_BENCH.json.
+        The device→host payload is O(K) peak records + the top-M accepted,
+        rank-ordered limb candidates, packed into ONE fp32 buffer
+        (~100 KB) instead of the full (H, W, C) maps (~100 MB at 512-class
+        sizes) — the fix for the transfer-bound end-to-end path measured
+        in E2E_BENCH.json.
 
         ``params`` overrides the predictor's own inference params for the
         device-side scoring (thre2 / mid_num / offset_radius) — pass the
         same object the subsequent ``decode_compact`` call will use.
         """
-        from .decode import CompactResult
-
         prm = params or self.params
         mp = self.model_params
         if len(prm.scale_search) != 1 or tuple(prm.rotation_search) != (0.0,):
@@ -332,43 +380,127 @@ class Predictor:
                 "predict_compact requires a single-entry scale/rotation grid")
         if thre1 is None:
             thre1 = prm.thre1
-        from ..ops.peaks import PairStats, TopKPeaks
-
         oh, ow = image_bgr.shape[:2]
         scale = prm.scale_search[0] * mp.boxsize / oh
         img, (rh, rw) = self._prepare_input(image_bgr, scale)
-        spec = (prm.thre2, prm.mid_num, prm.offset_radius, self.compact_topk)
+        spec = (prm.thre2, prm.mid_num, prm.offset_radius, self.compact_topk,
+                prm.connect_ration)
         packed_d = self._ensemble_fn(
             img.shape[:2], mode="compact", thre1=thre1, compact_spec=spec)(
             self.variables, img, rh, rw)
 
-        c, k = self.skeleton.num_parts, spec[3]
-        n_limbs = len(self.skeleton.limbs_conn)
-
         def resolve():
             # ONE device→host fetch; split back into the typed records
-            buf = np.asarray(packed_d)
-            fields, pos = [], 0
-            for shape, dtype in (
-                    ((c, k), np.int32), ((c, k), np.int32),       # xs, ys
-                    ((c, k), np.float32), ((c, k), np.float32),   # x/y_ref
-                    ((c, k), np.float32),                         # score
-                    ((c, k), bool), ((c,), np.int32),             # valid, count
-                    ((n_limbs, k, k), np.float32),                # mean_score
-                    ((n_limbs, k, k), np.int32),                  # above
-                    ((n_limbs, k, k), np.int32),                  # num_samples
-                    ((n_limbs, k, k), np.float32)):               # norm
-                n = int(np.prod(shape))
-                chunk = buf[pos:pos + n].reshape(shape)
-                fields.append(chunk.astype(dtype) if dtype is not np.float32
-                              else chunk)
-                pos += n
-            assert pos == buf.size, (pos, buf.size)
-            return CompactResult(peaks=TopKPeaks(*fields[:7]),
-                                 stats=PairStats(*fields[7:]),
-                                 image_size=rh, coord_scale=(ow / rw, oh / rh))
+            return self._unpack_compact(np.asarray(packed_d), spec[3],
+                                        rh, (ow / rw, oh / rh))
 
         return resolve
+
+    def predict_compact_batch(self, images_bgr: Sequence[np.ndarray],
+                              thre1: Optional[float] = None,
+                              params: Optional[InferenceParams] = None):
+        """Throughput mode: run the compact path on N images in ONE
+        dispatch; returns a list of ``CompactResult`` (one per image)."""
+        return self.predict_compact_batch_async(images_bgr, thre1, params)()
+
+    def predict_compact_batch_async(self, images_bgr: Sequence[np.ndarray],
+                                    thre1: Optional[float] = None,
+                                    params: Optional[InferenceParams] = None):
+        """Batched twin of :meth:`predict_compact_async`.
+
+        The 2N-lane forward (N images + N mirrors) runs at ~2× the
+        single-image rate on the chip (PERF_AUDIT_B.json) and all N images
+        in a lane-shape group share one dispatch + one transfer round trip.
+
+        Images landing on different padded input shapes are grouped and
+        dispatched per shape, each group padded up to the full batch size
+        so one compiled program exists per shape (not per occupancy) —
+        feed same-bucket images for peak throughput.  Results come back in
+        input order.
+        """
+        prm = params or self.params
+        mp = self.model_params
+        if self.mesh is not None:
+            raise ValueError("compact_batch does not support the spatial "
+                             "sharding mesh (meant for single giant inputs)")
+        if len(prm.scale_search) != 1 or tuple(prm.rotation_search) != (0.0,):
+            raise ValueError(
+                "predict_compact requires a single-entry scale/rotation grid")
+        if thre1 is None:
+            thre1 = prm.thre1
+        if not len(images_bgr):
+            return lambda: []
+
+        prepared, sizes = [], []
+        for image in images_bgr:
+            oh, ow = image.shape[:2]
+            scale = prm.scale_search[0] * mp.boxsize / oh
+            img, (rh, rw) = self._prepare_input(image, scale)
+            prepared.append(img)
+            sizes.append((oh, ow, rh, rw))
+
+        n = len(prepared)
+        spec = (prm.thre2, prm.mid_num, prm.offset_radius, self.compact_topk,
+                prm.connect_ration)
+        groups: Dict[Tuple[int, ...], list] = {}
+        for i, p in enumerate(prepared):
+            groups.setdefault(p.shape, []).append(i)
+
+        dispatched = []
+        for shape, idxs in groups.items():
+            # pad the group to the full batch size with copies of its first
+            # image: one compiled program per lane shape, not per occupancy
+            sel = idxs + [idxs[0]] * (n - len(idxs))
+            batch = np.stack([prepared[i] for i in sel], axis=0)
+            valid_h = np.asarray([sizes[i][2] for i in sel], np.int32)
+            valid_w = np.asarray([sizes[i][3] for i in sel], np.int32)
+            packed_d = self._ensemble_fn(
+                batch.shape, mode="compact_batch", thre1=thre1,
+                compact_spec=spec)(self.variables, batch, valid_h, valid_w)
+            dispatched.append((idxs, packed_d))
+
+        def resolve():
+            results = [None] * n
+            for idxs, packed_d in dispatched:
+                buf = np.asarray(packed_d)  # (N, P) — one fetch per group
+                for row, i in enumerate(idxs):
+                    oh, ow, rh, rw = sizes[i]
+                    results[i] = self._unpack_compact(
+                        buf[row], spec[3], rh, (ow / rw, oh / rh))
+            return results
+
+        return resolve
+
+    def _unpack_compact(self, buf: np.ndarray, k: int, image_size: int,
+                        coord_scale: Tuple[float, float]):
+        """Split one packed fp32 compact buffer back into typed records."""
+        from ..ops.peaks import LimbCandidates, TopKPeaks
+        from .decode import CompactResult
+
+        c = self.skeleton.num_parts
+        n_limbs = len(self.skeleton.limbs_conn)
+        m = COMPACT_M_FACTOR * k  # candidate cap per limb (device m_cap)
+        fields, pos = [], 0
+        for shape, dtype in (
+                ((c, k), np.int32), ((c, k), np.int32),       # xs, ys
+                ((c, k), np.float32), ((c, k), np.float32),   # x/y_ref
+                ((c, k), np.float32),                         # score
+                ((c, k), bool), ((c,), np.int32),             # valid, count
+                ((n_limbs, m), np.int32),                     # slot_a
+                ((n_limbs, m), np.int32),                     # slot_b
+                ((n_limbs, m), np.float32),                   # prior
+                ((n_limbs, m), np.float32),                   # norm
+                ((n_limbs, m), bool),                         # valid
+                ((n_limbs,), np.int32)):                      # count
+            n = int(np.prod(shape))
+            chunk = buf[pos:pos + n].reshape(shape)
+            fields.append(chunk.astype(dtype) if dtype is not np.float32
+                          else chunk)
+            pos += n
+        assert pos == buf.size, (pos, buf.size)
+        return CompactResult(peaks=TopKPeaks(*fields[:7]),
+                             stats=LimbCandidates(*fields[7:]),
+                             image_size=image_size, coord_scale=coord_scale)
 
     def _clamp_scale(self, scale: float, oh: int, ow: int) -> float:
         mp = self.model_params

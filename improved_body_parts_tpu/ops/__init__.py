@@ -1,9 +1,17 @@
 from .losses import avg_pool_to, downsample_mask, focal_l2, l1, l2, multi_task_loss
 from .gt_device import make_gt_synthesizer
 from .nms import gaussian_blur, keypoint_nms, peak_mask_np, refine_peaks
-from .peaks import PairStats, TopKPeaks, limb_pair_stats, topk_peaks
+from .peaks import (
+    LimbCandidates,
+    PairStats,
+    TopKPeaks,
+    limb_pair_stats,
+    limb_topk_candidates,
+    topk_peaks,
+)
 
 __all__ = ["avg_pool_to", "downsample_mask", "focal_l2", "l1", "l2",
            "multi_task_loss", "gaussian_blur", "keypoint_nms",
            "peak_mask_np", "refine_peaks", "make_gt_synthesizer",
-           "PairStats", "TopKPeaks", "limb_pair_stats", "topk_peaks"]
+           "LimbCandidates", "PairStats", "TopKPeaks", "limb_pair_stats",
+           "limb_topk_candidates", "topk_peaks"]

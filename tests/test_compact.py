@@ -2,8 +2,8 @@
 
 The contract under test: ``predict_compact`` + ``decode_compact`` must
 reproduce the fast path (``predict_fast`` + ``decode``) while shipping only
-O(K) peak records and (L, K, K) pair statistics instead of full maps — the
-fix for the transfer-bound end-to-end path recorded in E2E_BENCH.json.
+O(K) peak records and the top-M accepted limb candidates instead of full
+maps — the fix for the transfer-bound end-to-end path in E2E_BENCH.json.
 """
 import dataclasses
 import sys
@@ -183,3 +183,115 @@ def test_compact_pipeline_matches_sequential():
         assert len(res) == len(want)
         for (ck, cs), (wk, ws) in zip(res, want):
             assert cs == ws and ck == wk
+
+
+def test_compact_batch_matches_single():
+    """predict_compact_batch must reproduce per-image predict_compact
+    exactly (same programs modulo the batch dim), incl. mixed-size chunks
+    (grouped + padded internally) and results in input order."""
+    from improved_body_parts_tpu.infer import decode_compact
+
+    pred, img = _planted_person_predictor()
+    params, _ = default_inference_params()
+    # second image: different original size -> different padded lane shape
+    img_wide = np.zeros((img.shape[0], img.shape[1] + 120, 3), np.uint8)
+    img_wide[:, :img.shape[1]] = img
+
+    singles = [decode_compact(pred.predict_compact(im), params, SK)
+               for im in (img, img_wide, img)]
+    batch = pred.predict_compact_batch([img, img_wide, img])
+    assert len(batch) == 3
+    batched = [decode_compact(res, params, SK) for res in batch]
+
+    for got, want in zip(batched, singles):
+        assert len(got) == len(want)
+        for (gk, gs), (wk, ws) in zip(got, want):
+            assert gs == pytest.approx(ws, abs=1e-6)
+            for pa, pb in zip(gk, wk):
+                assert (pa is None) == (pb is None)
+                if pa is not None:
+                    np.testing.assert_allclose(pa, pb, atol=1e-3)
+
+
+def test_compact_batch_pipeline_matches_sequential():
+    from improved_body_parts_tpu.infer import decode_compact, pipelined_inference
+
+    pred, img = _planted_person_predictor()
+    params, _ = default_inference_params()
+    want = decode_compact(pred.predict_compact(img), params, SK)
+
+    out = list(pipelined_inference(pred, [img] * 5, params, SK,
+                                   compact_batch=2))
+    assert len(out) == 5
+    for res in out:
+        assert len(res) == len(want)
+        for (ck, cs), (wk, ws) in zip(res, want):
+            assert cs == pytest.approx(ws, abs=1e-6)
+
+
+def test_limb_topk_candidates_matches_host_acceptance():
+    """Device candidate selection == host acceptance rule + rank order."""
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.infer.decode import _acceptance
+    from improved_body_parts_tpu.ops.peaks import (
+        TopKPeaks,
+        limb_pair_stats,
+        limb_topk_candidates,
+    )
+
+    rng = np.random.default_rng(23)
+    h = w = 48
+    n_parts, k_cap, s = 4, 5, 12
+    image_size = 40
+    paf = rng.uniform(0, 1, (h, w, 3)).astype(np.float32)
+    x_ref = rng.uniform(1, w - 2, (n_parts, k_cap)).astype(np.float32)
+    y_ref = rng.uniform(1, h - 2, (n_parts, k_cap)).astype(np.float32)
+    score = rng.uniform(0, 1, (n_parts, k_cap)).astype(np.float32)
+    valid = rng.uniform(size=(n_parts, k_cap)) < 0.8
+    limbs = ((0, 1), (1, 2), (2, 3))
+    params, _ = default_inference_params()
+    params = dataclasses.replace(params, thre2=0.45, connect_ration=0.5)
+
+    peaks = TopKPeaks(
+        xs=jnp.zeros((n_parts, k_cap), jnp.int32),
+        ys=jnp.zeros((n_parts, k_cap), jnp.int32),
+        x_ref=jnp.asarray(x_ref), y_ref=jnp.asarray(y_ref),
+        score=jnp.asarray(score), valid=jnp.asarray(valid),
+        count=jnp.asarray(valid.sum(1), jnp.int32))
+    cd = limb_topk_candidates(
+        jnp.asarray(paf), peaks, image_size,
+        limbs_from=tuple(a for a, _ in limbs),
+        limbs_to=tuple(b for _, b in limbs),
+        num_samples=s, thre2=params.thre2,
+        connect_ration=params.connect_ration, m_cap=k_cap * k_cap)
+    cd = type(cd)(*[np.asarray(a) for a in cd])
+
+    st = limb_pair_stats(
+        jnp.asarray(paf), jnp.asarray(x_ref), jnp.asarray(y_ref),
+        limbs_from=tuple(a for a, _ in limbs),
+        limbs_to=tuple(b for _, b in limbs), num_samples=s,
+        thre2=params.thre2)
+    st = type(st)(*[np.asarray(a) for a in st])
+
+    for li, (ia, ib) in enumerate(limbs):
+        prior, ok = _acceptance(
+            st.mean_score[li].astype(np.float64), st.above[li],
+            st.num_samples[li], st.norm[li].astype(np.float64),
+            image_size, params)
+        ok &= valid[ia][:, None] & valid[ib][None, :]
+        want = {(i, j) for i, j in zip(*np.nonzero(ok))}
+        sel = np.nonzero(cd.valid[li])[0]
+        got = {(int(a), int(b))
+               for a, b in zip(cd.slot_a[li, sel], cd.slot_b[li, sel])}
+        assert cd.count[li] == len(want)
+        assert got == want
+        # rank order descending
+        rank = [0.5 * cd.prior[li, t] + 0.25 * score[ia, cd.slot_a[li, t]]
+                + 0.25 * score[ib, cd.slot_b[li, t]] for t in sel]
+        assert all(rank[x] >= rank[x + 1] - 1e-6 for x in range(len(rank) - 1))
+        # per-pair prior matches the host formula
+        for t in sel:
+            i, j = int(cd.slot_a[li, t]), int(cd.slot_b[li, t])
+            np.testing.assert_allclose(cd.prior[li, t], prior[i, j],
+                                       atol=1e-5)

@@ -61,8 +61,10 @@ def main():
     ap.add_argument("--decode-workers", type=int, default=2)
     ap.add_argument("--out", default="E2E_BENCH.json")
     ap.add_argument("--modes", default="full,fast,pipelined,compact,"
-                    "compact-pipelined",
+                    "compact-pipelined,compact-batch",
                     help="comma-separated subset of sections to run")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="chunk size for the compact-batch throughput mode")
     args = ap.parse_args()
     modes = set(args.modes.split(","))
 
@@ -111,7 +113,7 @@ def main():
         run_fast(pred, imgs, decode, cfg, report, flush)
     if "pipelined" in modes:
         run_pipelined(pred, imgs, pipelined_inference, args, report, flush)
-    if "compact" in modes or "compact-pipelined" in modes:
+    if modes & {"compact", "compact-pipelined", "compact-batch"}:
         run_compact_modes(pred, imgs, decode, cfg, args, report, flush,
                           modes, pipelined_inference)
     print(json.dumps(report))
@@ -172,7 +174,8 @@ def run_compact_modes(pred, imgs, decode, cfg, args, report, flush, modes,
             decode(heat, paf, pred.params, cfg.skeleton, peak_mask=mask,
                    coord_scale=scale)
 
-    run_compact(imgs[0])  # compile
+    if modes & {"compact", "compact-pipelined"}:
+        run_compact(imgs[0])  # compile (batch mode compiles its own program)
     if "compact" in modes:
         t0 = time.perf_counter()
         for im in imgs:
@@ -191,6 +194,23 @@ def run_compact_modes(pred, imgs, decode, cfg, args, report, flush, modes,
         report["decode_workers"] = args.decode_workers
         flush()
         print(f"compact pipelined: {1.0 / dt:.2f} FPS", flush=True)
+
+    if "compact-batch" in modes:
+        # throughput mode: N images + mirrors per dispatch, pipelined
+        b = args.batch
+        list(pipelined_inference(            # compile the batched program
+            pred, imgs[:b], decode_workers=args.decode_workers,
+            compact_batch=b))
+        t0 = time.perf_counter()
+        n = sum(1 for _ in pipelined_inference(
+            pred, imgs, decode_workers=args.decode_workers,
+            compact_batch=b))
+        dt = (time.perf_counter() - t0) / n
+        report["compact_batch_fps"] = round(1.0 / dt, 2)
+        report["compact_batch"] = b
+        flush()
+        print(f"compact batch({b}) pipelined: {1.0 / dt:.2f} FPS",
+              flush=True)
 
 
 if __name__ == "__main__":
