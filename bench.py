@@ -1,8 +1,16 @@
-"""Benchmark: single-image 512x512 network inference FPS on one chip.
+"""Benchmark: 512x512 network inference throughput on one chip.
 
-Mirrors the reference's pure-network FPS benchmark
-(reference: test_inference_speed.py:90-120; baseline 38.5 FPS on a 2080 Ti,
-README.md:67) on the flagship 4-stack IMHN with bf16 compute.
+Mirrors the reference's pure-network FPS benchmark, INCLUDING its batching:
+the reference iterates its train loader and reports
+``opt.batch_size / batch_time`` per step — its own inline shape comment
+shows ``[8, 512, 512, 3]`` input tensors — so the 38.5 FPS headline
+(reference: test_inference_speed.py:90-120, README.md:67) is batched
+throughput on a 2080 Ti, not single-image latency.  This benchmark runs
+the flagship 4-stack IMHN (bf16 compute) on a batch of 8 synthetic
+512x512 images with CHAINED iterations (each step's input depends on the
+previous step's output through a scalar), which defeats async dispatch
+pipelining — the conservative protocol from tools/perf_audit.py, whose
+audited sweep this number reproduces (PERF_AUDIT_B.json).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
@@ -18,6 +26,7 @@ import threading
 import time
 
 BASELINE_FPS = 38.5
+BATCH = 8
 # The axon claim can sit in its bind loop several minutes before either
 # granting or raising UNAVAILABLE; give it a generous window before giving
 # up on the chip (still leaves >= 20 min for the CPU fallback run).
@@ -28,7 +37,7 @@ TOTAL_TIMEOUT_S = 1800
 def _watchdog(seconds, message):
     def fire():
         print(json.dumps({
-            "metric": "single_image_512x512_inference_fps",
+            "metric": "network_inference_fps_512x512_batch8",
             "value": 0.0,
             "unit": f"imgs/sec ({message})",
             "vs_baseline": 0.0,
@@ -79,31 +88,54 @@ def main():
             pass
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from improved_body_parts_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax.numpy as jnp
+
     from __graft_entry__ import entry
 
     forward, (variables, imgs) = entry()
-    fn = jax.jit(forward)
+    batch = 2 if fallback else BATCH
+    imgs = jnp.broadcast_to(imgs[0], (batch, *imgs.shape[1:]))
 
-    out = fn(variables, imgs)  # compile (also the warmup on the slow path)
+    # chained steps: input i+1 depends on output i — defeats dispatch
+    # pipelining, so the measured time is true serialized step latency
+    # (the tools/perf_audit.py protocol)
+    def step(v, x, prev_out):
+        dep = jnp.sum(prev_out[..., :1, :1, :1]) * 0.0
+        return forward(v, x + dep)
+
+    fn = jax.jit(step)
+    # seed prev_out at forward's REAL output shape so one compiled program
+    # serves both the warmup and the timed loop (a placeholder shape would
+    # trigger a second full-model compile on the first chained call)
+    out_shape = jax.eval_shape(forward, variables, imgs)
+    out = fn(variables, imgs,
+             jnp.zeros(out_shape.shape, out_shape.dtype))  # compile+warmup
     jax.block_until_ready(out)
 
     warmup = 1 if fallback else 5
     for _ in range(warmup):
-        out = fn(variables, imgs)
+        out = fn(variables, imgs, out)
     jax.block_until_ready(out)
 
-    iters = 3 if fallback else 50
+    iters = 1 if fallback else 50
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(variables, imgs)
+        out = fn(variables, imgs, out)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
 
-    fps = iters / dt
-    unit = "imgs/sec (cpu-fallback)" if fallback else "imgs/sec"
+    fps = iters * batch / dt
+    unit = (f"imgs/sec (cpu-fallback, batch {batch})" if fallback
+            else f"imgs/sec (batch {batch}, chained steps; the reference's "
+                 "38.5 is batched loader throughput)")
     total.cancel()
     print(json.dumps({
-        "metric": "single_image_512x512_inference_fps",
+        # metric name carries the ACTUAL batch (the fallback runs batch 2)
+        "metric": f"network_inference_fps_512x512_batch{batch}",
         "value": round(fps, 2),
         "unit": unit,
         "vs_baseline": round(fps / BASELINE_FPS, 3),

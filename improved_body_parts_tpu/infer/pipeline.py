@@ -80,27 +80,56 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
                     yield fut.result()
 
         if compact_batch > 1:
-            def dispatch(chunk):
-                # pad the tail chunk to the full batch size so it reuses
+            # bucket the stream by predicted lane shape so full batches
+            # share one compiled program (a mixed-shape chunk would split
+            # into per-shape groups each padded to N lanes — up to batch×
+            # redundant forward compute); results still yield in input
+            # order via an index-keyed reorder buffer
+            buckets: dict = {}          # lane shape -> (indices, images)
+            done: dict = {}             # input index -> decoded result
+            next_out = 0
+            n_in = 0
+
+            def dispatch(idxs, chunk):
+                # pad partial chunks to the full batch size so they reuse
                 # the compiled N-lane program (a fresh compile costs
                 # minutes on a relay-attached chip); extras are discarded
                 padded = chunk + [chunk[-1]] * (compact_batch - len(chunk))
                 resolve = predictor.predict_compact_batch_async(
                     padded, thre1=params.thre1, params=params)
-                futures.append((pool.submit(
+                futures.append((idxs, pool.submit(
                     run_decode_compact_batch,
-                    lambda: resolve()[:len(chunk)], chunk), True))
+                    lambda: resolve()[:len(chunk)], chunk)))
 
-            chunk: list = []
+            def collect(limit):
+                nonlocal next_out
+                while len(futures) > limit:
+                    idxs, fut = futures.pop(0)
+                    for i, r in zip(idxs, fut.result()):
+                        done[i] = r
+                while next_out in done:
+                    yield done.pop(next_out)
+                    next_out += 1
+
             for image in images:
+                key = predictor.compact_lane_shape(image, params)
+                idxs, chunk = buckets.setdefault(key, ([], []))
+                idxs.append(n_in)
                 chunk.append(image)
+                n_in += 1
                 if len(chunk) == compact_batch:
-                    dispatch(chunk)
-                    chunk = []
-                    yield from drain(window)
-            if chunk:
-                dispatch(chunk)
-            yield from drain(0)
+                    dispatch(*buckets.pop(key))
+                # bound buffered images: flush the fullest bucket when the
+                # backlog reaches one extra batch worth of images
+                backlog = sum(len(v[0]) for v in buckets.values())
+                if backlog >= 2 * compact_batch:
+                    fullest = max(buckets, key=lambda s: len(buckets[s][0]))
+                    dispatch(*buckets.pop(fullest))
+                yield from collect(window)
+            for key in list(buckets):
+                dispatch(*buckets.pop(key))
+            yield from collect(0)
+            assert next_out == n_in, "compact_batch lost results"
             return
 
         for image in images:

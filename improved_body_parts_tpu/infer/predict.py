@@ -141,8 +141,6 @@ class Predictor:
         from ..ops.peaks import limb_topk_candidates, topk_peaks
 
         sk = self.skeleton
-        flip_paf = jnp.asarray(sk.flip_paf_ord)
-        flip_heat = jnp.asarray(sk.flip_heat_ord)
         stride = sk.stride
 
         if self.mesh is not None:
@@ -160,13 +158,7 @@ class Predictor:
                 both = jax.lax.with_sharding_constraint(both, lane_spatial)
             preds = self.model.apply(variables, both, train=False)
             out = preds[-1][0]  # last stack, scale 0: (2, H/4, W/4, C)
-            straight, mirrored = out[0], out[1][:, ::-1, :]
-            paf = (straight[..., :sk.paf_layers]
-                   + mirrored[..., :sk.paf_layers][..., flip_paf]) / 2
-            heat = (straight[..., sk.heat_start:sk.num_layers]
-                    + mirrored[..., sk.heat_start:sk.num_layers][..., flip_heat]
-                    ) / 2
-            maps = jnp.concatenate([paf, heat], axis=-1)
+            maps = self._merge_flip(out[0], out[1][:, ::-1, :])
             h, w = maps.shape[0] * stride, maps.shape[1] * stride
             return jax.image.resize(maps, (h, w, maps.shape[-1]),
                                     method="cubic")
@@ -229,29 +221,57 @@ class Predictor:
         import jax
         import jax.numpy as jnp
 
-        sk = self.skeleton
-        flip_paf = jnp.asarray(sk.flip_paf_ord)
-        flip_heat = jnp.asarray(sk.flip_heat_ord)
-        stride = sk.stride
+        stride = self.skeleton.stride
 
         def fn(variables, imgs, valid_h, valid_w):
             n = imgs.shape[0]
             both = jnp.concatenate([imgs, imgs[:, :, ::-1, :]], axis=0)
             preds = self.model.apply(variables, both, train=False)
             out = preds[-1][0]                    # (2N, h/4, w/4, C)
-            straight, mirrored = out[:n], out[n:, :, ::-1, :]
-            paf = (straight[..., :sk.paf_layers]
-                   + mirrored[..., :sk.paf_layers][..., flip_paf]) / 2
-            heat = (straight[..., sk.heat_start:sk.num_layers]
-                    + mirrored[..., sk.heat_start:sk.num_layers]
-                    [..., flip_heat]) / 2
-            maps = jnp.concatenate([paf, heat], axis=-1)
+            maps = self._merge_flip(out[:n], out[n:, :, ::-1, :])
             h, w = maps.shape[1] * stride, maps.shape[2] * stride
             maps = jax.vmap(lambda m: jax.image.resize(
                 m, (h, w, m.shape[-1]), method="cubic"))(maps)
             return jax.vmap(one_image)(maps, valid_h, valid_w)
 
         return fn
+
+    def compact_lane_shape(self, image_bgr: np.ndarray,
+                           params: Optional[InferenceParams] = None
+                           ) -> Tuple[int, int]:
+        """Predicted padded input shape for this image under the
+        single-scale protocol — the grouping key for compact batching
+        (``infer.pipeline`` buckets a stream by this so full-occupancy
+        batches share one compiled program).
+
+        Advisory only: ``predict_compact_batch_async`` regroups by the
+        ACTUAL prepared shapes, so a rare rounding mismatch with cv2's
+        resize costs a split batch, never correctness.
+        """
+        prm = params or self.params
+        oh, ow = image_bgr.shape[:2]
+        scale = self._clamp_scale(
+            prm.scale_search[0] * self.model_params.boxsize / oh, oh, ow)
+        rh, rw = round(oh * scale), round(ow * scale)
+        b = self.bucket
+        return (rh + (-rh) % b, rw + (-rw) % b)
+
+    def _merge_flip(self, straight, mirrored):
+        """The flip-ensemble merge shared by the single (2-lane) and
+        batched (2N-lane) programs: mirror-lane channel permutation +
+        averaging + paf/heat concat.  ``mirrored`` must already be
+        width-unflipped; leading axes are free."""
+        import jax.numpy as jnp
+
+        sk = self.skeleton
+        flip_paf = jnp.asarray(sk.flip_paf_ord)
+        flip_heat = jnp.asarray(sk.flip_heat_ord)
+        paf = (straight[..., :sk.paf_layers]
+               + mirrored[..., :sk.paf_layers][..., flip_paf]) / 2
+        heat = (straight[..., sk.heat_start:sk.num_layers]
+                + mirrored[..., sk.heat_start:sk.num_layers][..., flip_heat]
+                ) / 2
+        return jnp.concatenate([paf, heat], axis=-1)
 
     # ------------------------------------------------------------------ #
     def predict(self, image_bgr: np.ndarray

@@ -27,8 +27,22 @@ def enable_compile_cache(cache_dir: str = "") -> None:
     ``JAX_COMPILATION_CACHE_DIR`` when set; pass ``cache_dir=""`` with the
     env var unset to default to ``~/.cache/improved_body_parts_tpu/jax``.
     """
-    cache_dir = (cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR")
-                 or os.path.expanduser("~/.cache/improved_body_parts_tpu/jax"))
+    if not cache_dir:
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
+    if not cache_dir:
+        # scope by a host-CPU fingerprint: XLA:CPU AOT entries bake in the
+        # compile machine's ISA features, and loading them on a different
+        # host warns "could lead to SIGILL" — containers migrate between
+        # fleet nodes, so never share CPU cache entries across hosts
+        import hashlib
+        try:
+            with open("/proc/cpuinfo") as f:
+                flags = next((ln for ln in f if ln.startswith("flags")), "")
+        except OSError:
+            flags = ""
+        fp = hashlib.sha1(flags.encode()).hexdigest()[:10]
+        cache_dir = os.path.expanduser(
+            f"~/.cache/improved_body_parts_tpu/jax-{fp}")
     import jax
 
     try:
