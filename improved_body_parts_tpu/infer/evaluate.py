@@ -93,7 +93,8 @@ def validation(predictor: Predictor, anno_file: str, images_dir: str,
                = None, max_images: int = 500,
                params: Optional[InferenceParams] = None,
                use_native: bool = True, results_dir: str = "results",
-               fast: bool = False, compact: bool = False):
+               fast: bool = False, compact: bool = False,
+               compact_batch: int = 0):
     """Run COCOeval on ``validation_ids`` (default: first ``max_images`` val
     ids — the reference's first-500 protocol, evaluate.py:597-598).
 
@@ -112,7 +113,7 @@ def validation(predictor: Predictor, anno_file: str, images_dir: str,
     keypoints = _collect_detections(
         predictor, {i: coco_gt.imgs[i]["file_name"] for i in validation_ids},
         images_dir, list(validation_ids), params, use_native, fast,
-        decode_timer, compact=compact)
+        decode_timer, compact=compact, compact_batch=compact_batch)
 
     res_file = os.path.join(results_dir, f"person_keypoints_{dump_name}.json")
     format_results(keypoints, res_file)
@@ -133,12 +134,14 @@ def _collect_detections(predictor: Predictor, id_to_name: Dict[int, str],
                         params: InferenceParams, use_native: bool,
                         fast: bool,
                         decode_timer: Optional[AverageMeter] = None,
-                        compact: bool = False) -> Dict[int, list]:
+                        compact: bool = False,
+                        compact_batch: int = 0) -> Dict[int, list]:
     """Run inference over ``ids`` — the one detection-collection loop shared
     by the COCOeval and OKS-proxy protocols.  ``fast`` uses the pipelined
     single-scale path (forward N+1 overlaps threaded decode N);
     ``compact`` additionally keeps peak extraction + pair scoring on the
-    device (minimal device→host transfer)."""
+    device (minimal device→host transfer); ``compact_batch`` > 1 runs the
+    shape-bucketed batched throughput mode."""
 
     def load(image_id):
         image = cv2.imread(os.path.join(images_dir, id_to_name[image_id]))
@@ -147,13 +150,14 @@ def _collect_detections(predictor: Predictor, id_to_name: Dict[int, str],
         return image
 
     keypoints: Dict[int, list] = {}
-    if fast or compact:
+    if fast or compact or compact_batch >= 1:
         from .pipeline import pipelined_inference
 
         t0 = time.perf_counter()
         results_iter = pipelined_inference(
             predictor, (load(i) for i in ids), params,
-            use_native=use_native, compact=compact)
+            use_native=use_native, compact=compact,
+            compact_batch=compact_batch)
         for image_id, results in zip(ids, results_iter):
             keypoints[image_id] = results
         dt = time.perf_counter() - t0
@@ -197,7 +201,7 @@ def validation_oks(predictor: Predictor, anno_file: str, images_dir: str,
                    max_images: int = 500,
                    params: Optional[InferenceParams] = None,
                    use_native: bool = True, fast: bool = False,
-                   compact: bool = False,
+                   compact: bool = False, compact_batch: int = 0,
                    dump_name: str = "tpu", results_dir: str = "results"):
     """The first-500 protocol evaluated with the dependency-free OKS
     evaluator (COCOeval ignore/crowd/maxDets semantics, see APCHECK.md) —
@@ -219,7 +223,8 @@ def validation_oks(predictor: Predictor, anno_file: str, images_dir: str,
 
     detections = _collect_detections(predictor, images, images_dir, ids,
                                      params, use_native, fast,
-                                     compact=compact)
+                                     compact=compact,
+                                     compact_batch=compact_batch)
     res_file = os.path.join(results_dir, f"person_keypoints_{dump_name}.json")
     format_results(detections, res_file)
 

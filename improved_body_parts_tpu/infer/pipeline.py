@@ -43,9 +43,13 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
     ``compact_batch`` > 1 (throughput mode, implies ``compact``) chunks
     the stream and runs ``predict_compact_batch`` — N images + mirrors in
     one 2N-lane dispatch sharing one transfer round trip.
+    ``compact_batch == 1`` degrades to the plain compact path rather than
+    being silently ignored.
     """
     params = params or predictor.params
     skeleton = skeleton or predictor.skeleton
+    if compact_batch == 1:
+        compact, compact_batch = True, 0
 
     def run_decode(resolve: Callable):
         heat, paf, mask, scale = resolve()

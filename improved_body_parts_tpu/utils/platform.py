@@ -30,19 +30,26 @@ def enable_compile_cache(cache_dir: str = "") -> None:
     if not cache_dir:
         cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
     if not cache_dir:
-        # scope by a host-CPU fingerprint: XLA:CPU AOT entries bake in the
-        # compile machine's ISA features, and loading them on a different
-        # host warns "could lead to SIGILL" — containers migrate between
-        # fleet nodes, so never share CPU cache entries across hosts
-        import hashlib
-        try:
-            with open("/proc/cpuinfo") as f:
-                flags = next((ln for ln in f if ln.startswith("flags")), "")
-        except OSError:
-            flags = ""
-        fp = hashlib.sha1(flags.encode()).hexdigest()[:10]
+        # CPU runs scope the dir by a host-CPU fingerprint: XLA:CPU AOT
+        # entries bake in the compile machine's ISA features, and loading
+        # them on a different host warns "could lead to SIGILL" —
+        # containers migrate between fleet nodes. Accelerator runs keep a
+        # shared dir (their executables don't bake host ISA, and the
+        # minutes-long TPU compiles are what the cache exists to avoid).
+        platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+        cpu_ish = not platforms or "cpu" in platforms
+        suffix = ""
+        if cpu_ish:
+            import hashlib
+            try:
+                with open("/proc/cpuinfo") as f:
+                    flags = next((ln for ln in f
+                                  if ln.startswith("flags")), "")
+            except OSError:
+                flags = ""
+            suffix = "-" + hashlib.sha1(flags.encode()).hexdigest()[:10]
         cache_dir = os.path.expanduser(
-            f"~/.cache/improved_body_parts_tpu/jax-{fp}")
+            f"~/.cache/improved_body_parts_tpu/jax{suffix}")
     import jax
 
     try:

@@ -18,13 +18,14 @@ three ways:
 
 Caveat: with randomly initialized weights the network's maps (and thus the
 decode workload) do not reflect trained behavior — near-zero maps give the
-decoder almost nothing to assemble. The numbers here bound the
-forward+transfer pipeline; for a decode-stage workload benchmark see the
-planted-map parity tests (tests/test_decode.py) and the C++ decoder timing
-in PARITY.md. With an imported reference checkpoint
-(tools/import_torch_checkpoint.py) this tool measures the real thing.
+decoder almost nothing to assemble. ``--planted N`` fixes that: the model's
+output is augmented with ground-truth-style maps for N synthetic people
+(the real forward still runs and contributes, so device time is honest),
+giving the decode/assembly stages a trained-model-like workload. With an
+imported reference checkpoint (tools/import_torch_checkpoint.py) this tool
+measures the real thing.
 
-    python tools/e2e_bench.py --images 30 --out E2E_BENCH.json
+    python tools/e2e_bench.py --images 30 --planted 3 --out E2E_BENCH.json
 """
 import argparse
 import json
@@ -53,6 +54,82 @@ def synth_images(n, size, rng):
     return imgs
 
 
+def planted_maps(skeleton, n_people, rng, canvas=1024):
+    """Stride-grid GT maps for N synthetic stick people (the data
+    pipeline's own Heatmapper), used to give the decode stage a
+    trained-model-like workload.  ``canvas`` must cover the predictor's
+    padded input size (boxsize-scaled, e.g. 640-odd for the default
+    protocol); people are planted in the top-left boxsize-ish region so
+    they land inside the valid area for typical bench sizes."""
+    import dataclasses
+
+    import numpy as np
+
+    from improved_body_parts_tpu.data.heatmapper import Heatmapper
+
+    sk = dataclasses.replace(skeleton, width=canvas, height=canvas)
+    joints = np.zeros((n_people, sk.num_parts, 3), np.float32)
+    joints[:, :, 2] = 2
+    layout = [("nose", 0, 0.12), ("neck", 0, 0.21), ("Rsho", -0.09, 0.22),
+              ("Lsho", 0.09, 0.22), ("Relb", -0.13, 0.33),
+              ("Lelb", 0.13, 0.33), ("Rwri", -0.14, 0.43),
+              ("Lwri", 0.14, 0.43), ("Rhip", -0.05, 0.45),
+              ("Lhip", 0.05, 0.45), ("Rkne", -0.06, 0.59),
+              ("Lkne", 0.06, 0.59), ("Rank", -0.06, 0.72),
+              ("Lank", 0.06, 0.72), ("Reye", -0.02, 0.10),
+              ("Leye", 0.02, 0.10), ("Rear", -0.04, 0.11),
+              ("Lear", 0.04, 0.11)]
+    region = canvas * 0.6  # keep people inside the typical valid area
+    for p in range(n_people):
+        cx = rng.uniform(0.2, 0.8) * region
+        scale = rng.uniform(0.5, 0.8) * region
+        y0 = rng.uniform(0.0, 0.2) * region
+        for name, dx, dy in layout:
+            joints[p, sk.parts_dict[name]] = [cx + dx * scale,
+                                              y0 + dy * scale, 1]
+    maps = Heatmapper(sk).create_heatmaps(
+        joints, np.ones(sk.grid_shape, np.float32))
+    return (maps + rng.uniform(0, 1e-6, maps.shape)).astype(np.float32)
+
+
+class PlantedModel:
+    """Wraps the real model: output = planted GT maps + 1e-3 × the real
+    last-stack output — the full forward still runs (honest device time)
+    while the maps contain decodable people.
+
+    Flip-aware: the Predictor's lanes are [straight..., mirrored...] (first
+    half straight in BOTH the 2-lane single and 2N-lane batch programs), so
+    the mirror lanes get the width-flipped, channel-permuted maps — the
+    flip-ensemble merge then reconstructs exactly the planted people at
+    full amplitude (no ghosts, no halving)."""
+
+    def __init__(self, model, maps, skeleton):
+        self.model = model
+        self.maps = maps  # (H/stride, W/stride, C) numpy
+        self.skeleton = skeleton
+
+    def apply(self, variables, imgs, train=False):
+        import jax.numpy as jnp
+
+        sk = self.skeleton
+        preds = self.model.apply(variables, imgs, train=train)
+        out = preds[-1][0]
+        assert (self.maps.shape[0] >= out.shape[1]
+                and self.maps.shape[1] >= out.shape[2]), (
+            "planted canvas smaller than the model grid — raise canvas")
+        m = jnp.asarray(self.maps[:out.shape[1], :out.shape[2]])
+        # what a mirrored input would produce: L/R channel swap (the flip
+        # orders are involutions) + width flip
+        mm = jnp.concatenate(
+            [m[..., :sk.paf_layers][..., jnp.asarray(sk.flip_paf_ord)],
+             m[..., sk.heat_start:sk.num_layers]
+             [..., jnp.asarray(sk.flip_heat_ord)]], axis=-1)[:, ::-1]
+        n = out.shape[0] // 2
+        planted = jnp.concatenate(
+            [m[None] + 1e-3 * out[:n], mm[None] + 1e-3 * out[n:]], axis=0)
+        return [[planted]]
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--config", default="canonical")
@@ -65,6 +142,9 @@ def main():
                     help="comma-separated subset of sections to run")
     ap.add_argument("--batch", type=int, default=8,
                     help="chunk size for the compact-batch throughput mode")
+    ap.add_argument("--planted", type=int, default=0,
+                    help="plant GT-style maps for N synthetic people into "
+                         "the model output (realistic decode workload)")
     args = ap.parse_args()
     modes = set(args.modes.split(","))
 
@@ -95,10 +175,17 @@ def main():
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, args.size, args.size, 3)),
                            train=False)
+    if args.planted > 0:
+        model = PlantedModel(model, planted_maps(cfg.skeleton, args.planted,
+                                                 rng), cfg.skeleton)
+        report_planted = args.planted
+    else:
+        report_planted = 0
     pred = Predictor(model, variables, cfg.skeleton)
 
     report = {"platform": platform, "config": args.config,
               "size": args.size, "images": args.images,
+              "planted_people": report_planted,
               "reference_fps": {"python_assignment": 5.2,
                                 "cpp_rebuild_e2e": "7-8"}}
 

@@ -48,7 +48,10 @@ def main():
                          "network resolution")
     ap.add_argument("--compact", action="store_true",
                     help="single-scale compact path: peak extraction + limb "
-                         "pair scoring on-device, ~1 MB/image transfer")
+                         "pair scoring on-device, ~100 KB/image transfer")
+    ap.add_argument("--compact-batch", type=int, default=0,
+                    help="throughput mode: N images + mirrors per dispatch, "
+                         "shape-bucketed (implies the compact path)")
     ap.add_argument("--oks-proxy", action="store_true",
                     help="evaluate with the dependency-free OKS evaluator "
                          "(COCOeval ignore/crowd/maxDets semantics, "
@@ -75,6 +78,7 @@ def main():
                                  max_images=args.max_images,
                                  use_native=not args.no_native,
                                  fast=args.fast, compact=args.compact,
+                                 compact_batch=args.compact_batch,
                                  dump_name=args.dump_name)
         print("AP:", metrics["AP"])
     else:
@@ -82,7 +86,8 @@ def main():
                                dump_name=args.dump_name,
                                max_images=args.max_images,
                                use_native=not args.no_native,
-                               fast=args.fast, compact=args.compact)
+                               fast=args.fast, compact=args.compact,
+                               compact_batch=args.compact_batch)
         print("AP:", coco_eval.stats[0])
 
 
