@@ -1,0 +1,86 @@
+"""The user journey through the real CLIs, as subprocesses: synthetic
+corpus → tools/train.py (fresh) → resume → tools/evaluate.py --oks-proxy
+--compact on a synthetic val set.  This pins the end-to-end surface a
+reference user would actually touch (train / resume / evaluate scripts),
+not just the library internals.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.data import build_fixture
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, cwd):
+    # cwd is the test's tmp dir, so relative side effects (the evaluate
+    # CLI's results/ dump) land there, never in the checkout; the tools
+    # put the repo root on sys.path themselves
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.run([sys.executable] + args, cwd=cwd, env=env,
+                          capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_cli_journey_train_resume_evaluate(tmp_path):
+    corpus = str(tmp_path / "fixture.h5")
+    n = build_fixture(corpus, num_images=3, people_per_image=1, seed=3)
+    assert n > 0
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    # fresh 1-epoch training run on the tiny config
+    out = _run([os.path.join(REPO, "tools", "train.py"), "--config", "tiny", "--epochs", "1",
+                "--train-h5", corpus, "--checkpoint-dir", ckpt_dir,
+                "--print-freq", "1"], cwd=str(tmp_path))
+    assert "epoch" in out.lower()
+    ckpts = os.listdir(ckpt_dir)
+    assert any("epoch" in c for c in ckpts), ckpts
+
+    # resume for one more epoch from the latest checkpoint
+    out = _run([os.path.join(REPO, "tools", "train.py"), "--config", "tiny", "--epochs", "2",
+                "--train-h5", corpus, "--checkpoint-dir", ckpt_dir,
+                "--resume", "auto", "--print-freq", "1"], cwd=str(tmp_path))
+    ckpts = sorted(os.listdir(ckpt_dir))
+    assert len([c for c in ckpts if "epoch" in c]) >= 2, ckpts
+
+    # synthetic val set: 2 images + COCO-format annotations (no people in
+    # the untrained model's output is fine — the protocol must still run)
+    import cv2
+
+    val_dir = tmp_path / "val"
+    val_dir.mkdir()
+    rng = np.random.default_rng(0)
+    images, annotations = [], []
+    for i in range(2):
+        name = f"{i:012d}.jpg"
+        cv2.imwrite(str(val_dir / name),
+                    rng.integers(0, 255, (96, 128, 3)).astype(np.uint8))
+        images.append({"id": i + 1, "file_name": name,
+                       "width": 128, "height": 96})
+        annotations.append({
+            "id": i + 1, "image_id": i + 1, "category_id": 1,
+            "keypoints": [40, 40, 2] * 17, "num_keypoints": 17,
+            "area": 900.0, "bbox": [25, 25, 30, 30], "iscrowd": 0})
+    anno = tmp_path / "person_keypoints_val.json"
+    anno.write_text(json.dumps({
+        "images": images, "annotations": annotations,
+        "categories": [{"id": 1, "name": "person"}]}))
+
+    from improved_body_parts_tpu.train.checkpoint import latest_checkpoint
+
+    latest = latest_checkpoint(ckpt_dir)
+    assert latest is not None
+    out = _run([os.path.join(REPO, "tools", "evaluate.py"), "--config", "tiny",
+                "--checkpoint", latest, "--anno", str(anno),
+                "--images", str(val_dir), "--oks-proxy", "--compact"],
+               cwd=str(tmp_path))
+    assert "AP:" in out, out

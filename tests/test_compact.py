@@ -325,3 +325,52 @@ def test_compact_batch_bucketing_preserves_order():
                 assert (pa is None) == (pb is None)
                 if pa is not None:
                     np.testing.assert_allclose(pa, pb, atol=1e-3)
+
+
+def test_compact_under_spatial_mesh_matches_plain(eight_devices):
+    """The compact program composes with the ('data','model') spatial
+    sharding mesh (flip lanes over 'data', height over 'model'): same
+    decode as the single-device compact path.  A planted-maps wrapper
+    around a real conv model keeps peak positions deterministic while the
+    sharded convolution (GSPMD halos) still executes."""
+    import os as _os
+    import jax
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.config import (
+        InferenceModelParams,
+        InferenceParams,
+        get_config,
+    )
+    from improved_body_parts_tpu.infer import Predictor, decode_compact
+    from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.parallel import make_mesh
+
+    sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))), "tools"))
+    from e2e_bench import PlantedModel, planted_maps
+
+    cfg = get_config("tiny")
+    model = build_model(cfg, dtype=jnp.float32)
+    img0 = jnp.zeros((1, 128, 128, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), img0, train=False)
+
+    rng = np.random.default_rng(9)
+    planted = PlantedModel(
+        model, planted_maps(SK, 2, rng, canvas=256), SK)
+    params = InferenceParams(scale_search=(1.0,))
+    mp = InferenceModelParams(boxsize=128, max_downsample=64)
+    plain = Predictor(planted, variables, SK, params, mp, bucket=64)
+    sharded = Predictor(planted, variables, SK, params, mp, bucket=64,
+                        mesh=make_mesh(data=2, model=4))
+
+    img = rng.integers(0, 255, (128, 128, 3), dtype=np.uint8)
+    want = decode_compact(plain.predict_compact(img), params, SK)
+    got = decode_compact(sharded.predict_compact(img), params, SK)
+    assert len(want) == len(got) >= 1
+    for (gk, gs), (wk, ws) in zip(got, want):
+        assert gs == pytest.approx(ws, abs=1e-4)
+        for pa, pb in zip(gk, wk):
+            assert (pa is None) == (pb is None)
+            if pa is not None:
+                np.testing.assert_allclose(pa, pb, atol=0.05)
