@@ -23,7 +23,6 @@ import json
 import os
 import sys
 import threading
-import time
 
 BASELINE_FPS = 38.5
 BATCH = 8
@@ -95,6 +94,7 @@ def main():
     import jax.numpy as jnp
 
     from __graft_entry__ import entry
+    from improved_body_parts_tpu.utils import chained_time
 
     forward, (variables, imgs) = entry()
     batch = 2 if fallback else BATCH
@@ -102,33 +102,12 @@ def main():
 
     # chained steps: input i+1 depends on output i — defeats dispatch
     # pipelining, so the measured time is true serialized step latency
-    # (the tools/perf_audit.py protocol)
-    def step(v, x, prev_out):
-        dep = jnp.sum(prev_out[..., :1, :1, :1]) * 0.0
-        return forward(v, x + dep)
+    # (the shared utils.profiling.chained_time protocol)
+    dt = chained_time(forward, variables, imgs,
+                      iters=1 if fallback else 50,
+                      warmup=1 if fallback else 5)
 
-    fn = jax.jit(step)
-    # seed prev_out at forward's REAL output shape so one compiled program
-    # serves both the warmup and the timed loop (a placeholder shape would
-    # trigger a second full-model compile on the first chained call)
-    out_shape = jax.eval_shape(forward, variables, imgs)
-    out = fn(variables, imgs,
-             jnp.zeros(out_shape.shape, out_shape.dtype))  # compile+warmup
-    jax.block_until_ready(out)
-
-    warmup = 1 if fallback else 5
-    for _ in range(warmup):
-        out = fn(variables, imgs, out)
-    jax.block_until_ready(out)
-
-    iters = 1 if fallback else 50
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(variables, imgs, out)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-
-    fps = iters * batch / dt
+    fps = batch / dt
     unit = (f"imgs/sec (cpu-fallback, batch {batch})" if fallback
             else f"imgs/sec (batch {batch}, chained steps; the reference's "
                  "38.5 is batched loader throughput)")

@@ -1,7 +1,7 @@
 from .meters import AverageMeter, StepTimer
 from .platform import apply_platform_env, devices_with_timeout, force_cpu
 from .precision import bf16_params
-from .profiling import profile_trace, timed
+from .profiling import chained_time, profile_trace, timed
 from .visualize import (
     colorize_jet,
     export_serialized,
@@ -13,7 +13,7 @@ from .visualize import (
 
 __all__ = ["AverageMeter", "StepTimer", "apply_platform_env",
            "bf16_params", "devices_with_timeout", "force_cpu",
-           "profile_trace", "timed",
+           "chained_time", "profile_trace", "timed",
            "colorize_jet", "export_serialized", "export_stablehlo",
            "param_table",
            "save_batch_overlays", "train_batch_overlay"]

@@ -41,3 +41,36 @@ def timed(label: str, meter: Optional[AverageMeter] = None,
     if meter is not None:
         meter.update(dt)
     print(f"[{label}] {dt * 1000:.2f} ms")
+
+
+def chained_time(forward, variables, x, iters: int = 50, warmup: int = 2
+                 ) -> float:
+    """Seconds per step with CHAINED dependencies: step i+1's input depends
+    on step i's output through a zero-valued scalar, so steps serialize and
+    async dispatch pipelining cannot inflate the rate (a pooled relay can
+    fan INDEPENDENT identical dispatches across chips and report physically
+    impossible throughput — the round-2 TPURUN post-mortem).  The one
+    honest timing protocol, shared by bench.py, tools/perf_audit.py and
+    tools/tpu_session.py.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def step(v, xx, prev):
+        dep = jnp.sum(prev[..., :1, :1, :1]) * 0.0
+        return forward(v, xx + dep)
+
+    fn = jax.jit(step)
+    # seed at the REAL output shape: one compiled program serves warmup
+    # and the timed loop
+    out_sd = jax.eval_shape(forward, variables, x)
+    out = fn(variables, x, jnp.zeros(out_sd.shape, out_sd.dtype))
+    jax.block_until_ready(out)
+    for _ in range(warmup):
+        out = fn(variables, x, out)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(variables, x, out)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters

@@ -110,19 +110,11 @@ def main():
         best = min(reps)
 
         # latency: defeat pipelining — each next input depends on the
-        # previous output through a scalar, so steps serialize
-        def chained(v, x, prev_out):
-            dep = jnp.sum(prev_out[..., :1, :1, :1]) * 0.0
-            return forward(v, x + dep)
+        # previous output through a scalar, so steps serialize (the shared
+        # utils.profiling.chained_time protocol)
+        from improved_body_parts_tpu.utils import chained_time
 
-        cfn = jax.jit(chained)
-        cout = cfn(variables, x, out)
-        jax.block_until_ready(cout)
-        t0 = time.perf_counter()
-        for _ in range(args.iters):
-            cout = cfn(variables, x, cout)
-        jax.block_until_ready(cout)
-        lat = (time.perf_counter() - t0) / args.iters
+        lat = chained_time(forward, variables, x, iters=args.iters)
 
         fps_med, fps_best = b / med, b / best
         tflops = gflops / 1e3 / med if gflops else None

@@ -5,11 +5,15 @@ The chip behind the axon relay is claimed EXCLUSIVELY at first device use
 and a dead claimant can wedge the pool — so when a chip is available, run
 everything in ONE process, sequentially, and exit cleanly:
 
-1. single-image 512x512 flip-model forward FPS (the bench.py headline,
-   reference: test_inference_speed.py:90-120, baseline 38.5);
+1. single-image 512x512 forward FPS, CHAINED-step timing (the honest
+   protocol; reference: test_inference_speed.py:90-120, baseline 38.5),
+   plus bf16-param storage;
 2. batch sweep (throughput mode — TPUs amortize per-dispatch overhead);
 3. Pallas focal kernel parity + timing vs the XLA loss (Mosaic lowering);
-4. optional profiler trace for the single-image program.
+4. compact end-to-end (planted 3-person workload): sequential, pipelined,
+   and shape-bucketed batch modes (--skip-e2e to skip);
+5. train-step timing, state-chained by construction (--skip-train);
+6. optional profiler trace for the single-image program.
 
 Writes a JSON summary to --out (default TPURUN.json) and prints progress.
 
@@ -35,6 +39,11 @@ def main():
     ap.add_argument("--quick", action="store_true",
                     help="tiny shapes / few iters (CPU smoke)")
     ap.add_argument("--skip-pallas", action="store_true")
+    ap.add_argument("--skip-e2e", action="store_true",
+                    help="skip the compact end-to-end section")
+    ap.add_argument("--skip-train", action="store_true",
+                    help="skip the train-step section")
+    ap.add_argument("--e2e-images", type=int, default=16)
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace here")
     args = ap.parse_args()
@@ -71,29 +80,23 @@ def main():
     cfg = get_config("tiny" if args.quick else "canonical")
     model = build_model(cfg)
 
-    def timed(fn, *a, n=iters, warmup=2):
-        out = fn(*a)
-        jax.block_until_ready(out)
-        for _ in range(warmup):
-            out = fn(*a)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(n):
-            out = fn(*a)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / n
+    from improved_body_parts_tpu.utils import chained_time
 
-    # --- 1. single-image forward (the headline) --------------------------
+    def timed_chained(forward, variables, x, n=iters, warmup=2):
+        return chained_time(forward, variables, x, iters=n, warmup=warmup)
+
+    # --- 1. single-image forward (chained = honest latency) --------------
     imgs = jnp.zeros((1, size, size, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), imgs, train=False)
-    fwd = jax.jit(lambda v, x: model.apply(v, x, train=False)[-1][0])
-    print("compiling single-image forward...", flush=True)
-    dt = timed(fwd, variables, imgs)
+    raw_fwd = lambda v, x: model.apply(v, x, train=False)[-1][0]  # noqa: E731
+    fwd = jax.jit(raw_fwd)
+    print("timing single-image forward (chained steps)...", flush=True)
+    dt = timed_chained(raw_fwd, variables, imgs)
     fps = 1.0 / dt
     summary["single_image_fps"] = round(fps, 2)
     summary["vs_baseline"] = round(fps / 38.5, 3)
     flush_summary()
-    print(f"single-image {size}x{size}: {fps:.2f} imgs/s "
+    print(f"single-image {size}x{size} (chained): {fps:.2f} imgs/s "
           f"({dt * 1e3:.2f} ms)", flush=True)
 
     # --- 1b. bf16 param storage (HBM-traffic lever: fp32 params are
@@ -102,16 +105,16 @@ def main():
     from improved_body_parts_tpu.utils import bf16_params
 
     bf16_vars = bf16_params(variables)
-    dt16 = timed(fwd, bf16_vars, imgs)
+    dt16 = timed_chained(raw_fwd, bf16_vars, imgs)
     summary["single_image_fps_bf16_params"] = round(1.0 / dt16, 2)
     flush_summary()
     print(f"bf16-param storage: {1.0 / dt16:.2f} imgs/s", flush=True)
 
-    # --- 2. batch sweep --------------------------------------------------
+    # --- 2. batch sweep (chained) ----------------------------------------
     sweep = {}
     for b in args.batches:
         bi = jnp.zeros((b, size, size, 3), jnp.float32)
-        dt = timed(fwd, variables, bi)
+        dt = timed_chained(raw_fwd, variables, bi)
         sweep[b] = round(b / dt, 2)
         print(f"batch {b}: {sweep[b]:.2f} imgs/s", flush=True)
     summary["batch_sweep_fps"] = sweep
@@ -133,9 +136,108 @@ def main():
             print(f"pallas FAILED under real lowering: {e}", flush=True)
         flush_summary()
 
-    # --- 4. optional profile trace --------------------------------------
+    # --- 4. compact end-to-end (planted workload) ------------------------
+    if not args.skip_e2e:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from e2e_bench import PlantedModel, planted_maps, synth_images
+
+        from improved_body_parts_tpu.infer import (
+            Predictor, decode_compact, pipelined_inference)
+        from improved_body_parts_tpu.infer.decode import CompactOverflow
+
+        nprng = np.random.default_rng(0)
+        planted = PlantedModel(
+            model, planted_maps(cfg.skeleton, 3, nprng,
+                                canvas=max(1024, 2 * size)), cfg.skeleton)
+        pred = Predictor(planted, variables, cfg.skeleton)
+        stream = synth_images(args.e2e_images, size, nprng)
+
+        def one(im):
+            try:
+                return decode_compact(pred.predict_compact(im), pred.params,
+                                      cfg.skeleton)
+            except CompactOverflow:
+                return []
+
+        e2e = {"planted_people": 3, "images": len(stream)}
+        summary["e2e_compact"] = e2e  # flushed after EVERY measurement
+        n_people = len(one(stream[0]))  # compile
+        t0 = time.perf_counter()
+        for im in stream:
+            one(im)
+        e2e["compact_fps"] = round(len(stream)
+                                   / (time.perf_counter() - t0), 2)
+        flush_summary()
+        print(f"e2e compact: {e2e['compact_fps']} FPS "
+              f"({n_people} people/img)", flush=True)
+
+        t0 = time.perf_counter()
+        n = sum(1 for _ in pipelined_inference(pred, stream,
+                                               decode_workers=4,
+                                               compact=True))
+        e2e["compact_pipelined_fps"] = round(n / (time.perf_counter() - t0),
+                                             2)
+        flush_summary()
+        print(f"e2e compact pipelined: {e2e['compact_pipelined_fps']} FPS",
+              flush=True)
+
+        b = 4 if args.quick else 8
+        list(pipelined_inference(pred, stream[:b], decode_workers=4,
+                                 compact_batch=b))  # compile
+        t0 = time.perf_counter()
+        n = sum(1 for _ in pipelined_inference(pred, stream,
+                                               decode_workers=4,
+                                               compact_batch=b))
+        e2e["compact_batch_fps"] = round(n / (time.perf_counter() - t0), 2)
+        e2e["compact_batch"] = b
+        flush_summary()
+        print(f"e2e compact batch({b}): {e2e['compact_batch_fps']} FPS",
+              flush=True)
+
+    # --- 5. train step (state-chained by construction) -------------------
+    if not args.skip_train:
+        from improved_body_parts_tpu.train import (
+            create_train_state, make_train_step)
+
+        b = 2 if args.quick else 8
+        label_hw = size // cfg.skeleton.stride
+        t_imgs = jnp.asarray(
+            np.random.default_rng(1).uniform(0, 1, (b, size, size, 3)),
+            jnp.float32)
+        labels = jnp.asarray(
+            np.random.default_rng(2).uniform(
+                0, 1, (b, label_hw, label_hw, cfg.skeleton.num_layers)),
+            jnp.float32)
+        mask = jnp.ones((b, label_hw, label_hw, 1), jnp.float32)
+        import optax
+
+        opt = optax.sgd(1e-4, momentum=0.9)
+        state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
+                                   t_imgs[:1])
+        step = make_train_step(model, cfg, opt, donate=True)
+        state, loss = step(state, t_imgs, mask, labels)
+        jax.block_until_ready(loss)
+        n_steps = 3 if args.quick else 15
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, loss = step(state, t_imgs, mask, labels)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / n_steps
+        summary["train_step"] = {
+            "batch": b, "step_ms": round(dt * 1e3, 2),
+            "imgs_per_sec": round(b / dt, 2),
+            "loss_finite": bool(np.isfinite(float(loss))),
+        }
+        flush_summary()
+        print(f"train step b{b}: {dt * 1e3:.1f} ms "
+              f"({b / dt:.1f} imgs/s)", flush=True)
+
+    # --- 6. optional profile trace --------------------------------------
     if args.profile_dir:
         try:
+            # compile + warm OUTSIDE the trace so it shows steady-state
+            # steps, not a multi-second compile
+            jax.block_until_ready(fwd(variables, imgs))
             with jax.profiler.trace(args.profile_dir):
                 for _ in range(5):
                     out = fwd(variables, imgs)
