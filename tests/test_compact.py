@@ -374,3 +374,49 @@ def test_compact_under_spatial_mesh_matches_plain(eight_devices):
             assert (pa is None) == (pb is None)
             if pa is not None:
                 np.testing.assert_allclose(pa, pb, atol=0.05)
+
+
+@pytest.mark.parametrize("variant", ["three_stack_384", "dense_384"])
+def test_compact_matches_fast_on_variant_skeletons(variant):
+    """The compact path is skeleton-driven (limb tables, channel layout):
+    pin equality with the fast path on the 24-limb 3-stack and 49-limb
+    dense skeletons, not just canonical's 30 limbs."""
+    from improved_body_parts_tpu.data.heatmapper import Heatmapper
+    from improved_body_parts_tpu.infer import decode, decode_compact
+
+    vsk = get_config(variant).skeleton
+    h = 256
+    rng = np.random.default_rng(4)
+    joints = np.zeros((1, vsk.num_parts, 3), np.float32)
+    joints[:, :, 2] = 2
+    # reuse the canonical layout names — every variant shares the 18 parts
+    layout = [("nose", 0, 40), ("neck", 0, 70), ("Rsho", -30, 75),
+              ("Lsho", 30, 75), ("Relb", -42, 110), ("Lelb", 42, 110),
+              ("Rwri", -46, 145), ("Lwri", 46, 145), ("Rhip", -18, 150),
+              ("Lhip", 18, 150), ("Rkne", -20, 195), ("Lkne", 20, 195),
+              ("Rank", -21, 240), ("Lank", 21, 240), ("Reye", -8, 34),
+              ("Leye", 8, 34), ("Rear", -14, 38), ("Lear", 14, 38)]
+    for name, dx, y in layout:
+        joints[0, vsk.parts_dict[name]] = [100 + dx, y * 0.9, 1]
+    small = dataclasses.replace(vsk, width=h, height=h)
+    maps = Heatmapper(small).create_heatmaps(
+        joints, np.ones(small.grid_shape, np.float32))
+    maps = (maps + rng.uniform(0, 1e-6, maps.shape)).astype(np.float32)
+
+    pred = _stub_predictor(maps, boxsize=h, skeleton=vsk)
+    img = np.zeros((h, h, 3), np.uint8)
+    params = pred.params
+
+    fh, fp, mask, scale = pred.predict_fast(img)
+    fast = decode(fh, fp, params, vsk, peak_mask=mask, coord_scale=scale,
+                  use_native=False)
+    compact = decode_compact(pred.predict_compact(img), params, vsk,
+                             use_native=False)
+    assert len(fast) == len(compact) >= 1
+    for (ck, cs), (fk, fs) in zip(sorted(compact, key=lambda r: -r[1]),
+                                  sorted(fast, key=lambda r: -r[1])):
+        assert abs(cs - fs) < 1e-4
+        for pa, pb in zip(ck, fk):
+            assert (pa is None) == (pb is None)
+            if pa is not None:
+                assert abs(pa[0] - pb[0]) < 0.05 and abs(pa[1] - pb[1]) < 0.05

@@ -23,26 +23,27 @@ class StubModel:
     """Ignores the input image; returns fixed stride-4 maps for whatever
     spatial size it is given (both flip-batch lanes see the same maps)."""
 
-    def __init__(self, maps):
+    def __init__(self, maps, skeleton=SK):
         self.maps = maps  # (h, w, C) numpy
+        self.skeleton = skeleton
 
     def apply(self, variables, imgs, train=False):
         import jax.numpy as jnp
 
         n, h, w, _ = imgs.shape
-        sh, sw = h // SK.stride, w // SK.stride
-        maps = jnp.asarray(self.maps[:sh, :sw])
+        stride = self.skeleton.stride
+        maps = jnp.asarray(self.maps[:h // stride, :w // stride])
         batch = jnp.broadcast_to(maps, (n, *maps.shape))
         return [[batch]]
 
 
-def _stub_predictor(maps, boxsize, bucket=64):
+def _stub_predictor(maps, boxsize, bucket=64, skeleton=SK):
     from improved_body_parts_tpu.infer import Predictor
 
     params, _ = default_inference_params()
     model_params = InferenceModelParams(boxsize=boxsize, max_downsample=64)
-    return Predictor(StubModel(maps), {}, SK, params, model_params,
-                     bucket=bucket)
+    return Predictor(StubModel(maps, skeleton), {}, skeleton, params,
+                     model_params, bucket=bucket)
 
 
 def test_flip_ensemble_algebra():
