@@ -44,8 +44,12 @@ def process_image(predictor: Predictor, image_bgr: np.ndarray,
         from .decode import CompactOverflow, decode_compact
 
         try:
-            res = predictor.predict_compact(image_bgr, thre1=params.thre1,
-                                            params=params)
+            if len(params.scale_search) > 1:
+                res = predictor.predict_compact_ms(
+                    image_bgr, thre1=params.thre1, params=params)
+            else:
+                res = predictor.predict_compact(
+                    image_bgr, thre1=params.thre1, params=params)
             t0 = time.perf_counter()
             results = decode_compact(res, params, predictor.skeleton,
                                      use_native=use_native)
@@ -53,7 +57,9 @@ def process_image(predictor: Predictor, image_bgr: np.ndarray,
                 timer.update(time.perf_counter() - t0)
             return results
         except CompactOverflow:
-            fast = True
+            # single-scale falls back to the fast path; multi-scale grids
+            # fall through to the full map-transfer protocol below
+            fast = len(params.scale_search) == 1
     if fast:
         heat, paf, peak_mask, coord_scale = predictor.predict_fast(
             image_bgr, thre1=params.thre1)
@@ -62,7 +68,7 @@ def process_image(predictor: Predictor, image_bgr: np.ndarray,
                          use_native=use_native, peak_mask=peak_mask,
                          coord_scale=coord_scale)
     else:
-        heat, paf = predictor.predict(image_bgr)
+        heat, paf = predictor.predict(image_bgr, params=params)
         t0 = time.perf_counter()
         results = decode(heat, paf, params, predictor.skeleton,
                          use_native=use_native)

@@ -50,6 +50,10 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
     skeleton = skeleton or predictor.skeleton
     if compact_batch == 1:
         compact, compact_batch = True, 0
+    if compact_batch > 1 and len(params.scale_search) > 1:
+        raise ValueError(
+            "compact_batch supports the single-scale protocol only; use "
+            "compact=True for multi-scale grids (predict_compact_ms)")
 
     def run_decode(resolve: Callable):
         heat, paf, mask, scale = resolve()
@@ -61,6 +65,12 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
             return decode_compact(compact_res, params, skeleton,
                                   use_native=use_native)
         except CompactOverflow:
+            if len(params.scale_search) > 1:
+                # multi-scale grids can't use the fast path; fall back to
+                # the full map-transfer protocol for this image
+                heat, paf = predictor.predict(image, params=params)
+                return decode(heat, paf, params, skeleton,
+                              use_native=use_native)
             return run_decode(
                 predictor.predict_fast_async(image, thre1=params.thre1))
 
@@ -140,8 +150,13 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
             # dispatch forward; thre1 from the caller's params must reach
             # the on-device NMS, same as the sequential fast path
             if compact:
-                resolve = predictor.predict_compact_async(
-                    image, thre1=params.thre1, params=params)
+                if len(params.scale_search) > 1:
+                    # full scale-grid protocol, device-resident averaging
+                    resolve = predictor.predict_compact_ms_async(
+                        image, thre1=params.thre1, params=params)
+                else:
+                    resolve = predictor.predict_compact_async(
+                        image, thre1=params.thre1, params=params)
                 futures.append(
                     (pool.submit(run_decode_compact, resolve, image), False))
             else:

@@ -151,17 +151,7 @@ class Predictor:
             lane_spatial = None
 
         def ensemble(variables, img):
-            both = jnp.stack([img, img[:, ::-1, :]], axis=0)
-            if lane_spatial is not None:
-                # flip lanes over 'data', height over 'model' — GSPMD
-                # inserts the conv halo exchanges
-                both = jax.lax.with_sharding_constraint(both, lane_spatial)
-            preds = self.model.apply(variables, both, train=False)
-            out = preds[-1][0]  # last stack, scale 0: (2, H/4, W/4, C)
-            maps = self._merge_flip(out[0], out[1][:, ::-1, :])
-            h, w = maps.shape[0] * stride, maps.shape[1] * stride
-            return jax.image.resize(maps, (h, w, maps.shape[-1]),
-                                    method="cubic")
+            return self._ensemble_maps(variables, img, lane_spatial)
 
         if mode == "maps":
             fn = ensemble
@@ -182,23 +172,7 @@ class Predictor:
             # trip PER fetched array and ~bytes for the rest, so both the
             # array count (1) and the payload (~100 KB/img) are minimized
             # (ints ≤2^24 are exact in fp32)
-            thre2, mid_num, radius, topk, connect_ration = compact_spec
-            limbs_from = tuple(a for a, _ in sk.limbs_conn)
-            limbs_to = tuple(b for _, b in sk.limbs_conn)
-
-            def one_image(maps, valid_h, valid_w):
-                kp = maps[..., sk.paf_layers:sk.paf_layers + sk.num_parts]
-                peaks = topk_peaks(kp, valid_h, valid_w, thre=thre1,
-                                   k=topk, radius=radius)
-                cands = limb_topk_candidates(
-                    maps[..., :sk.paf_layers], peaks, valid_h,
-                    limbs_from=limbs_from, limbs_to=limbs_to,
-                    num_samples=mid_num, thre2=thre2,
-                    connect_ration=connect_ration,
-                    m_cap=COMPACT_M_FACTOR * topk)
-                return jnp.concatenate(
-                    [a.astype(jnp.float32).ravel()
-                     for a in tuple(peaks) + tuple(cands)])
+            one_image = self._compact_extract_fn(thre1, compact_spec)
 
             if mode == "compact":
                 def fn(variables, img, valid_h, valid_w):
@@ -212,6 +186,58 @@ class Predictor:
         jitted = jax.jit(fn)
         self._fns[key] = jitted
         return jitted
+
+    def _ensemble_maps(self, variables, img, lane_spatial=None):
+        """The flip-ensemble forward for ONE image (traced inside a jitted
+        program): [image, mirror] 2-lane apply → mirror-merge →
+        ×stride cubic upsample.  The single source for every compact /
+        maps / multi-scale program."""
+        import jax
+        import jax.numpy as jnp
+
+        both = jnp.stack([img, img[:, ::-1, :]], axis=0)
+        if lane_spatial is not None:
+            # flip lanes over 'data', height over 'model' — GSPMD
+            # inserts the conv halo exchanges
+            both = jax.lax.with_sharding_constraint(both, lane_spatial)
+        preds = self.model.apply(variables, both, train=False)
+        out = preds[-1][0]  # last stack, scale 0: (2, H/4, W/4, C)
+        maps = self._merge_flip(out[0], out[1][:, ::-1, :])
+        stride = self.skeleton.stride
+        h, w = maps.shape[0] * stride, maps.shape[1] * stride
+        return jax.image.resize(maps, (h, w, maps.shape[-1]),
+                                method="cubic")
+
+    def _compact_extract_fn(self, thre1: float, spec):
+        """The compact extraction (traced inside a jitted program):
+        (maps, valid_h, valid_w) → ONE packed fp32 buffer of top-K peaks +
+        accepted limb candidates.  The single source for the compact,
+        compact-batch and multi-scale programs (payload layout twin of
+        ``_unpack_compact``)."""
+        import jax.numpy as jnp
+
+        from ..ops.peaks import limb_topk_candidates, topk_peaks
+
+        sk = self.skeleton
+        thre2, mid_num, radius, topk, connect_ration = spec
+        limbs_from = tuple(a for a, _ in sk.limbs_conn)
+        limbs_to = tuple(b for _, b in sk.limbs_conn)
+
+        def one_image(maps, valid_h, valid_w):
+            kp = maps[..., sk.paf_layers:sk.paf_layers + sk.num_parts]
+            peaks = topk_peaks(kp, valid_h, valid_w, thre=thre1,
+                               k=topk, radius=radius)
+            cands = limb_topk_candidates(
+                maps[..., :sk.paf_layers], peaks, valid_h,
+                limbs_from=limbs_from, limbs_to=limbs_to,
+                num_samples=mid_num, thre2=thre2,
+                connect_ration=connect_ration,
+                m_cap=COMPACT_M_FACTOR * topk)
+            return jnp.concatenate(
+                [a.astype(jnp.float32).ravel()
+                 for a in tuple(peaks) + tuple(cands)])
+
+        return one_image
 
     def _compact_batch_fn(self, one_image):
         """Build the batched compact program: N images + N mirrors in one
@@ -235,6 +261,111 @@ class Predictor:
             return jax.vmap(one_image)(maps, valid_h, valid_w)
 
         return fn
+
+    def predict_compact_ms(self, image_bgr: np.ndarray,
+                           thre1: Optional[float] = None,
+                           params: Optional[InferenceParams] = None):
+        """Multi-scale compact path; see :meth:`predict_compact_ms_async`."""
+        return self.predict_compact_ms_async(image_bgr, thre1, params)()
+
+    def predict_compact_ms_async(self, image_bgr: np.ndarray,
+                                 thre1: Optional[float] = None,
+                                 params: Optional[InferenceParams] = None):
+        """Multi-scale ensemble with DEVICE-RESIDENT averaging + compact
+        extraction — the full scale-grid protocol (reference:
+        evaluate.py:87-161) without any map ever crossing the device
+        boundary.
+
+        Per scale, one jitted program runs the flip ensemble and resizes
+        the valid map region onto the scale-1 grid; the per-scale maps
+        stay on the device between programs, a second program averages
+        them and runs the compact peak/candidate extraction, and only the
+        packed ~100 KB buffer transfers.  Decode happens at the LARGEST
+        scale's (boxsize-scaled) resolution with coordinates rescaled back — the
+        same documented deviation as the fast path (the reference
+        averages at original image resolution with cv2 resizes).
+
+        Rotations are not supported on this path (the default protocol
+        uses none); ``rotation_search != (0,)`` raises.
+        """
+        prm = params or self.params
+        mp = self.model_params
+        if self.mesh is not None:
+            raise ValueError(
+                "predict_compact_ms does not support the spatial sharding "
+                "mesh (use Predictor.predict for mesh-sharded inference)")
+        if tuple(prm.rotation_search) != (0.0,):
+            raise ValueError(
+                "predict_compact_ms supports the scale grid only; use "
+                "Predictor.predict for rotation ensembles")
+        if thre1 is None:
+            thre1 = prm.thre1
+        oh, ow = image_bgr.shape[:2]
+
+        # decode on the LARGEST scale's grid (finest resolution, and
+        # independent of scale_search ordering)
+        scales = [s * mp.boxsize / oh for s in prm.scale_search]
+        prepared = [self._prepare_input(image_bgr, s) for s in scales]
+        rh0, rw0 = max((p[1] for p in prepared), key=lambda v: v[0] * v[1])
+
+        maps_d = [
+            self._scale_to_grid_fn(img.shape[:2], (rh, rw), (rh0, rw0))(
+                self.variables, img)
+            for img, (rh, rw) in prepared]
+
+        spec = (prm.thre2, prm.mid_num, prm.offset_radius, self.compact_topk,
+                prm.connect_ration)
+        packed_d = self._compact_avg_fn(len(maps_d), (rh0, rw0), thre1,
+                                        spec)(maps_d)
+
+        def resolve():
+            return self._unpack_compact(np.asarray(packed_d), spec[3],
+                                        rh0, (ow / rw0, oh / rh0))
+
+        return resolve
+
+    def _scale_to_grid_fn(self, shape: Tuple[int, int],
+                          valid: Tuple[int, int], grid: Tuple[int, int]):
+        """Jitted per-scale program: (H, W, 3) image → flip-ensembled maps
+        with the valid region resized onto the common decode grid.  All
+        shapes are static, so the program cache is keyed by
+        (input shape, valid extent, grid)."""
+        key = (shape, valid, grid, "to_grid")
+        if key in self._fns:
+            return self._fns[key]
+
+        import jax
+
+        def fn(variables, img):
+            maps = self._ensemble_maps(variables, img)
+            maps = maps[:valid[0], :valid[1]]
+            return jax.image.resize(maps, (*grid, maps.shape[-1]),
+                                    method="cubic")
+
+        jitted = jax.jit(fn)
+        self._fns[key] = jitted
+        return jitted
+
+    def _compact_avg_fn(self, n_scales: int, grid: Tuple[int, int],
+                        thre1: float, spec):
+        """Jitted: average ``n_scales`` grid-aligned map stacks (device
+        arrays from *_scale_to_grid_fn*) and run the compact peak +
+        candidate extraction on the mean."""
+        key = (n_scales, grid, thre1, spec, "compact_avg")
+        if key in self._fns:
+            return self._fns[key]
+
+        import jax
+
+        one_image = self._compact_extract_fn(thre1, spec)
+
+        def fn(maps_list):
+            maps = sum(maps_list) / len(maps_list)
+            return one_image(maps, grid[0], grid[1])
+
+        jitted = jax.jit(fn)
+        self._fns[key] = jitted
+        return jitted
 
     def compact_lane_shape(self, image_bgr: np.ndarray,
                            params: Optional[InferenceParams] = None
@@ -274,15 +405,19 @@ class Predictor:
         return jnp.concatenate([paf, heat], axis=-1)
 
     # ------------------------------------------------------------------ #
-    def predict(self, image_bgr: np.ndarray
+    def predict(self, image_bgr: np.ndarray,
+                params: Optional[InferenceParams] = None
                 ) -> Tuple[np.ndarray, np.ndarray]:
         """Average maps over the scale × rotation grid at original resolution.
 
         :param image_bgr: (H, W, 3) uint8 (cv2 imread order, like the
             reference's pipeline end-to-end)
+        :param params: optional override of the predictor's inference
+            params (scale/rotation grid)
         :returns: (heatmap (H, W, heat_layers+2), paf (H, W, paf_layers))
         """
-        sk, prm, mp = self.skeleton, self.params, self.model_params
+        sk, mp = self.skeleton, self.model_params
+        prm = params or self.params
         oh, ow = image_bgr.shape[:2]
         heat_avg = np.zeros((oh, ow, sk.heat_layers + 2), np.float32)
         paf_avg = np.zeros((oh, ow, sk.paf_layers), np.float32)

@@ -420,3 +420,124 @@ def test_compact_matches_fast_on_variant_skeletons(variant):
             assert (pa is None) == (pb is None)
             if pa is not None:
                 assert abs(pa[0] - pb[0]) < 0.05 and abs(pa[1] - pb[1]) < 0.05
+
+
+def test_compact_ms_single_scale_equals_compact():
+    """With a 1-entry scale grid the multi-scale compact path must equal
+    the plain compact path exactly (same extraction on the same maps)."""
+    from improved_body_parts_tpu.infer import decode_compact
+
+    pred, img = _planted_person_predictor()
+    params, _ = default_inference_params()
+    a = decode_compact(pred.predict_compact(img), params, SK)
+    b = decode_compact(pred.predict_compact_ms(img), params, SK)
+    assert len(a) == len(b) >= 1
+    for (ak, asc), (bk, bsc) in zip(a, b):
+        assert asc == pytest.approx(bsc, abs=1e-6)
+        for pa, pb in zip(ak, bk):
+            assert (pa is None) == (pb is None)
+            if pa is not None:
+                np.testing.assert_allclose(pa, pb, atol=1e-4)
+
+
+def test_compact_ms_multi_scale_matches_host_mirror():
+    """Device-resident scale averaging vs an independent host mirror of
+    the same algorithm (per-scale upsample -> valid slice -> regrid ->
+    mean).  Maps are compared directly, and the compact payload's peaks
+    must match host NMS on the mirrored mean — decoded-people equality is
+    deliberately not asserted (the symmetric synthetic maps create exact
+    L/R ties that fp32-device vs float64-host break differently)."""
+    import dataclasses as dc
+
+    import jax
+    import jax.numpy as jnp
+
+    pred, img = _planted_person_predictor()
+    params, _ = default_inference_params()
+    ms_params = dc.replace(params, scale_search=(0.75, 1.0))
+
+    res = pred.predict_compact_ms(img, params=ms_params)
+
+    # host mirror: rebuild the averaged grid maps from the stub's content
+    stub_maps = pred.model.maps
+    oh = img.shape[0]
+    scales = [s * pred.model_params.boxsize / oh
+              for s in ms_params.scale_search]
+    prepared = [pred._prepare_input(img, s) for s in scales]
+    # decode grid = the LARGEST scale's grid (matches predict_compact_ms)
+    rh0, rw0 = max((p[1] for p in prepared), key=lambda v: v[0] * v[1])
+    acc = []
+    for pimg, (rh, rw) in prepared:
+        h, w = pimg.shape[:2]
+        m = jnp.asarray(stub_maps[:h // SK.stride, :w // SK.stride])
+        mm = pred._merge_flip(m, m[:, ::-1, :])
+        up = jax.image.resize(mm, (h, w, mm.shape[-1]), method="cubic")
+        up = up[:rh, :rw]
+        acc.append(jax.image.resize(up, (rh0, rw0, up.shape[-1]),
+                                    method="cubic"))
+    mean = np.asarray(sum(acc) / len(acc), np.float32)
+
+    # 1. the device-averaged grid maps == the mirror (wiring contract);
+    #    fetch them by re-running the cached per-scale programs + mean
+    dev_maps = [np.asarray(
+        pred._scale_to_grid_fn(pimg.shape[:2], (rh, rw), (rh0, rw0))(
+            pred.variables, pimg))
+        for pimg, (rh, rw) in prepared]
+    np.testing.assert_allclose(np.mean(dev_maps, axis=0), mean, atol=2e-5)
+
+    # 2. payload peaks == host NMS peak set on the mirrored mean
+    from improved_body_parts_tpu.ops.nms import peak_mask_np
+
+    kp = np.ascontiguousarray(
+        mean[..., SK.paf_layers:SK.paf_layers + SK.num_parts])
+    host_mask = peak_mask_np(kp, thre=ms_params.thre1)
+    for c in range(SK.num_parts):
+        ys, xs = np.nonzero(host_mask[..., c])
+        slots = np.nonzero(res.peaks.valid[c])[0]
+        dev = set(zip(res.peaks.xs[c, slots].tolist(),
+                      res.peaks.ys[c, slots].tolist()))
+        assert dev == set(zip(xs.tolist(), ys.tolist())), f"channel {c}"
+
+    # 3. the person decodes from the payload
+    from improved_body_parts_tpu.infer import decode_compact
+
+    got = decode_compact(res, ms_params, SK)
+    assert len(got) >= 1
+    assert res.image_size == rh0
+    assert res.coord_scale == (img.shape[1] / rw0, oh / rh0)
+
+    to_grid = [k for k in pred._fns if k[-1] == "to_grid"]
+    avg = [k for k in pred._fns if k[-1] == "compact_avg"]
+    assert len(to_grid) == 2 and len(avg) >= 1  # 2 scales; shared avg
+
+
+def test_compact_ms_rejects_rotations():
+    import dataclasses as dc
+
+    pred, img = _planted_person_predictor()
+    params, _ = default_inference_params()
+    with pytest.raises(ValueError, match="rotation"):
+        pred.predict_compact_ms(
+            img, params=dc.replace(params, rotation_search=(0.0, 40.0)))
+
+
+def test_compact_pipeline_multi_scale_grid():
+    """pipelined_inference(compact=True) with a multi-entry scale grid
+    routes through predict_compact_ms and matches the sequential result."""
+    import dataclasses as dc
+
+    from improved_body_parts_tpu.infer import decode_compact, pipelined_inference
+
+    pred, img = _planted_person_predictor()
+    params, _ = default_inference_params()
+    ms_params = dc.replace(params, scale_search=(0.75, 1.0))
+    want = decode_compact(pred.predict_compact_ms(img, params=ms_params),
+                          ms_params, SK)
+
+    out = list(pipelined_inference(pred, [img, img], ms_params, SK,
+                                   compact=True))
+    assert len(out) == 2
+    for res in out:
+        assert len(res) == len(want)
+        for (ck, cs), (wk, ws) in zip(res, want):
+            assert cs == pytest.approx(ws, abs=1e-6)
