@@ -108,7 +108,9 @@ def main():
                       warmup=1 if fallback else 5)
 
     fps = batch / dt
-    unit = (f"imgs/sec (cpu-fallback, batch {batch})" if fallback
+    unit = (f"imgs/sec (cpu-fallback, batch {batch}; TPU claim unavailable "
+            "— last audited on-chip: 278 imgs/s b8, PERF_AUDIT_B.json)"
+            if fallback
             else f"imgs/sec (batch {batch}, chained steps; the reference's "
                  "38.5 is batched loader throughput)")
     total.cancel()

@@ -386,7 +386,11 @@ def decode_compact(compact: CompactResult, params: InferenceParams,
 
     :raises CompactOverflow: when a channel's true NMS peak count exceeds
         the top-K capacity (``Predictor(compact_topk=...)``) or a limb's
-        accepted-pair count exceeds the candidate cap.
+        accepted-pair count exceeds the candidate cap.  Callers (the
+        pipeline) catch this and fall back to the full-map path.
+    :raises RuntimeError: when a device candidate references an invalid
+        peak slot — a corrupt payload, deliberately NOT CompactOverflow:
+        it must surface as a hard error, never a silent fallback.
     """
     pk, cd = compact.peaks, compact.stats
     num_parts = skeleton.num_parts
@@ -439,9 +443,21 @@ def decode_compact(compact: CompactResult, params: InferenceParams,
         rows = []
         limit = min(na, nb)
         for slot in np.nonzero(cd.valid[k])[0]:
-            i = slot_pos[ia][cd.slot_a[k, slot]]
-            j = slot_pos[ib][cd.slot_b[k, slot]]
-            assert i >= 0 and j >= 0, "candidate references an invalid peak"
+            sa = int(cd.slot_a[k, slot])
+            sb = int(cd.slot_b[k, slot])
+            # hard errors even under `python -O`: an out-of-range or
+            # invalid slot would silently wrap to another peak (Python
+            # negative indexing) and corrupt skeletons
+            if not (0 <= sa < k_cap and 0 <= sb < k_cap):
+                raise RuntimeError(
+                    f"limb {k}: device candidate slot out of range "
+                    f"(a={sa}, b={sb}, capacity={k_cap})")
+            i = slot_pos[ia][sa]
+            j = slot_pos[ib][sb]
+            if i < 0 or j < 0:
+                raise RuntimeError(
+                    f"limb {k}: device candidate references an invalid "
+                    f"peak slot (a={sa}, b={sb})")
             if used_a[i] or used_b[j]:
                 continue
             used_a[i] = used_b[j] = True

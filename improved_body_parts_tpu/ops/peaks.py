@@ -55,7 +55,7 @@ class PairStats(NamedTuple):
     """
     mean_score: jnp.ndarray  # float32
     above: jnp.ndarray       # int32 — samples with response > thre2
-    num_samples: jnp.ndarray  # int32 — m = min(round(norm)+1, S)
+    num_samples: jnp.ndarray  # int32 — m = min(round(norm+1), S)
     norm: jnp.ndarray        # float32
 
 

@@ -30,26 +30,7 @@ def enable_compile_cache(cache_dir: str = "") -> None:
     if not cache_dir:
         cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
     if not cache_dir:
-        # CPU runs scope the dir by a host-CPU fingerprint: XLA:CPU AOT
-        # entries bake in the compile machine's ISA features, and loading
-        # them on a different host warns "could lead to SIGILL" —
-        # containers migrate between fleet nodes. Accelerator runs keep a
-        # shared dir (their executables don't bake host ISA, and the
-        # minutes-long TPU compiles are what the cache exists to avoid).
-        platforms = os.environ.get("JAX_PLATFORMS", "").lower()
-        cpu_ish = not platforms or "cpu" in platforms
-        suffix = ""
-        if cpu_ish:
-            import hashlib
-            try:
-                with open("/proc/cpuinfo") as f:
-                    flags = next((ln for ln in f
-                                  if ln.startswith("flags")), "")
-            except OSError:
-                flags = ""
-            suffix = "-" + hashlib.sha1(flags.encode()).hexdigest()[:10]
-        cache_dir = os.path.expanduser(
-            f"~/.cache/improved_body_parts_tpu/jax{suffix}")
+        cache_dir = _default_cache_dir()
     import jax
 
     try:
@@ -59,6 +40,90 @@ def enable_compile_cache(cache_dir: str = "") -> None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:  # unwritable dir / old jax — cache is best-effort
         pass
+
+
+def _accelerator_plugin_registered() -> bool:
+    """True when a non-CPU PJRT backend factory is registered.
+
+    Factory registration is readable WITHOUT initializing any backend, so
+    this never touches an exclusively-claimed device.  ``sitecustomize``
+    deployments register at interpreter start; stock jax registers
+    ``jax_plugins`` entry-point backends lazily inside ``backends()``, so
+    run the (cheap, non-initializing) discovery step first to see those.
+    """
+    try:
+        from jax._src import xla_bridge as xb
+
+        try:
+            xb._discover_and_register_pjrt_plugins()
+        except Exception:  # discovery is best-effort
+            pass
+        return bool(set(xb._backend_factories) - {"cpu"})
+    except Exception:  # jax internals moved — assume CPU-only host
+        return False
+
+
+def _resolved_platform():
+    """The active backend's platform, or None when none is initialized.
+
+    Never initializes a backend itself (that could hang on a wedged
+    exclusive claim); it only reports a selection already made.
+    """
+    try:
+        from jax._src import xla_bridge as xb
+
+        if not xb.backends_are_initialized():
+            return None
+        import jax
+
+        return jax.devices()[0].platform.lower()  # cached — instant
+    except Exception:
+        return None
+
+
+def _default_cache_dir() -> str:
+    """Cache dir when neither argument nor env var picks one.
+
+    CPU runs scope the dir by a host-CPU fingerprint: XLA:CPU AOT entries
+    bake in the compile machine's ISA features, and loading them on a
+    different host warns "could lead to SIGILL" — containers migrate
+    between fleet nodes.  A run counts as CPU when a backend is already
+    initialized and resolved to CPU, when ``JAX_PLATFORMS`` selects cpu
+    explicitly, or when it is unset on a host with no accelerator plugin
+    registered (autodiscovery can only resolve to CPU there).  With the
+    var unset on an accelerator host, the run must share the accelerator
+    cache dir (whose executables don't bake host ISA, and whose
+    minutes-long compiles are what the cache exists to avoid), not
+    fragment it per host CPU.  Residual hazard, accepted: a
+    pre-backend-init call with the var unset on an accelerator host whose
+    device later fails to initialize (jax then falls back to CPU) will
+    write CPU AOT entries into the shared dir; loading those on a
+    different host warns and may fall back, but never poisons the
+    accelerator entries (cache keys include the platform).
+    """
+    platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+    # only the FIRST entry decides: "tpu,cpu" means TPU primary with CPU
+    # fallback, which is an accelerator run
+    primary = platforms.split(",")[0].strip()
+    resolved = _resolved_platform()
+    if resolved is not None:
+        cpu_ish = resolved == "cpu"
+    else:
+        cpu_ish = (primary == "cpu"
+                   or (not primary
+                       and not _accelerator_plugin_registered()))
+    suffix = ""
+    if cpu_ish:
+        import hashlib
+        try:
+            with open("/proc/cpuinfo") as f:
+                flags = next((ln for ln in f
+                              if ln.startswith("flags")), "")
+        except OSError:
+            flags = ""
+        suffix = "-" + hashlib.sha1(flags.encode()).hexdigest()[:10]
+    return os.path.expanduser(
+        f"~/.cache/improved_body_parts_tpu/jax{suffix}")
 
 
 def apply_platform_env() -> None:

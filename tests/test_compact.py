@@ -169,6 +169,38 @@ def test_compact_overflow_raises_and_pipeline_falls_back():
     assert len(out) == 1 and len(out[0]) == len(fast)
 
 
+def test_corrupt_candidate_slot_raises_not_asserts():
+    """A device candidate referencing an invalid peak slot must be a hard
+    error even under ``python -O``: a bare assert would let the -1 slot
+    position silently wrap to the last peak and corrupt skeletons."""
+    from improved_body_parts_tpu.infer import decode_compact
+
+    pred, img = _planted_person_predictor()
+    params, _ = default_inference_params()
+    compact = pred.predict_compact(img)
+    pk, cd = compact.peaks, compact.stats
+    slot_a = np.array(cd.slot_a)
+    pk_valid = np.array(pk.valid)
+    for k, (ia, _ib) in enumerate(SK.limbs_conn):
+        cand_slots = np.nonzero(np.array(cd.valid)[k])[0]
+        invalid_peaks = np.nonzero(~pk_valid[ia])[0]
+        if cand_slots.size and invalid_peaks.size:
+            slot_a[k, cand_slots[0]] = invalid_peaks[0]
+            break
+    else:
+        pytest.skip("no corruptible limb candidate in this fixture")
+    corrupted = compact._replace(stats=cd._replace(slot_a=slot_a))
+    with pytest.raises(RuntimeError, match="invalid peak"):
+        decode_compact(corrupted, params, SK, use_native=False)
+
+    # a NEGATIVE slot must not wrap via Python indexing to a real peak
+    slot_neg = np.array(cd.slot_a)
+    slot_neg[k, cand_slots[0]] = -1
+    corrupted = compact._replace(stats=cd._replace(slot_a=slot_neg))
+    with pytest.raises(RuntimeError, match="out of range"):
+        decode_compact(corrupted, params, SK, use_native=False)
+
+
 def test_compact_pipeline_matches_sequential():
     from improved_body_parts_tpu.infer import decode_compact, pipelined_inference
 

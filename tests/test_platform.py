@@ -1,0 +1,84 @@
+"""Platform helpers: cache-dir scoping and the bring-up watchdog."""
+import improved_body_parts_tpu.utils.platform as platform_mod
+
+
+def test_cache_dir_scoping_rules(monkeypatch):
+    # Pre-backend-init cases: no resolved platform, decide from env +
+    # plugin registry.
+    monkeypatch.setattr(platform_mod, "_resolved_platform", lambda: None)
+
+    # Explicit cpu selection → host-fingerprinted dir (XLA:CPU AOT entries
+    # bake the compile host's ISA; cross-host reuse risks SIGILL).
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    cpu_dir = platform_mod._default_cache_dir()
+    assert cpu_dir.rsplit("jax", 1)[1].startswith("-")
+
+    # Unset on an accelerator host (a plugin is registered) → the shared
+    # (unfingerprinted) dir, so accelerator runs on different hosts keep
+    # hitting the same cache.
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(platform_mod, "_accelerator_plugin_registered",
+                        lambda: True)
+    shared_dir = platform_mod._default_cache_dir()
+    assert shared_dir.endswith("jax")
+    assert shared_dir != cpu_dir
+
+    # Unset on a CPU-only host (no plugin) → autodiscovery can only
+    # resolve to CPU, so the fingerprint guard applies.
+    monkeypatch.setattr(platform_mod, "_accelerator_plugin_registered",
+                        lambda: False)
+    assert platform_mod._default_cache_dir() == cpu_dir
+
+    # Explicit accelerator selection → shared dir regardless of plugins.
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    assert platform_mod._default_cache_dir() == shared_dir
+
+    # Multi-platform lists: only the PRIMARY (first) entry decides.
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+    assert platform_mod._default_cache_dir() == shared_dir
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu,tpu")
+    assert platform_mod._default_cache_dir() == cpu_dir
+
+    # Post-init cases: the RESOLVED backend wins over the env heuristics.
+    monkeypatch.setattr(platform_mod, "_resolved_platform", lambda: "cpu")
+    assert platform_mod._default_cache_dir() == cpu_dir  # despite env=tpu
+    monkeypatch.setattr(platform_mod, "_resolved_platform", lambda: "tpu")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert platform_mod._default_cache_dir() == shared_dir
+
+
+def test_resolved_platform_reports_initialized_backend():
+    # The test process initialized the (forced-CPU) backend in conftest,
+    # so the resolved platform must be cpu — read without re-initializing.
+    assert platform_mod._resolved_platform() == "cpu"
+
+
+def test_accelerator_plugin_registry_readable():
+    # Never initializes a backend; on this image the sitecustomize
+    # registers the axon plugin, but the assertion only requires a clean
+    # boolean either way.
+    assert platform_mod._accelerator_plugin_registered() in (True, False)
+
+
+def test_explicit_cache_dir_env_wins(monkeypatch, tmp_path):
+    import jax
+
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "c"))
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        # enable_compile_cache must honour the env var (smoke: no
+        # exception and the dir is created).
+        platform_mod.enable_compile_cache()
+        assert (tmp_path / "c").is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "c")
+    finally:
+        # pytest prunes tmp dirs — don't leave later compilations in this
+        # process writing cache entries into a removed directory
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_devices_with_timeout_returns_devices():
+    # On the (forced-CPU) test backend bring-up is instant; the watchdog
+    # path must return the device list, not raise.
+    devices = platform_mod.devices_with_timeout(60)
+    assert devices and devices[0].platform == "cpu"
