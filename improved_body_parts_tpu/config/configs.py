@@ -363,6 +363,24 @@ def _tiny() -> Config:
     )
 
 
+def _synth() -> Config:
+    """Drawn-person synthetic benchmark (framework-native, no reference
+    counterpart): the tiny IMHN with a hotter LR and a real batch, used by
+    tools/synth_ap.py to demonstrate the full learn→decode→AP loop on the
+    rendered stick-figure fixture (data/fixture.py ``drawn=True``)."""
+    return Config(
+        name="synth",
+        skeleton=SkeletonConfig(width=128, height=128),
+        model=ModelConfig(nstack=2, inp_dim=16, increase=8,
+                          hourglass_depth=2, se_reduction=4),
+        train=TrainConfig(batch_size_per_device=4,
+                          learning_rate_per_device=2.5e-4,
+                          nstack_weight=(1.0, 1.0),
+                          scale_weight=(0.5, 1.0, 2.0),
+                          epochs=40, warmup_epochs=2),
+    )
+
+
 def _ae() -> Config:
     """Associative-Embedding-style classic hourglass (reference:
     models/ae_pose.py, kept for ablation): ONE full-resolution output per
@@ -381,6 +399,7 @@ _REGISTRY = {
     "dense_384": _dense_384,
     "final_384": _final_384,
     "tiny": _tiny,
+    "synth": _synth,
     "ae": _ae,
 }
 

@@ -29,7 +29,7 @@ _UNIT_POSE = {
 
 
 def synthetic_person(rng: np.random.Generator, img_w: int, img_h: int,
-                     image_size: int):
+                     image_size: int, all_visible: bool = False):
     from ..config import COCO_PARTS
 
     h = rng.uniform(0.4, 0.8) * img_h
@@ -41,7 +41,8 @@ def synthetic_person(rng: np.random.Generator, img_w: int, img_h: int,
         ux, uy = _UNIT_POSE[part]
         joints[i, 0] = x0 + ux * w + rng.normal(0, 2)
         joints[i, 1] = y0 + uy * h + rng.normal(0, 2)
-        joints[i, 2] = rng.choice([0, 1], p=[0.2, 0.8])  # hidden/visible
+        # stored (internal) visibility: 1 visible, 0 occluded, 2 unlabeled
+        joints[i, 2] = 1 if all_visible else rng.choice([0, 1], p=[0.2, 0.8])
     bbox = [x0, y0, w, h]
     return {
         "objpos": [x0 + w / 2, y0 + h / 2],
@@ -53,10 +54,80 @@ def synthetic_person(rng: np.random.Generator, img_w: int, img_h: int,
     }
 
 
+# limb segments for RENDERING drawn people (COCO part names); distinct
+# per-part / per-limb colors make the figures genuinely learnable from
+# pixels, unlike the noise-background fixture
+_DRAW_LIMBS = [
+    ("nose", "Leye"), ("nose", "Reye"), ("Leye", "Lear"), ("Reye", "Rear"),
+    ("Lsho", "Rsho"), ("Lsho", "Lelb"), ("Lelb", "Lwri"),
+    ("Rsho", "Relb"), ("Relb", "Rwri"), ("Lsho", "Lhip"), ("Rsho", "Rhip"),
+    ("Lhip", "Rhip"), ("Lhip", "Lkne"), ("Lkne", "Lank"),
+    ("Rhip", "Rkne"), ("Rkne", "Rank"),
+]
+
+
+def _part_color(i: int):
+    # fixed, well-separated 8-bit colors (deterministic, no rng)
+    return (int((37 + i * 53) % 200 + 55), int((91 + i * 97) % 200 + 55),
+            int((13 + i * 151) % 200 + 55))
+
+
+def draw_person(img: np.ndarray, joints: np.ndarray) -> None:
+    """Render one stick figure into ``img`` in place.
+
+    Limbs are thick colored lines, joints filled circles with a per-part
+    color.  Joints with stored visibility < 2 (visible AND occluded) are
+    drawn — the same ``v < 2`` rule the heatmapper uses to synthesize GT
+    (heatmapper.py), so every labeled joint has pixel evidence and the
+    fixture stays learnable even without ``all_visible``.
+    """
+    import cv2
+
+    from ..config import COCO_PARTS
+
+    idx = {p: i for i, p in enumerate(COCO_PARTS)}
+    for li, (a, b) in enumerate(_DRAW_LIMBS):
+        pa, pb = joints[idx[a]], joints[idx[b]]
+        if pa[2] < 2 and pb[2] < 2:
+            cv2.line(img, (int(pa[0]), int(pa[1])), (int(pb[0]), int(pb[1])),
+                     _part_color(17 + li), thickness=3)
+    for i in range(len(COCO_PARTS)):
+        x, y, v = joints[i]
+        if v < 2:
+            cv2.circle(img, (int(x), int(y)), 4, _part_color(i),
+                       thickness=-1)
+
+
+def _synth_image(rng: np.random.Generator, h: int, w: int,
+                 people_per_image: int, image_size: int, drawn: bool):
+    """One synthetic image + its person records (shared by the corpus and
+    val-set builders so train and eval see the same distribution)."""
+    if drawn:
+        # low-amplitude noise background so the rendered figures are the
+        # dominant signal — this is the LEARNABLE variant
+        img = rng.integers(0, 64, (h, w, 3), dtype=np.uint8)
+        persons = [synthetic_person(rng, w, h, image_size, all_visible=True)
+                   for _ in range(people_per_image)]
+        for p in persons:
+            draw_person(img, p["joint"])
+    else:
+        img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+        persons = [synthetic_person(rng, w, h, image_size)
+                   for _ in range(people_per_image)]
+    return img, persons
+
+
 def build_fixture(path: str, num_images: int = 4, img_size: Tuple[int, int]
                   = (240, 320), people_per_image: int = 2,
-                  image_size: int = 512, seed: int = 0) -> int:
-    """Write the fixture; returns the number of records."""
+                  image_size: int = 512, seed: int = 0,
+                  drawn: bool = False) -> int:
+    """Write the fixture; returns the number of records.
+
+    ``drawn=True`` renders the stick figures into the images (visible,
+    colored limbs/joints on a quiet background) so a model can genuinely
+    LEARN detection from pixels and generalize — the default noise images
+    carry no pixel signal and only support overfit/protocol tests.
+    """
     import h5py
 
     from .hdf5_corpus import build_masks, iter_records
@@ -70,9 +141,8 @@ def build_fixture(path: str, num_images: int = 4, img_size: Tuple[int, int]
         mask_grp = f.create_group("masks")
         for image_index in range(num_images):
             img_id = 1000 + image_index
-            img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
-            persons = [synthetic_person(rng, w, h, image_size)
-                       for _ in range(people_per_image)]
+            img, persons = _synth_image(rng, h, w, people_per_image,
+                                        image_size, drawn)
             person_masks = []
             for p in persons:
                 m = np.zeros((h, w), np.uint8)
@@ -88,3 +158,48 @@ def build_fixture(path: str, num_images: int = 4, img_size: Tuple[int, int]
                              mask_miss, mask_all)
                 count += 1
     return count
+
+
+def build_val_set(images_dir: str, anno_path: str, num_images: int = 16,
+                  img_size: Tuple[int, int] = (240, 320),
+                  people_per_image: int = 2, image_size: int = 512,
+                  seed: int = 1, drawn: bool = True) -> int:
+    """Held-out val set on disk: jpgs + a COCO-format keypoint JSON, the
+    exact inputs of ``tools/evaluate.py`` (reference: evaluate.py:585-622
+    reads COCO annotations + an image dir).  Returns the person count.
+
+    Stored visibility (1=visible, 0=occluded, 2=unlabeled) is re-coded
+    back to COCO (2 / 1 / 0) for the annotations file.
+    """
+    import os
+
+    import cv2
+
+    os.makedirs(images_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    h, w = img_size
+    images, annotations = [], []
+    ann_id = 0
+    for image_index in range(num_images):
+        img_id = 1 + image_index
+        img, persons = _synth_image(rng, h, w, people_per_image,
+                                    image_size, drawn)
+        name = f"{img_id:012d}.jpg"
+        cv2.imwrite(os.path.join(images_dir, name), img)
+        images.append({"id": img_id, "file_name": name,
+                       "width": w, "height": h})
+        for p in persons:
+            kp = []
+            for x, y, v in p["joint"]:
+                coco_v = {1: 2, 0: 1, 2: 0}[int(v)]
+                kp.extend([float(x), float(y), coco_v])
+            ann_id += 1
+            annotations.append({
+                "id": ann_id, "image_id": img_id, "category_id": 1,
+                "keypoints": kp, "num_keypoints": p["num_keypoints"],
+                "area": float(p["segment_area"]),
+                "bbox": [float(v) for v in p["bbox"]], "iscrowd": 0})
+    with open(anno_path, "w") as f:
+        json.dump({"images": images, "annotations": annotations,
+                   "categories": [{"id": 1, "name": "person"}]}, f)
+    return ann_id

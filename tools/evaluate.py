@@ -11,14 +11,16 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def load_predictor(config_name: str, checkpoint: str, bucket: int = 128):
+def load_predictor(config_name: str, checkpoint: str, bucket: int = 128,
+                   boxsize: int = 0):
     import jax
     import jax.numpy as jnp
 
     from improved_body_parts_tpu.utils import apply_platform_env
     apply_platform_env()  # honour JAX_PLATFORMS even under a sitecustomize
 
-    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.config import (
+        InferenceModelParams, get_config)
     from improved_body_parts_tpu.infer import Predictor
     from improved_body_parts_tpu.models import build_model
     from improved_body_parts_tpu.train import restore_checkpoint
@@ -28,7 +30,9 @@ def load_predictor(config_name: str, checkpoint: str, bucket: int = 128):
     payload = restore_checkpoint(checkpoint)
     variables = {"params": payload["params"],
                  "batch_stats": payload["batch_stats"]}
-    return Predictor(model, variables, cfg.skeleton, bucket=bucket)
+    model_params = InferenceModelParams(boxsize=boxsize) if boxsize else None
+    return Predictor(model, variables, cfg.skeleton, bucket=bucket,
+                     model_params=model_params)
 
 
 def main():
@@ -52,6 +56,11 @@ def main():
     ap.add_argument("--compact-batch", type=int, default=0,
                     help="throughput mode: N images + mirrors per dispatch, "
                          "shape-bucketed (implies the compact path)")
+    ap.add_argument("--boxsize", type=int, default=0,
+                    help="scale val images so their height maps to this "
+                         "network input size (the reference's INI "
+                         "[models] boxsize, utils/config:37-41); 0 keeps "
+                         "the library default")
     ap.add_argument("--oks-proxy", action="store_true",
                     help="evaluate with the dependency-free OKS evaluator "
                          "(COCOeval ignore/crowd/maxDets semantics, "
@@ -72,7 +81,8 @@ def main():
                   "proxy evaluator (--oks-proxy)")
             use_proxy = True
 
-    predictor = load_predictor(args.config, args.checkpoint)
+    predictor = load_predictor(args.config, args.checkpoint,
+                               boxsize=args.boxsize)
     if use_proxy:
         metrics = validation_oks(predictor, args.anno, args.images,
                                  max_images=args.max_images,

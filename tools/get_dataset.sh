@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Download MS-COCO 2017 train/val/test images + keypoint annotations.
+# Equivalent of the reference's data/dataset/get_dataset.sh (gsutil), with
+# a curl fallback for hosts without the gcloud SDK.
+#
+#   tools/get_dataset.sh [target_dir]   # default: ./data/coco2017
+#
+# Afterwards build the training corpus:
+#   python tools/make_corpus.py --anno <dir>/annotations/person_keypoints_train2017.json \
+#       --images <dir>/train2017 --out coco_train.h5
+set -euo pipefail
+
+DIR="${1:-./data/coco2017}"
+mkdir -p "$DIR"
+cd "$DIR"
+
+fetch() {
+    # extract into a temp dir and mv into place so an interrupted unzip
+    # can never masquerade as a complete dataset on rerun
+    local url="$1" name
+    name="$(basename "$url")"
+    local out="${2:-${name%.zip}}"
+    if [ -e "$out" ]; then
+        echo "$out already present, skipping"
+        return
+    fi
+    if [ ! -f "$name" ]; then
+        # download to .part and mv into place so an interrupted download
+        # can never masquerade as a complete zip on rerun (-C - resumes;
+        # a gsutil partial is deleted first — its sliced writes are not
+        # prefix-consistent, so resuming on top of one would corrupt)
+        if command -v gsutil >/dev/null 2>&1 && [[ "$url" == *images.cocodataset.org/zips/* ]]; then
+            gsutil -m cp "gs://images.cocodataset.org/zips/${name}" "$name.part" 2>/dev/null \
+                || { rm -f "$name.part"; curl -fL -C - -o "$name.part" "$url"; }
+        else
+            curl -fL -C - -o "$name.part" "$url"
+        fi
+        mv "$name.part" "$name"
+    fi
+    # fixed temp name (not $$): a failed run's leftovers are removed by
+    # the rerun instead of accumulating under fresh PID names
+    local tmp=".extract_${name%.zip}"
+    rm -rf "$tmp" && mkdir "$tmp"
+    if ! unzip -q "$name" -d "$tmp"; then
+        rm -rf "$tmp" "$name"
+        echo "unzip failed for $name — deleted it; rerun to re-download" >&2
+        exit 1
+    fi
+    mv "$tmp/$out" .
+    rmdir "$tmp" && rm -f "$name"
+}
+
+fetch "http://images.cocodataset.org/zips/train2017.zip"
+fetch "http://images.cocodataset.org/zips/val2017.zip"
+fetch "http://images.cocodataset.org/zips/test2017.zip"
+fetch "http://images.cocodataset.org/annotations/annotations_trainval2017.zip" annotations
+
+echo "COCO 2017 ready under $DIR"
