@@ -224,3 +224,47 @@ class TestDrawnFixture:
             img = cv2.imread(str(tmp_path / "val" / rec["file_name"]))
             assert img is not None and img.shape[:2] == (192, 256)
             assert img.max() > 150  # drawn by default
+
+    def test_drawn_render_is_mirror_symmetric(self):
+        """The flip ensemble assumes a mirrored left part LOOKS like the
+        right part (true for humans); the RENDERER must honour that or the
+        flipped inference lane contradicts the unflipped one (measured
+        regression with chiral colors: ensembled heat max 1.0 → 0.21).
+        Renders a figure and its L/R-swapped mirror and compares per-color
+        pixel histograms on the actual draw_person output."""
+        from improved_body_parts_tpu.config import COCO_PARTS
+        from improved_body_parts_tpu.data import draw_person
+
+        h = w = 160
+        rng = np.random.default_rng(4)
+        joints = np.zeros((len(COCO_PARTS), 3))
+        from improved_body_parts_tpu.data.fixture import _UNIT_POSE
+
+        for i, part in enumerate(COCO_PARTS):
+            ux, uy = _UNIT_POSE[part]
+            joints[i] = [20 + ux * 80 + rng.normal(0, 2),
+                         10 + uy * 140, 1]
+
+        # use the SAME mirroring rule the flip ensemble derives its
+        # channel permutations from
+        from improved_body_parts_tpu.config.configs import _mirror_name
+
+        mirrored = joints.copy()
+        mirrored[:, 0] = (w - 1) - mirrored[:, 0]
+        order = [COCO_PARTS.index(_mirror_name(p)) for p in COCO_PARTS]
+        mirrored = mirrored[order]
+
+        a = np.zeros((h, w, 3), np.uint8)
+        b = np.zeros((h, w, 3), np.uint8)
+        draw_person(a, joints)
+        draw_person(b, mirrored)
+        b_flip = b[:, ::-1]
+        for img in (a, b_flip):
+            assert img.max() > 150
+        # POSITIONAL comparison (a color-histogram check cannot catch
+        # chirality: label-swapping keeps the color multiset identical).
+        # The two renders must agree pixelwise up to 1px rasterization
+        # noise along stroke edges; with the old chiral coloring most of
+        # the ~7.7% drawn area differs (measured 2.6% edge noise today).
+        diff = np.abs(a.astype(int) - b_flip.astype(int)).max(axis=2) > 30
+        assert diff.mean() < 0.04, diff.mean()

@@ -94,7 +94,9 @@ def main():
     ap.add_argument("--train-images", type=int, default=96)
     ap.add_argument("--val-images", type=int, default=24)
     ap.add_argument("--people", type=int, default=2)
-    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--epochs", type=int, default=0,
+                    help="0 = the config's own epoch budget (synth: 60, "
+                         "the SYNTH_AP.json headline protocol)")
     ap.add_argument("--canvas", type=int, nargs=2, default=(192, 256),
                     metavar=("H", "W"))
     ap.add_argument("--workdir", default=None,
@@ -102,6 +104,10 @@ def main():
     ap.add_argument("--out", default="SYNTH_AP.json")
     ap.add_argument("--decode-path", default="compact",
                     choices=["full", "fast", "compact"])
+    ap.add_argument("--workers", type=int, default=0,
+                    help="corpus worker processes for the train CLI; 0 "
+                         "(synchronous) is fastest on few-core hosts — "
+                         "each spawned worker re-imports the jax stack")
     ap.add_argument("--keep-workdir", action="store_true")
     args = ap.parse_args()
 
@@ -117,6 +123,7 @@ def main():
     work = os.path.abspath(args.workdir or tempfile.mkdtemp(prefix="synth_ap_"))
     os.makedirs(work, exist_ok=True)
     cfg = get_config(args.config)
+    epochs = args.epochs or cfg.train.epochs
     net_size = cfg.skeleton.height
     canvas = tuple(args.canvas)
     # scale val images so the average person lands at the same size the
@@ -136,11 +143,11 @@ def main():
           f"({args.val_images} images)", flush=True)
 
     ckpt_dir = os.path.join(work, "ckpt")
-    print(f"training {args.config} for {args.epochs} epochs...", flush=True)
+    print(f"training {args.config} for {epochs} epochs...", flush=True)
     run_cli([os.path.join(REPO, "tools", "train.py"),
-             "--config", args.config, "--epochs", str(args.epochs),
+             "--config", args.config, "--epochs", str(epochs),
              "--train-h5", corpus, "--checkpoint-dir", ckpt_dir,
-             "--print-freq", "20"])
+             "--workers", str(args.workers), "--print-freq", "20"])
     # per-epoch losses live in the reference-format append-only epoch log
     with open(os.path.join(ckpt_dir, "log")) as f:
         losses = re.findall(r"train_loss: ([0-9.eE+-]+)", f.read())
@@ -179,7 +186,7 @@ def main():
         "config": args.config,
         "train_images": args.train_images, "train_records": n_rec,
         "val_images": args.val_images, "val_persons": n_val,
-        "epochs": args.epochs, "people_per_image": args.people,
+        "epochs": epochs, "people_per_image": args.people,
         "canvas": list(canvas), "decode_path": args.decode_path,
         "train_loss_first": float(losses[0]) if losses else None,
         "train_loss_last": float(losses[-1]) if losses else None,
