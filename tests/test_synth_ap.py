@@ -1,0 +1,42 @@
+"""Smoke test of the learn→AP benchmark tool (tools/synth_ap.py).
+
+Tiny scale: the full orchestration (drawn corpus → train CLI → fresh +
+trained checkpoints → evaluate CLI with --boxsize → SYNTH_AP-style JSON)
+must run and produce a well-formed artifact; the AP VALUE is only
+asserted to be a finite number in [0, 1] — learning quality at this scale
+is not the point (SYNTH_AP.json records the real 60-epoch result).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_synth_ap_tool_end_to_end(tmp_path):
+    out = tmp_path / "ap.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "synth_ap.py"),
+         "--train-images", "6", "--val-images", "2", "--epochs", "2",
+         "--workdir", str(tmp_path / "work"), "--out", str(out)],
+        capture_output=True, text=True, env=env, timeout=900,
+        cwd=str(tmp_path))
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    result = json.loads(out.read_text())
+    assert result["epochs"] == 2
+    assert result["train_records"] > 0 and result["val_persons"] > 0
+    for key in ("ap_trained", "ap_untrained"):
+        assert 0.0 <= result[key] <= 1.0, (key, result[key])
+    # the loss log was parsed from the real train CLI's epoch log
+    assert result["train_loss_first"] is not None
+    assert result["train_loss_last"] is not None
+    # artifacts stayed inside the workdir (the --dump-name regression)
+    assert not (tmp_path / "results").exists()
+    assert (tmp_path / "work" / "results").is_dir()
