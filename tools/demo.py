@@ -18,12 +18,17 @@ def main():
     ap.add_argument("--image", required=True)
     ap.add_argument("--output", default="result.jpg")
     ap.add_argument("--no-native", action="store_true")
+    ap.add_argument("--boxsize", type=int, default=0,
+                    help="scale the image so its height maps to this "
+                         "network input size (the reference's INI "
+                         "[models] boxsize); 0 keeps the library default")
     args = ap.parse_args()
 
     from improved_body_parts_tpu.infer.demo import run_demo
     from tools.evaluate import load_predictor
 
-    predictor = load_predictor(args.config, args.checkpoint)
+    predictor = load_predictor(args.config, args.checkpoint,
+                               boxsize=args.boxsize)
     _, (subset, _) = run_demo(predictor, args.image, args.output,
                               use_native=not args.no_native)
     print(f"{len(subset)} people -> {args.output}")
