@@ -214,8 +214,10 @@ def validation_oks(predictor: Predictor, anno_file: str, images_dir: str,
     runs in environments without pycocotools.  Defaults (including
     ``fast``) match :func:`validation` so the two protocols stay
     comparable; the detections JSON is still written, so it can be
-    re-scored with pycocotools elsewhere.  Returns the metrics dict
-    {AP, AP50, AP75, AR}."""
+    re-scored with pycocotools elsewhere.  Returns the 10-stat COCO
+    keypoint summary {AP, AP50, AP75, AP_M, AP_L, AR, AR50, AR75, AR_M,
+    AR_L} (area-split entries are nan when the val set has no GT in that
+    range)."""
     from .oks import evaluate_oks
 
     params = params or default_inference_params()[0]

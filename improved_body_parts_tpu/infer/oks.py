@@ -19,7 +19,11 @@ edge cases, each pinned by analytic goldens in tests/test_oks_and_variants.py:
   is computed from each detected keypoint's distance OUTSIDE the doubly
   expanded GT bbox (computeOks' ``k1 == 0`` branch), so detections inside a
   crowd region are absorbed by it;
-- **maxDets = 20** detections per image (the COCO keypoint protocol).
+- **maxDets = 20** detections per image (the COCO keypoint protocol);
+- **area-range splits** (AP_M/AP_L, AR_M/AR_L): per range, GTs outside the
+  range are ignored, and an UNMATCHED detection whose own area (the
+  loadRes-style tight keypoint bbox) is outside the range is ignored
+  rather than counted as a false positive.
 
 Formats:
 - ground truth: per image, list of dicts {"keypoints": (17, 3) array in COCO
@@ -42,6 +46,13 @@ COCO_SIGMAS = np.array([
 OKS_THRESHOLDS = np.arange(0.5, 0.95 + 1e-9, 0.05)
 
 MAX_DETS = 20  # COCO keypoint protocol (COCOeval Params.maxDets)
+
+# keypoint-task area ranges (COCOeval Params.setKpParams: no 'small')
+AREA_RANGES = {
+    "all": (0.0, 1e5 ** 2),
+    "medium": (32 ** 2, 96 ** 2),
+    "large": (96 ** 2, 1e5 ** 2),
+}
 
 
 def oks(det_xy: np.ndarray, gt: np.ndarray, area: float,
@@ -97,7 +108,8 @@ def _oks_matrix(gts: Sequence[Dict], dts: Sequence[Tuple]) -> np.ndarray:
 
 
 def _match_image(oks_mat: np.ndarray, det_scores: np.ndarray,
-                 gt_ignored: np.ndarray, gt_crowd: np.ndarray, thr: float
+                 gt_ignored: np.ndarray, gt_crowd: np.ndarray, thr: float,
+                 det_outside: Optional[np.ndarray] = None
                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """Greedy matching for one image at one threshold (COCOeval evaluateImg):
     detections by descending score, each takes its best available GT; crowd
@@ -105,6 +117,10 @@ def _match_image(oks_mat: np.ndarray, det_scores: np.ndarray,
     GT is itself ignored (neither TP nor FP).
 
     GT columns must be ordered non-ignored first (COCOeval's gtind sort).
+
+    ``det_outside`` marks detections whose own area falls outside the
+    active area range: if UNMATCHED they are ignored rather than counted
+    as false positives (evaluateImg's ``dtIg = dtIg | (dtm==0 & outside)``).
 
     Returns (scores, is_tp, det_ignored, number of non-ignored GT).
     """
@@ -130,6 +146,8 @@ def _match_image(oks_mat: np.ndarray, det_scores: np.ndarray,
             matched[best_gi] = True
             ignored[oi] = gt_ignored[best_gi]
             tps[oi] = not ignored[oi]
+        elif det_outside is not None and det_outside[di]:
+            ignored[oi] = True
     return scores, tps, ignored, int((~gt_ignored).sum())
 
 
@@ -154,10 +172,23 @@ def average_precision(scores: np.ndarray, tps: np.ndarray, n_gt: int
     return float(prec_at.mean())
 
 
+def _det_area(coords) -> float:
+    """Detection area the way pycocotools COCO.loadRes derives it for
+    keypoint results: the tight bbox over ALL keypoint coordinates —
+    including (0, 0) placeholders for missing keypoints.  A quirk, but
+    it is exactly what COCOeval sees for the dt-side area gating."""
+    xy = np.array([(0.0, 0.0) if c is None else c for c in coords],
+                  dtype=np.float64)
+    x0, y0 = xy.min(axis=0)
+    x1, y1 = xy.max(axis=0)
+    return float((x1 - x0) * (y1 - y0))
+
+
 def evaluate_oks(ground_truth: Dict[int, Sequence[Dict]],
                  detections: Dict[int, Sequence[Tuple]]
                  ) -> Dict[str, float]:
-    """AP / AP50 / AP75 / AR over all images.
+    """The 10-stat COCO keypoint summary: AP / AP50 / AP75 / AP_M / AP_L
+    and AR / AR50 / AR75 / AR_M / AR_L (COCOeval summarize, kps mode).
 
     :param ground_truth: image_id -> list of GT person dicts
     :param detections: image_id -> list of (coords, score) from ``decode``
@@ -166,42 +197,61 @@ def evaluate_oks(ground_truth: Dict[int, Sequence[Dict]],
     for image_id, gts in ground_truth.items():
         dts = sorted(detections.get(image_id, []),
                      key=lambda d: -d[1])[:MAX_DETS]
-        # non-ignored GTs first (COCOeval's gtind sort), so the matching
-        # loop's early break on the ignored tail is valid
-        ignore = np.asarray([_gt_ignore(g) for g in gts], dtype=bool)
-        gt_order = np.argsort(ignore, kind="stable")
-        gts = [gts[i] for i in gt_order]
         per_image[image_id] = (
-            _oks_matrix(gts, dts),
+            _oks_matrix(gts, dts),  # column order = original gts order
             np.asarray([score for _, score in dts], dtype=np.float64),
-            ignore[gt_order],
-            np.asarray([bool(g.get("iscrowd")) for g in gts], dtype=bool))
-
-    aps = []
-    recalls = []
-    for thr in OKS_THRESHOLDS:
-        all_scores, all_tps, total_gt = [], [], 0
-        for image_id, (mat, det_scores, g_ign, g_crowd) in per_image.items():
-            s, t, d_ign, n = _match_image(mat, det_scores, g_ign, g_crowd,
-                                          thr)
-            all_scores.append(s[~d_ign])
-            all_tps.append(t[~d_ign])
-            total_gt += n
-        scores = np.concatenate(all_scores) if all_scores else np.zeros(0)
-        tps = (np.concatenate(all_tps) if all_tps
-               else np.zeros(0, dtype=bool))
-        aps.append(average_precision(scores, tps, total_gt))
-        recalls.append(tps.sum() / total_gt if total_gt else np.nan)
-
-    aps = np.asarray(aps)
-    recalls = np.asarray(recalls)
+            np.asarray([_gt_ignore(g) for g in gts], dtype=bool),
+            np.asarray([bool(g.get("iscrowd")) for g in gts], dtype=bool),
+            np.asarray([float(g["area"]) for g in gts], dtype=np.float64),
+            np.asarray([_det_area(coords) for coords, _ in dts],
+                       dtype=np.float64))
 
     def mean_or_nan(x):
         return float(np.nanmean(x)) if not np.isnan(x).all() else float("nan")
 
-    return {
-        "AP": mean_or_nan(aps),
-        "AP50": float(aps[0]),
-        "AP75": float(aps[5]),
-        "AR": mean_or_nan(recalls),
-    }
+    out: Dict[str, float] = {}
+    for rng_name, (lo, hi) in AREA_RANGES.items():
+        # range-specific ignore (evaluateImg: gtIg = _ignore or area
+        # outside aRng), then non-ignored GTs first (COCOeval's gtind
+        # sort) so the matching loop's early break on the ignored tail is
+        # valid — all threshold-independent, so precomputed per image
+        prepared = []
+        for (mat, det_scores, g_base_ign, g_crowd, g_area,
+             d_area) in per_image.values():
+            g_ign = g_base_ign | (g_area < lo) | (g_area > hi)
+            gt_order = np.argsort(g_ign, kind="stable")
+            d_out = (d_area < lo) | (d_area > hi)
+            prepared.append((mat[:, gt_order], det_scores,
+                             g_ign[gt_order], g_crowd[gt_order], d_out))
+        aps = []
+        recalls = []
+        for thr in OKS_THRESHOLDS:
+            all_scores, all_tps, total_gt = [], [], 0
+            for mat, det_scores, g_ign, g_crowd, d_out in prepared:
+                s, t, d_ign, n = _match_image(
+                    mat, det_scores, g_ign, g_crowd, thr,
+                    det_outside=d_out)
+                all_scores.append(s[~d_ign])
+                all_tps.append(t[~d_ign])
+                total_gt += n
+            scores = (np.concatenate(all_scores) if all_scores
+                      else np.zeros(0))
+            tps = (np.concatenate(all_tps) if all_tps
+                   else np.zeros(0, dtype=bool))
+            aps.append(average_precision(scores, tps, total_gt))
+            recalls.append(tps.sum() / total_gt if total_gt else np.nan)
+
+        aps = np.asarray(aps)
+        recalls = np.asarray(recalls)
+        suffix = {"all": "", "medium": "_M", "large": "_L"}[rng_name]
+        if rng_name == "all":
+            out["AP"] = mean_or_nan(aps)
+            out["AP50"] = float(aps[0])
+            out["AP75"] = float(aps[5])
+            out["AR"] = mean_or_nan(recalls)
+            out["AR50"] = float(recalls[0])
+            out["AR75"] = float(recalls[5])
+        else:
+            out["AP" + suffix] = mean_or_nan(aps)
+            out["AR" + suffix] = mean_or_nan(recalls)
+    return out

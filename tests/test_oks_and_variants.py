@@ -311,3 +311,57 @@ class TestVariants:
         with pytest.raises(ValueError):
             bad = cfg.replace(model=cfg.model.__class__(variant="nope"))
             build_model(bad)
+
+
+class TestAreaRangeSplits:
+    """Analytic goldens for AP_M/AP_L (COCOeval area-range semantics):
+    per range, out-of-range GTs are ignored; an UNMATCHED detection whose
+    own (loadRes tight-keypoint-bbox) area is outside the range is ignored
+    rather than counted as a false positive."""
+
+    @staticmethod
+    def _person(x0, y0, spread):
+        gt = np.zeros((17, 3))
+        gt[:, 0] = x0 + np.linspace(0, spread, 17)
+        gt[:, 1] = y0 + (np.arange(17) % 4) * spread / 4
+        gt[:, 2] = 2
+        return gt
+
+    def test_medium_large_splits_analytic(self):
+        # medium GT (area 2500 in [32^2, 96^2]) and large GT (area 10^4)
+        gt_m = self._person(100, 100, 40)
+        gt_l = self._person(400, 100, 90)
+        gts = {1: [{"keypoints": gt_m, "area": 2500.0},
+                   {"keypoints": gt_l, "area": 10000.0}]}
+        det = lambda g: [tuple(p) for p in g[:, :2]]  # noqa: E731
+        # dC: highest-scored FALSE positive far from both GTs, with a
+        # medium-sized keypoint bbox (spread 40 -> area 40*30 = 1200)
+        d_c = self._person(800, 600, 40)
+        dts = {1: [(det(d_c), 0.95),          # FP, medium-sized
+                   (det(gt_m), 0.90),         # perfect on medium GT
+                   (det(gt_l), 0.80)]}        # perfect on large GT
+
+        m = evaluate_oks(gts, dts)
+        # all: order FP,TP,TP -> precision [0,.5,2/3] -> monotone 2/3
+        assert m["AP"] == pytest.approx(2 / 3, abs=1e-9)
+        assert m["AR"] == pytest.approx(1.0)
+        # medium: large GT ignored (its det too); the FP's own area is
+        # in-range so it COUNTS -> order FP,TP -> precision .5 everywhere
+        assert m["AP_M"] == pytest.approx(0.5, abs=1e-9)
+        assert m["AR_M"] == pytest.approx(1.0)
+        # large: medium GT ignored; the FP's area is OUTSIDE the large
+        # range -> ignored, not an FP -> clean AP 1.0
+        assert m["AP_L"] == pytest.approx(1.0)
+        assert m["AR_L"] == pytest.approx(1.0)
+        # the 10-stat summary is complete
+        for key in ("AP", "AP50", "AP75", "AP_M", "AP_L",
+                    "AR", "AR50", "AR75", "AR_M", "AR_L"):
+            assert key in m
+
+    def test_range_with_no_gt_is_nan(self):
+        gt = self._person(100, 100, 40)
+        gts = {1: [{"keypoints": gt, "area": 2500.0}]}  # medium only
+        dts = {1: [([tuple(p) for p in gt[:, :2]], 0.9)]}
+        m = evaluate_oks(gts, dts)
+        assert np.isnan(m["AP_L"]) and np.isnan(m["AR_L"])
+        assert m["AP_M"] == pytest.approx(1.0)
