@@ -6,6 +6,7 @@ from .visualize import (
     colorize_jet,
     export_serialized,
     export_stablehlo,
+    module_dot,
     param_table,
     save_batch_overlays,
     train_batch_overlay,
@@ -15,5 +16,5 @@ __all__ = ["AverageMeter", "StepTimer", "apply_platform_env",
            "bf16_params", "devices_with_timeout", "force_cpu",
            "chained_time", "profile_trace", "timed",
            "colorize_jet", "export_serialized", "export_stablehlo",
-           "param_table",
+           "module_dot", "param_table",
            "save_batch_overlays", "train_batch_overlay"]

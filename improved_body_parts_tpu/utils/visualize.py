@@ -31,6 +31,49 @@ def param_table(variables, max_rows: Optional[int] = None) -> str:
     return "\n".join(rows)
 
 
+def module_dot(variables, max_depth: Optional[int] = None) -> str:
+    """Graphviz DOT of the module/parameter tree — the literal ``make_dot``
+    equivalent (reference: visulizatoin/draw_net.py:6-56, which renders the
+    autograd graph; under JAX the compiled graph lives in StableHLO, so the
+    DOT view here shows the MODULE hierarchy with per-subtree parameter
+    counts).  Render with ``dot -Tpng`` or any graphviz viewer.
+    """
+    import jax
+
+    # aggregate parameter counts per tree prefix
+    counts: dict = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            variables["params"])[0]:
+        keys = [str(getattr(k, "key", k)) for k in path]
+        n = int(np.prod(leaf.shape))
+        for d in range(1, len(keys) + 1):
+            prefix = "/".join(keys[:d])
+            counts[prefix] = counts.get(prefix, 0) + n
+
+    def node_id(prefix: str) -> str:
+        # QUOTED DOT ID: any module name is legal (user models can carry
+        # arbitrary explicit names), and distinct prefixes can never merge
+        escaped = prefix.replace("\\", "\\\\").replace('"', '\\"')
+        return f'"n/{escaped}"'
+
+    total = sum(v for k, v in counts.items() if "/" not in k)
+    lines = ["digraph model {", "  rankdir=LR;",
+             "  node [shape=box, fontsize=10];",
+             f'  root [label="params\\n{total:,}"];']
+    for prefix in sorted(counts):
+        depth = prefix.count("/") + 1
+        if max_depth is not None and depth > max_depth:
+            continue
+        label = prefix.rsplit("/", 1)[-1]
+        lines.append(
+            f'  {node_id(prefix)} [label="{label}\\n{counts[prefix]:,}"];')
+        parent = ("root" if "/" not in prefix
+                  else node_id(prefix.rsplit("/", 1)[0]))
+        lines.append(f"  {parent} -> {node_id(prefix)};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
 def export_stablehlo(model, variables, sample_images) -> str:
     """StableHLO text of the jitted forward — the XLA-world ONNX export
     (reference: visulizatoin/draw_net.py:89-93)."""

@@ -185,3 +185,28 @@ def test_param_table():
     v = se.init(jax.random.PRNGKey(0), jnp.zeros((1, 4, 4, 16)))
     table = param_table(v)
     assert "TOTAL" in table and "Dense_0" in table
+
+
+def test_module_dot():
+    """DOT export of the module tree (the make_dot equivalent,
+    reference: visulizatoin/draw_net.py:6-56): valid digraph syntax,
+    parent->child edges, per-subtree parameter counts, depth capping."""
+    import jax
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.models.layers import SELayer
+    from improved_body_parts_tpu.utils import module_dot
+
+    se = SELayer(reduction=4, dtype=jnp.float32)
+    v = se.init(jax.random.PRNGKey(0), jnp.zeros((1, 4, 4, 16)))
+    dot = module_dot(v)
+    assert dot.startswith("digraph model {") and dot.rstrip().endswith("}")
+    assert "root" in dot and "->" in dot and "Dense_0" in dot
+    # total on the root node equals the model's parameter count
+    total = sum(int(np.prod(p.shape))
+                for p in jax.tree.leaves(v["params"]))
+    assert f"params\\n{total:,}" in dot
+    # depth capping prunes leaf kernels but keeps the first level
+    capped = module_dot(v, max_depth=1)
+    assert "Dense_0" in capped and "kernel" not in capped
+    assert len(capped.splitlines()) < len(dot.splitlines())
