@@ -375,7 +375,11 @@ def _synth() -> Config:
                           hourglass_depth=2, se_reduction=4),
         train=TrainConfig(batch_size_per_device=4,
                           # SGD+momentum sweep on the drawn fixture:
-                          # 1e-3 converges fastest, 1e-2 diverges
+                          # 1e-3 converges fastest, 1e-2 diverges; near
+                          # the stability edge — corpora much larger than
+                          # ~100 images (3x the steps/epoch) have been
+                          # observed to explode mid-run at 1e-3, so drop
+                          # to 5e-4 or stretch warmup when scaling up
                           learning_rate_per_device=1e-3,
                           nstack_weight=(1.0, 1.0),
                           scale_weight=(0.5, 1.0, 2.0),
