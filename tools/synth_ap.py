@@ -104,6 +104,10 @@ def main():
     ap.add_argument("--out", default="SYNTH_AP.json")
     ap.add_argument("--decode-path", default="compact",
                     choices=["full", "fast", "compact"])
+    ap.add_argument("--lr", type=float, default=0.0,
+                    help="override the config learning rate (passed to "
+                         "the train CLI; use e.g. 5e-4 for corpora much "
+                         "larger than ~100 images — see configs.py synth)")
     ap.add_argument("--workers", type=int, default=0,
                     help="corpus worker processes for the train CLI; 0 "
                          "(synchronous) is fastest on few-core hosts — "
@@ -144,10 +148,13 @@ def main():
 
     ckpt_dir = os.path.join(work, "ckpt")
     print(f"training {args.config} for {epochs} epochs...", flush=True)
-    run_cli([os.path.join(REPO, "tools", "train.py"),
-             "--config", args.config, "--epochs", str(epochs),
-             "--train-h5", corpus, "--checkpoint-dir", ckpt_dir,
-             "--workers", str(args.workers), "--print-freq", "20"])
+    train_args = [os.path.join(REPO, "tools", "train.py"),
+                  "--config", args.config, "--epochs", str(epochs),
+                  "--train-h5", corpus, "--checkpoint-dir", ckpt_dir,
+                  "--workers", str(args.workers), "--print-freq", "20"]
+    if args.lr:
+        train_args += ["--lr", str(args.lr)]
+    run_cli(train_args)
     # per-epoch losses live in the reference-format append-only epoch log
     with open(os.path.join(ckpt_dir, "log")) as f:
         losses = re.findall(r"train_loss: ([0-9.eE+-]+)", f.read())
@@ -187,6 +194,7 @@ def main():
         "train_images": args.train_images, "train_records": n_rec,
         "val_images": args.val_images, "val_persons": n_val,
         "epochs": epochs, "people_per_image": args.people,
+        "lr": args.lr or cfg.train.learning_rate_per_device,
         "canvas": list(canvas), "decode_path": args.decode_path,
         "train_loss_first": float(losses[0]) if losses else None,
         "train_loss_last": float(losses[-1]) if losses else None,

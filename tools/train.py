@@ -31,6 +31,10 @@ def main():
     ap.add_argument("--resume", default=None,
                     help="checkpoint path or 'auto' for the latest")
     ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.0,
+                    help="override the config's per-device learning rate "
+                         "(the framework-native equivalent of editing the "
+                         "reference's config.py constants)")
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--no-focal", action="store_true",
                     help="plain L2 loss (the reference's L2 curriculum stage)")
@@ -76,10 +80,21 @@ def main():
     initialize_distributed(args.coordinator, args.num_processes,
                            args.process_id)
     cfg = get_config(args.config)
-    if args.checkpoint_dir:
+    if args.lr and args.swa:
+        # the SWA stage runs its own cyclic schedule from
+        # --swa-lr-max/--swa-lr-min; a silently ignored --lr would let the
+        # user believe they fine-tuned at that rate
+        raise SystemExit("--lr does not apply to the SWA stage; use "
+                         "--swa-lr-max/--swa-lr-min instead")
+    if args.checkpoint_dir or args.lr:
         import dataclasses
-        cfg = cfg.replace(train=dataclasses.replace(
-            cfg.train, checkpoint_dir=args.checkpoint_dir))
+
+        overrides = {}
+        if args.checkpoint_dir:
+            overrides["checkpoint_dir"] = args.checkpoint_dir
+        if args.lr:
+            overrides["learning_rate_per_device"] = args.lr
+        cfg = cfg.replace(train=dataclasses.replace(cfg.train, **overrides))
 
     train_h5 = args.train_h5 or cfg.train.hdf5_train_data
     val_h5 = args.val_h5 or cfg.train.hdf5_val_data
