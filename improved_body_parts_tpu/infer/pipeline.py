@@ -42,18 +42,19 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
 
     ``compact_batch`` > 1 (throughput mode, implies ``compact``) chunks
     the stream and runs ``predict_compact_batch`` — N images + mirrors in
-    one 2N-lane dispatch sharing one transfer round trip.
-    ``compact_batch == 1`` degrades to the plain compact path rather than
-    being silently ignored.
+    one 2N-lane dispatch sharing one transfer round trip.  Non-trivial
+    scale/rotation grids still work (routed per image through the ms
+    compact path, one fetch per chunk); the 2N-lane sharing only applies
+    to the trivial grid.  ``compact_batch == 1`` degrades to the plain
+    compact path rather than being silently ignored.
     """
+    from .predict import trivial_grid
+
     params = params or predictor.params
     skeleton = skeleton or predictor.skeleton
     if compact_batch == 1:
         compact, compact_batch = True, 0
-    if compact_batch > 1 and len(params.scale_search) > 1:
-        raise ValueError(
-            "compact_batch supports the single-scale protocol only; use "
-            "compact=True for multi-scale grids (predict_compact_ms)")
+    single_dispatch_grid = trivial_grid(params)
 
     def run_decode(resolve: Callable):
         heat, paf, mask, scale = resolve()
@@ -65,9 +66,9 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
             return decode_compact(compact_res, params, skeleton,
                                   use_native=use_native)
         except CompactOverflow:
-            if len(params.scale_search) > 1:
-                # multi-scale grids can't use the fast path; fall back to
-                # the full map-transfer protocol for this image
+            if not single_dispatch_grid:
+                # scale/rotation grids can't use the fast path; fall back
+                # to the full map-transfer protocol for this image
                 heat, paf = predictor.predict(image, params=params)
                 return decode(heat, paf, params, skeleton,
                               use_native=use_native)
@@ -94,26 +95,20 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
                     yield fut.result()
 
         if compact_batch > 1:
-            # bucket the stream by predicted lane shape so full batches
-            # share one compiled program (a mixed-shape chunk would split
-            # into per-shape groups each padded to N lanes — up to batch×
-            # redundant forward compute); results still yield in input
-            # order via an index-keyed reorder buffer
+            # bucket the stream by predicted lane shape so each dispatch
+            # is single-shape (predict_compact_batch_async then runs its
+            # exact pow2 decomposition — no padded lanes); results still
+            # yield in input order via an index-keyed reorder buffer
             buckets: dict = {}          # lane shape -> (indices, images)
             done: dict = {}             # input index -> decoded result
             next_out = 0
             n_in = 0
 
             def dispatch(idxs, chunk):
-                # pad partial chunks to the full batch size so they reuse
-                # the compiled N-lane program (a fresh compile costs
-                # minutes on a relay-attached chip); extras are discarded
-                padded = chunk + [chunk[-1]] * (compact_batch - len(chunk))
                 resolve = predictor.predict_compact_batch_async(
-                    padded, thre1=params.thre1, params=params)
+                    chunk, thre1=params.thre1, params=params)
                 futures.append((idxs, pool.submit(
-                    run_decode_compact_batch,
-                    lambda: resolve()[:len(chunk)], chunk)))
+                    run_decode_compact_batch, resolve, chunk)))
 
             def collect(limit):
                 nonlocal next_out
@@ -126,7 +121,11 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
                     next_out += 1
 
             for image in images:
-                key = predictor.compact_lane_shape(image, params)
+                # non-trivial grids dispatch per image inside the batch
+                # call anyway — shape bucketing would only fragment
+                # chunks and delay results, so chunk in arrival order
+                key = (predictor.compact_lane_shape(image, params)
+                       if single_dispatch_grid else "arrival")
                 idxs, chunk = buckets.setdefault(key, ([], []))
                 idxs.append(n_in)
                 chunk.append(image)
@@ -150,13 +149,11 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
             # dispatch forward; thre1 from the caller's params must reach
             # the on-device NMS, same as the sequential fast path
             if compact:
-                if len(params.scale_search) > 1:
-                    # full scale-grid protocol, device-resident averaging
-                    resolve = predictor.predict_compact_ms_async(
-                        image, thre1=params.thre1, params=params)
-                else:
-                    resolve = predictor.predict_compact_async(
-                        image, thre1=params.thre1, params=params)
+                # predict_compact_async itself routes non-trivial
+                # scale/rotation grids to the device-resident ms path —
+                # ONE routing point, no predicate copy to drift here
+                resolve = predictor.predict_compact_async(
+                    image, thre1=params.thre1, params=params)
                 futures.append(
                     (pool.submit(run_decode_compact, resolve, image), False))
             else:

@@ -42,6 +42,9 @@ def enable_compile_cache(cache_dir: str = "") -> None:
         pass
 
 
+_warned_internals_probe = False
+
+
 def _accelerator_plugin_registered() -> bool:
     """True when a non-CPU PJRT backend factory is registered.
 
@@ -50,6 +53,12 @@ def _accelerator_plugin_registered() -> bool:
     deployments register at interpreter start; stock jax registers
     ``jax_plugins`` entry-point backends lazily inside ``backends()``, so
     run the (cheap, non-initializing) discovery step first to see those.
+
+    Depends on private jax internals (``xb._backend_factories``); when
+    they move on a jax upgrade, the fallback classifies the host as
+    CPU-only, which on an accelerator host silently fragments the shared
+    compile cache into per-host fingerprinted dirs (losing minutes-long
+    TPU compile reuse) — so the failure is warned once, not swallowed.
     """
     try:
         from jax._src import xla_bridge as xb
@@ -59,7 +68,18 @@ def _accelerator_plugin_registered() -> bool:
         except Exception:  # discovery is best-effort
             pass
         return bool(set(xb._backend_factories) - {"cpu"})
-    except Exception:  # jax internals moved — assume CPU-only host
+    except Exception as e:  # jax internals moved — assume CPU-only host
+        global _warned_internals_probe
+        if not _warned_internals_probe:
+            _warned_internals_probe = True
+            import warnings
+
+            warnings.warn(
+                "jax internals probe failed (jax upgrade?): cannot tell "
+                "whether an accelerator plugin is registered; assuming a "
+                "CPU-only host. On an accelerator host this fragments the "
+                f"shared JAX compile cache per host CPU. ({e!r})",
+                RuntimeWarning, stacklevel=2)
         return False
 
 

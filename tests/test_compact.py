@@ -168,6 +168,20 @@ def test_compact_overflow_raises_and_pipeline_falls_back():
                                    use_native=False, compact=True))
     assert len(out) == 1 and len(out[0]) == len(fast)
 
+    # with a ROTATION grid the overflow fallback must route to the full
+    # host predict (predict_fast rejects grids) — reachable since round 4
+    # let rotation grids into the compact path
+    rot_params = dataclasses.replace(params, rotation_search=(0.0, 15.0))
+    out_rot = list(pipelined_inference(pred, [img], rot_params, SK,
+                                       use_native=False, compact=True))
+    assert len(out_rot) == 1 and len(out_rot[0]) >= 1
+
+    # multi-scale compact_batch: the stale single-scale-only guard is gone
+    ms_params = dataclasses.replace(params, scale_search=(0.75, 1.0))
+    out_ms = list(pipelined_inference(pred, [img, img], ms_params, SK,
+                                      use_native=False, compact_batch=2))
+    assert len(out_ms) == 2
+
 
 def test_corrupt_candidate_slot_raises_not_asserts():
     """A device candidate referencing an invalid peak slot must be a hard
@@ -329,6 +343,48 @@ def test_limb_topk_candidates_matches_host_acceptance():
                                        atol=1e-5)
 
 
+def test_compact_batch_pow2_occupancy():
+    """A mixed-shape stream must dispatch each shape group as its exact
+    binary decomposition — every forward lane carries a real image.  The
+    round-3 verdict's occupancy finding: a stream spanning G shape
+    buckets used to dispatch G FULL-size batches padded with copies (up
+    to G× wasted forward compute)."""
+    from improved_body_parts_tpu.infer import decode_compact
+    from improved_body_parts_tpu.infer.predict import _pow2_chunks
+
+    assert [len(c) for c in _pow2_chunks(list(range(5)))] == [4, 1]
+    assert [len(c) for c in _pow2_chunks(list(range(8)))] == [8]
+    assert sum(_pow2_chunks(list(range(7))), []) == list(range(7))
+
+    pred, img = _planted_person_predictor()
+    params, _ = default_inference_params()
+    wide = np.zeros((img.shape[0], img.shape[1] + 64, 3), np.uint8)
+    stream = [img, wide, img, wide, img]  # groups: 3 square + 2 wide
+
+    lane_counts = []
+    orig = pred._ensemble_fn
+
+    def spy(shape, mode="maps", **kw):
+        if mode == "compact_batch":
+            lane_counts.append(shape[0])
+        return orig(shape, mode=mode, **kw)
+
+    pred._ensemble_fn = spy
+    results = pred.predict_compact_batch(stream, params=params)
+    pred._ensemble_fn = orig
+
+    # 3 → 2+1, 2 → 2: five real lanes total, zero padding copies
+    assert sorted(lane_counts, reverse=True) == [2, 2, 1]
+    assert sum(lane_counts) == len(stream)
+
+    # and the chunked dispatch still returns per-image results in order
+    singles = [decode_compact(pred.predict_compact(im), params, SK)
+               for im in stream]
+    batched = [decode_compact(r, params, SK) for r in results]
+    assert batched == singles
+    assert len(batched[0]) >= 1
+
+
 def test_compact_batch_bucketing_preserves_order():
     """Interleaved lane shapes get bucketed into full batches, and results
     still come back in input order (distinguishable by image size)."""
@@ -472,6 +528,31 @@ def test_compact_ms_single_scale_equals_compact():
                 np.testing.assert_allclose(pa, pb, atol=1e-4)
 
 
+def test_compact_routes_rotation_grids_to_ms():
+    """predict_compact / predict_compact_batch must accept rotation grids
+    by routing through the multi-scale compact path (same CompactResult
+    contract) instead of raising — and the result must equal calling
+    predict_compact_ms directly."""
+    import dataclasses as dc
+
+    from improved_body_parts_tpu.infer import decode_compact
+
+    pred, img = _planted_person_predictor()
+    params, _ = default_inference_params()
+    rot_params = dc.replace(params, rotation_search=(0.0, 15.0))
+
+    want = decode_compact(
+        pred.predict_compact_ms(img, params=rot_params), rot_params, SK)
+    via_compact = decode_compact(
+        pred.predict_compact(img, params=rot_params), rot_params, SK)
+    via_batch = [decode_compact(r, rot_params, SK) for r in
+                 pred.predict_compact_batch([img, img], params=rot_params)]
+
+    assert via_compact == want
+    assert via_batch == [want, want]
+    assert len(want) >= 1  # the planted person still decodes
+
+
 def test_compact_ms_multi_scale_matches_host_mirror():
     """Device-resident scale averaging vs an independent host mirror of
     the same algorithm (per-scale upsample -> valid slice -> regrid ->
@@ -543,14 +624,21 @@ def test_compact_ms_multi_scale_matches_host_mirror():
     assert len(to_grid) == 2 and len(avg) >= 1  # 2 scales; shared avg
 
 
-def test_compact_ms_rejects_rotations():
+def test_compact_ms_rotation_single_entry_noop():
+    """A (0°)+rotation grid through compact_ms must still decode the
+    planted person — and the 0°-only grid must stay bitwise identical to
+    the rotation-free single-scale path (the angle-0 program adds no
+    warp ops)."""
     import dataclasses as dc
+
+    from improved_body_parts_tpu.infer import decode_compact
 
     pred, img = _planted_person_predictor()
     params, _ = default_inference_params()
-    with pytest.raises(ValueError, match="rotation"):
-        pred.predict_compact_ms(
-            img, params=dc.replace(params, rotation_search=(0.0, 40.0)))
+    a = decode_compact(pred.predict_compact(img), params, SK)
+    b = decode_compact(pred.predict_compact_ms(
+        img, params=dc.replace(params, rotation_search=(0.0,))), params, SK)
+    assert a == b and len(a) >= 1
 
 
 def test_compact_pipeline_multi_scale_grid():

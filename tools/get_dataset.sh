@@ -46,8 +46,10 @@ fetch() {
         echo "unzip failed for $name — deleted it; rerun to re-download" >&2
         exit 1
     fi
+    # rm -rf, not rmdir: stray zip cruft (e.g. __MACOSX/) must not fail
+    # an otherwise-successful extraction after the data was moved
     mv "$tmp/$out" .
-    rmdir "$tmp" && rm -f "$name"
+    rm -rf "$tmp" "$name"
 }
 
 fetch "http://images.cocodataset.org/zips/train2017.zip"
