@@ -121,10 +121,44 @@ def draw_person(img: np.ndarray, joints: np.ndarray) -> None:
                        _part_color(_color_index(name)), thickness=-1)
 
 
+def _render_crowd_box(rng: np.random.Generator, img: np.ndarray,
+                      image_size: int) -> np.ndarray:
+    """Draw a crowd: several overlapping partial stick figures inside a
+    box; returns the {0,1} crowd mask (the synthetic stand-in for COCO's
+    RLE crowd regions, reference coco_masks_hdf5.py:66-99)."""
+    h, w = img.shape[:2]
+    bw = int(rng.uniform(0.25, 0.4) * w)
+    bh = int(rng.uniform(0.3, 0.5) * h)
+    x0 = int(rng.uniform(0, w - bw))
+    y0 = int(rng.uniform(0, h - bh))
+    for _ in range(3):
+        p = synthetic_person(rng, bw, bh, image_size, all_visible=True)
+        joints = p["joint"].copy()
+        joints[:, 0] += x0
+        joints[:, 1] += y0
+        draw_person(img, joints)
+    mask = np.zeros((h, w), np.uint8)
+    mask[y0:y0 + bh, x0:x0 + bw] = 1
+    return mask
+
+
 def _synth_image(rng: np.random.Generator, h: int, w: int,
-                 people_per_image: int, image_size: int, drawn: bool):
+                 people_per_image: int, image_size: int, drawn: bool,
+                 crowd: bool = False):
     """One synthetic image + its person records (shared by the corpus and
-    val-set builders so train and eval see the same distribution)."""
+    val-set builders so train and eval see the same distribution).
+
+    ``crowd=True`` (drawn protocol only) additionally renders, with pixel
+    evidence but NO ground truth: an unannotated person (appended to
+    ``persons`` with ``num_keypoints=0`` — the corpus rules then exclude
+    it from records and zero its region in mask_miss) and/or a crowd box
+    of overlapping figures (returned as a crowd mask).  This reproduces
+    the structure that makes the reference's miss-mask machinery matter
+    (reference: coco_masks_hdf5.py:38-116, loss_model.py:52-56): real
+    people in pixels the loss must NOT penalize the model for detecting.
+
+    Returns (img, persons, crowd_masks).
+    """
     if drawn:
         # low-amplitude noise background so the rendered figures are the
         # dominant signal — this is the LEARNABLE variant
@@ -137,19 +171,40 @@ def _synth_image(rng: np.random.Generator, h: int, w: int,
         img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
         persons = [synthetic_person(rng, w, h, image_size)
                    for _ in range(people_per_image)]
-    return img, persons
+    crowd_masks = []
+    if crowd:
+        add_unannotated = bool(rng.uniform() < 0.5)
+        add_crowd = bool(rng.uniform() < 0.5) or not add_unannotated
+        if add_unannotated:
+            extra = synthetic_person(rng, w, h, image_size, all_visible=True)
+            if drawn:
+                draw_person(img, extra["joint"])
+            extra["num_keypoints"] = 0  # pixel evidence, no annotation
+            persons.append(extra)
+        if add_crowd:
+            crowd_masks.append(_render_crowd_box(rng, img, image_size))
+    return img, persons, crowd_masks
 
 
 def build_fixture(path: str, num_images: int = 4, img_size: Tuple[int, int]
                   = (240, 320), people_per_image: int = 2,
                   image_size: int = 512, seed: int = 0,
-                  drawn: bool = False) -> int:
+                  drawn: bool = False, crowd: bool = False,
+                  mask_extras: bool = True) -> int:
     """Write the fixture; returns the number of records.
 
     ``drawn=True`` renders the stick figures into the images (visible,
     colored limbs/joints on a quiet background) so a model can genuinely
     LEARN detection from pixels and generalize — the default noise images
     carry no pixel signal and only support overfit/protocol tests.
+
+    ``crowd=True`` adds unannotated people and crowd regions with pixel
+    evidence but no GT (see ``_synth_image``), producing corpora with
+    non-trivial mask_miss — the end-to-end exercise of the reference's
+    miss-mask semantics.  ``mask_extras=False`` is the ablation: the SAME
+    extras are rendered but mask_miss stays all-ones, so training wrongly
+    penalizes the model for detecting them (quantifies what the masking
+    machinery buys; tools/synth_ap.py --crowd runs both arms).
     """
     import h5py
 
@@ -164,8 +219,8 @@ def build_fixture(path: str, num_images: int = 4, img_size: Tuple[int, int]
         mask_grp = f.create_group("masks")
         for image_index in range(num_images):
             img_id = 1000 + image_index
-            img, persons = _synth_image(rng, h, w, people_per_image,
-                                        image_size, drawn)
+            img, persons, crowd_masks = _synth_image(
+                rng, h, w, people_per_image, image_size, drawn, crowd=crowd)
             person_masks = []
             for p in persons:
                 m = np.zeros((h, w), np.uint8)
@@ -173,7 +228,10 @@ def build_fixture(path: str, num_images: int = 4, img_size: Tuple[int, int]
                 m[max(y0, 0): y0 + bh, max(x0, 0): x0 + bw] = 1
                 person_masks.append(m)
             mask_miss, mask_all = build_masks(
-                (h, w), person_masks, [p["num_keypoints"] for p in persons])
+                (h, w), person_masks, [p["num_keypoints"] for p in persons],
+                crowd_masks=crowd_masks)
+            if not mask_extras:
+                mask_miss = np.full((h, w), 255, np.uint8)
             image_rec = {"width": w, "height": h}
             for rec in iter_records(image_rec, img_id, image_index, persons,
                                     "SYNTH", is_validation=False):
@@ -186,13 +244,21 @@ def build_fixture(path: str, num_images: int = 4, img_size: Tuple[int, int]
 def build_val_set(images_dir: str, anno_path: str, num_images: int = 16,
                   img_size: Tuple[int, int] = (240, 320),
                   people_per_image: int = 2, image_size: int = 512,
-                  seed: int = 1, drawn: bool = True) -> int:
+                  seed: int = 1, drawn: bool = True,
+                  crowd: bool = False) -> int:
     """Held-out val set on disk: jpgs + a COCO-format keypoint JSON, the
     exact inputs of ``tools/evaluate.py`` (reference: evaluate.py:585-622
-    reads COCO annotations + an image dir).  Returns the person count.
+    reads COCO annotations + an image dir).  Returns the count of
+    NON-ignored person annotations.
 
     Stored visibility (1=visible, 0=occluded, 2=unlabeled) is re-coded
     back to COCO (2 / 1 / 0) for the annotations file.
+
+    ``crowd=True`` renders the same unannotated-people / crowd-box extras
+    as the training corpus and annotates their regions ``iscrowd=1`` with
+    zero keypoints — COCOeval (and the OKS proxy's ``k1 == 0`` bbox
+    fallback) then IGNORES detections landing there instead of counting
+    false positives, exactly real COCO's treatment of crowds.
     """
     import os
 
@@ -203,26 +269,43 @@ def build_val_set(images_dir: str, anno_path: str, num_images: int = 16,
     h, w = img_size
     images, annotations = [], []
     ann_id = 0
+    n_scored = 0
     for image_index in range(num_images):
         img_id = 1 + image_index
-        img, persons = _synth_image(rng, h, w, people_per_image,
-                                    image_size, drawn)
+        img, persons, crowd_masks = _synth_image(
+            rng, h, w, people_per_image, image_size, drawn, crowd=crowd)
         name = f"{img_id:012d}.jpg"
         cv2.imwrite(os.path.join(images_dir, name), img)
         images.append({"id": img_id, "file_name": name,
                        "width": w, "height": h})
         for p in persons:
+            unannotated = p["num_keypoints"] == 0
             kp = []
             for x, y, v in p["joint"]:
-                coco_v = {1: 2, 0: 1, 2: 0}[int(v)]
-                kp.extend([float(x), float(y), coco_v])
+                coco_v = 0 if unannotated else {1: 2, 0: 1, 2: 0}[int(v)]
+                kp.extend([0.0, 0.0, 0] if unannotated
+                          else [float(x), float(y), coco_v])
             ann_id += 1
+            n_scored += 0 if unannotated else 1
             annotations.append({
                 "id": ann_id, "image_id": img_id, "category_id": 1,
                 "keypoints": kp, "num_keypoints": p["num_keypoints"],
                 "area": float(p["segment_area"]),
-                "bbox": [float(v) for v in p["bbox"]], "iscrowd": 0})
+                "bbox": [float(v) for v in p["bbox"]],
+                "iscrowd": 1 if unannotated else 0})
+        for cm in crowd_masks:
+            from ..config import COCO_PARTS
+
+            ys, xs = np.nonzero(cm)
+            x0, y0 = float(xs.min()), float(ys.min())
+            bw, bh = float(xs.max() - x0 + 1), float(ys.max() - y0 + 1)
+            ann_id += 1
+            annotations.append({
+                "id": ann_id, "image_id": img_id, "category_id": 1,
+                "keypoints": [0.0, 0.0, 0] * len(COCO_PARTS),
+                "num_keypoints": 0, "area": bw * bh,
+                "bbox": [x0, y0, bw, bh], "iscrowd": 1})
     with open(anno_path, "w") as f:
         json.dump({"images": images, "annotations": annotations,
                    "categories": [{"id": 1, "name": "person"}]}, f)
-    return ann_id
+    return n_scored

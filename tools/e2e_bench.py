@@ -142,6 +142,9 @@ def main():
                     help="comma-separated subset of sections to run")
     ap.add_argument("--batch", type=int, default=8,
                     help="chunk size for the compact-batch throughput mode")
+    ap.add_argument("--params-dtype", default="auto",
+                    choices=["auto", "bf16", "fp32"],
+                    help="weight storage; auto = bf16 on TPU, fp32 elsewhere")
     ap.add_argument("--planted", type=int, default=0,
                     help="plant GT-style maps for N synthetic people into "
                          "the model output (realistic decode workload)")
@@ -175,6 +178,11 @@ def main():
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, args.size, args.size, 3)),
                            train=False)
+    from improved_body_parts_tpu.utils.precision import resolve_params_dtype
+
+    # bf16 weight storage on TPU by default (PERF_AUDIT_BF16.json win;
+    # reduced-precision eval matches the reference's AMP-O1, evaluate.py:636)
+    variables = resolve_params_dtype(args.params_dtype, variables)
     if args.planted > 0:
         model = PlantedModel(model, planted_maps(cfg.skeleton, args.planted,
                                                  rng), cfg.skeleton)

@@ -12,7 +12,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def load_predictor(config_name: str, checkpoint: str, bucket: int = 128,
-                   boxsize: int = 0):
+                   boxsize: int = 0, params_dtype: str = "auto"):
     import jax
     import jax.numpy as jnp
 
@@ -24,12 +24,14 @@ def load_predictor(config_name: str, checkpoint: str, bucket: int = 128,
     from improved_body_parts_tpu.infer import Predictor
     from improved_body_parts_tpu.models import build_model
     from improved_body_parts_tpu.train import restore_checkpoint
+    from improved_body_parts_tpu.utils.precision import resolve_params_dtype
 
     cfg = get_config(config_name)
     model = build_model(cfg)
     payload = restore_checkpoint(checkpoint)
-    variables = {"params": payload["params"],
-                 "batch_stats": payload["batch_stats"]}
+    variables = resolve_params_dtype(
+        params_dtype, {"params": payload["params"],
+                       "batch_stats": payload["batch_stats"]})
     model_params = InferenceModelParams(boxsize=boxsize) if boxsize else None
     return Predictor(model, variables, cfg.skeleton, bucket=bucket,
                      model_params=model_params)
@@ -61,6 +63,12 @@ def main():
                          "network input size (the reference's INI "
                          "[models] boxsize, utils/config:37-41); 0 keeps "
                          "the library default")
+    ap.add_argument("--params-dtype", default="auto",
+                    choices=["auto", "bf16", "fp32"],
+                    help="inference weight storage; auto = bf16 on TPU "
+                         "(halves weight HBM traffic, PERF_AUDIT_BF16.json; "
+                         "matches the reference's AMP-O1 eval), fp32 "
+                         "elsewhere")
     ap.add_argument("--oks-proxy", action="store_true",
                     help="evaluate with the dependency-free OKS evaluator "
                          "(COCOeval ignore/crowd/maxDets semantics, "
@@ -82,7 +90,8 @@ def main():
             use_proxy = True
 
     predictor = load_predictor(args.config, args.checkpoint,
-                               boxsize=args.boxsize)
+                               boxsize=args.boxsize,
+                               params_dtype=args.params_dtype)
     if use_proxy:
         metrics = validation_oks(predictor, args.anno, args.images,
                                  max_images=args.max_images,

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Input-pipeline feed rate vs the chip's training consumption rate.
+
+The reference feeds ~40 samples/s per DataLoader worker process
+(reference: README.md:35, data/mydataset.py:42-63) and scales by adding
+workers (train_distributed.py:205-213).  This tool measures OUR pipeline's
+per-process rate on the flagship 512-pixel protocol — both label modes —
+through the REAL feed path (``data.batches`` → ``parallel.device_prefetch``
+→ a device sink), then answers the capacity question SURVEY.md §7f asks:
+how many host worker processes keep one chip (and a v5e-8 slice) fed at
+the audited batch-8 train rate?
+
+Label modes measured:
+- host-GT: the full (image, mask, 50-channel label) synthesis on the host
+  (the reference's protocol);
+- device-GT (``--device-gt`` training): the host ships only
+  (image, masks, padded joints) and the 50-channel tensor is synthesized
+  inside the jitted train step (``ops.make_gt_synthesizer``) — the
+  designed answer for pod-slice feeding, measured here as the host-side
+  cost it actually leaves behind.
+
+Writes one JSON artifact (``--out``, default INPUT_PIPELINE.json).
+
+Note on this container: with a single host core, multi-worker rows
+timeshare one core (ROADMAP documents the same ceiling for the scaling
+tests), so worker counts are projected from the measured per-process rate
+rather than demonstrated; on a real TPU host the same tool reports
+demonstrated rates.
+"""
+import argparse
+import json
+import math
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure_epochs(ds, batch_size, num_workers, raw_gt, mesh, min_seconds,
+                   device_sink=True):
+    """Samples/s through batches() -> device_prefetch -> blocking sink."""
+    from improved_body_parts_tpu.data.dataset import batches
+    from improved_body_parts_tpu.parallel import device_prefetch
+
+    import jax
+
+    n = 0
+    t0 = time.perf_counter()
+    epoch = 0
+    while True:
+        it = batches(ds, batch_size, epoch, num_workers=num_workers,
+                     raw_gt=raw_gt)
+        if device_sink:
+            it = device_prefetch(it, mesh)
+        for batch in it:
+            jax.block_until_ready(batch)
+            n += batch[0].shape[0]
+        epoch += 1
+        dt = time.perf_counter() - t0
+        if dt >= min_seconds:
+            return n / dt, n, dt
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="input pipeline feed-rate benchmark (SURVEY.md 7f)")
+    ap.add_argument("--config", default="canonical",
+                    help="the 512-pixel flagship protocol by default")
+    ap.add_argument("--records", type=int, default=48)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--min-seconds", type=float, default=20.0,
+                    help="measure at least this long per row")
+    ap.add_argument("--workers", default="0,1,2",
+                    help="comma-separated worker counts (0 = synchronous)")
+    ap.add_argument("--max-people", type=int, default=8,
+                    help="joint padding for the device-GT payload")
+    ap.add_argument("--train-rate", type=float, default=0.0,
+                    help="chip train consumption in imgs/s; 0 reads "
+                         "TRAIN_BENCH.json (audited b8 step rate)")
+    ap.add_argument("--out", default="INPUT_PIPELINE.json")
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.utils import apply_platform_env
+    apply_platform_env()
+
+    import jax
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.data import build_fixture
+    from improved_body_parts_tpu.data.dataset import CocoPoseDataset
+    from improved_body_parts_tpu.parallel import make_mesh
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    train_rate = args.train_rate
+    if not train_rate:
+        try:
+            with open(os.path.join(repo, "TRAIN_BENCH.json")) as f:
+                audit = json.load(f)["batches"]
+            # the largest audited train batch (b8: 71.75 imgs/s on-chip)
+            train_rate = max(
+                (float(v["imgs_per_sec"]) for v in audit.values()))
+        except Exception:  # artifact absent — fall back to the known figure
+            train_rate = 71.75
+
+    cfg = get_config(args.config)
+    mesh = make_mesh()
+    size = cfg.skeleton.height
+
+    with tempfile.TemporaryDirectory(prefix="feed_rate_") as work:
+        corpus = os.path.join(work, "corpus.h5")
+        n_rec = build_fixture(corpus, num_images=args.records,
+                              people_per_image=2,
+                              img_size=(size * 3 // 4, size),
+                              image_size=size, seed=0, drawn=True)
+        ds = CocoPoseDataset(corpus, cfg, augment=True)
+        print(f"corpus: {n_rec} records at {size}px; chip rate target "
+              f"{train_rate:.1f} imgs/s", flush=True)
+
+        rows = []
+        for mode, raw_gt in (("host_gt", 0), ("device_gt", args.max_people)):
+            for w in [int(x) for x in args.workers.split(",")]:
+                rate, n, dt = measure_epochs(
+                    ds, args.batch, w, raw_gt, mesh, args.min_seconds)
+                rows.append({"mode": mode, "workers": w,
+                             "samples_per_sec": round(rate, 2),
+                             "samples": n, "seconds": round(dt, 2)})
+                print(f"{mode} workers={w}: {rate:.2f} samples/s "
+                      f"({n} in {dt:.1f}s)", flush=True)
+
+        # capacity projection from the best measured PER-PROCESS rate
+        # (sync row — pool rows on a 1-core host timeshare the same core)
+        per_proc = {m: max(r["samples_per_sec"] for r in rows
+                           if r["mode"] == m and r["workers"] == 0)
+                    for m in ("host_gt", "device_gt")}
+        projection = {
+            m: {"per_process_rate": per_proc[m],
+                "workers_for_one_chip": math.ceil(train_rate / per_proc[m]),
+                "workers_for_v5e8": math.ceil(8 * train_rate / per_proc[m])}
+            for m in per_proc}
+
+        result = {
+            "config": args.config, "image_size": size, "batch": args.batch,
+            "platform": jax.devices()[0].platform,
+            "host_cores": os.cpu_count(),
+            "chip_train_rate_imgs_per_sec": train_rate,
+            "protocol": "data.batches -> parallel.device_prefetch -> "
+                        "block_until_ready sink; drawn fixture corpus; "
+                        "augment on",
+            "rows": rows,
+            "projection": projection,
+        }
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

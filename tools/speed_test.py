@@ -20,9 +20,12 @@ def main():
     ap.add_argument("--size", type=int, default=512)
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--fp32", action="store_true", help="disable bf16 compute")
+    ap.add_argument("--params-dtype", default="auto",
+                    choices=["auto", "bf16", "fp32"],
+                    help="weight storage (halves weight HBM traffic per "
+                         "pass in bf16); auto = bf16 on TPU, fp32 elsewhere")
     ap.add_argument("--bf16-params", action="store_true",
-                    help="store params in bf16 (halves weight HBM traffic "
-                         "per pass; inference only)")
+                    help="deprecated alias for --params-dtype bf16")
     args = ap.parse_args()
 
     import jax
@@ -38,10 +41,17 @@ def main():
     model = build_model(cfg, dtype=jnp.float32 if args.fp32 else None)
     imgs = jnp.zeros((args.batch, args.size, args.size, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), imgs, train=False)
-    if args.bf16_params:
-        from improved_body_parts_tpu.utils import bf16_params
+    from improved_body_parts_tpu.utils.precision import resolve_params_dtype
 
-        variables = bf16_params(variables)
+    if args.bf16_params:
+        params_dtype = "bf16"
+    elif args.params_dtype == "auto" and args.fp32:
+        # --fp32 is the full-precision baseline: don't let auto sneak
+        # bf16 weights under fp32 compute (explicit --params-dtype wins)
+        params_dtype = "fp32"
+    else:
+        params_dtype = args.params_dtype
+    variables = resolve_params_dtype(params_dtype, variables)
 
     @jax.jit
     def forward(variables, imgs):

@@ -112,6 +112,18 @@ def main():
                     help="corpus worker processes for the train CLI; 0 "
                          "(synchronous) is fastest on few-core hosts — "
                          "each spawned worker re-imports the jax stack")
+    ap.add_argument("--crowd", action="store_true",
+                    help="render unannotated people + crowd regions into "
+                         "train AND val (miss-masked in training, "
+                         "iscrowd-ignored in eval) — the end-to-end "
+                         "exercise of the reference's mask_miss semantics")
+    ap.add_argument("--no-miss-mask", action="store_true",
+                    help="ablation for --crowd: identical corpus but with "
+                         "mask_miss forced to all-ones, so training "
+                         "penalizes detections of the unannotated extras")
+    ap.add_argument("--device-gt", type=int, default=0,
+                    help="train with on-device GT synthesis (--device-gt "
+                         "N = max_people padding passed to the train CLI)")
     ap.add_argument("--keep-workdir", action="store_true")
     args = ap.parse_args()
 
@@ -137,12 +149,15 @@ def main():
     corpus = os.path.join(work, "train_drawn.h5")
     n_rec = build_fixture(corpus, num_images=args.train_images,
                           people_per_image=args.people, img_size=canvas,
-                          image_size=net_size, seed=0, drawn=True)
+                          image_size=net_size, seed=0, drawn=True,
+                          crowd=args.crowd,
+                          mask_extras=not args.no_miss_mask)
     val_dir = os.path.join(work, "val")
     anno = os.path.join(work, "person_keypoints_synth.json")
     n_val = build_val_set(val_dir, anno, num_images=args.val_images,
                           people_per_image=args.people, img_size=canvas,
-                          image_size=net_size, seed=12345, drawn=True)
+                          image_size=net_size, seed=12345, drawn=True,
+                          crowd=args.crowd)
     print(f"corpus: {n_rec} records; val: {n_val} persons "
           f"({args.val_images} images)", flush=True)
 
@@ -154,6 +169,8 @@ def main():
                   "--workers", str(args.workers), "--print-freq", "20"]
     if args.lr:
         train_args += ["--lr", str(args.lr)]
+    if args.device_gt:
+        train_args += ["--device-gt", str(args.device_gt)]
     run_cli(train_args)
     # per-epoch losses live in the reference-format append-only epoch log
     with open(os.path.join(ckpt_dir, "log")) as f:
@@ -196,6 +213,8 @@ def main():
         "epochs": epochs, "people_per_image": args.people,
         "lr": args.lr or cfg.train.learning_rate_per_device,
         "canvas": list(canvas), "decode_path": args.decode_path,
+        "crowd": args.crowd, "miss_mask": not args.no_miss_mask,
+        "device_gt": args.device_gt,
         "train_loss_first": float(losses[0]) if losses else None,
         "train_loss_last": float(losses[-1]) if losses else None,
         "ap_trained": ap_trained, "ap_untrained": ap_fresh,
