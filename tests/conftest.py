@@ -24,8 +24,35 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+# Modules whose tests are compile-heavy (measured with --durations=0 on a
+# 1-core host): excluded from the `-m quick` tier so `pytest -m quick`
+# finishes in ~2 minutes there.  Everything not listed (and not marked
+# `slow` or named in _HEAVY_TESTS) is marked `quick` automatically in
+# pytest_collection_modifyitems.
+_HEAVY_MODULES = frozenset({
+    "test_cli_journey.py",      # 340s: full train->resume->evaluate CLI run
+    "test_scaling.py",          # 330s: 5 mesh shapes x compiled train steps
+    "test_synth_ap.py",         # 200s: whole synth_ap orchestration
+    "test_graft_entry.py",      # 190s: dryrun_multichip compiles 2x
+    "test_gt_device.py",        # 125s: device-GT vs host-label train steps
+    "test_oks_and_variants.py", # 116s: every model variant forward
+    "test_learning.py",         # 82s: real overfit run
+})
+# Individually heavy tests inside otherwise-quick modules.
+_HEAVY_TESTS = frozenset({
+    "test_models.py::test_bf16_compute_keeps_fp32_params",
+    "test_training.py::TestTrainStep::test_curriculum_resolution_resume",
+    "test_training.py::TestTrainStep::test_spmd_step_on_8_device_mesh",
+    "test_training.py::TestTrainStep::test_checkpoint_roundtrip",
+    "test_compact.py::test_compact_under_spatial_mesh_matches_plain",
+})
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running integration test")
+    config.addinivalue_line(
+        "markers", "quick: fast tier — `pytest -m quick` stays ~2 min on "
+        "one core (auto-applied; see _HEAVY_MODULES)")
     import jax
 
     # The env assignment above is too late when sitecustomize has already
@@ -41,6 +68,19 @@ def pytest_configure(config):
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-mark the quick tier: every test whose module is not
+    compile-heavy, which is not individually heavy, and which is not
+    explicitly marked ``slow``."""
+    for item in items:
+        path, _, rest = item.nodeid.partition("::")
+        module = path.rsplit("/", 1)[-1]
+        if (module not in _HEAVY_MODULES
+                and f"{module}::{rest}" not in _HEAVY_TESTS
+                and "slow" not in item.keywords):
+            item.add_marker(pytest.mark.quick)
 
 
 @pytest.fixture(scope="session")
