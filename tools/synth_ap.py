@@ -124,6 +124,13 @@ def main():
     ap.add_argument("--device-gt", type=int, default=0,
                     help="train with on-device GT synthesis (--device-gt "
                          "N = max_people padding passed to the train CLI)")
+    ap.add_argument("--train-timeout", type=int, default=0,
+                    help="seconds before the train subprocess is killed; "
+                         "0 = scale with the epoch count (600 s/epoch + "
+                         "1 h slack, floor 2 h) — the old fixed 7200 s "
+                         "silently killed production-shape runs "
+                         "mid-training (synth_deep measures ~320 s/epoch "
+                         "on a contended 1-core host)")
     ap.add_argument("--keep-workdir", action="store_true")
     args = ap.parse_args()
 
@@ -171,7 +178,8 @@ def main():
         train_args += ["--lr", str(args.lr)]
     if args.device_gt:
         train_args += ["--device-gt", str(args.device_gt)]
-    run_cli(train_args)
+    run_cli(train_args,
+            timeout=args.train_timeout or max(7200, 600 * epochs + 3600))
     # per-epoch losses live in the reference-format append-only epoch log
     with open(os.path.join(ckpt_dir, "log")) as f:
         losses = re.findall(r"train_loss: ([0-9.eE+-]+)", f.read())
