@@ -5,12 +5,13 @@ from .dataset import (
     epoch_permutation,
     host_shard,
 )
-from .fixture import build_fixture, build_val_set, draw_person
+from .fixture import (build_coco_train_set, build_fixture,
+                      build_val_set, draw_person)
 from .heatmapper import Heatmapper, OffsetMapper
 from .transformer import AugmentParams, Transformer
 
 __all__ = [
     "CocoPoseDataset", "batches", "convert_joints", "epoch_permutation",
-    "host_shard", "build_fixture", "build_val_set", "draw_person", "Heatmapper", "OffsetMapper", "AugmentParams",
+    "host_shard", "build_fixture", "build_coco_train_set", "build_val_set", "draw_person", "Heatmapper", "OffsetMapper", "AugmentParams",
     "Transformer",
 ]

@@ -13,7 +13,7 @@ from typing import Tuple
 
 import numpy as np
 
-from .hdf5_corpus import write_record
+from .hdf5_corpus import NUM_COCO_PARTS, write_record
 
 # rough upright stick figure in a unit box: (x, y) per COCO part
 _UNIT_POSE = {
@@ -241,33 +241,41 @@ def build_fixture(path: str, num_images: int = 4, img_size: Tuple[int, int]
     return count
 
 
-def build_val_set(images_dir: str, anno_path: str, num_images: int = 16,
-                  img_size: Tuple[int, int] = (240, 320),
-                  people_per_image: int = 2, image_size: int = 512,
-                  seed: int = 1, drawn: bool = True,
-                  crowd: bool = False) -> int:
-    """Held-out val set on disk: jpgs + a COCO-format keypoint JSON, the
-    exact inputs of ``tools/evaluate.py`` (reference: evaluate.py:585-622
-    reads COCO annotations + an image dir).  Returns the count of
-    NON-ignored person annotations.
+def _write_coco_set(images_dir: str, anno_path: str, num_images: int,
+                    img_size: Tuple[int, int], people_per_image: int,
+                    image_size: int, seed: int, drawn: bool, crowd: bool,
+                    train_side: bool) -> int:
+    """Shared emitter behind :func:`build_val_set` /
+    :func:`build_coco_train_set` — one per-image loop so the visibility
+    recode, crowd-bbox extraction and JSON shape cannot drift between the
+    two surfaces.  The only policy differences:
 
-    Stored visibility (1=visible, 0=occluded, 2=unlabeled) is re-coded
-    back to COCO (2 / 1 / 0) for the annotations file.
+    - ``train_side=True`` writes segmentations (cycling polygon →
+      uncompressed RLE → compressed RLE so one corpus build exercises
+      every ``coco_masks`` decode path) and keeps unannotated people as
+      ``iscrowd=0, num_keypoints=0`` — real COCO's shape for people
+      lacking keypoint labels, which the corpus rules route into
+      mask_miss;
+    - ``train_side=False`` (eval side) writes no segmentations and marks
+      unannotated people ``iscrowd=1`` so COCOeval / the OKS proxy
+      IGNORES detections landing there (real COCO crowds' treatment);
+    - crowd-region ``area``: mask pixel count on the train side (real
+      COCO derives crowd area from the RLE) vs bbox area on the eval
+      side (the OKS proxy's ignore radius works from the bbox).
 
-    ``crowd=True`` renders the same unannotated-people / crowd-box extras
-    as the training corpus and annotates their regions ``iscrowd=1`` with
-    zero keypoints — COCOeval (and the OKS proxy's ``k1 == 0`` bbox
-    fallback) then IGNORES detections landing there instead of counting
-    false positives, exactly real COCO's treatment of crowds.
+    Returns the number of annotated (scored) persons.
     """
     import os
 
     import cv2
 
+    from .coco_masks import rle_encode, rle_to_string
+
     os.makedirs(images_dir, exist_ok=True)
     rng = np.random.default_rng(seed)
     h, w = img_size
     images, annotations = [], []
+    encodings = ("polygon", "rle", "crle")
     ann_id = 0
     n_scored = 0
     for image_index in range(num_images):
@@ -287,25 +295,102 @@ def build_val_set(images_dir: str, anno_path: str, num_images: int = 16,
                           else [float(x), float(y), coco_v])
             ann_id += 1
             n_scored += 0 if unannotated else 1
-            annotations.append({
+            ann = {
                 "id": ann_id, "image_id": img_id, "category_id": 1,
                 "keypoints": kp, "num_keypoints": p["num_keypoints"],
                 "area": float(p["segment_area"]),
                 "bbox": [float(v) for v in p["bbox"]],
-                "iscrowd": 1 if unannotated else 0})
+                "iscrowd": (1 if unannotated and not train_side else 0)}
+            if train_side:
+                ann["segmentation"] = _rect_segmentation(
+                    p["bbox"], h, w, encodings[ann_id % len(encodings)])
+            annotations.append(ann)
         for cm in crowd_masks:
-            from ..config import COCO_PARTS
-
             ys, xs = np.nonzero(cm)
             x0, y0 = float(xs.min()), float(ys.min())
             bw, bh = float(xs.max() - x0 + 1), float(ys.max() - y0 + 1)
             ann_id += 1
-            annotations.append({
+            ann = {
                 "id": ann_id, "image_id": img_id, "category_id": 1,
-                "keypoints": [0.0, 0.0, 0] * len(COCO_PARTS),
-                "num_keypoints": 0, "area": bw * bh,
-                "bbox": [x0, y0, bw, bh], "iscrowd": 1})
+                "keypoints": [0.0, 0.0, 0] * NUM_COCO_PARTS,
+                "num_keypoints": 0,
+                "area": float(cm.sum()) if train_side else bw * bh,
+                "bbox": [x0, y0, bw, bh], "iscrowd": 1}
+            if train_side:
+                ann["segmentation"] = {
+                    "size": [h, w], "counts": rle_to_string(rle_encode(cm))}
+            annotations.append(ann)
     with open(anno_path, "w") as f:
         json.dump({"images": images, "annotations": annotations,
                    "categories": [{"id": 1, "name": "person"}]}, f)
     return n_scored
+
+
+def build_val_set(images_dir: str, anno_path: str, num_images: int = 16,
+                  img_size: Tuple[int, int] = (240, 320),
+                  people_per_image: int = 2, image_size: int = 512,
+                  seed: int = 1, drawn: bool = True,
+                  crowd: bool = False) -> int:
+    """Held-out val set on disk: jpgs + a COCO-format keypoint JSON, the
+    exact inputs of ``tools/evaluate.py`` (reference: evaluate.py:585-622
+    reads COCO annotations + an image dir).  Returns the count of
+    NON-ignored person annotations.
+
+    Stored visibility (1=visible, 0=occluded, 2=unlabeled) is re-coded
+    back to COCO (2 / 1 / 0) for the annotations file.
+
+    ``crowd=True`` renders the same unannotated-people / crowd-box extras
+    as the training corpus and annotates their regions ``iscrowd=1`` with
+    zero keypoints — COCOeval (and the OKS proxy's ``k1 == 0`` bbox
+    fallback) then IGNORES detections landing there instead of counting
+    false positives, exactly real COCO's treatment of crowds.
+    """
+    return _write_coco_set(images_dir, anno_path, num_images, img_size,
+                           people_per_image, image_size, seed, drawn, crowd,
+                           train_side=False)
+
+
+def _rect_mask(bbox, h: int, w: int) -> np.ndarray:
+    x0, y0, bw, bh = [int(round(v)) for v in bbox]
+    m = np.zeros((h, w), np.uint8)
+    m[max(y0, 0): y0 + bh, max(x0, 0): x0 + bw] = 1
+    return m
+
+
+def _rect_segmentation(bbox, h: int, w: int, encoding: str):
+    """A rectangle in one of the three COCO segmentation encodings.
+
+    RLE variants encode the exact same pixel set as the fixture's HDF5
+    person masks; the polygon variant covers the rect with ``cv2.fillPoly``
+    inclusive-boundary semantics (see coco_masks.polygons_to_mask).
+    """
+    from .coco_masks import rle_encode, rle_to_string
+
+    if encoding == "polygon":
+        x0, y0, bw, bh = bbox
+        return [[x0, y0, x0 + bw, y0, x0 + bw, y0 + bh, x0, y0 + bh]]
+    counts = rle_encode(_rect_mask(bbox, h, w))
+    if encoding == "rle":
+        return {"size": [h, w], "counts": counts}
+    assert encoding == "crle", encoding
+    return {"size": [h, w], "counts": rle_to_string(counts)}
+
+
+def build_coco_train_set(images_dir: str, anno_path: str,
+                         num_images: int = 8,
+                         img_size: Tuple[int, int] = (240, 320),
+                         people_per_image: int = 2, image_size: int = 512,
+                         seed: int = 0, drawn: bool = True,
+                         crowd: bool = False) -> int:
+    """Synthetic TRAIN-side COCO dataset on disk: jpgs + a
+    person_keypoints JSON **with segmentations** — the exact inputs of
+    ``tools/make_corpus.py`` (reference: data/coco_masks_hdf5.py:304-351
+    reads COCO annotations + an image dir), enabling the full COCO-format
+    journey (JSON+images → HDF5 → train → evaluate) without any COCO
+    download or pycocotools.  See :func:`_write_coco_set` for the
+    train-side annotation policy.  Returns the number of annotated
+    (scored) persons.
+    """
+    return _write_coco_set(images_dir, anno_path, num_images, img_size,
+                           people_per_image, image_size, seed, drawn, crowd,
+                           train_side=True)

@@ -126,7 +126,7 @@ def iter_records(image_rec: Dict, img_id: int, image_index: int,
         "img_height": image_rec["height"],
         "image_id": img_id,
         "annolist_index": image_index,
-        "img_path": "%012d.jpg" % img_id,
+        "img_path": image_rec.get("file_name", "%012d.jpg" % img_id),
     }
     for mi in mains:
         main = persons[mi]
@@ -169,20 +169,44 @@ def write_record(dataset_grp, images_grp, masks_grp, record: Dict, count: int,
     ds.attrs["meta"] = json.dumps(record)
 
 
+def load_coco_annotations(anno_path: str) -> Tuple[Dict, Dict]:
+    """Stdlib parse of a person_keypoints_*.json: (image_id → image rec,
+    image_id → list of person annotations), both in file order.
+
+    Replaces the reference's ``pycocotools.coco.COCO`` index
+    (coco_masks_hdf5.py:306-309) — the builder only ever needs images and
+    per-image person annotations, which a single JSON pass provides.
+    """
+    with open(anno_path) as f:
+        data = json.load(f)
+    person_ids = {c["id"] for c in data.get("categories", [])
+                  if c.get("name") == "person"} or {1}
+    imgs = {im["id"]: im for im in data["images"]}
+    anns: Dict[int, List[Dict]] = {i: [] for i in imgs}
+    for ann in data.get("annotations", []):
+        if ann.get("category_id", 1) in person_ids:
+            anns.setdefault(ann["image_id"], []).append(ann)
+    return imgs, anns
+
+
 def build_coco_corpus(anno_path: str, img_dir: str, out_train: str,
                       out_val: str, image_size: int = 512,
                       val_size: int = 100,
                       limit: Optional[int] = None) -> Tuple[int, int]:
     """Full COCO → HDF5 pipeline (coco_masks_hdf5.py:304-351).
 
-    Requires pycocotools (host-side dependency, SURVEY.md §2.9).
-    Returns (train_count, val_count).
+    Dependency-free: annotations are parsed with the stdlib and
+    segmentation masks decoded by :mod:`.coco_masks` (polygons,
+    uncompressed and compressed RLE), so the whole COCO-format journey
+    runs without pycocotools (which the reference hard-requires,
+    coco_masks_hdf5.py:6).  Returns (train_count, val_count).
     """
     import h5py
-    from pycocotools.coco import COCO
 
-    coco = COCO(anno_path)
-    ids = list(coco.imgs.keys())
+    from .coco_masks import ann_to_mask
+
+    imgs, anns_by_img = load_coco_annotations(anno_path)
+    ids = list(imgs.keys())
     if limit is not None:
         ids = ids[:limit]
 
@@ -193,8 +217,8 @@ def build_coco_corpus(anno_path: str, img_dir: str, out_train: str,
     counts = {tr: 0, va: 0}
 
     for image_index, img_id in enumerate(ids):
-        anns = coco.loadAnns(coco.getAnnIds(imgIds=img_id))
-        image_rec = coco.imgs[img_id]
+        anns = anns_by_img.get(img_id, [])
+        image_rec = imgs[img_id]
         persons = [person_record(a, image_size) for a in anns
                    if a["iscrowd"] == 0]
         is_val = image_index < val_size
@@ -202,11 +226,15 @@ def build_coco_corpus(anno_path: str, img_dir: str, out_train: str,
                                     persons, "COCO", is_val))
         if not records:
             continue
-        img = cv2.imread(os.path.join(img_dir, "%012d.jpg" % img_id))
+        fname = image_rec.get("file_name", "%012d.jpg" % img_id)
+        img = cv2.imread(os.path.join(img_dir, fname))
         if img is None:
-            raise IOError(f"missing image {img_id} in {img_dir}")
-        person_masks = [coco.annToMask(a) for a in anns if a["iscrowd"] == 0]
-        crowd_masks = [coco.annToMask(a) for a in anns if a["iscrowd"] == 1]
+            raise IOError(f"missing image {fname} in {img_dir}")
+        h, w = img.shape[:2]
+        person_masks = [ann_to_mask(a, h, w) for a in anns
+                        if a["iscrowd"] == 0]
+        crowd_masks = [ann_to_mask(a, h, w) for a in anns
+                       if a["iscrowd"] == 1]
         nks = [a["num_keypoints"] for a in anns if a["iscrowd"] == 0]
         mask_miss, mask_all = build_masks(img.shape[:2], person_masks, nks,
                                           crowd_masks)

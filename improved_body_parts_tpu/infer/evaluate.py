@@ -57,12 +57,15 @@ def process_image(predictor: Predictor, image_bgr: np.ndarray,
                 timer.update(time.perf_counter() - t0)
             return results
         except CompactOverflow:
-            # single-scale falls back to the fast path; multi-scale grids
-            # fall through to the full map-transfer protocol below
-            fast = len(params.scale_search) == 1
+            # a trivial grid falls back to the fast path; scale/rotation
+            # grids fall through to the full map-transfer protocol below
+            # (predict_fast rejects non-trivial grids)
+            from .predict import trivial_grid
+
+            fast = trivial_grid(params)
     if fast:
         heat, paf, peak_mask, coord_scale = predictor.predict_fast(
-            image_bgr, thre1=params.thre1)
+            image_bgr, params=params)
         t0 = time.perf_counter()
         results = decode(heat, paf, params, predictor.skeleton,
                          use_native=use_native, peak_mask=peak_mask,

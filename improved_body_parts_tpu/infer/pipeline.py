@@ -73,7 +73,7 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
                 return decode(heat, paf, params, skeleton,
                               use_native=use_native)
             return run_decode(
-                predictor.predict_fast_async(image, thre1=params.thre1))
+                predictor.predict_fast_async(image, params=params))
 
     def run_decode_compact(resolve: Callable, image: np.ndarray):
         return decode_one_compact(resolve(), image)
@@ -158,7 +158,7 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
                     (pool.submit(run_decode_compact, resolve, image), False))
             else:
                 resolve = predictor.predict_fast_async(
-                    image, thre1=params.thre1)
+                    image, params=params)
                 futures.append((pool.submit(run_decode, resolve), False))
             # bound the number of in-flight images; yield the oldest
             yield from drain(window)

@@ -405,11 +405,18 @@ class Predictor:
         evaluate.py:89-90,108-112,139-161 runs the rotation grid through
         cv2 on the host): the valid region is rotated about its centre
         (zero border — the pad region is excluded from sampling and
-        re-filled with pad_value afterwards, because the reference rotates
-        BEFORE padding), the ensemble runs on the rotated image, and the
-        maps are rotated back before the regrid.  The rotation centre
-        replicates the reference's (h/2, w/2)-as-(x, y) argument order
-        (evaluate.py:108 ``rc``), matching :meth:`predict`'s host path.
+        re-filled with pad_value afterwards), the ensemble runs on the
+        rotated image, and the maps are rotated back before the regrid.
+        Documented deviation (PARITY.md): the reference pads FIRST
+        (padRightDownCorner, evaluate.py:~100) and rotates the padded
+        frame about its centre (evaluate.py:108); this repo — both this
+        device lane and :meth:`predict`'s host path, which it matches —
+        rotates the pre-pad valid region about the valid-region centre.
+        Forward and inverse share the centre so maps stay aligned, but
+        content clipped at the border differs from the reference's
+        rotation-grid protocol.  The rotation centre replicates the
+        reference's (h/2, w/2)-as-(x, y) argument order (evaluate.py:108
+        ``rc``).
         """
         key = (shape, valid, grid, angle, "to_grid")
         if key in self._fns:
@@ -544,7 +551,8 @@ class Predictor:
 
     # ------------------------------------------------------------------ #
     def predict_fast(self, image_bgr: np.ndarray,
-                     thre1: Optional[float] = None):
+                     thre1: Optional[float] = None,
+                     params: Optional[InferenceParams] = None):
         """Single-scale fast path: ensemble + upsample + peak NMS all in one
         on-device program; decode happens at network-input resolution and
         coordinates are mapped back by the returned scale.
@@ -558,10 +566,11 @@ class Predictor:
             resolution; multiply decoded (x, y) by (sx, sy) to land in
             original-image coordinates.
         """
-        return self.predict_fast_async(image_bgr, thre1)()
+        return self.predict_fast_async(image_bgr, thre1, params)()
 
     def predict_fast_async(self, image_bgr: np.ndarray,
-                           thre1: Optional[float] = None):
+                           thre1: Optional[float] = None,
+                           params: Optional[InferenceParams] = None):
         """Dispatch the fast-path ensemble for one image and return a
         ``resolve()`` closure instead of blocking on the result.
 
@@ -569,9 +578,12 @@ class Predictor:
         while the host goes on to decode the PREVIOUS image (or prepare the
         next one).  ``resolve()`` blocks on this image's device→host
         transfer and returns exactly what :meth:`predict_fast` returns.
+        ``params`` overrides the predictor's own inference params (scale,
+        thre1 default) — pass the same object the subsequent decode uses.
         Used by ``infer.pipeline.pipelined_inference``.
         """
-        sk, prm, mp = self.skeleton, self.params, self.model_params
+        sk, mp = self.skeleton, self.model_params
+        prm = params or self.params
         if not trivial_grid(prm):
             raise ValueError(
                 "predict_fast requires a single-entry scale/rotation grid "

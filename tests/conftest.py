@@ -31,6 +31,7 @@ import pytest  # noqa: E402
 # pytest_collection_modifyitems.
 _HEAVY_MODULES = frozenset({
     "test_cli_journey.py",      # 340s: full train->resume->evaluate CLI run
+    "test_coco_journey.py",     # COCO JSON->corpus->train->evaluate CLI run
     "test_scaling.py",          # 330s: 5 mesh shapes x compiled train steps
     "test_synth_ap.py",         # 200s: whole synth_ap orchestration
     "test_graft_entry.py",      # 190s: dryrun_multichip compiles 2x
@@ -73,14 +74,41 @@ def pytest_configure(config):
 def pytest_collection_modifyitems(config, items):
     """Auto-mark the quick tier: every test whose module is not
     compile-heavy, which is not individually heavy, and which is not
-    explicitly marked ``slow``."""
+    explicitly marked ``slow``.
+
+    Heavy-list entries are exact strings; a rename/move/parametrization
+    would silently drop a listed test back into the quick tier and blow
+    the ~2-minute budget, so stale entries that matched nothing in a full
+    collection fail loudly here.
+    """
+    seen_modules, seen_tests = set(), set()
     for item in items:
         path, _, rest = item.nodeid.partition("::")
         module = path.rsplit("/", 1)[-1]
+        # parametrized ids ("test_x[case]") still match their listed base
+        base = f"{module}::{rest.partition('[')[0]}"
+        seen_modules.add(module)
+        seen_tests.add(base)
         if (module not in _HEAVY_MODULES
-                and f"{module}::{rest}" not in _HEAVY_TESTS
+                and base not in _HEAVY_TESTS
                 and "slow" not in item.keywords):
             item.add_marker(pytest.mark.quick)
+    # only a full, unfiltered collection can prove an entry stale
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    whole_suite = all(
+        os.path.abspath(a) in (tests_dir, os.path.dirname(tests_dir))
+        for a in config.args)
+    filtered = any(
+        getattr(config.option, opt, None)
+        for opt in ("keyword", "markexpr", "ignore", "ignore_glob",
+                    "deselect", "lf", "last_failed", "ff", "failed_first"))
+    if whole_suite and not filtered:
+        stale = sorted(_HEAVY_MODULES - seen_modules) + sorted(
+            _HEAVY_TESTS - seen_tests)
+        if stale:
+            raise pytest.UsageError(
+                "conftest heavy-tier entries matched no collected test "
+                f"(renamed or removed?): {stale}")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,232 @@
+"""Dependency-free COCO segmentation decode (data/coco_masks.py).
+
+The reference decodes masks with pycocotools (reference:
+data/coco_masks_hdf5.py:6,52-76); these tests pin our NumPy
+implementation of the same encodings — uncompressed RLE, pycocotools'
+compressed-RLE string format, and polygons — plus the corpus builder's
+stdlib annotation parser that replaces ``pycocotools.coco.COCO``.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.data.coco_masks import (
+    ann_to_mask,
+    polygons_to_mask,
+    rle_decode,
+    rle_encode,
+    rle_from_string,
+    rle_to_string,
+)
+
+
+class TestRLE:
+    def test_decode_column_major(self):
+        # 3x3, first column foreground: runs = 0 bg, 3 fg, 6 bg
+        m = rle_decode([0, 3, 6], 3, 3)
+        expected = np.zeros((3, 3), np.uint8)
+        expected[:, 0] = 1
+        np.testing.assert_array_equal(m, expected)
+
+    def test_decode_rejects_bad_total(self):
+        with pytest.raises(ValueError, match="runs sum"):
+            rle_decode([1, 2], 3, 3)
+
+    def test_encode_decode_roundtrip_random(self):
+        rng = np.random.default_rng(0)
+        for _ in range(10):
+            h, w = rng.integers(1, 40, 2)
+            mask = (rng.uniform(size=(h, w)) < 0.3).astype(np.uint8)
+            counts = rle_encode(mask)
+            np.testing.assert_array_equal(rle_decode(counts, h, w), mask)
+
+    def test_encode_leading_foreground(self):
+        mask = np.ones((2, 2), np.uint8)
+        assert rle_encode(mask) == [0, 4]
+
+    def test_string_golden(self):
+        # hand-computed from the pycocotools rleToString algorithm:
+        # 0 -> '0', 3 -> '3', 6 -> '6' (all single-char, no continuation)
+        assert rle_to_string([0, 3, 6]) == "036"
+        assert rle_from_string("036") == [0, 3, 6]
+
+    def test_string_difference_coding(self):
+        # counts[i>=3] are stored as diffs vs counts[i-2]; negative diffs
+        # exercise the sign-extension path (bit 0x10 of the last char)
+        counts = [10, 2, 3, 1, 40, 1]
+        assert rle_from_string(rle_to_string(counts)) == counts
+
+    def test_string_multi_char_values(self):
+        # values >= 16 need continuation chars; > 1024 need three
+        counts = [0, 100000, 7, 31, 32, 1000]
+        total = sum(counts)
+        assert rle_from_string(rle_to_string(counts)) == counts
+        # and the decoded mask is consistent end-to-end
+        h, w = 331, total // 331 + 1
+        pad = h * w - total
+        m = rle_decode(counts + [pad], h, w)
+        assert int(m.sum()) == 100000 + 31 + 1000
+
+    def test_roundtrip_through_string_random_masks(self):
+        rng = np.random.default_rng(7)
+        for _ in range(5):
+            h, w = rng.integers(5, 64, 2)
+            mask = (rng.uniform(size=(h, w)) < rng.uniform(0.05, 0.9))
+            mask = mask.astype(np.uint8)
+            s = rle_to_string(rle_encode(mask))
+            np.testing.assert_array_equal(
+                rle_decode(rle_from_string(s), h, w), mask)
+
+    def test_pycocotools_parity_if_available(self):
+        # byte-for-byte compatibility with the real encoder, when present
+        mu = pytest.importorskip("pycocotools.mask")
+        rng = np.random.default_rng(1)
+        mask = (rng.uniform(size=(23, 31)) < 0.4).astype(np.uint8)
+        ref = mu.encode(np.asfortranarray(mask))
+        assert rle_to_string(rle_encode(mask)) == ref["counts"].decode()
+        np.testing.assert_array_equal(
+            ann_to_mask({"segmentation": ref, "id": 0}, 23, 31), mask)
+
+
+class TestPolygons:
+    def test_rect_polygon(self):
+        m = polygons_to_mask([[2, 1, 6, 1, 6, 4, 2, 4]], 8, 10)
+        # fillPoly includes the boundary: x in [2,6], y in [1,4]
+        expected = np.zeros((8, 10), np.uint8)
+        expected[1:5, 2:7] = 1
+        np.testing.assert_array_equal(m, expected)
+
+    def test_multiple_polygons_merge(self):
+        m = polygons_to_mask([[0, 0, 2, 0, 2, 2, 0, 2],
+                              [5, 5, 7, 5, 7, 7, 5, 7]], 10, 10)
+        assert m[1, 1] == 1 and m[6, 6] == 1 and m[4, 4] == 0
+
+    def test_short_polygons_skipped(self):
+        # degenerate (< 3 point) polygons contribute nothing
+        m = polygons_to_mask([[1, 1, 2, 2]], 5, 5)
+        assert m.sum() == 0
+
+
+class TestAnnToMask:
+    def test_dispatch_all_encodings(self):
+        h, w = 6, 8
+        rect = np.zeros((h, w), np.uint8)
+        rect[1:4, 2:5] = 1
+        counts = rle_encode(rect)
+        by_rle = ann_to_mask(
+            {"segmentation": {"size": [h, w], "counts": counts}}, h, w)
+        by_crle = ann_to_mask(
+            {"segmentation": {"size": [h, w],
+                              "counts": rle_to_string(counts)}}, h, w)
+        np.testing.assert_array_equal(by_rle, rect)
+        np.testing.assert_array_equal(by_crle, rect)
+        by_poly = ann_to_mask(
+            {"segmentation": [[2, 1, 4, 1, 4, 3, 2, 3]]}, h, w)
+        assert by_poly[2, 3] == 1
+
+    def test_missing_segmentation_raises(self):
+        with pytest.raises(ValueError, match="no segmentation"):
+            ann_to_mask({"id": 9}, 4, 4)
+
+    def test_size_mismatch_raises(self):
+        with pytest.raises(ValueError, match="size"):
+            ann_to_mask({"segmentation": {"size": [3, 3],
+                                          "counts": [9]}}, 4, 4)
+
+
+class TestLoadCocoAnnotations:
+    def test_parse_and_order(self, tmp_path):
+        from improved_body_parts_tpu.data.hdf5_corpus import (
+            load_coco_annotations)
+
+        data = {
+            "images": [{"id": 7, "file_name": "a.jpg", "width": 4,
+                        "height": 4},
+                       {"id": 3, "file_name": "b.jpg", "width": 4,
+                        "height": 4}],
+            "annotations": [
+                {"id": 1, "image_id": 3, "category_id": 1, "iscrowd": 0},
+                {"id": 2, "image_id": 7, "category_id": 2, "iscrowd": 0},
+                {"id": 3, "image_id": 7, "category_id": 1, "iscrowd": 1},
+            ],
+            "categories": [{"id": 1, "name": "person"},
+                           {"id": 2, "name": "bicycle"}],
+        }
+        p = tmp_path / "ann.json"
+        p.write_text(json.dumps(data))
+        imgs, anns = load_coco_annotations(str(p))
+        assert list(imgs) == [7, 3]  # file order preserved
+        assert [a["id"] for a in anns[7]] == [3]  # non-person filtered
+        assert [a["id"] for a in anns[3]] == [1]
+
+
+class TestCocoCorpusBuild:
+    """COCO-format JSON+images → HDF5, fully in-image (no pycocotools)."""
+
+    def test_build_corpus_masks_and_records(self, tmp_path):
+        import h5py
+
+        from improved_body_parts_tpu.data import build_coco_train_set
+        from improved_body_parts_tpu.data.hdf5_corpus import (
+            build_coco_corpus, load_coco_annotations)
+
+        img_dir = str(tmp_path / "images")
+        anno = str(tmp_path / "ann.json")
+        n = build_coco_train_set(img_dir, anno, num_images=6,
+                                 img_size=(96, 128), people_per_image=1,
+                                 image_size=128, crowd=True, seed=5)
+        assert n >= 6
+        out_tr, out_va = str(tmp_path / "tr.h5"), str(tmp_path / "va.h5")
+        tr, va = build_coco_corpus(anno, img_dir, out_tr, out_va,
+                                   image_size=128, val_size=1)
+        assert tr > 0 and va > 0
+
+        imgs, anns = load_coco_annotations(anno)
+        with h5py.File(out_tr) as f:
+            assert set(f) == {"dataset", "images", "masks"}
+            key = sorted(f["dataset"])[0]
+            rec = json.loads(f["dataset"][key][()])
+            assert set(rec) == {"image", "joints", "objpos",
+                                "scale_provided"}
+            meta = json.loads(f["dataset"][key].attrs["meta"])
+            img_id = meta["image_id"]
+            mask = f["masks"]["%012d" % img_id][()]
+            assert mask.shape == (96, 128, 2)
+            mask_miss, mask_all = mask[..., 0], mask[..., 1]
+            # every unannotated person / crowd region must be zeroed in
+            # mask_miss and covered by mask_all
+            for a in anns[img_id]:
+                from improved_body_parts_tpu.data.coco_masks import (
+                    ann_to_mask)
+
+                m = ann_to_mask(a, 96, 128).astype(bool)
+                assert (mask_all[m] == 255).all()
+                if a["iscrowd"] or a["num_keypoints"] == 0:
+                    # crowd overlap with annotated people stays unmasked
+                    annotated = np.zeros((96, 128), bool)
+                    for b in anns[img_id]:
+                        if not b["iscrowd"] and b["num_keypoints"] > 0:
+                            annotated |= ann_to_mask(b, 96, 128) > 0
+                    region = m & ~annotated
+                    assert (mask_miss[region] == 0).all()
+                    assert region.any()
+
+    def test_missing_image_raises(self, tmp_path):
+        from improved_body_parts_tpu.data import build_coco_train_set
+        from improved_body_parts_tpu.data.hdf5_corpus import (
+            build_coco_corpus)
+
+        img_dir = str(tmp_path / "images")
+        anno = str(tmp_path / "ann.json")
+        # large enough that the person clears the 32²-area main-person
+        # rule, so the builder actually reaches the image read
+        build_coco_train_set(img_dir, anno, num_images=1,
+                             img_size=(160, 160), people_per_image=1,
+                             image_size=160)
+        import os
+
+        os.remove(os.path.join(img_dir, "000000000001.jpg"))
+        with pytest.raises(IOError, match="missing image"):
+            build_coco_corpus(anno, img_dir, str(tmp_path / "t.h5"),
+                              str(tmp_path / "v.h5"), val_size=0)

@@ -27,6 +27,9 @@ def main():
     ap.add_argument("--bf16-params", action="store_true",
                     help="deprecated alias for --params-dtype bf16")
     args = ap.parse_args()
+    if args.bf16_params and args.params_dtype not in ("auto", "bf16"):
+        ap.error("--bf16-params (deprecated) conflicts with "
+                 f"--params-dtype {args.params_dtype}; drop the alias")
 
     import jax
     import jax.numpy as jnp
