@@ -29,10 +29,24 @@ _UNIT_POSE = {
 
 
 def synthetic_person(rng: np.random.Generator, img_w: int, img_h: int,
-                     image_size: int, all_visible: bool = False):
+                     image_size: int, all_visible: bool = False,
+                     hard: bool = False):
+    """One stick-figure person record.
+
+    ``hard=True`` is the harder benchmark tier (round-5): a wider scale
+    range (0.25–0.85 vs 0.4–0.8 of image height) and a per-person
+    IN-PLANE ROTATION of the whole figure (uniform ±60°) about its
+    centre — beyond the training augmentation's ±40° range
+    (configs.TransformParams.max_rotate_degree), so upright-only
+    inference degrades and the reference's rotation TTA grid
+    (reference: evaluate.py:89-90) has poses where it genuinely pays.
+    The bbox/objpos/scale are recomputed from the rotated joints, the
+    way COCO boxes follow the person, not the canvas.
+    """
     from ..config import COCO_PARTS
 
-    h = rng.uniform(0.4, 0.8) * img_h
+    lo, hi = (0.25, 0.85) if hard else (0.4, 0.8)
+    h = rng.uniform(lo, hi) * img_h
     w = 0.5 * h
     x0 = rng.uniform(0, max(img_w - w, 1))
     y0 = rng.uniform(0, max(img_h - h, 1))
@@ -44,6 +58,27 @@ def synthetic_person(rng: np.random.Generator, img_w: int, img_h: int,
         # stored (internal) visibility: 1 visible, 0 occluded, 2 unlabeled
         joints[i, 2] = 1 if all_visible else rng.choice([0, 1], p=[0.2, 0.8])
     bbox = [x0, y0, w, h]
+    if hard:
+        theta = np.radians(rng.uniform(-60.0, 60.0))
+        c, s = np.cos(theta), np.sin(theta)
+        cx, cy = x0 + w / 2, y0 + h / 2
+        dx, dy = joints[:, 0] - cx, joints[:, 1] - cy
+        joints[:, 0] = cx + c * dx - s * dy
+        joints[:, 1] = cy + s * dx + c * dy
+        # keep the figure on-canvas after rotation; when it cannot fit
+        # (rotated extent wider than the canvas), center it instead —
+        # min(lo, hi) ordering matters, np.clip(0, lo, hi) silently
+        # returns hi when lo > hi
+        for axis, bound in ((0, img_w - 1), (1, img_h - 1)):
+            lo = -joints[:, axis].min()          # shift needed at the low edge
+            hi = bound - joints[:, axis].max()   # headroom at the high edge
+            joints[:, axis] += (lo + hi) / 2 if lo > hi else np.clip(0, lo, hi)
+        margin = 0.05 * h
+        jx0, jy0 = joints[:, 0].min() - margin, joints[:, 1].min() - margin
+        bw = joints[:, 0].max() + margin - jx0
+        bh = joints[:, 1].max() + margin - jy0
+        bbox = [jx0, jy0, bw, bh]
+        x0, y0, w, h = jx0, jy0, bw, bh
     return {
         "objpos": [x0 + w / 2, y0 + h / 2],
         "bbox": bbox,
@@ -144,7 +179,7 @@ def _render_crowd_box(rng: np.random.Generator, img: np.ndarray,
 
 def _synth_image(rng: np.random.Generator, h: int, w: int,
                  people_per_image: int, image_size: int, drawn: bool,
-                 crowd: bool = False):
+                 crowd: bool = False, hard: bool = False):
     """One synthetic image + its person records (shared by the corpus and
     val-set builders so train and eval see the same distribution).
 
@@ -163,13 +198,14 @@ def _synth_image(rng: np.random.Generator, h: int, w: int,
         # low-amplitude noise background so the rendered figures are the
         # dominant signal — this is the LEARNABLE variant
         img = rng.integers(0, 64, (h, w, 3), dtype=np.uint8)
-        persons = [synthetic_person(rng, w, h, image_size, all_visible=True)
+        persons = [synthetic_person(rng, w, h, image_size, all_visible=True,
+                                    hard=hard)
                    for _ in range(people_per_image)]
         for p in persons:
             draw_person(img, p["joint"])
     else:
         img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
-        persons = [synthetic_person(rng, w, h, image_size)
+        persons = [synthetic_person(rng, w, h, image_size, hard=hard)
                    for _ in range(people_per_image)]
     crowd_masks = []
     if crowd:
@@ -190,7 +226,7 @@ def build_fixture(path: str, num_images: int = 4, img_size: Tuple[int, int]
                   = (240, 320), people_per_image: int = 2,
                   image_size: int = 512, seed: int = 0,
                   drawn: bool = False, crowd: bool = False,
-                  mask_extras: bool = True) -> int:
+                  mask_extras: bool = True, hard: bool = False) -> int:
     """Write the fixture; returns the number of records.
 
     ``drawn=True`` renders the stick figures into the images (visible,
@@ -220,7 +256,8 @@ def build_fixture(path: str, num_images: int = 4, img_size: Tuple[int, int]
         for image_index in range(num_images):
             img_id = 1000 + image_index
             img, persons, crowd_masks = _synth_image(
-                rng, h, w, people_per_image, image_size, drawn, crowd=crowd)
+                rng, h, w, people_per_image, image_size, drawn, crowd=crowd,
+                hard=hard)
             person_masks = []
             for p in persons:
                 m = np.zeros((h, w), np.uint8)
@@ -244,7 +281,7 @@ def build_fixture(path: str, num_images: int = 4, img_size: Tuple[int, int]
 def _write_coco_set(images_dir: str, anno_path: str, num_images: int,
                     img_size: Tuple[int, int], people_per_image: int,
                     image_size: int, seed: int, drawn: bool, crowd: bool,
-                    train_side: bool) -> int:
+                    train_side: bool, hard: bool = False) -> int:
     """Shared emitter behind :func:`build_val_set` /
     :func:`build_coco_train_set` — one per-image loop so the visibility
     recode, crowd-bbox extraction and JSON shape cannot drift between the
@@ -281,7 +318,8 @@ def _write_coco_set(images_dir: str, anno_path: str, num_images: int,
     for image_index in range(num_images):
         img_id = 1 + image_index
         img, persons, crowd_masks = _synth_image(
-            rng, h, w, people_per_image, image_size, drawn, crowd=crowd)
+            rng, h, w, people_per_image, image_size, drawn, crowd=crowd,
+            hard=hard)
         name = f"{img_id:012d}.jpg"
         cv2.imwrite(os.path.join(images_dir, name), img)
         images.append({"id": img_id, "file_name": name,
@@ -330,7 +368,7 @@ def build_val_set(images_dir: str, anno_path: str, num_images: int = 16,
                   img_size: Tuple[int, int] = (240, 320),
                   people_per_image: int = 2, image_size: int = 512,
                   seed: int = 1, drawn: bool = True,
-                  crowd: bool = False) -> int:
+                  crowd: bool = False, hard: bool = False) -> int:
     """Held-out val set on disk: jpgs + a COCO-format keypoint JSON, the
     exact inputs of ``tools/evaluate.py`` (reference: evaluate.py:585-622
     reads COCO annotations + an image dir).  Returns the count of
@@ -347,7 +385,7 @@ def build_val_set(images_dir: str, anno_path: str, num_images: int = 16,
     """
     return _write_coco_set(images_dir, anno_path, num_images, img_size,
                            people_per_image, image_size, seed, drawn, crowd,
-                           train_side=False)
+                           train_side=False, hard=hard)
 
 
 def _rect_mask(bbox, h: int, w: int) -> np.ndarray:
@@ -381,7 +419,7 @@ def build_coco_train_set(images_dir: str, anno_path: str,
                          img_size: Tuple[int, int] = (240, 320),
                          people_per_image: int = 2, image_size: int = 512,
                          seed: int = 0, drawn: bool = True,
-                         crowd: bool = False) -> int:
+                         crowd: bool = False, hard: bool = False) -> int:
     """Synthetic TRAIN-side COCO dataset on disk: jpgs + a
     person_keypoints JSON **with segmentations** — the exact inputs of
     ``tools/make_corpus.py`` (reference: data/coco_masks_hdf5.py:304-351
@@ -393,4 +431,4 @@ def build_coco_train_set(images_dir: str, anno_path: str,
     """
     return _write_coco_set(images_dir, anno_path, num_images, img_size,
                            people_per_image, image_size, seed, drawn, crowd,
-                           train_side=True)
+                           train_side=True, hard=hard)

@@ -1,4 +1,5 @@
 from .mesh import (
+    barrier,
     batch_sharding,
     batch_spec,
     initialize_distributed,
@@ -9,6 +10,6 @@ from .mesh import (
 from .prefetch import device_prefetch
 
 __all__ = [
-    "batch_sharding", "batch_spec", "device_prefetch",
+    "barrier", "batch_sharding", "batch_spec", "device_prefetch",
     "initialize_distributed", "make_mesh", "replicated", "shard_batch",
 ]

@@ -32,6 +32,35 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                                    process_id=process_id)
 
 
+def barrier(name: str, timeout_ms: int = 600_000) -> None:
+    """Align all processes at a named coordination-service barrier.
+
+    The transport contexts behind the first XLA collective (Gloo pairs on
+    CPU; ICI bring-up on TPU slices) have a short fixed rendezvous window
+    (~30 s for Gloo's key-value wait), while hosts can legitimately drift
+    minutes apart during per-host work — imports, corpus open, parameter
+    init, compilation.  A rank that reaches the collective early times
+    out waiting for the stragglers and takes the job down (observed:
+    ``Gloo context initialization failed: GetKeyValue() timed out``).
+    The coordination service's barrier has a long, configurable timeout,
+    so re-aligning here lets the collective's own rendezvous start from
+    zero skew.  No-op in single-process runs; best-effort if the client
+    API is unavailable (the collective then simply keeps its own window).
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    try:
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is not None:
+            client.wait_at_barrier(name, timeout_ms)
+    except Exception:
+        pass
+
+
 def make_mesh(data: Optional[int] = None, model: int = 1,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build a ('data', 'model') mesh over available devices.

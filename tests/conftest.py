@@ -103,8 +103,18 @@ def pytest_collection_modifyitems(config, items):
         for opt in ("keyword", "markexpr", "ignore", "ignore_glob",
                     "deselect", "lf", "last_failed", "ff", "failed_first"))
     if whole_suite and not filtered:
-        stale = sorted(_HEAVY_MODULES - seen_modules) + sorted(
-            _HEAVY_TESTS - seen_tests)
+        # a module FILE that exists but collected zero items had a
+        # COLLECTION ERROR — let pytest report that, don't misdiagnose it
+        # as stale; a missing file, or an uncollected test inside a
+        # collected module, IS stale (renamed/deleted)
+        def _module_errored(module):
+            return (module not in seen_modules
+                    and os.path.exists(os.path.join(tests_dir, module)))
+
+        stale = [m for m in sorted(_HEAVY_MODULES - seen_modules)
+                 if not _module_errored(m)]
+        stale += [t for t in sorted(_HEAVY_TESTS - seen_tests)
+                  if not _module_errored(t.partition("::")[0])]
         if stale:
             raise pytest.UsageError(
                 "conftest heavy-tier entries matched no collected test "

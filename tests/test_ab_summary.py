@@ -1,0 +1,65 @@
+"""tools/ab_summary.py: aggregation + honest-labeling rules."""
+import json
+import subprocess
+import sys
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(d, name, **kw):
+    with open(os.path.join(d, name), "w") as f:
+        json.dump(kw, f)
+
+
+def _run(tmp_path):
+    out = str(tmp_path / "AB.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ab_summary.py"),
+         "--dir", str(tmp_path), "--out", out],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    return json.load(open(out))
+
+
+def test_neutral_when_delta_within_spread(tmp_path):
+    d = str(tmp_path)
+    # base arm spread 0.02, inconsistent-sign SWA deltas -> neutral
+    _write(d, "SYNTH_AP_DEEP_S1.json", ap_trained=0.90)
+    _write(d, "SYNTH_AP_DEEP_S2.json", ap_trained=0.92)
+    _write(d, "SYNTH_AP_DEEP_SWA_S1.json", ap_swa=0.905)
+    _write(d, "SYNTH_AP_DEEP_SWA_S2.json", ap_swa=0.915)
+    s = _run(tmp_path)["swa_vs_base"]
+    assert s["seeds"] == [1, 2]
+    assert "neutral" in s["verdict"]
+
+
+def test_win_when_delta_exceeds_spread(tmp_path):
+    d = str(tmp_path)
+    _write(d, "SYNTH_AP_DEEP_S1.json", ap_trained=0.90)
+    _write(d, "SYNTH_AP_DEEP_S2.json", ap_trained=0.91)
+    _write(d, "SYNTH_AP_DEEP_DEVICEGT_S1.json", ap_trained=0.95)
+    _write(d, "SYNTH_AP_DEEP_DEVICEGT_S2.json", ap_trained=0.96)
+    s = _run(tmp_path)["devgt_vs_hostgt"]
+    assert s["verdict"] == "device_gt wins"
+    assert s["mean_delta"] == 0.05
+
+
+def test_consistent_small_delta_still_wins(tmp_path):
+    d = str(tmp_path)
+    # noisy arms (spread 0.04) but the PAIRED delta is sign-consistent:
+    # pairing removes the seed-level noise, so it counts
+    _write(d, "SYNTH_AP_CROWD_S1.json", ap_trained=0.60)
+    _write(d, "SYNTH_AP_CROWD_S2.json", ap_trained=0.64)
+    _write(d, "SYNTH_AP_CROWD_UNMASKED_S1.json", ap_trained=0.59)
+    _write(d, "SYNTH_AP_CROWD_UNMASKED_S2.json", ap_trained=0.63)
+    s = _run(tmp_path)["crowd_masked_vs_ablated"]
+    assert s["delta_sign_consistent"]
+    assert s["verdict"] == "masked wins"
+
+
+def test_missing_arm_reports_note(tmp_path):
+    d = str(tmp_path)
+    _write(d, "SYNTH_AP_DEEP_S1.json", ap_trained=0.9)
+    s = _run(tmp_path)["swa_vs_base"]
+    assert "no common seeds" in s["note"]

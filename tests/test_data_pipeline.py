@@ -305,3 +305,78 @@ class TestDrawnFixture:
         # the ~7.7% drawn area differs (measured 2.6% edge noise today).
         diff = np.abs(a.astype(int) - b_flip.astype(int)).max(axis=2) > 30
         assert diff.mean() < 0.04, diff.mean()
+
+
+class TestHardFixture:
+    """The round-5 harder benchmark tier: rotated figures, wider scales."""
+
+    def test_hard_persons_are_rotated_and_in_bounds(self):
+        from improved_body_parts_tpu.config import COCO_PARTS
+        from improved_body_parts_tpu.data.fixture import synthetic_person
+
+        rng = np.random.default_rng(0)
+        nose, lank = COCO_PARTS.index("nose"), COCO_PARTS.index("Lank")
+        angles = []
+        for _ in range(40):
+            p = synthetic_person(rng, 320, 240, 256, all_visible=True,
+                                 hard=True)
+            j = p["joint"]
+            assert (j[:, 0] >= -1).all() and (j[:, 0] <= 320).all()
+            assert (j[:, 1] >= -1).all() and (j[:, 1] <= 240).all()
+            x0, y0, bw, bh = p["bbox"]
+            assert (j[:, 0] >= x0 - 1e-6).all()
+            assert (j[:, 0] <= x0 + bw + 1e-6).all()
+            assert (j[:, 1] >= y0 - 1e-6).all()
+            assert (j[:, 1] <= y0 + bh + 1e-6).all()
+            # body-axis angle vs upright (nose->left ankle)
+            dx, dy = j[lank, 0] - j[nose, 0], j[lank, 1] - j[nose, 1]
+            angles.append(np.degrees(np.arctan2(dx, dy)))
+        angles = np.abs(np.asarray(angles))
+        # rotations up to +-60 deg must actually occur...
+        assert angles.max() > 30, angles.max()
+        # ...and the tier is a mix, not all extreme
+        assert np.median(angles) < 50
+
+    def test_hard_portrait_canvas_overflow_is_symmetric(self):
+        # a rotated figure can be wider than a narrow portrait canvas; it
+        # must then be CENTERED (symmetric overflow), not dumped 60+ px
+        # off one edge (np.clip(0, lo, hi) returns hi when lo > hi)
+        from improved_body_parts_tpu.data.fixture import synthetic_person
+
+        rng = np.random.default_rng(3)
+        for _ in range(300):
+            p = synthetic_person(rng, 256, 512, 256, all_visible=True,
+                                 hard=True)
+            j = p["joint"]
+            left, right = -j[:, 0].min(), j[:, 0].max() - 255
+            if left > 0 or right > 0:  # overflow -> must be balanced
+                assert abs(left - right) <= 1.0, (left, right)
+
+    def test_upright_tier_unchanged(self):
+        from improved_body_parts_tpu.config import COCO_PARTS
+        from improved_body_parts_tpu.data.fixture import synthetic_person
+
+        rng = np.random.default_rng(1)
+        nose, lank = COCO_PARTS.index("nose"), COCO_PARTS.index("Lank")
+        for _ in range(10):
+            p = synthetic_person(rng, 320, 240, 256, all_visible=True)
+            j = p["joint"]
+            dx, dy = j[lank, 0] - j[nose, 0], j[lank, 1] - j[nose, 1]
+            assert abs(np.degrees(np.arctan2(dx, dy))) < 20
+
+    def test_hard_fixture_and_val_set_build(self, tmp_path):
+        import json as _json
+
+        from improved_body_parts_tpu.data import build_fixture, build_val_set
+
+        n = build_fixture(str(tmp_path / "hard.h5"), num_images=3,
+                          img_size=(192, 256), people_per_image=3,
+                          image_size=256, seed=4, drawn=True, hard=True)
+        assert n > 0
+        n_val = build_val_set(str(tmp_path / "val"),
+                              str(tmp_path / "ann.json"), num_images=2,
+                              img_size=(192, 256), people_per_image=3,
+                              image_size=256, seed=5, hard=True)
+        assert n_val > 0
+        anns = _json.load(open(tmp_path / "ann.json"))["annotations"]
+        assert all(len(a["keypoints"]) == 51 for a in anns)

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""Multi-process distributed training drive at the flagship shape.
+
+Runs the REAL multi-process path — ``tools/train.py --coordinator
+--num-processes 2`` (jax.distributed over Gloo on CPU; the same code path
+brings up TPU pods over DCN) — on the synth_deep production-architecture
+config, exercises a CROSS-PROCESS checkpoint/resume boundary, and pins
+per-epoch loss parity against a single-process run on the same data
+(reference: train_distributed.py:69-84 NCCL bring-up; :149-197 resume;
+parity is how the reference validated its DDP path).
+
+Why parity is exact up to float tolerance: the host shard is strided
+(data/dataset.py ``host_shard``: process p takes perm[p::P]), so step k's
+GLOBAL batch in a P-process run is the same SAMPLE SET as step k of a
+single-process run over a P-device mesh, and augmentation is
+(seed, epoch, index)-keyed — order within the batch differs, but the
+mean loss and batch-wide BN statistics are order-invariant.
+
+    python tools/dist_drive.py --out DIST_DRIVE.json
+"""
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_train(h5, val_h5, ckpt_dir, epochs, env_extra, extra_args=(),
+              timeout=3600, log_path=None, config="synth_deep"):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu"})
+    env.update(env_extra)
+    args = [sys.executable, os.path.join(REPO, "tools", "train.py"),
+            "--config", config, "--train-h5", h5, "--val-h5", val_h5,
+            "--checkpoint-dir", ckpt_dir, "--epochs", str(epochs),
+            "--workers", "0", "--print-freq", "1"] + list(extra_args)
+    proc = subprocess.run(args, capture_output=True, text=True, env=env,
+                          timeout=timeout)
+    if log_path:
+        with open(log_path, "w") as f:
+            f.write(proc.stdout + "\n--- stderr ---\n" + proc.stderr)
+    if proc.returncode != 0:
+        raise RuntimeError(f"train rc={proc.returncode}\n"
+                           f"{proc.stdout[-1500:]}\n{proc.stderr[-1500:]}")
+    return proc
+
+
+def epoch_losses(ckpt_dir):
+    """Epoch → loss from the append-only log, LAST occurrence winning —
+    a retried/relaunched run may append a duplicate epoch line."""
+    with open(os.path.join(ckpt_dir, "log")) as f:
+        entries = re.findall(r"Epoch (\d+)\ttrain_loss: ([0-9.eE+-]+)",
+                             f.read())
+    by_epoch = {int(e): float(v) for e, v in entries}
+    return [by_epoch[e] for e in sorted(by_epoch)]
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", default="synth_deep",
+                    help="synth_deep = the flagship-shape drive; tiny for "
+                         "a fast protocol smoke")
+    ap.add_argument("--images", type=int, default=16)
+    ap.add_argument("--epochs", type=int, default=3,
+                    help="total epochs; the 2-process run restarts from a "
+                         "checkpoint after epoch --resume-after")
+    ap.add_argument("--resume-after", type=int, default=2)
+    ap.add_argument("--port", type=int, default=12897)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--out", default="DIST_DRIVE.json")
+    ap.add_argument("--tolerance", type=float, default=0.02,
+                    help="max relative per-epoch loss difference")
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from improved_body_parts_tpu.data import build_fixture
+
+    work = os.path.abspath(args.workdir
+                           or tempfile.mkdtemp(prefix="dist_drive_"))
+    os.makedirs(work, exist_ok=True)
+    h5 = os.path.join(work, "corpus.h5")
+    n_rec = build_fixture(h5, num_images=args.images, people_per_image=2,
+                          img_size=(384, 512), image_size=256, seed=0,
+                          drawn=True)
+    # a val corpus too: per-epoch eval is a COLLECTIVE in multi-process
+    # runs (every host must enter it), so the drive exercises that path
+    val_h5 = os.path.join(work, "val_corpus.h5")
+    build_fixture(val_h5, num_images=max(args.images // 4, 2),
+                  people_per_image=2, img_size=(384, 512), image_size=256,
+                  seed=99, drawn=True)
+    print(f"corpus: {n_rec} records", flush=True)
+
+    # --- phase A: single process, 2-device mesh (the parity arm) --------
+    ckpt_a = os.path.join(work, "ckpt_single")
+    t0 = time.time()
+    run_train(h5, val_h5, ckpt_a, args.epochs,
+              {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+              log_path=os.path.join(work, "single.log"),
+              config=args.config)
+    t_single = time.time() - t0
+    losses_a = epoch_losses(ckpt_a)
+    print(f"single-process losses: {losses_a} ({t_single:.0f}s)", flush=True)
+
+    # --- phase B: 2 processes, 1 device each, with a cross-process
+    # checkpoint/resume boundary after --resume-after epochs -------------
+    ckpt_b = os.path.join(work, "ckpt_dist")
+    coord = f"127.0.0.1:{args.port}"
+    env1 = {"XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+
+    def _latest_epoch():
+        import glob as g
+        eps = []
+        for p in g.glob(os.path.join(ckpt_b, "epoch_*")):
+            m = re.search(r"epoch_(\d+)$", p)
+            if m:
+                eps.append(int(m.group(1)))
+        return max(eps) if eps else -1
+
+    def launch_pair(end_epoch, resume, attempt=0):
+        if resume:
+            # --epochs is ADDITIONAL after a resume (fit runs
+            # range(start_epoch, start_epoch + epochs)); compute the
+            # remainder from the latest checkpoint so a retry after a
+            # partial run stays idempotent
+            additional = end_epoch - (_latest_epoch() + 1)
+            if additional <= 0:
+                return
+        else:
+            additional = end_epoch
+        procs = []
+        for pid in (0, 1):
+            env = dict(os.environ)
+            env.update({"JAX_PLATFORMS": "cpu"})
+            env.update(env1)
+            extra = ["--coordinator", coord, "--num-processes", "2",
+                     "--process-id", str(pid)]
+            if resume:
+                extra += ["--resume", "auto"]
+            cmd = [sys.executable, os.path.join(REPO, "tools", "train.py"),
+                   "--config", args.config, "--train-h5", h5,
+                   "--val-h5", val_h5,
+                   "--checkpoint-dir", ckpt_b, "--epochs", str(additional),
+                   "--workers", "0", "--print-freq", "1"] + extra
+            log = open(os.path.join(work, f"dist_rank{pid}"
+                       f"{'_resumed' if resume else ''}.log"), "w")
+            procs.append((subprocess.Popen(cmd, stdout=log, stderr=log,
+                                           env=env), log))
+        rcs = []
+        try:
+            for p, log in procs:
+                rcs.append(p.wait(timeout=3600))
+        except subprocess.TimeoutExpired:
+            # a wedged rank must not orphan its peer: both keep the
+            # coordinator port bound and poison the retry
+            for p, _ in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            rcs = [p.returncode for p, _ in procs]
+        finally:
+            for _, log in procs:
+                log.close()
+        if any(rc != 0 for rc in rcs) and attempt == 0:
+            # Gloo's context bring-up has a fixed ~30 s window; on a
+            # contended host the ranks can drift past it (compiles are
+            # per-process).  One retry with a warm compile cache keeps
+            # the ranks aligned.
+            print(f"rank failure rcs={rcs}; retrying once with a warm "
+                  "cache", flush=True)
+            return launch_pair(end_epoch, resume, attempt=1)
+        assert all(rc == 0 for rc in rcs), (
+            f"distributed ranks failed rcs={rcs}; see {work}/dist_rank*.log")
+
+    t0 = time.time()
+    launch_pair(args.resume_after, resume=False)
+    print(f"2-process epochs 0..{args.resume_after - 1} done", flush=True)
+    # the resume boundary: a fresh pair of processes picks up the
+    # checkpoint both ranks agreed on
+    launch_pair(args.epochs, resume=True)
+    t_dist = time.time() - t0
+    losses_b = epoch_losses(ckpt_b)
+    print(f"2-process losses:      {losses_b} ({t_dist:.0f}s)", flush=True)
+
+    assert len(losses_a) == len(losses_b) == args.epochs, (
+        losses_a, losses_b)
+    rel = [abs(a - b) / max(abs(a), 1e-9)
+           for a, b in zip(losses_a, losses_b)]
+    parity_ok = max(rel) <= args.tolerance
+    result = {
+        "config": args.config,
+        "records": n_rec,
+        "epochs": args.epochs,
+        "resume_boundary_after_epoch": args.resume_after,
+        "single_process_losses": losses_a,
+        "two_process_losses": losses_b,
+        "relative_diff_per_epoch": [round(r, 5) for r in rel],
+        "tolerance": args.tolerance,
+        "parity_ok": bool(parity_ok),
+        "seconds": {"single": round(t_single, 1),
+                    "two_process": round(t_dist, 1)},
+        "protocol": "phase A: 1 process x 2 virtual CPU devices; phase B: "
+                    "2 processes x 1 device over jax.distributed (Gloo), "
+                    "restarted from the shared checkpoint after epoch "
+                    f"{args.resume_after}; strided host shards make each "
+                    "step's global batch the same sample set in both "
+                    "phases (see module docstring)",
+        "per_process_logs": sorted(
+            os.path.basename(p) for p in os.listdir(work)
+            if p.endswith(".log")),
+        "workdir": work,
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    if not parity_ok:
+        raise SystemExit(f"loss parity exceeded tolerance: {rel}")
+
+
+if __name__ == "__main__":
+    main()

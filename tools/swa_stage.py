@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""SWA fine-tuning stage on a synth_ap workdir, with before/after AP.
+
+Continues a completed ``tools/synth_ap.py --keep-workdir`` run through
+the reference's SWA protocol — cyclic LR, frozen BN, averaged-swap
+checkpoints (reference: train_distributed_SWA.py) — then evaluates the
+averaged weights on the SAME held-out val set and writes one artifact
+with ap_base / ap_swa / delta.  This is the committed pipeline behind
+the SYNTH_AP_DEEP_SWA_S<seed>.json artifacts that tools/ab_summary.py
+aggregates.
+
+    python tools/synth_ap.py --config synth_deep --seed 1 ... \
+        --workdir WORK --keep-workdir --out SYNTH_AP_DEEP_S1.json
+    python tools/swa_stage.py --workdir WORK --base SYNTH_AP_DEEP_S1.json \
+        --out SYNTH_AP_DEEP_SWA_S1.json
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+# one parser/runner for the train/evaluate CLI output format, shared with
+# the base-run orchestrator
+from synth_ap import parse_ap, run_cli  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", required=True,
+                    help="a synth_ap --keep-workdir directory (train_drawn"
+                         ".h5, ckpt/, val/, person_keypoints_synth.json)")
+    ap.add_argument("--config", default="synth_deep")
+    ap.add_argument("--base", default=None,
+                    help="the base run's artifact JSON; its ap_trained "
+                         "becomes ap_base in the output")
+    ap.add_argument("--epochs", type=int, default=5,
+                    help="ADDITIONAL SWA epochs (one --swa-freq cycle by "
+                         "default)")
+    ap.add_argument("--swa-freq", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--boxsize", type=int, default=0,
+                    help="0 = the config's input height (synth protocol)")
+    ap.add_argument("--out", default="SYNTH_AP_SWA.json")
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    work = os.path.abspath(args.workdir)
+    ckpt_dir = os.path.join(work, "ckpt")
+    anno = os.path.join(work, "person_keypoints_synth.json")
+    val_dir = os.path.join(work, "val")
+    h5 = os.path.join(work, "train_drawn.h5")
+    for path in (ckpt_dir, anno, val_dir, h5):
+        assert os.path.exists(path), f"not a synth_ap workdir: {path} missing"
+
+    if not args.boxsize:
+        from improved_body_parts_tpu.config import get_config
+
+        args.boxsize = get_config(args.config).skeleton.height
+
+    print(f"SWA stage: +{args.epochs} epochs on {ckpt_dir}", flush=True)
+    run_cli([os.path.join(REPO, "tools", "train.py"), "--config",
+             args.config, "--swa", "--resume", "auto",
+             "--epochs", str(args.epochs), "--swa-freq", str(args.swa_freq),
+             "--train-h5", h5, "--checkpoint-dir", ckpt_dir,
+             "--workers", "0", "--seed", str(args.seed)], timeout=21600)
+
+    from improved_body_parts_tpu.train.checkpoint import latest_checkpoint
+
+    latest = latest_checkpoint(ckpt_dir)
+    assert latest, f"no checkpoint under {ckpt_dir} after the SWA stage"
+    print(f"evaluating SWA checkpoint {latest}", flush=True)
+    out = run_cli([os.path.join(REPO, "tools", "evaluate.py"), "--config",
+                   args.config, "--checkpoint", latest, "--anno", anno,
+                   "--images", val_dir, "--boxsize", str(args.boxsize),
+                   "--compact", "--oks-proxy", "--dump-name", "swa"],
+                  cwd=work)
+    ap_swa = parse_ap(out)
+
+    result = {"config": args.config, "seed": args.seed,
+              "swa_epochs": args.epochs, "swa_freq": args.swa_freq,
+              "ap_swa": ap_swa, "checkpoint": latest,
+              "protocol": "tools/train.py --swa --resume auto (cyclic LR "
+                          "1e-5->1e-6, frozen BN, averaged swap) -> "
+                          "tools/evaluate.py --compact --oks-proxy on the "
+                          "workdir's held-out val"}
+    if args.base:
+        with open(args.base) as f:
+            base = json.load(f)
+        result["ap_base"] = base["ap_trained"]
+        result["base_artifact"] = os.path.basename(args.base)
+        result["swa_delta"] = round(ap_swa - base["ap_trained"], 6)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -131,6 +131,20 @@ def main():
                          "silently killed production-shape runs "
                          "mid-training (synth_deep measures ~320 s/epoch "
                          "on a contended 1-core host)")
+    ap.add_argument("--hard", action="store_true",
+                    help="harder corpus tier: wider scale range and "
+                         "per-person rotations up to +-60 deg (beyond "
+                         "the +-40 training augmentation) in train AND "
+                         "val -- the benchmark arm where rotation TTA "
+                         "should pay (reference: evaluate.py:89-90)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="replication seed: varies the train corpus AND "
+                         "the train CLI's init/data seed; the val set "
+                         "stays fixed (--val-seed) so seeds are compared "
+                         "on identical held-out data")
+    ap.add_argument("--val-seed", type=int, default=12345,
+                    help="val-set seed (use 777 with --val-images 64 for "
+                         "the big-val protocol of SYNTH_AP_DEEP_BIGVAL)")
     ap.add_argument("--keep-workdir", action="store_true")
     args = ap.parse_args()
 
@@ -156,15 +170,15 @@ def main():
     corpus = os.path.join(work, "train_drawn.h5")
     n_rec = build_fixture(corpus, num_images=args.train_images,
                           people_per_image=args.people, img_size=canvas,
-                          image_size=net_size, seed=0, drawn=True,
-                          crowd=args.crowd,
+                          image_size=net_size, seed=args.seed, drawn=True,
+                          crowd=args.crowd, hard=args.hard,
                           mask_extras=not args.no_miss_mask)
     val_dir = os.path.join(work, "val")
     anno = os.path.join(work, "person_keypoints_synth.json")
     n_val = build_val_set(val_dir, anno, num_images=args.val_images,
                           people_per_image=args.people, img_size=canvas,
-                          image_size=net_size, seed=12345, drawn=True,
-                          crowd=args.crowd)
+                          image_size=net_size, seed=args.val_seed, drawn=True,
+                          crowd=args.crowd, hard=args.hard)
     print(f"corpus: {n_rec} records; val: {n_val} persons "
           f"({args.val_images} images)", flush=True)
 
@@ -173,7 +187,8 @@ def main():
     train_args = [os.path.join(REPO, "tools", "train.py"),
                   "--config", args.config, "--epochs", str(epochs),
                   "--train-h5", corpus, "--checkpoint-dir", ckpt_dir,
-                  "--workers", str(args.workers), "--print-freq", "20"]
+                  "--workers", str(args.workers), "--print-freq", "20",
+                  "--seed", str(args.seed)]
     if args.lr:
         train_args += ["--lr", str(args.lr)]
     if args.device_gt:
@@ -223,8 +238,10 @@ def main():
         "canvas": list(canvas), "decode_path": args.decode_path,
         "crowd": args.crowd, "miss_mask": not args.no_miss_mask,
         "device_gt": args.device_gt,
+        "seed": args.seed, "val_seed": args.val_seed, "hard": args.hard,
         "train_loss_first": float(losses[0]) if losses else None,
         "train_loss_last": float(losses[-1]) if losses else None,
+        "train_loss_curve": [float(v) for v in losses],
         "ap_trained": ap_trained, "ap_untrained": ap_fresh,
         "protocol": "drawn-person fixture; held-out val (different seed); "
                     "OKS-proxy evaluator (APCHECK.md); real train/evaluate "

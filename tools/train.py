@@ -55,6 +55,10 @@ def main():
                          "epoch under <checkpoint_dir>/overlays (the "
                          "reference's show_image debug display, "
                          "train.py:188-200)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="seed for parameter init and the data-pipeline "
+                         "RNG ((seed, epoch, index) scheme) — vary for "
+                         "seed-replicated runs")
     # multi-host (jax.distributed)
     ap.add_argument("--coordinator", default=None)
     ap.add_argument("--num-processes", type=int, default=1)
@@ -71,7 +75,7 @@ def main():
     from improved_body_parts_tpu.data import CocoPoseDataset, batches
     from improved_body_parts_tpu.models import build_model
     from improved_body_parts_tpu.parallel import (
-        initialize_distributed, make_mesh, replicated)
+        barrier, initialize_distributed, make_mesh, replicated)
     from improved_body_parts_tpu.train import (
         create_train_state, cyclic_swa_schedule, fit, latest_checkpoint,
         make_eval_step, make_optimizer, make_train_step, restore_checkpoint,
@@ -98,7 +102,7 @@ def main():
 
     train_h5 = args.train_h5 or cfg.train.hdf5_train_data
     val_h5 = args.val_h5 or cfg.train.hdf5_val_data
-    ds = CocoPoseDataset(train_h5, cfg, augment=True)
+    ds = CocoPoseDataset(train_h5, cfg, augment=True, seed=args.seed)
     if args.num_processes > 1 and val_h5 and not os.path.exists(val_h5):
         # eval is a collective: a host silently skipping it while others
         # enter eval_epoch leaves the job in mismatched collectives forever
@@ -140,8 +144,11 @@ def main():
     optimizer = make_optimizer(cfg, schedule)
     sample = jnp.zeros((global_batch, cfg.skeleton.height,
                         cfg.skeleton.width, 3))
-    state = create_train_state(model, cfg, optimizer, jax.random.PRNGKey(0),
-                               sample)
+    state = create_train_state(model, cfg, optimizer,
+                               jax.random.PRNGKey(args.seed), sample)
+    # re-align ranks before the FIRST collective: per-host init/compile
+    # skew can exceed the transport bring-up window (see parallel.barrier)
+    barrier("pre_state_replication")
     state = jax.device_put(state, replicated(mesh))
 
     start_epoch = 0
@@ -217,6 +224,9 @@ def main():
             jax.distributed.shutdown()  # aligned exit across processes
 
     epochs = args.epochs or cfg.train.epochs
+    # second alignment: resume/restore and step-function setup add more
+    # per-host skew before the first step's collective execution
+    barrier("pre_train_loop")
     if not args.swa:
         fit(state, train_step, cfg, make_train_batches, epochs,
             start_epoch=start_epoch, mesh=mesh, eval_step=eval_step,

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Test-time-augmentation grid comparison on one checkpoint.
+
+Evaluates the same model + val set under several inference grids — the
+reference's TTA surface (reference: evaluate.py:87-96: ``scale_search`` ×
+rotation grid × flip ensemble; ``utils/config:14`` ships scale_search=1
+as the default protocol) — and writes one JSON artifact with AP + wall
+time per grid, so "does this grid pay on this data?" is a measured row
+instead of a plumbing claim.  Round 4 measured these grids with scratch
+scripts (TTA_SYNTH.json); this is the committed tool.
+
+    python tools/tta_bench.py --config synth_deep --checkpoint ckpt/epoch_N \
+        --anno person_keypoints.json --images val/ --out TTA.json
+
+Grids: single (scale 1, no rotation — the default protocol),
+rot±30 (the reference's hard-pose rotation ensemble), ms (0.8/1.0/1.2
+multi-scale).  All run device-resident through the compact ms path.
+"""
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS))
+sys.path.insert(0, _TOOLS)  # for `from evaluate import load_predictor`
+
+
+GRIDS = {
+    "single_scale": {},
+    "rotation_pm30": {"rotation_search": (0.0, 30.0, -30.0)},
+    "multi_scale": {"scale_search": (0.8, 1.0, 1.2)},
+}
+
+
+def main():
+    ap = argparse.ArgumentParser(description="TTA grid comparison")
+    ap.add_argument("--config", default="canonical")
+    ap.add_argument("--checkpoint", required=True)
+    ap.add_argument("--anno", required=True)
+    ap.add_argument("--images", required=True)
+    ap.add_argument("--max-images", type=int, default=500)
+    ap.add_argument("--boxsize", type=int, default=0)
+    ap.add_argument("--grids", nargs="+", default=list(GRIDS),
+                    choices=list(GRIDS))
+    ap.add_argument("--out", default="TTA.json")
+    ap.add_argument("--results-dir", default=None,
+                    help="where the per-grid detection dumps land; "
+                         "default: a temp dir (NOT ./results — running "
+                         "from the checkout must not pollute it)")
+    ap.add_argument("--no-native", action="store_true")
+    args = ap.parse_args()
+
+    from evaluate import load_predictor
+
+    from improved_body_parts_tpu.config import default_inference_params
+    from improved_body_parts_tpu.infer.evaluate import validation_oks
+
+    predictor = load_predictor(args.config, args.checkpoint,
+                               boxsize=args.boxsize)
+    results_dir = args.results_dir or tempfile.mkdtemp(prefix="tta_results_")
+    base, _ = default_inference_params()
+    results = {}
+    for name in args.grids:
+        params = dataclasses.replace(base, **GRIDS[name])
+        t0 = time.time()
+        metrics = validation_oks(
+            predictor, args.anno, args.images, max_images=args.max_images,
+            params=params, use_native=not args.no_native, compact=True,
+            dump_name=f"tta_{name}", results_dir=results_dir)
+        entry = {k: metrics[k] for k in ("AP", "AP50", "AP75", "AR")}
+        entry["seconds"] = round(time.time() - t0, 1)
+        for k, v in GRIDS[name].items():
+            entry[k] = list(v)
+        results[name] = entry
+        print(f"{name}: AP={metrics['AP']:.4f} ({entry['seconds']}s)",
+              flush=True)
+
+    out = {"config": args.config, "checkpoint": args.checkpoint,
+           "val": args.images,
+           "decode_path": "compact (device-resident grid)",
+           "grids": results}
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({k: v["AP"] for k, v in results.items()}))
+
+
+if __name__ == "__main__":
+    main()
