@@ -87,9 +87,9 @@ def main():
     summary = {
         "protocol": "per-seed pairs share corpus seed, init seed and the "
                     "fixed 64-image big val (seed 777); synth_deep arms: "
-                    "96 images / 12 epochs (SWA: +5 cyclic-LR frozen-BN "
+                    "96 images / 10 epochs (SWA: +5 cyclic-LR frozen-BN "
                     "epochs from the base checkpoint); crowd arms: toy "
-                    "synth config, 96 images / 60 epochs",
+                    "synth config, 48 images / 60 epochs",
         "swa_vs_base": _pair(swa, base, "swa", "base"),
         "devgt_vs_hostgt": _pair(devgt, base, "device_gt", "host_gt"),
         "crowd_masked_vs_ablated": _pair(crowd, uncrowd, "masked",
