@@ -45,32 +45,82 @@ def timed(label: str, meter: Optional[AverageMeter] = None,
 
 def chained_time(forward, variables, x, iters: int = 50, warmup: int = 2
                  ) -> float:
-    """Seconds per step with CHAINED dependencies: step i+1's input depends
-    on step i's output through a zero-valued scalar, so steps serialize and
-    async dispatch pipelining cannot inflate the rate (a pooled relay can
-    fan INDEPENDENT identical dispatches across chips and report physically
-    impossible throughput — the round-2 TPURUN post-mortem).  The one
-    honest timing protocol, shared by bench.py, tools/perf_audit.py and
+    """Seconds per SERIALIZED step of ``forward`` — the one honest timing
+    protocol, shared by bench.py, tools/perf_audit.py and
     tools/tpu_session.py.
+
+    Protocol v3 (round 5).  Through a relay-attached chip, a timing
+    protocol must survive three failure modes that round 5 measured:
+
+    - ASYNC-DISPATCH PIPELINING: independent dispatches overlap (and a
+      pooled relay can even fan them across chips), inflating throughput
+      into a latency claim — the round-2 post-mortem;
+    - RESULT MEMOIZATION: the v1 protocol chained steps through a
+      zero-valued scalar, leaving every dispatch bit-identical in
+      argument VALUES; round 5 measured 788 imgs/s single-image /
+      5,419 imgs/s b8 from it — 386 TFLOP/s / 2.6 PFLOP/s implied, 2–13×
+      the chip's physical bf16 peak — i.e. a cache somewhere behind the
+      relay was serving repeated identical computations;
+    - PER-DISPATCH ROUND-TRIPS: fixing distinctness per dispatch from
+      the host (v2: host-fed counters, device-carried counters, or
+      device-resident noise consumed dispatch-by-dispatch) pushes a
+      ~37 ms relay round-trip into EVERY step — a property of this
+      relay, not of the chip the claim is about.
+
+    v3 therefore runs the whole chain INSIDE one compiled program:
+    ``lax.scan`` over a bank of on-device random noise slices, each
+    iteration's input = base + that step's noise + a bounded nonzero
+    function of the previous output (serialization the compiler cannot
+    remove, distinct values a cache cannot serve).  The bank is seeded
+    from ``os.urandom`` so no two *invocations* are identical either,
+    and the program returns a 4-byte scalar reduced from the final
+    carry, whose value transitively proves every step executed
+    (block_until_ready alone trusts the relay's notion of "ready").
+    One dispatch per measurement amortizes the relay round-trip across
+    all ``iters`` steps — matching how a local serving loop (the
+    reference's protocol, test_inference_speed.py:90-120) would run.
     """
+    import os
+
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
-    def step(v, xx, prev):
-        dep = jnp.sum(prev[..., :1, :1, :1]) * 0.0
-        return forward(v, xx + dep)
-
-    fn = jax.jit(step)
-    # seed at the REAL output shape: one compiled program serves warmup
-    # and the timed loop
     out_sd = jax.eval_shape(forward, variables, x)
-    out = fn(variables, x, jnp.zeros(out_sd.shape, out_sd.dtype))
-    jax.block_until_ready(out)
-    for _ in range(warmup):
-        out = fn(variables, x, out)
-    jax.block_until_ready(out)
+
+    def chain(v, xx, ns_bank):
+        def body(prev, ns):
+            # tanh bounds the feedback; the 1e-5/1e-3 scales stay
+            # representable against O(1) pixels in fp32 (eps≈1.2e-7)
+            # while remaining numerically irrelevant
+            dep = jnp.tanh(jnp.sum(prev[..., :1, :1, :1])) * 1e-5
+            return forward(v, xx + ns + dep), ()
+        final, _ = jax.lax.scan(
+            body, jnp.zeros(out_sd.shape, out_sd.dtype), ns_bank)
+        return jnp.sum(final[..., :1, :1, :1])
+
+    fn = jax.jit(chain)
+
+    def bank():
+        # fresh values every invocation — a memoizing relay never sees
+        # the same dispatch twice; generated ON DEVICE (no host transfer
+        # beyond the 4-byte seed)
+        seed = int.from_bytes(os.urandom(4), "little")
+        return jax.random.uniform(
+            jax.random.PRNGKey(seed), (iters, *x.shape[-3:]),
+            jnp.float32, 0.0, 1e-3)
+
+    # compile + warm: ONE untimed full-chain invocation — the timed call
+    # reuses the same trace, and each invocation is already an
+    # ``iters``-step chain, so honoring a caller's step-count-era
+    # ``warmup`` here would burn warmup×iters forwards of scarce chip
+    # time (the parameter is kept for API compatibility; any value ≥ 1
+    # warms identically)
+    del warmup
+    float(np.asarray(fn(variables, x, bank())))
+
+    noise = bank()
+    jax.block_until_ready(noise)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(variables, x, out)
-    jax.block_until_ready(out)
+    float(np.asarray(fn(variables, x, noise)))
     return (time.perf_counter() - t0) / iters

@@ -98,12 +98,24 @@ def main():
         assert np.isfinite(np.asarray(out, np.float32)).all(), \
             f"non-finite outputs at batch {b}"
 
-        # throughput: R repeats of N dispatches, block at each repeat end
+        # throughput: R repeats of N pipelined dispatches, block at each
+        # repeat end.  Protocol v2: a host-fed counter perturbs one input
+        # pixel so no two dispatches are bit-identical — round 5 caught a
+        # cache behind the relay serving repeated identical dispatches at
+        # 2× the chip's physical peak FLOP rate.  (Independent distinct
+        # dispatches can still fan across a pooled relay, so this mode
+        # stays the optimistic bound; chained_fps is the honest claim.)
+        perturbed = jax.jit(
+            lambda v, xx, k: forward(v, xx.at[..., :1, :1, :].add(k * 1e-3)))
+        out = perturbed(variables, x, np.float32(0))
+        jax.block_until_ready(out)
         reps = []
+        kk = 1
         for _ in range(args.repeats):
             t0 = time.perf_counter()
             for _ in range(args.iters):
-                out = compiled(variables, x)
+                out = perturbed(variables, x, np.float32(kk))
+                kk += 1
             jax.block_until_ready(out)
             reps.append((time.perf_counter() - t0) / args.iters)
         med = statistics.median(reps)
