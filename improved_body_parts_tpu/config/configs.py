@@ -449,6 +449,27 @@ def _synth_canonical() -> Config:
     )
 
 
+def _synth_canonical_512() -> Config:
+    """``synth_canonical`` at FULL resolution: the reference flagship
+    exactly as trained (reference: config/config.py:14-16 — nstack=4,
+    inp_dim=256, increase=128, 512² input → 128,998,760 params) on the
+    synthetic drawn-person benchmark, for the ON-CHIP learn→AP run the
+    round-4 verdict staged (CANONICAL_TRAIN.json was the reduced-canvas
+    CPU stage).  Batch 8 is the one-chip batch the round-5 train-step
+    timing measured at 110 ms/step = 72.6 imgs/s on a v5e; LR follows
+    synth_canonical's stability-tested 2.5e-4 (the reference's COCO
+    2.5e-5 barely moves on a ~100-image corpus), with the reference's
+    warmup + /5-every-15-epochs schedule unchanged."""
+    return Config(
+        name="synth_canonical_512",
+        model=ModelConfig(remat=True),
+        train=TrainConfig(batch_size_per_device=8,
+                          learning_rate_per_device=2.5e-4,
+                          epochs=30, warmup_epochs=2,
+                          bf16_compute=True),
+    )
+
+
 def _ae() -> Config:
     """Associative-Embedding-style classic hourglass (reference:
     models/ae_pose.py, kept for ablation): ONE full-resolution output per
@@ -470,6 +491,7 @@ _REGISTRY = {
     "synth": _synth,
     "synth_deep": _synth_deep,
     "synth_canonical": _synth_canonical,
+    "synth_canonical_512": _synth_canonical_512,
     "ae": _ae,
 }
 

@@ -4,17 +4,32 @@
 Runs the REAL multi-process path — ``tools/train.py --coordinator
 --num-processes 2`` (jax.distributed over Gloo on CPU; the same code path
 brings up TPU pods over DCN) — on the synth_deep production-architecture
-config, exercises a CROSS-PROCESS checkpoint/resume boundary, and pins
-per-epoch loss parity against a single-process run on the same data
-(reference: train_distributed.py:69-84 NCCL bring-up; :149-197 resume;
-parity is how the reference validated its DDP path).
+config, in THREE arms (reference: train_distributed.py:69-84 NCCL
+bring-up; :149-197 resume; parity is how the reference validated DDP):
 
-Why parity is exact up to float tolerance: the host shard is strided
-(data/dataset.py ``host_shard``: process p takes perm[p::P]), so step k's
-GLOBAL batch in a P-process run is the same SAMPLE SET as step k of a
-single-process run over a P-device mesh, and augmentation is
-(seed, epoch, index)-keyed — order within the batch differs, but the
-mean loss and batch-wide BN statistics are order-invariant.
+A. single process × 2 virtual devices (the topology-parity arm);
+B. 2 processes × 1 device, straight through all epochs;
+C. 2 processes × 1 device with a CROSS-PROCESS checkpoint/resume
+   boundary after ``--resume-after`` epochs.
+
+Two distinct parity claims, separately asserted:
+
+- RESUME parity (C vs B): must be BIT-EXACT (rel diff ≤ 1e-6 per
+  epoch).  Restarting both ranks from the shared checkpoint restores
+  params / optimizer momentum / schedule step / RNG-keyed data order
+  completely, so the resumed trajectory is indistinguishable from the
+  uninterrupted one.  Round 5 measured exactly this (44.12479782104492
+  at the post-boundary epoch in both arms).
+- TOPOLOGY parity (B vs A): same per-step global SAMPLE SET (strided
+  host shards: process p takes perm[p::P]; augmentation is
+  (seed, epoch, index)-keyed) but a different order of floating-point
+  reduction — so the first epoch must agree to ``--tolerance`` (~0.1%
+  measured), while later epochs drift chaotically as tiny weight
+  differences amplify through a steep loss descent (round 5 measured
+  0.09% / 0.16% / 7.2% over three epochs; the 7.2% is trajectory
+  divergence, NOT a state bug — arm C reproduces arm B bit-exactly).
+  Only the FIRST epoch is asserted; the full per-epoch drift is
+  reported for the record.
 
     python tools/dist_drive.py --out DIST_DRIVE.json
 """
@@ -54,11 +69,20 @@ def run_train(h5, val_h5, ckpt_dir, epochs, env_extra, extra_args=(),
 def epoch_losses(ckpt_dir):
     """Epoch → loss from the append-only log, LAST occurrence winning —
     a retried/relaunched run may append a duplicate epoch line."""
-    with open(os.path.join(ckpt_dir, "log")) as f:
+    log = os.path.join(ckpt_dir, "log")
+    if not os.path.exists(log):
+        return []
+    with open(log) as f:
         entries = re.findall(r"Epoch (\d+)\ttrain_loss: ([0-9.eE+-]+)",
                              f.read())
     by_epoch = {int(e): float(v) for e, v in entries}
     return [by_epoch[e] for e in sorted(by_epoch)]
+
+
+def have_epochs(ckpt_dir, n):
+    """True when the arm already trained ≥ n epochs (idempotent reruns:
+    a completed arm is parsed, not retrained)."""
+    return len(epoch_losses(ckpt_dir)) >= n
 
 
 def main():
@@ -85,50 +109,78 @@ def main():
                            or tempfile.mkdtemp(prefix="dist_drive_"))
     os.makedirs(work, exist_ok=True)
     h5 = os.path.join(work, "corpus.h5")
-    n_rec = build_fixture(h5, num_images=args.images, people_per_image=2,
-                          img_size=(384, 512), image_size=256, seed=0,
-                          drawn=True)
-    # a val corpus too: per-epoch eval is a COLLECTIVE in multi-process
-    # runs (every host must enter it), so the drive exercises that path
     val_h5 = os.path.join(work, "val_corpus.h5")
-    build_fixture(val_h5, num_images=max(args.images // 4, 2),
-                  people_per_image=2, img_size=(384, 512), image_size=256,
-                  seed=99, drawn=True)
+    # arms skip-resume on their logs, so the corpus they trained on must
+    # not silently change under a rerun with different parameters —
+    # pin the fixture params in the workdir and refuse a mismatch
+    fixture_params = {"config": args.config, "images": args.images,
+                      "epochs": args.epochs,
+                      "resume_after": args.resume_after}
+    params_path = os.path.join(work, "fixture_params.json")
+    if os.path.exists(params_path):
+        with open(params_path) as f:
+            pinned = json.load(f)
+        assert pinned == fixture_params, (
+            f"workdir {work} was built with {pinned}, rerun requests "
+            f"{fixture_params}; use a fresh --workdir")
+        import h5py
+        with h5py.File(h5, "r") as f:
+            n_rec = len(f["dataset"])
+    else:
+        # a workdir with arm logs but no params file predates the pinning
+        # (or crashed before the pin was written): rebuilding the corpus
+        # under skip-resumed arms would compare losses across corpora
+        stale = [d for d in ("ckpt_single", "ckpt_dist_straight",
+                             "ckpt_dist")
+                 if os.path.exists(os.path.join(work, d, "log"))]
+        assert not stale, (
+            f"workdir {work} has arm logs {stale} but no "
+            "fixture_params.json; use a fresh --workdir")
+        n_rec = build_fixture(h5, num_images=args.images,
+                              people_per_image=2, img_size=(384, 512),
+                              image_size=256, seed=0, drawn=True)
+        # a val corpus too: per-epoch eval is a COLLECTIVE in
+        # multi-process runs (every host must enter it), so the drive
+        # exercises that path
+        build_fixture(val_h5, num_images=max(args.images // 4, 2),
+                      people_per_image=2, img_size=(384, 512),
+                      image_size=256, seed=99, drawn=True)
+        with open(params_path, "w") as f:
+            json.dump(fixture_params, f)
     print(f"corpus: {n_rec} records", flush=True)
 
-    # --- phase A: single process, 2-device mesh (the parity arm) --------
+    # --- arm A: single process, 2-device mesh (topology-parity arm) -----
     ckpt_a = os.path.join(work, "ckpt_single")
     t0 = time.time()
-    run_train(h5, val_h5, ckpt_a, args.epochs,
-              {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
-              log_path=os.path.join(work, "single.log"),
-              config=args.config)
+    if not have_epochs(ckpt_a, args.epochs):
+        run_train(h5, val_h5, ckpt_a, args.epochs,
+                  {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+                  log_path=os.path.join(work, "single.log"),
+                  config=args.config)
     t_single = time.time() - t0
-    losses_a = epoch_losses(ckpt_a)
-    print(f"single-process losses: {losses_a} ({t_single:.0f}s)", flush=True)
+    losses_a = epoch_losses(ckpt_a)[:args.epochs]
+    print(f"A single-process losses:    {losses_a} ({t_single:.0f}s)",
+          flush=True)
 
-    # --- phase B: 2 processes, 1 device each, with a cross-process
-    # checkpoint/resume boundary after --resume-after epochs -------------
-    ckpt_b = os.path.join(work, "ckpt_dist")
     coord = f"127.0.0.1:{args.port}"
     env1 = {"XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
 
-    def _latest_epoch():
+    def _latest_epoch(ckpt_dir):
         import glob as g
         eps = []
-        for p in g.glob(os.path.join(ckpt_b, "epoch_*")):
+        for p in g.glob(os.path.join(ckpt_dir, "epoch_*")):
             m = re.search(r"epoch_(\d+)$", p)
             if m:
                 eps.append(int(m.group(1)))
         return max(eps) if eps else -1
 
-    def launch_pair(end_epoch, resume, attempt=0):
+    def launch_pair(ckpt_dir, tag, end_epoch, resume, attempt=0):
         if resume:
             # --epochs is ADDITIONAL after a resume (fit runs
             # range(start_epoch, start_epoch + epochs)); compute the
             # remainder from the latest checkpoint so a retry after a
             # partial run stays idempotent
-            additional = end_epoch - (_latest_epoch() + 1)
+            additional = end_epoch - (_latest_epoch(ckpt_dir) + 1)
             if additional <= 0:
                 return
         else:
@@ -145,10 +197,10 @@ def main():
             cmd = [sys.executable, os.path.join(REPO, "tools", "train.py"),
                    "--config", args.config, "--train-h5", h5,
                    "--val-h5", val_h5,
-                   "--checkpoint-dir", ckpt_b, "--epochs", str(additional),
+                   "--checkpoint-dir", ckpt_dir,
+                   "--epochs", str(additional),
                    "--workers", "0", "--print-freq", "1"] + extra
-            log = open(os.path.join(work, f"dist_rank{pid}"
-                       f"{'_resumed' if resume else ''}.log"), "w")
+            log = open(os.path.join(work, f"dist_rank{pid}{tag}.log"), "w")
             procs.append((subprocess.Popen(cmd, stdout=log, stderr=log,
                                            env=env), log))
         rcs = []
@@ -173,43 +225,76 @@ def main():
             # the ranks aligned.
             print(f"rank failure rcs={rcs}; retrying once with a warm "
                   "cache", flush=True)
-            return launch_pair(end_epoch, resume, attempt=1)
+            return launch_pair(ckpt_dir, tag, end_epoch, resume, attempt=1)
         assert all(rc == 0 for rc in rcs), (
             f"distributed ranks failed rcs={rcs}; see {work}/dist_rank*.log")
 
+    # --- arm B: 2 processes, straight through (no boundary) -------------
+    ckpt_b = os.path.join(work, "ckpt_dist_straight")
     t0 = time.time()
-    launch_pair(args.resume_after, resume=False)
-    print(f"2-process epochs 0..{args.resume_after - 1} done", flush=True)
-    # the resume boundary: a fresh pair of processes picks up the
-    # checkpoint both ranks agreed on
-    launch_pair(args.epochs, resume=True)
-    t_dist = time.time() - t0
-    losses_b = epoch_losses(ckpt_b)
-    print(f"2-process losses:      {losses_b} ({t_dist:.0f}s)", flush=True)
+    if not have_epochs(ckpt_b, args.epochs):
+        launch_pair(ckpt_b, "_straight", args.epochs, resume=False)
+    t_straight = time.time() - t0
+    losses_b = epoch_losses(ckpt_b)[:args.epochs]
+    print(f"B 2-process straight:       {losses_b} ({t_straight:.0f}s)",
+          flush=True)
 
-    assert len(losses_a) == len(losses_b) == args.epochs, (
-        losses_a, losses_b)
-    rel = [abs(a - b) / max(abs(a), 1e-9)
-           for a, b in zip(losses_a, losses_b)]
-    parity_ok = max(rel) <= args.tolerance
+    # --- arm C: 2 processes with a cross-process resume boundary --------
+    ckpt_c = os.path.join(work, "ckpt_dist")
+    t0 = time.time()
+    if not have_epochs(ckpt_c, args.epochs):
+        if not have_epochs(ckpt_c, args.resume_after):
+            launch_pair(ckpt_c, "", args.resume_after, resume=False)
+        print(f"C 2-process epochs 0..{args.resume_after - 1} done",
+              flush=True)
+        # the resume boundary: a fresh pair of processes picks up the
+        # checkpoint both ranks agreed on
+        launch_pair(ckpt_c, "_resumed", args.epochs, resume=True)
+    t_dist = time.time() - t0
+    losses_c = epoch_losses(ckpt_c)[:args.epochs]
+    print(f"C 2-process with resume:    {losses_c} ({t_dist:.0f}s)",
+          flush=True)
+
+    assert len(losses_a) == len(losses_b) == len(losses_c) == args.epochs, (
+        losses_a, losses_b, losses_c)
+    resume_rel = [abs(b - c) / max(abs(b), 1e-9)
+                  for b, c in zip(losses_b, losses_c)]
+    topology_rel = [abs(a - b) / max(abs(a), 1e-9)
+                    for a, b in zip(losses_a, losses_b)]
+    # resume must be EXACT; topology only bounded on the first epoch
+    # (later epochs drift chaotically — see module docstring)
+    resume_exact = max(resume_rel) <= 1e-6
+    topology_ok = topology_rel[0] <= args.tolerance
+    parity_ok = resume_exact and topology_ok
     result = {
         "config": args.config,
         "records": n_rec,
         "epochs": args.epochs,
         "resume_boundary_after_epoch": args.resume_after,
         "single_process_losses": losses_a,
-        "two_process_losses": losses_b,
-        "relative_diff_per_epoch": [round(r, 5) for r in rel],
+        "two_process_straight_losses": losses_b,
+        "two_process_resumed_losses": losses_c,
+        "resume_rel_diff_per_epoch": [round(r, 9) for r in resume_rel],
+        "topology_rel_diff_per_epoch": [round(r, 5) for r in topology_rel],
+        "resume_exact": bool(resume_exact),
+        "topology_first_epoch_ok": bool(topology_ok),
         "tolerance": args.tolerance,
         "parity_ok": bool(parity_ok),
-        "seconds": {"single": round(t_single, 1),
-                    "two_process": round(t_dist, 1)},
-        "protocol": "phase A: 1 process x 2 virtual CPU devices; phase B: "
-                    "2 processes x 1 device over jax.distributed (Gloo), "
-                    "restarted from the shared checkpoint after epoch "
-                    f"{args.resume_after}; strided host shards make each "
-                    "step's global batch the same sample set in both "
-                    "phases (see module docstring)",
+        # an arm skipped as already-complete reports null, not a
+        # meaningless near-zero reparse time
+        "seconds": {"single": round(t_single, 1) if t_single > 1 else None,
+                    "two_process_straight": (round(t_straight, 1)
+                                             if t_straight > 1 else None),
+                    "two_process_resumed": (round(t_dist, 1)
+                                            if t_dist > 1 else None)},
+        "protocol": "arm A: 1 process x 2 virtual CPU devices; arms B/C: "
+                    "2 processes x 1 device over jax.distributed (Gloo); "
+                    "C restarts both ranks from the shared checkpoint "
+                    f"after epoch {args.resume_after}. Resume parity "
+                    "(C vs B) asserted bit-exact; topology parity (B vs "
+                    "A) asserted on the first epoch only — same per-step "
+                    "sample set, different float-reduction order, so "
+                    "later epochs drift chaotically (module docstring).",
         "per_process_logs": sorted(
             os.path.basename(p) for p in os.listdir(work)
             if p.endswith(".log")),
@@ -219,7 +304,9 @@ def main():
         json.dump(result, f, indent=2)
     print(json.dumps(result))
     if not parity_ok:
-        raise SystemExit(f"loss parity exceeded tolerance: {rel}")
+        raise SystemExit(
+            f"parity failed: resume_rel={resume_rel} "
+            f"topology_rel={topology_rel}")
 
 
 if __name__ == "__main__":

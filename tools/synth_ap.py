@@ -146,6 +146,18 @@ def main():
                     help="val-set seed (use 777 with --val-images 64 for "
                          "the big-val protocol of SYNTH_AP_DEEP_BIGVAL)")
     ap.add_argument("--keep-workdir", action="store_true")
+    ap.add_argument("--train-platform", default="",
+                    help="JAX_PLATFORMS for the train subprocess (e.g. "
+                         "'axon' to train on the TPU). Default: inherit "
+                         "the environment (cpu if unset). Only ONE "
+                         "subprocess should target an exclusively-claimed "
+                         "accelerator at a time; the fresh-checkpoint "
+                         "helper is always pinned to cpu for this reason.")
+    ap.add_argument("--eval-platform", default="",
+                    help="JAX_PLATFORMS for the evaluate subprocesses; "
+                         "set 'cpu' when training on an exclusive-claim "
+                         "accelerator to avoid a second claim bind (the "
+                         "decode/OKS protocol is platform-agnostic)")
     args = ap.parse_args()
 
     # the whole benchmark is a CPU protocol check unless the caller
@@ -193,7 +205,9 @@ def main():
         train_args += ["--lr", str(args.lr)]
     if args.device_gt:
         train_args += ["--device-gt", str(args.device_gt)]
-    run_cli(train_args,
+    train_env = ({"JAX_PLATFORMS": args.train_platform}
+                 if args.train_platform else None)
+    run_cli(train_args, env_extra=train_env,
             timeout=args.train_timeout or max(7200, 600 * epochs + 3600))
     # per-epoch losses live in the reference-format append-only epoch log
     with open(os.path.join(ckpt_dir, "log")) as f:
@@ -215,10 +229,12 @@ def main():
                  "--config", args.config, "--anno", anno,
                  "--images", val_dir, "--oks-proxy",
                  "--boxsize", str(boxsize)] + decode_flag
+    eval_env = ({"JAX_PLATFORMS": args.eval_platform}
+                if args.eval_platform else None)
     print("evaluating trained checkpoint...", flush=True)
     ap_trained = parse_ap(run_cli(
         eval_args + ["--checkpoint", latest, "--dump-name", "synth_trained"],
-        cwd=work))
+        cwd=work, env_extra=eval_env))
 
     # contrast: an untrained (fresh-init) model through the same protocol
     # — shows the AP is learned, not an artifact of the decoder
@@ -227,7 +243,7 @@ def main():
     print("evaluating untrained baseline...", flush=True)
     ap_fresh = parse_ap(run_cli(
         eval_args + ["--checkpoint", fresh, "--dump-name", "synth_fresh"],
-        cwd=work))
+        cwd=work, env_extra=eval_env))
 
     result = {
         "config": args.config,
@@ -239,6 +255,10 @@ def main():
         "crowd": args.crowd, "miss_mask": not args.no_miss_mask,
         "device_gt": args.device_gt,
         "seed": args.seed, "val_seed": args.val_seed, "hard": args.hard,
+        "train_platform": args.train_platform
+        or os.environ.get("JAX_PLATFORMS", "cpu"),
+        "eval_platform": args.eval_platform
+        or os.environ.get("JAX_PLATFORMS", "cpu"),
         "train_loss_first": float(losses[0]) if losses else None,
         "train_loss_last": float(losses[-1]) if losses else None,
         "train_loss_curve": [float(v) for v in losses],
