@@ -1,0 +1,512 @@
+#!/usr/bin/env python
+"""One-process on-chip TRAINING session: every learn→AP benchmark the
+round-4 verdict asked for, under ONE exclusive chip claim.
+
+The chip behind the axon relay is claimed exclusively at first device use
+and sequential short-lived claimants have been observed to wedge the pool
+(ROADMAP round-4/5 logs) — so, mirroring tools/tpu_session.py for the
+measurement suite, this driver runs the TRAINING agenda in one process by
+calling the real train/evaluate CLI mains in-process (sys.argv patching),
+sequentially, and exits cleanly:
+
+1. ``canonical`` — the reference flagship at FULL resolution
+   (synth_canonical_512: 128,998,760 params @512², reference:
+   config/config.py:14-16) through the drawn-corpus learn→AP protocol →
+   SYNTH_AP_CANONICAL_TPU.json.  CANONICAL_TRAIN.json was the CPU stage
+   at reduced canvas; this is the run it staged.
+2. ``hard`` — synth_deep on the --hard corpus tier (±60° figure
+   rotations, wider scales) → SYNTH_AP_HARD.json, then the TTA grid
+   comparison on the SAME trained checkpoint and hard val →
+   TTA_HARD.json (the benchmark arm where rotation TTA should pay;
+   reference: evaluate.py:89-90).
+3. ``ab`` — the seed-replicated A/B matrix tools/ab_summary.py
+   aggregates: per seed, synth_deep base (96 img / 10 epochs, big 64-img
+   val seed 777) → SWA stage (+5 cyclic-LR epochs) → device-GT twin →
+   crowd masked/ablated pair (toy synth config, 48 img / 60 epochs) →
+   AB_SUMMARY.json.
+
+Every run writes its artifact immediately; sections skip runs whose
+artifact already exists (crash-resumable), and a failed run records the
+error and moves on — a scarce chip session never discards earlier work.
+
+    python tools/tpu_train_session.py                  # full agenda
+    python tools/tpu_train_session.py --sections ab    # one section
+    JAX_PLATFORMS=cpu python tools/tpu_train_session.py --smoke  # CPU smoke
+
+Exit codes: 0 = agenda done (individual runs may still have recorded
+errors), 3 = backend bind timed out (wedged claim — retry later).
+"""
+import argparse
+import contextlib
+import gc
+import io
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+sys.path.insert(0, REPO)
+sys.path.insert(0, TOOLS)
+
+BIND_TIMEOUT_S = 420
+
+
+def _call_main(module, argv):
+    """Run a CLI module's main() in-process with a patched argv."""
+    saved = sys.argv
+    sys.argv = [f"{module.__name__}.py"] + [str(a) for a in argv]
+    try:
+        module.main()
+    finally:
+        sys.argv = saved
+        gc.collect()  # drop device buffers (params/opt state) between runs
+
+
+def _call_eval(module, argv, cwd):
+    """evaluate.py main() in-process, stdout captured for the AP line.
+
+    cwd matters: the detection dump lands under ``results/`` relative to
+    the working directory (same contract as synth_ap's subprocess evals).
+    """
+    buf = io.StringIO()
+    saved_cwd = os.getcwd()
+    os.chdir(cwd)
+    try:
+        with contextlib.redirect_stdout(buf):
+            _call_main(module, argv)
+    finally:
+        os.chdir(saved_cwd)
+    return buf.getvalue()
+
+
+class Session:
+    def __init__(self, args):
+        self.args = args
+        self.summary = {"sections": {}, "platform": None}
+        import train as train_cli          # tools/train.py
+        import evaluate as evaluate_cli    # tools/evaluate.py
+        import tta_bench as tta_cli        # tools/tta_bench.py
+        from synth_ap import parse_ap, _save_fresh_checkpoint_impl
+        self.train_cli = train_cli
+        self.evaluate_cli = evaluate_cli
+        self.tta_cli = tta_cli
+        self.parse_ap = parse_ap
+        self.make_fresh = _save_fresh_checkpoint_impl
+
+    def flush(self):
+        with open(self.args.session_out, "w") as f:
+            json.dump(self.summary, f, indent=2)
+
+    def art(self, name):
+        """Artifact filename; --smoke runs get a SMOKE_ prefix so a later
+        REAL session never skip-resumes over 1-epoch CPU smoke numbers."""
+        return ("SMOKE_" + name) if self.args.smoke else name
+
+    def try_run(self, out, **kw):
+        """Run-level error isolation: one failed run records its error and
+        the section moves on (the module docstring's contract)."""
+        try:
+            return self.synth_run(out, **kw)
+        except (Exception, SystemExit) as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            self.summary.setdefault("run_errors", {})[out] = (
+                f"{type(e).__name__}: {e}")
+            self.flush()
+            return None
+
+    # ---- one learn→AP run ------------------------------------------------
+    def synth_run(self, out, *, config, epochs, canvas, train_images=96,
+                  val_images=24, people=2, seed=0, val_seed=12345,
+                  crowd=False, hard=False, mask_extras=True, device_gt=0,
+                  lr=0.0, workdir=None, fresh_baseline=True,
+                  swa_from=None, swa_epochs=5, swa_freq=5, base_artifact=None):
+        """Mirror of tools/synth_ap.py's protocol, in-process.
+
+        ``swa_from`` = an existing run's workdir: continue its checkpoint
+        through the SWA stage (tools/swa_stage.py protocol) instead of
+        training from scratch.
+        """
+        if os.path.exists(out) and not self.args.force:
+            print(f"[skip] {out} exists", flush=True)
+            return json.load(open(out))
+        from improved_body_parts_tpu.config import get_config
+        from improved_body_parts_tpu.data import build_fixture, build_val_set
+        from improved_body_parts_tpu.train.checkpoint import latest_checkpoint
+
+        cfg = get_config(config)
+        boxsize = cfg.skeleton.height
+        work = os.path.abspath(workdir or swa_from or os.path.join(
+            self.args.work_root, os.path.splitext(os.path.basename(out))[0]))
+        os.makedirs(work, exist_ok=True)
+        corpus = os.path.join(work, "train_drawn.h5")
+        val_dir = os.path.join(work, "val")
+        anno = os.path.join(work, "person_keypoints_synth.json")
+        ckpt_dir = os.path.join(work, "ckpt")
+
+        # pin the corpus parameters in the workdir: a rerun with different
+        # args must not silently reuse data built with the old ones while
+        # stamping the artifact with the new (dist_drive's pinning rule)
+        pin = {"config": config, "train_images": train_images,
+               "val_images": val_images, "people": people,
+               "canvas": list(canvas), "seed": seed, "val_seed": val_seed,
+               "crowd": crowd, "hard": hard, "mask_extras": mask_extras}
+        pin_path = os.path.join(work, "fixture_params.json")
+        if not os.path.exists(corpus):
+            n_rec = build_fixture(corpus, num_images=train_images,
+                                  people_per_image=people, img_size=canvas,
+                                  image_size=boxsize, seed=seed, drawn=True,
+                                  crowd=crowd, hard=hard,
+                                  mask_extras=mask_extras)
+            with open(pin_path, "w") as f:
+                json.dump(pin, f)
+        else:
+            assert os.path.exists(pin_path) and json.load(
+                open(pin_path)) == pin, (
+                f"workdir {work} holds a corpus built with different "
+                f"parameters; use a fresh --work-root")
+            import h5py
+            with h5py.File(corpus, "r") as f:
+                n_rec = len(f["dataset"])
+        if not os.path.exists(anno):
+            n_val = build_val_set(val_dir, anno, num_images=val_images,
+                                  people_per_image=people, img_size=canvas,
+                                  image_size=boxsize, seed=val_seed,
+                                  drawn=True, crowd=crowd, hard=hard)
+        else:
+            n_val = None
+        print(f"[run] {out}: corpus {n_rec} records, training {config} "
+              f"{'SWA +' if swa_from else ''}{swa_epochs if swa_from else epochs}"
+              f" epochs on {self.summary['platform']}", flush=True)
+
+        # per-run loss provenance: train.py APPENDS to the workdir's epoch
+        # log.  A non-SWA run owns its whole epoch range (a crash-resume
+        # CONTINUES the same logical run, so pre-crash epochs belong in
+        # its curve); an SWA stage reuses the base arm's workdir and
+        # slices at the base epoch count.  Parsing is epoch-keyed with
+        # last-occurrence-wins (dist_drive.epoch_losses) — line counts
+        # are unreliable (leading-newline format) and a crash between
+        # the log line and the checkpoint write duplicates an epoch.
+        from dist_drive import epoch_losses
+        pre_epochs = 0
+
+        t0 = time.time()
+        if swa_from:
+            pre_swa = latest_checkpoint(ckpt_dir)
+            # --resume auto with an empty dir silently trains from
+            # scratch — which would score 5-epoch scratch weights as
+            # "SWA" and feed a bogus delta into AB_SUMMARY
+            assert pre_swa, (
+                f"SWA stage needs the base arm's checkpoint under "
+                f"{ckpt_dir}; run the base arm first (same --work-root)")
+            latest_epochs = (int(os.path.basename(pre_swa).split("_")[1])
+                             + 1)
+            # base_marker records the base arm's epoch count BEFORE any
+            # SWA epoch trains (so a mid-stage crash still knows the
+            # boundary); done_marker records stage completion.  Without
+            # them a re-entry would compound MORE cyclic-LR epochs onto
+            # the averaged run while reporting a fresh stage.
+            base_marker = os.path.join(work, "swa_base_epochs")
+            done_marker = os.path.join(work, "swa_stage_done")
+            if os.path.exists(base_marker):
+                base_epochs = int(open(base_marker).read())
+            else:
+                base_epochs = latest_epochs
+                with open(base_marker, "w") as f:
+                    f.write(str(base_epochs))
+            pre_epochs = base_epochs
+            if os.path.exists(done_marker):
+                print(f"[resume] {out}: SWA stage already trained, "
+                      "skipping to eval", flush=True)
+                pre_swa = None  # latest IS the SWA ckpt; drop the guard
+            else:
+                # a mid-stage crash leaves intermediate SWA checkpoints:
+                # train only the REMAINING epochs (train.py's --epochs is
+                # additional after a resume)
+                additional = swa_epochs - (latest_epochs - base_epochs)
+                if additional > 0:
+                    # train.py's SWA loop checkpoints every swa_freq
+                    # epochs — a cadence longer than the stage would
+                    # train epochs whose weights are never saved (and
+                    # the eval would silently score a stale checkpoint)
+                    swa_freq = min(swa_freq, additional)
+                    self._train([
+                        "--config", config, "--swa", "--resume", "auto",
+                        "--epochs", additional, "--swa-freq", swa_freq,
+                        "--train-h5", corpus, "--checkpoint-dir", ckpt_dir,
+                        "--workers", 0, "--seed", seed])
+                with open(done_marker, "w") as f:
+                    f.write("1")
+        else:
+            # crash-resume INSIDE a run: a rerun after a crash between
+            # training and artifact write continues from the last
+            # checkpoint instead of retraining from scratch (train.py's
+            # --epochs is ADDITIONAL after a resume)
+            done = latest_checkpoint(ckpt_dir)
+            additional = epochs
+            resume_args = []
+            if done:
+                done_epochs = int(os.path.basename(done).split("_")[1]) + 1
+                additional = epochs - done_epochs
+                resume_args = ["--resume", "auto"]
+                print(f"[resume] {out}: {done_epochs} epochs done, "
+                      f"{max(additional, 0)} to go", flush=True)
+            if additional > 0:
+                argv = (["--config", config, "--epochs", additional,
+                         "--train-h5", corpus, "--checkpoint-dir", ckpt_dir,
+                         "--workers", 0, "--print-freq", 20,
+                         "--seed", seed] + resume_args)
+                if lr:
+                    argv += ["--lr", lr]
+                if device_gt:
+                    argv += ["--device-gt", device_gt]
+                self._train(argv)
+        train_s = round(time.time() - t0, 1)
+
+        losses = epoch_losses(ckpt_dir)[pre_epochs:]
+        latest = latest_checkpoint(ckpt_dir)
+        assert latest, f"no checkpoint under {ckpt_dir}"
+        if swa_from:
+            assert latest != pre_swa, (
+                f"SWA stage saved no new checkpoint (latest still "
+                f"{latest}); the eval would score the base weights")
+
+        eval_args = ["--config", config, "--anno", anno, "--images", val_dir,
+                     "--oks-proxy", "--boxsize", boxsize, "--compact"]
+        # distinct dump names keep the SWA arm from clobbering the base
+        # arm's detections in the shared workdir
+        dump = "swa" if swa_from else "trained"
+        ap_trained = self.parse_ap(_call_eval(
+            self.evaluate_cli,
+            eval_args + ["--checkpoint", latest, "--dump-name", dump],
+            cwd=work))
+        ap_fresh = None
+        if fresh_baseline and not swa_from:
+            fresh_dir = os.path.join(work, "ckpt_fresh")
+            if not latest_checkpoint(fresh_dir):
+                self.make_fresh(config, fresh_dir)
+                gc.collect()
+            ap_fresh = self.parse_ap(_call_eval(
+                self.evaluate_cli,
+                eval_args + ["--checkpoint", latest_checkpoint(fresh_dir),
+                             "--dump-name", "fresh"],
+                cwd=work))
+
+        platform = self.summary["platform"]
+        result = {
+            "config": config, "train_images": train_images,
+            "train_records": n_rec, "val_images": val_images,
+            "val_persons": n_val, "people_per_image": people,
+            # the SWA stage trains under train.py's cyclic sawtooth
+            # (--swa-lr-max 1e-5 -> --swa-lr-min 1e-6), not the config LR
+            "lr": ("swa-cyclic-1e-05..1e-06" if swa_from
+                   else lr or cfg.train.learning_rate_per_device),
+            "canvas": list(canvas), "decode_path": "compact",
+            "crowd": crowd, "miss_mask": mask_extras, "device_gt": device_gt,
+            "seed": seed, "val_seed": val_seed, "hard": hard,
+            "train_platform": platform, "eval_platform": platform,
+            "train_wall_s": train_s,
+            "train_loss_first": losses[0] if losses else None,
+            "train_loss_last": losses[-1] if losses else None,
+            "train_loss_curve": losses,
+            "checkpoint": latest,
+            "protocol": "drawn-person fixture; held-out val (different "
+                        "seed); OKS-proxy evaluator (APCHECK.md); real "
+                        "train/evaluate CLI mains in-process under one "
+                        "chip claim (tools/tpu_train_session.py)",
+        }
+        if swa_from:
+            result.update({"ap_swa": ap_trained, "swa_epochs": swa_epochs,
+                           "swa_freq": swa_freq})
+            if base_artifact and os.path.exists(base_artifact):
+                base = json.load(open(base_artifact))
+                result["ap_base"] = base["ap_trained"]
+                result["base_artifact"] = os.path.basename(base_artifact)
+                result["swa_delta"] = round(ap_trained - base["ap_trained"], 6)
+        else:
+            result.update({"epochs": epochs, "ap_trained": ap_trained,
+                           "ap_untrained": ap_fresh})
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"[done] {out}: AP {ap_trained} (train {train_s}s)", flush=True)
+        return result
+
+    def _train(self, argv):
+        _call_main(self.train_cli, argv)
+
+    # ---- sections --------------------------------------------------------
+    def section(self, name, fn):
+        t0 = time.time()
+        entry = {"status": "running"}
+        self.summary["sections"][name] = entry
+        self.flush()
+        try:
+            fn()
+            entry["status"] = "ok"
+        except (Exception, SystemExit) as e:  # noqa: BLE001 — scarce
+            # session, keep going (SystemExit: the in-process CLI mains
+            # raise it for validation failures and argparse errors)
+            entry["status"] = "error"
+            entry["error"] = f"{type(e).__name__}: {e}"
+            import traceback
+            traceback.print_exc()
+        entry["wall_s"] = round(time.time() - t0, 1)
+        self.flush()
+
+    def run_canonical(self):
+        a = self.args
+        # smoke mode drops to the reduced-canvas CPU config — the 512²
+        # flagship takes minutes PER STEP on a 1-core host
+        config = "synth_canonical" if a.smoke else "synth_canonical_512"
+        canvas = (288, 384) if a.smoke else (768, 1024)
+        self.synth_run(
+            self.art("SYNTH_AP_CANONICAL_TPU.json"), config=config,
+            epochs=a.canonical_epochs, canvas=canvas,
+            train_images=a.canonical_images, val_images=24,
+            device_gt=8, seed=0)
+
+    def run_hard(self):
+        a = self.args
+        res = self.synth_run(
+            self.art("SYNTH_AP_HARD.json"), config="synth_deep",
+            epochs=a.hard_epochs, canvas=(384, 512), hard=True, seed=0)
+        if os.path.exists(self.art("TTA_HARD.json")) and not a.force:
+            return
+        work = os.path.join(a.work_root,
+                            os.path.splitext(self.art("SYNTH_AP_HARD.json"))[0])
+        # crash-resume: the artifact may predate this session (or come
+        # from tools/synth_ap.py, which records no checkpoint) — fall
+        # back to the session workdir's latest checkpoint
+        ckpt = res.get("checkpoint")
+        if not ckpt or not os.path.exists(ckpt):
+            from improved_body_parts_tpu.train.checkpoint import (
+                latest_checkpoint)
+            ckpt = latest_checkpoint(os.path.join(work, "ckpt"))
+        anno = os.path.join(work, "person_keypoints_synth.json")
+        if not ckpt or not os.path.exists(anno):
+            print("[skip] TTA_HARD: no checkpoint/val for the existing "
+                  "SYNTH_AP_HARD.json (rerun with --force)", flush=True)
+            return
+        from improved_body_parts_tpu.config import get_config
+        _call_main(self.tta_cli, [
+            "--config", "synth_deep", "--checkpoint", ckpt,
+            "--anno", anno,
+            "--images", os.path.join(work, "val"),
+            # match SYNTH_AP_HARD's eval protocol: boxsize = the config's
+            # input height (tta_bench's 0 default falls through to the
+            # 640 COCO default, which would rescale every val person off
+            # the trained scale and invalidate the grid comparison)
+            "--boxsize", get_config("synth_deep").skeleton.height,
+            "--out", self.art("TTA_HARD.json")])
+
+    def run_ab(self):
+        a = self.args
+        arms = set(a.ab_arms)
+        for seed in a.seeds:
+            base_out = self.art(f"SYNTH_AP_DEEP_S{seed}.json")
+            deep = dict(config="synth_deep", epochs=a.ab_epochs,
+                        canvas=(384, 512), val_images=64, val_seed=777,
+                        seed=seed, fresh_baseline=False)
+            if "base" in arms:
+                self.try_run(base_out, **deep)
+            # gate SWA on a COMPLETED base artifact: a partial base
+            # checkpoint would train "SWA" from the wrong epoch and the
+            # poisoned artifact would never self-correct (skip-resume)
+            if "swa" in arms and not os.path.exists(base_out):
+                print(f"[skip] SWA S{seed}: base artifact {base_out} "
+                      "missing/failed", flush=True)
+            elif "swa" in arms:
+                self.try_run(
+                    self.art(f"SYNTH_AP_DEEP_SWA_S{seed}.json"),
+                    config="synth_deep",
+                    epochs=0, canvas=(384, 512), val_images=64, val_seed=777,
+                    seed=seed, fresh_baseline=False,
+                    swa_from=os.path.join(a.work_root,
+                                          os.path.splitext(base_out)[0]),
+                    swa_epochs=a.swa_epochs, base_artifact=base_out)
+            if "devgt" in arms:
+                self.try_run(self.art(f"SYNTH_AP_DEEP_DEVICEGT_S{seed}.json"),
+                             device_gt=8, **deep)
+            crowd = dict(config="synth", epochs=a.crowd_epochs,
+                         canvas=(192, 256),
+                         train_images=48, val_images=64, val_seed=777,
+                         seed=seed, crowd=True, fresh_baseline=False)
+            if "crowd" in arms:
+                self.try_run(self.art(f"SYNTH_AP_CROWD_S{seed}.json"),
+                             **crowd)
+                self.try_run(
+                    self.art(f"SYNTH_AP_CROWD_UNMASKED_S{seed}.json"),
+                    mask_extras=False, **crowd)
+        if a.smoke:
+            # ab_summary's globs match the REAL artifact names; running it
+            # here would aggregate real chip data under a SMOKE_ label
+            print("[skip] AB_SUMMARY in smoke mode", flush=True)
+            return
+        import ab_summary
+        _call_main(ab_summary, ["--dir", ".", "--out", "AB_SUMMARY.json"])
+
+
+def main():
+    ap = argparse.ArgumentParser(description="one-process TPU train session")
+    ap.add_argument("--sections", nargs="+",
+                    default=["canonical", "hard", "ab"],
+                    choices=["canonical", "hard", "ab"])
+    ap.add_argument("--seeds", type=int, nargs="+", default=[0, 1, 2])
+    ap.add_argument("--ab-arms", nargs="+",
+                    default=["base", "swa", "devgt", "crowd"],
+                    choices=["base", "swa", "devgt", "crowd"],
+                    help="which A/B arms to run (CPU fallback sessions can "
+                         "pick just the cheap crowd pair)")
+    ap.add_argument("--canonical-epochs", type=int, default=30)
+    ap.add_argument("--canonical-images", type=int, default=96)
+    ap.add_argument("--hard-epochs", type=int, default=30)
+    ap.add_argument("--ab-epochs", type=int, default=10)
+    ap.add_argument("--crowd-epochs", type=int, default=60)
+    ap.add_argument("--swa-epochs", type=int, default=5)
+    ap.add_argument("--work-root", default="/tmp/tpu_train_session")
+    ap.add_argument("--session-out", default="TPU_TRAIN_SESSION.json")
+    ap.add_argument("--force", action="store_true",
+                    help="re-run even when the artifact already exists")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny epoch counts for a CPU protocol check")
+    args = ap.parse_args()
+    if args.smoke:
+        # the SMOKE_ prefix covers the session summary too — a CPU
+        # protocol check must not overwrite a real session's record
+        if args.session_out == "TPU_TRAIN_SESSION.json":
+            args.session_out = "SMOKE_TPU_TRAIN_SESSION.json"
+        args.canonical_epochs = 1
+        args.canonical_images = 8
+        args.hard_epochs = 1
+        args.ab_epochs = 1
+        args.crowd_epochs = 1
+        args.swa_epochs = 1
+        args.seeds = args.seeds[:1]
+    os.makedirs(args.work_root, exist_ok=True)
+
+    from improved_body_parts_tpu.utils import (apply_platform_env,
+                                               devices_with_timeout)
+    apply_platform_env()
+    try:
+        devices = devices_with_timeout(60 if args.smoke else BIND_TIMEOUT_S)
+    except (RuntimeError, TimeoutError) as e:
+        print(f"backend bind failed: {e}", flush=True)
+        raise SystemExit(3)
+
+    sess = Session(args)
+    sess.summary["platform"] = devices[0].platform
+    sess.summary["n_devices"] = len(devices)
+    print(f"platform={devices[0].platform} agenda={args.sections}",
+          flush=True)
+    for name in args.sections:
+        sess.section(name, {"canonical": sess.run_canonical,
+                            "hard": sess.run_hard,
+                            "ab": sess.run_ab}[name])
+    sess.flush()
+    print(json.dumps(sess.summary))
+
+
+if __name__ == "__main__":
+    main()
