@@ -1,0 +1,80 @@
+"""Unit coverage for the one-process session driver and the 3-arm
+distributed-drive helpers (tools/tpu_train_session.py, tools/dist_drive.py).
+
+The full orchestration is exercised by the committed CPU smokes
+(SMOKE_*-prefixed artifacts); these tests pin the pieces whose failure
+modes were caught in review: smoke-prefix isolation, epoch-keyed loss
+parsing (leading-newline log format, duplicate epochs after a
+crash-resume), corpus-parameter pinning, and idempotent arm skipping.
+"""
+import argparse
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.mark.quick
+def test_smoke_prefix_isolates_artifacts_and_session_out():
+    import tpu_train_session as t
+
+    ns = argparse.Namespace(smoke=True)
+    sess = object.__new__(t.Session)
+    sess.args = ns
+    assert t.Session.art(sess, "SYNTH_AP_HARD.json") == \
+        "SMOKE_SYNTH_AP_HARD.json"
+    ns.smoke = False
+    assert t.Session.art(sess, "SYNTH_AP_HARD.json") == "SYNTH_AP_HARD.json"
+
+
+@pytest.mark.quick
+def test_epoch_losses_handles_log_format_and_duplicates(tmp_path):
+    """The train loop writes '\\nEpoch k\\ttrain_loss: ...' (leading
+    newline); a crash between the log line and the checkpoint write makes
+    the resumed run append a SECOND line for the same epoch — last one
+    wins (the review-caught off-by-one slicing failure mode)."""
+    from dist_drive import epoch_losses, have_epochs
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    (ckpt / "log").write_text(
+        "\nEpoch 0\ttrain_loss: 10.0\tval_loss: 0.0"
+        "\nEpoch 1\ttrain_loss: 9.0\tval_loss: 0.0"
+        "\nEpoch 1\ttrain_loss: 8.5\tval_loss: 0.0")  # retried epoch
+    assert epoch_losses(str(ckpt)) == [10.0, 8.5]
+    assert have_epochs(str(ckpt), 2)
+    assert not have_epochs(str(ckpt), 3)
+    # missing log = zero epochs, not an exception
+    assert epoch_losses(str(tmp_path / "absent")) == []
+
+
+@pytest.mark.quick
+def test_fixture_param_pin_refuses_mismatched_rerun(tmp_path, monkeypatch):
+    """synth_run must refuse to reuse a corpus built with different
+    parameters while stamping the artifact with the new ones."""
+    import tpu_train_session as t
+
+    work = tmp_path / "w"
+    work.mkdir()
+    (work / "train_drawn.h5").write_bytes(b"")  # corpus "exists"
+    pin = {"config": "synth_deep", "train_images": 96, "val_images": 64,
+           "people": 2, "canvas": [384, 512], "seed": 0, "val_seed": 777,
+           "crowd": False, "hard": False, "mask_extras": True}
+    (work / "fixture_params.json").write_text(json.dumps(
+        dict(pin, train_images=48)))
+
+    ns = argparse.Namespace(smoke=False, force=False,
+                            work_root=str(tmp_path),
+                            session_out=str(tmp_path / "s.json"))
+    sess = object.__new__(t.Session)
+    sess.args = ns
+    sess.summary = {"platform": "cpu"}
+    with pytest.raises(AssertionError, match="different"):
+        t.Session.synth_run(
+            sess, str(tmp_path / "OUT.json"), config="synth_deep",
+            epochs=1, canvas=(384, 512), val_images=64, val_seed=777,
+            seed=0, workdir=str(work))

@@ -226,6 +226,11 @@ class Session:
                 # train only the REMAINING epochs (train.py's --epochs is
                 # additional after a resume)
                 additional = swa_epochs - (latest_epochs - base_epochs)
+                if additional <= 0:
+                    # crash fell between the final SWA checkpoint save
+                    # and the done-marker write: the stage IS complete
+                    # and pre_swa already points at the SWA checkpoint
+                    pre_swa = None
                 if additional > 0:
                     # train.py's SWA loop checkpoints every swa_freq
                     # epochs — a cadence longer than the stage would
@@ -247,12 +252,27 @@ class Session:
             done = latest_checkpoint(ckpt_dir)
             additional = epochs
             resume_args = []
+            # training parameters get their own pin (separate from the
+            # corpus pin, which the SWA arm shares): a crash-resume must
+            # not continue a checkpoint trained under different
+            # epochs/lr/device_gt while stamping the artifact with the
+            # new values
+            tpin = {"epochs": epochs, "lr": lr, "device_gt": device_gt,
+                    "config": config}
+            tpin_path = os.path.join(work, "train_params.json")
             if done:
+                assert os.path.exists(tpin_path) and json.load(
+                    open(tpin_path)) == tpin, (
+                    f"{ckpt_dir} holds a run trained under different "
+                    f"parameters than {tpin}; use a fresh --work-root")
                 done_epochs = int(os.path.basename(done).split("_")[1]) + 1
                 additional = epochs - done_epochs
                 resume_args = ["--resume", "auto"]
                 print(f"[resume] {out}: {done_epochs} epochs done, "
                       f"{max(additional, 0)} to go", flush=True)
+            else:
+                with open(tpin_path, "w") as f:
+                    json.dump(tpin, f)
             if additional > 0:
                 argv = (["--config", config, "--epochs", additional,
                          "--train-h5", corpus, "--checkpoint-dir", ckpt_dir,

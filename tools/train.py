@@ -246,10 +246,17 @@ def main():
         # the live weights while keeping the running average intact.
         # (start_swa already ran above when entering SWA fresh.)
         state = swap_swa_params(state)
+    from improved_body_parts_tpu.train.loop import _log_line
     for epoch in range(start_epoch, start_epoch + epochs):
         state, train_loss = train_epoch(
             state, train_step, make_train_batches(epoch), cfg, epoch,
             mesh=mesh, is_lead_host=is_lead)
+        if is_lead:
+            # same append-only epoch log fit() writes (reference logs its
+            # SWA epochs too, train_distributed_SWA.py) — without it the
+            # SWA stage leaves no loss provenance for the artifacts
+            _log_line(cfg.train.checkpoint_dir,
+                      f"\nEpoch {epoch}\ttrain_loss: {train_loss}")
         if (epoch - start_epoch + 1) % args.swa_freq == 0:
             state = update_swa(state)
             # collective save (orbax barriers across processes)
