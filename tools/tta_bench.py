@@ -12,9 +12,11 @@ scripts (TTA_SYNTH.json); this is the committed tool.
     python tools/tta_bench.py --config synth_deep --checkpoint ckpt/epoch_N \
         --anno person_keypoints.json --images val/ --out TTA.json
 
-Grids: single (scale 1, no rotation — the default protocol),
-rot±30 (the reference's hard-pose rotation ensemble), ms (0.8/1.0/1.2
-multi-scale).  All run device-resident through the compact ms path.
+Grids: single (scale 1, no rotation — the default protocol), rot±30
+(the reference's hard-pose rotation ensemble), rot±60 (covers the hard
+synthetic tier's ±60° figure rotations), ms (0.8/1.0/1.2 multi-scale),
+and ms×rot±60 (the full 15-lane product grid the reference's TTA
+surface spans).  All run device-resident through the compact ms path.
 """
 import argparse
 import dataclasses
@@ -32,7 +34,14 @@ sys.path.insert(0, _TOOLS)  # for `from evaluate import load_predictor`
 GRIDS = {
     "single_scale": {},
     "rotation_pm30": {"rotation_search": (0.0, 30.0, -30.0)},
+    # the hard synthetic tier rotates figures up to ±60° — a ±30 grid
+    # cannot cover it; the reference's rotation search takes arbitrary
+    # angle lists (reference: evaluate.py:89-90)
+    "rotation_pm60": {"rotation_search": (0.0, 30.0, -30.0, 60.0, -60.0)},
     "multi_scale": {"scale_search": (0.8, 1.0, 1.2)},
+    # the full product grid the reference's TTA surface spans
+    "ms_rot_pm60": {"scale_search": (0.8, 1.0, 1.2),
+                    "rotation_search": (0.0, 30.0, -30.0, 60.0, -60.0)},
 }
 
 
