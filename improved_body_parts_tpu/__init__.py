@@ -19,6 +19,8 @@ Subpackages
                 parallel_encoding/paralle.py — obsolete under SPMD)
 - ``train``     schedules, train state, SPMD training loop, SWA
 - ``infer``     multi-scale flip-ensemble prediction, decoding, COCO evaluation
+- ``serve``     dynamic-batching request serving (shape-bucket coalescing,
+                bounded admission, device-replica round-robin, warmup precompile)
 - ``utils``     meters, padding, logging helpers
 """
 

@@ -22,6 +22,48 @@ from ..config import InferenceParams, SkeletonConfig
 from .decode import CompactOverflow, decode, decode_compact
 
 
+def compact_decode_fn(predictor, params: Optional[InferenceParams] = None,
+                      skeleton: Optional[SkeletonConfig] = None,
+                      use_native: bool = True
+                      ) -> Callable[[object, np.ndarray], list]:
+    """Build the one-``CompactResult`` decoder with the documented
+    overflow fallback — the decode-side plumbing shared by
+    ``pipelined_inference`` and ``serve.DynamicBatcher`` (both run the
+    returned callable on thread pools; with the native decoder the GIL is
+    released during the ctypes call, so workers truly parallelize).
+
+    The returned ``decode_one(compact_res, image)`` decodes one image's
+    compact payload; on ``CompactOverflow`` (peak/candidate counts past
+    the device top-K capacity) it transparently re-runs that image
+    through the full-map path — ``predict_fast`` for the trivial grid,
+    the host ``Predictor.predict`` for scale/rotation grids (which the
+    fast path rejects).
+    """
+    from .predict import trivial_grid
+
+    params = params or predictor.params
+    skeleton = skeleton or predictor.skeleton
+    single_dispatch_grid = trivial_grid(params)
+
+    def decode_one(compact_res, image: np.ndarray) -> list:
+        try:
+            return decode_compact(compact_res, params, skeleton,
+                                  use_native=use_native)
+        except CompactOverflow:
+            if not single_dispatch_grid:
+                # scale/rotation grids can't use the fast path; fall back
+                # to the full map-transfer protocol for this image
+                heat, paf = predictor.predict(image, params=params)
+                return decode(heat, paf, params, skeleton,
+                              use_native=use_native)
+            heat, paf, mask, scale = predictor.predict_fast_async(
+                image, params=params)()
+            return decode(heat, paf, params, skeleton, peak_mask=mask,
+                          coord_scale=scale, use_native=use_native)
+
+    return decode_one
+
+
 def pipelined_inference(predictor, images: Iterable[np.ndarray],
                         params: Optional[InferenceParams] = None,
                         skeleton: Optional[SkeletonConfig] = None,
@@ -61,19 +103,10 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
         return decode(heat, paf, params, skeleton, peak_mask=mask,
                       coord_scale=scale, use_native=use_native)
 
-    def decode_one_compact(compact_res, image: np.ndarray):
-        try:
-            return decode_compact(compact_res, params, skeleton,
-                                  use_native=use_native)
-        except CompactOverflow:
-            if not single_dispatch_grid:
-                # scale/rotation grids can't use the fast path; fall back
-                # to the full map-transfer protocol for this image
-                heat, paf = predictor.predict(image, params=params)
-                return decode(heat, paf, params, skeleton,
-                              use_native=use_native)
-            return run_decode(
-                predictor.predict_fast_async(image, params=params))
+    # the shared compact decode plumbing (overflow fallback included) —
+    # same callable the serving engine's decode pool runs
+    decode_one_compact = compact_decode_fn(predictor, params, skeleton,
+                                           use_native)
 
     def run_decode_compact(resolve: Callable, image: np.ndarray):
         return decode_one_compact(resolve(), image)

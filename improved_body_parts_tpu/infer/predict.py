@@ -318,8 +318,12 @@ class Predictor:
             out = preds[-1][0]                    # (2N, h/4, w/4, C)
             maps = self._merge_flip(out[:n], out[n:, :, ::-1, :])
             h, w = maps.shape[1] * stride, maps.shape[2] * stride
-            maps = jax.vmap(lambda m: jax.image.resize(
-                m, (h, w, m.shape[-1]), method="cubic"))(maps)
+            # one 4-d resize, NOT vmap-of-3-d: unchanged dims are
+            # identity-skipped inside jax.image.resize, while the vmapped
+            # form lowers to a per-sample gather that costs ~40% of the
+            # whole batch program at 512px (serve_bench round 1 finding)
+            maps = jax.image.resize(maps, (n, h, w, maps.shape[-1]),
+                                    method="cubic")
             return jax.vmap(one_image)(maps, valid_h, valid_w)
 
         return fn
@@ -471,20 +475,116 @@ class Predictor:
                            ) -> Tuple[int, int]:
         """Predicted padded input shape for this image under the
         single-scale protocol — the grouping key for compact batching
-        (``infer.pipeline`` buckets a stream by this so full-occupancy
-        batches share one compiled program).
+        (``infer.pipeline`` and ``serve.DynamicBatcher`` bucket a stream
+        by this so full-occupancy batches share one compiled program).
 
         Advisory only: ``predict_compact_batch_async`` regroups by the
         ACTUAL prepared shapes, so a rare rounding mismatch with cv2's
         resize costs a split batch, never correctness.
         """
-        prm = params or self.params
         oh, ow = image_bgr.shape[:2]
+        return self.compact_lane_shape_for(oh, ow, params)
+
+    def compact_lane_shape_for(self, oh: int, ow: int,
+                               params: Optional[InferenceParams] = None
+                               ) -> Tuple[int, int]:
+        """:meth:`compact_lane_shape` from an (H, W) size instead of an
+        image — lets callers enumerate the bucket shapes a deployment's
+        expected image sizes land on without materializing images."""
+        prm = params or self.params
         scale = self._clamp_scale(
             prm.scale_search[0] * self.model_params.boxsize / oh, oh, ow)
         rh, rw = round(oh * scale), round(ow * scale)
         b = self.bucket
         return (rh + (-rh) % b, rw + (-rw) % b)
+
+    def enumerate_bucket_shapes(self, image_sizes: Sequence[Tuple[int, int]],
+                                params: Optional[InferenceParams] = None
+                                ) -> "list[Tuple[int, int]]":
+        """Deduplicated, sorted padded lane shapes the given (H, W) image
+        sizes bucket into under the single-scale protocol — the shape set
+        a serving deployment must precompile (:meth:`precompile_compact`)
+        so first requests never hit a compile stall."""
+        return sorted({self.compact_lane_shape_for(oh, ow, params)
+                       for oh, ow in image_sizes})
+
+    def device_replica(self, device) -> "Predictor":
+        """A serving replica of this predictor pinned to ``device``:
+        shares the model, config and the jitted-program cache (jax
+        re-specializes a cached program's executable per input
+        placement); only the variables are copied onto the target
+        device.  ``serve.DynamicBatcher`` round-robins batches across
+        replicas — data-parallel serving over a pod's chips (or a CPU
+        host's virtual devices), one batch per device at a time.
+        """
+        import copy
+
+        import jax
+
+        if self.mesh is not None:
+            raise ValueError(
+                "device_replica replicates WHOLE devices; a mesh-sharded "
+                "predictor already spans devices")
+        clone = copy.copy(self)
+        clone.variables = jax.device_put(self.variables, device)
+        return clone  # _fns intentionally shared (same program cache)
+
+    def precompile_compact(self, lane_shapes: Sequence[Tuple[int, int]],
+                           batch_sizes: Sequence[int] = (1,),
+                           thre1: Optional[float] = None,
+                           params: Optional[InferenceParams] = None) -> int:
+        """Compile (and warm) the compact-batch program for every
+        (lane shape × batch size) combination by running it once on
+        zeros, blocking until each executable is built.
+
+        This is the serving engine's startup warmup hook: with the
+        persistent compilation cache on (``utils.platform``), the first
+        process ever pays the real XLA compile, every later process a
+        cache load — and in both cases the cost lands at startup, not on
+        the first unlucky request in each bucket.  Pass every power of
+        two ≤ ``max_batch`` as ``batch_sizes`` to cover the exact-size
+        pow2 chunks ``predict_compact_batch_async`` dispatches.
+
+        Returns the number of programs that were NOT already in this
+        predictor's program cache (0 on a fully warm predictor).
+        """
+        import jax
+
+        prm = params or self.params
+        if not trivial_grid(prm):
+            raise ValueError(
+                "precompile_compact covers the single-scale compact-batch "
+                "protocol; scale/rotation grids compile per image")
+        if thre1 is None:
+            thre1 = prm.thre1
+        spec = (prm.thre2, prm.mid_num, prm.offset_radius, self.compact_topk,
+                prm.connect_ration)
+        # the row-concat/stack helpers are part of the serving hot path
+        # (multi-chunk flushes); touching the properties pre-creates them
+        self._concat_rows_fn, self._stack_rows_fn  # noqa: B018
+        compiled = 0
+        for h, w in lane_shapes:
+            # the single-image compact program too: serving dispatches a
+            # singleton flush (deadline straggler) through it instead of
+            # the batch path's stack/group/concat machinery
+            compiled += ((h, w), "compact", thre1, spec) not in self._fns
+            one = self._ensemble_fn((int(h), int(w)), mode="compact",
+                                    thre1=thre1, compact_spec=spec)
+            jax.block_until_ready(one(
+                self.variables, np.zeros((h, w, 3), np.float32),
+                int(h), int(w)))
+            for n in batch_sizes:
+                shape = (int(n), int(h), int(w), 3)
+                compiled += (shape, "compact_batch", thre1,
+                             spec) not in self._fns
+                fn = self._ensemble_fn(shape, mode="compact_batch",
+                                       thre1=thre1, compact_spec=spec)
+                out = fn(self.variables,
+                         np.zeros(shape, np.float32),
+                         np.full((shape[0],), h, np.int32),
+                         np.full((shape[0],), w, np.int32))
+                jax.block_until_ready(out)
+        return compiled
 
     def _merge_flip(self, straight, mirrored):
         """The flip-ensemble merge shared by the single (2-lane) and

@@ -1,0 +1,19 @@
+"""Dynamic-batching inference serving (ROADMAP: the path from concurrent
+user requests to the chip).
+
+- ``batcher``  — :class:`DynamicBatcher`: shape-bucketed coalescing,
+  ``max_batch``/``max_wait_ms`` flush, bounded admission with explicit
+  load-shedding (:class:`ServerOverloaded`), per-request futures.
+- ``metrics``  — :class:`ServeMetrics`: queue depth, batch occupancy
+  histogram, p50/p95/p99 latency, imgs/sec.
+- ``warmup``   — startup precompile of every (bucket shape × pow2 batch
+  size) program through the persistent compilation cache.
+
+Load generator / benchmark: ``tools/serve_bench.py`` → SERVE_BENCH.json.
+"""
+from .batcher import DynamicBatcher, ServerOverloaded
+from .metrics import ServeMetrics
+from .warmup import pow2_batch_sizes, precompile
+
+__all__ = ["DynamicBatcher", "ServerOverloaded", "ServeMetrics",
+           "pow2_batch_sizes", "precompile"]

@@ -1,0 +1,399 @@
+"""Asynchronous dynamic batcher: many concurrent single-image requests →
+shape-bucketed compact batches on the chip.
+
+Every inference entry point below this layer (``Predictor.predict*``,
+``pipelined_inference``) consumes a pre-known iterable; this is the path
+from independently-arriving requests to the chip.  E2E_BENCH.json shows
+the compact path is forward-bound on-chip but only wins when the 2N
+forward lanes are full, so throughput under real load hinges on batch
+occupancy — the serving twin of the large-effective-batch principle the
+training side exploits.
+
+Design:
+
+- **Admission** is bounded by ``max_queue`` in-flight requests (a
+  semaphore held from submit to completion).  When full, :meth:`submit`
+  raises :class:`ServerOverloaded` immediately — explicit load-shedding,
+  never unbounded growth, and in-flight work keeps draining.
+- **Coalescing**: a single dispatcher thread groups requests by
+  ``Predictor.compact_lane_shape`` (the same ``pad_right_down`` bucket
+  geometry every compact program is compiled against, so one jitted
+  ``predict_compact_batch_async`` program per bucket serves all
+  traffic).  A bucket flushes when it reaches ``max_batch`` occupancy or
+  when its oldest request has waited ``max_wait_ms`` — the classic
+  throughput/latency knob pair.
+- **Completion**: the device program is dispatched asynchronously; a
+  decode thread pool (the plumbing shared with
+  ``infer.pipeline.compact_decode_fn``, GIL-released under the native
+  decoder) resolves the single packed transfer and fulfils each
+  request's future with decoded skeletons.  Results always map back to
+  their own request (``predict_compact_batch_async`` returns input
+  order), so arrival order is preserved per caller.
+- **Warmup**: :meth:`warmup` precompiles every configured bucket shape at
+  every power-of-two batch size ≤ ``max_batch`` through the persistent
+  compilation cache (``utils.platform``), so the first request in each
+  bucket never eats a compile stall.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import InferenceParams, SkeletonConfig
+from ..infer.pipeline import compact_decode_fn
+from .metrics import ServeMetrics
+from .warmup import precompile
+
+_STOP = object()
+_KICK = object()   # device went idle — wake the dispatcher to flush
+
+
+class ServerOverloaded(RuntimeError):
+    """Admission queue full — the request was rejected (load shed).
+
+    The explicit fail-fast status: callers retry with backoff or surface
+    a 503; the server keeps serving everything already admitted."""
+
+
+class _Request:
+    __slots__ = ("image", "future", "t_submit", "finished")
+
+    def __init__(self, image: np.ndarray):
+        self.image = image
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+        self.finished = False  # server-side once-flag (see _finish)
+
+
+class DynamicBatcher:
+    """Dynamic-batching compact-inference server around one Predictor.
+
+    ::
+
+        with DynamicBatcher(pred, max_batch=8, max_wait_ms=5) as server:
+            server.warmup([(512, 512)])
+            fut = server.submit(image_bgr)       # from any thread
+            skeletons = fut.result()             # list[(coco_kps, score)]
+
+    Restricted to the trivial (single-scale, no-rotation) grid — the
+    protocol whose bucket geometry lets one compiled batch program per
+    shape serve all traffic; grid ensembles dispatch per image and
+    belong on the offline paths.
+
+    The predictor itself is driven only from the internal dispatcher
+    thread (plus the decode pool's overflow fallback, which re-runs
+    single images); callers never touch it concurrently.
+    """
+
+    def __init__(self, predictor, params: Optional[InferenceParams] = None,
+                 skeleton: Optional[SkeletonConfig] = None, *,
+                 max_batch: int = 8, max_wait_ms: float = 25.0,
+                 max_queue: int = 64, decode_workers: int = 2,
+                 use_native: bool = True, devices: Optional[Sequence] = None,
+                 eager_idle_flush: bool = True,
+                 metrics: Optional[ServeMetrics] = None):
+        from ..infer.predict import trivial_grid
+
+        self.predictor = predictor
+        self.params = params or predictor.params
+        self.skeleton = skeleton or predictor.skeleton
+        if not trivial_grid(self.params):
+            raise ValueError(
+                "DynamicBatcher serves the single-scale protocol; "
+                "scale/rotation grids dispatch per image — use "
+                "predict_compact_ms / pipelined_inference for those")
+        if max_batch < 1 or max_queue < 1:
+            raise ValueError(f"max_batch={max_batch} and max_queue="
+                             f"{max_queue} must be >= 1")
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1e3
+        self.max_queue = max_queue
+        # True: flush pending work the moment a device goes idle (hide
+        # the coalescing wait behind in-flight work — the throughput
+        # default).  False: only max_batch / deadline flush — maximizes
+        # occupancy at the cost of idle device time; also what makes
+        # flush behavior deterministic for tests.
+        self.eager_idle_flush = eager_idle_flush
+        self.metrics = metrics or ServeMetrics()
+        self._decode_one = compact_decode_fn(predictor, self.params,
+                                             self.skeleton, use_native)
+        self._decode_workers = max(1, decode_workers)
+        # device replicas: data-parallel serving — each batch runs whole
+        # on the least-loaded replica's device (a pod's chips, or a CPU
+        # host's virtual devices).  The serial per-image paths can only
+        # ever drive one device; this is throughput the engine alone
+        # unlocks.
+        if devices:
+            self._replicas = [predictor.device_replica(d) for d in devices]
+        else:
+            self._replicas = [predictor]
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._fetchqs = [queue.SimpleQueue() for _ in self._replicas]
+        self._slots = threading.BoundedSemaphore(max_queue)
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._dispatcher: Optional[threading.Thread] = None
+        self._fetchers: "list[threading.Thread]" = []
+        self._running = False
+        # per-replica batches dispatched whose device results are not yet
+        # fetched — the dispatcher's "is a device idle" signal for idle
+        # flushes and its least-loaded routing key
+        self._in_flight = [0] * len(self._replicas)
+        self._in_flight_lock = threading.Lock()
+        self._finish_lock = threading.Lock()
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "DynamicBatcher":
+        if self._running:
+            return self
+        self._pool = ThreadPoolExecutor(
+            max_workers=self._decode_workers,
+            thread_name_prefix="serve-decode")
+        self._running = True
+        self._dispatcher = threading.Thread(
+            target=self._run, name="serve-dispatcher", daemon=True)
+        self._fetchers = [
+            threading.Thread(target=self._run_fetcher, args=(i,),
+                             name=f"serve-fetcher-{i}", daemon=True)
+            for i in range(len(self._replicas))]
+        self._dispatcher.start()
+        for t in self._fetchers:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        """Drain everything admitted, then shut down.  Every future
+        returned by :meth:`submit` before the stop completes."""
+        if not self._running:
+            return
+        self._running = False
+        self._queue.put(_STOP)
+        self._dispatcher.join()
+        self._dispatcher = None
+        # the dispatcher flushed everything before exiting; now drain the
+        # fetch pipelines behind it
+        for q in self._fetchqs:
+            q.put(_STOP)
+        for t in self._fetchers:
+            t.join()
+        self._fetchers = []
+        # a submit that raced the _running flip may have enqueued behind
+        # the sentinel; fail those futures rather than hang their callers
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not _STOP and req is not _KICK:
+                self._finish(req, error=RuntimeError("batcher stopped"))
+        self._pool.shutdown(wait=True)
+        self._pool = None
+
+    def __enter__(self) -> "DynamicBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, image_bgr: np.ndarray) -> Future:
+        """Enqueue one BGR image; returns a future resolving to the
+        decoded skeletons (``decode_compact`` output: a list of
+        (coco_keypoints, score) tuples).
+
+        :raises ServerOverloaded: ``max_queue`` requests already in
+            flight — fail-fast backpressure, nothing is queued.
+        :raises RuntimeError: the batcher is not running.
+        """
+        if not self._running:
+            raise RuntimeError("DynamicBatcher is not running "
+                               "(use `with batcher:` or call start())")
+        if not self._slots.acquire(blocking=False):
+            self.metrics.on_reject()
+            raise ServerOverloaded(
+                f"{self.max_queue} requests in flight (max_queue); "
+                "retry with backoff")
+        req = _Request(image_bgr)
+        self.metrics.on_submit()
+        self._queue.put(req)
+        if not self._running:
+            # raced stop(): the drain may already have passed our queue
+            # entry, which would strand this future forever.  _finish is
+            # idempotent, so if the dispatcher did catch it, this no-ops.
+            self._finish(req, error=RuntimeError("batcher stopped"))
+        return req.future
+
+    # ------------------------------------------------------------- warmup
+    def warmup(self, image_sizes: Sequence[Tuple[int, int]],
+               batch_sizes: Optional[Sequence[int]] = None) -> dict:
+        """Precompile the batch programs the configured traffic needs:
+        every bucket the given (H, W) image sizes land in × every
+        power-of-two batch size ≤ ``max_batch`` (or an explicit
+        ``batch_sizes``), on EVERY device replica.  Call before
+        accepting traffic; see :func:`serve.warmup.precompile` for the
+        returned summary."""
+        out = None
+        for replica in self._replicas:
+            info = precompile(replica, image_sizes, self.max_batch,
+                              params=self.params, batch_sizes=batch_sizes)
+            # replicas share the program cache, so only the first pass
+            # reports new programs; the later passes still build/warm
+            # each device's executable
+            out = out or info
+        return out
+
+    # --------------------------------------------------------- dispatcher
+    def _run(self) -> None:
+        """The coalescing loop.  A bucket flushes when any of:
+
+        - it reached ``max_batch`` occupancy (full lanes — always);
+        - its oldest request waited out ``max_wait_ms`` (the latency
+          promise — always);
+        - the device went idle (no batch in flight): holding requests
+          back can only raise occupancy if the wait is hidden behind
+          in-flight work, so an idle device flushes whatever exists
+          immediately.  This makes throughput insensitive to
+          ``max_wait_ms`` — the deadline buys occupancy only out of
+          time the device was busy anyway.
+        """
+        pending: Dict[Tuple[int, int], List[_Request]] = {}
+        stop = False
+        while not stop:
+            timeout = None
+            if pending:
+                oldest = min(reqs[0].t_submit for reqs in pending.values())
+                timeout = max(0.0, oldest + self.max_wait_s
+                              - time.perf_counter())
+            try:
+                item = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                item = None
+            if item is _STOP:
+                stop = True
+            elif item is not None and item is not _KICK:
+                try:
+                    key = self.predictor.compact_lane_shape(item.image,
+                                                            self.params)
+                except Exception as e:  # noqa: BLE001 — a malformed
+                    # image must fail ITS future, never the dispatcher
+                    self._finish(item, error=e)
+                    continue
+                bucket = pending.setdefault(key, [])
+                bucket.append(item)
+                if len(bucket) >= self.max_batch:
+                    self._dispatch(pending.pop(key))
+            now = time.perf_counter()
+            with self._in_flight_lock:
+                idle = (self.eager_idle_flush
+                        and min(self._in_flight) == 0)
+            # oldest bucket first: deadline and idle flushes drain in
+            # arrival order
+            for key in sorted(pending,
+                              key=lambda k: pending[k][0].t_submit):
+                if stop or idle or (now - pending[key][0].t_submit
+                                    >= self.max_wait_s):
+                    self._dispatch(pending.pop(key))
+                    with self._in_flight_lock:
+                        idle = (self.eager_idle_flush
+                                and min(self._in_flight) == 0)
+
+    def _dispatch(self, reqs: List[_Request]) -> None:
+        """Dispatch one shape bucket's batch to the least-loaded device
+        replica (async) and queue its fetch.  Runs on the dispatcher
+        thread; a dispatch failure fails exactly this batch's futures and
+        the loop keeps serving."""
+        with self._in_flight_lock:
+            idx = min(range(len(self._replicas)),
+                      key=self._in_flight.__getitem__)
+        try:
+            if len(reqs) == 1:
+                # singleton flush: the single-image compact program skips
+                # the batch path's stack/group/concat machinery
+                resolve_one = self._replicas[idx].predict_compact_async(
+                    reqs[0].image, thre1=self.params.thre1,
+                    params=self.params)
+                resolve = lambda: [resolve_one()]  # noqa: E731
+            else:
+                resolve = self._replicas[idx].predict_compact_batch_async(
+                    [r.image for r in reqs], thre1=self.params.thre1,
+                    params=self.params)
+        except Exception as e:  # noqa: BLE001 — delivered per request
+            for r in reqs:
+                self._finish(r, error=e)
+            return
+        self.metrics.on_dispatch(len(reqs))
+        with self._in_flight_lock:
+            self._in_flight[idx] += 1
+        self._fetchqs[idx].put((reqs, resolve))
+
+    def _run_fetcher(self, idx: int) -> None:
+        """One replica's fetch stage: block on each batch's single
+        device→host transfer (FIFO per replica — a device executes its
+        dispatches in order, so waiting in dispatch order is optimal),
+        then fan the per-image decodes out to the pool.  Dedicated
+        threads so a resolve wait can never occupy a decode worker —
+        with every worker stuck fetching, nothing would decode and the
+        pipeline would stall."""
+        while True:
+            item = self._fetchqs[idx].get()
+            if item is _STOP:
+                return
+            reqs, resolve = item
+            try:
+                results = resolve()
+            except Exception as e:  # noqa: BLE001 — delivered per request
+                self._batch_done(idx)
+                for r in reqs:
+                    self._finish(r, error=e)
+                continue
+            self._batch_done(idx)
+            for r, res in zip(reqs, results):
+                try:
+                    self._pool.submit(self._decode_and_finish, r, res)
+                except RuntimeError:  # pool draining (stop()) — inline
+                    self._decode_and_finish(r, res)
+
+    def _batch_done(self, idx: int) -> None:
+        """One batch's device results landed: drop the replica's
+        in-flight count and wake the dispatcher so an idle device gets
+        fed at once."""
+        with self._in_flight_lock:
+            self._in_flight[idx] -= 1
+            idle = self._in_flight[idx] == 0
+        if idle and self._running:
+            self._queue.put(_KICK)
+
+    def _decode_and_finish(self, req: _Request, res) -> None:
+        try:
+            self._finish(req, result=self._decode_one(res, req.image))
+        except Exception as e:  # noqa: BLE001 — delivered per request
+            self._finish(req, error=e)
+
+    def _finish(self, req: _Request, result=None, error=None) -> None:
+        """Fulfil one request exactly once: metrics, future, admission
+        slot.  Keyed on the request's own once-flag, NOT future.done():
+        a caller may cancel() the pending future, and that must not leak
+        the admission slot or the metrics depth — the slot is released
+        exactly once per admitted request, no matter what."""
+        with self._finish_lock:  # atomic once-flag: a double release
+            # would blow the bounded admission semaphore
+            if req.finished:
+                return
+            req.finished = True
+        try:
+            if error is not None:
+                self.metrics.on_fail()
+                req.future.set_exception(error)
+            else:
+                self.metrics.on_complete(time.perf_counter()
+                                         - req.t_submit)
+                req.future.set_result(result)
+        except Exception:  # noqa: BLE001 — future cancelled by caller;
+            # the server-side work still completed and is accounted
+            pass
+        finally:
+            self._slots.release()

@@ -1,0 +1,111 @@
+"""Per-stage serving metrics: admission counters, queue depth, batch
+occupancy histogram, end-to-end latency percentiles.
+
+Built on ``utils.meters`` (``PercentileMeter`` reservoir for tail
+latency); every mutator is thread-safe — submit happens on N client
+threads, dispatch on the batcher thread, completion on the decode pool.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..utils.meters import PercentileMeter
+
+
+class ServeMetrics:
+    """Counters and histograms for one :class:`serve.DynamicBatcher`.
+
+    Stages and their signals (ISSUE: queue depth, batch occupancy
+    histogram, p50/p95/p99 latency, imgs/sec):
+
+    - admission: ``submitted`` / ``rejected`` (load-shed) counts and the
+      current/peak in-flight depth;
+    - coalescing: ``occupancy`` — dispatched-batch-size → batch count
+      (full ``max_batch`` entries mean the deadline never fired; a spike
+      at 1 means traffic is too sparse for the configured wait);
+    - completion: ``completed`` / ``failed`` counts, a latency reservoir
+      (submit → decoded-result, seconds), and the wall-clock window for
+      the imgs/sec readout.
+    """
+
+    def __init__(self, latency_reservoir: int = 4096):
+        self._lock = threading.Lock()
+        self.latency = PercentileMeter(latency_reservoir)
+        self.submitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.failed = 0
+        self.depth = 0              # in-flight requests (admitted, not done)
+        self.depth_peak = 0
+        self.occupancy: Dict[int, int] = {}
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    # ------------------------------------------------------------- hooks
+    def on_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+            self.depth += 1
+            self.depth_peak = max(self.depth_peak, self.depth)
+            if self._t_first is None:
+                self._t_first = time.perf_counter()
+
+    def on_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def on_dispatch(self, batch_size: int) -> None:
+        with self._lock:
+            self.occupancy[batch_size] = self.occupancy.get(
+                batch_size, 0) + 1
+
+    def on_complete(self, latency_s: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self.depth -= 1
+            self.latency.update(latency_s)
+            self._t_last = time.perf_counter()
+
+    def on_fail(self) -> None:
+        with self._lock:
+            self.failed += 1
+            self.depth -= 1
+            self._t_last = time.perf_counter()
+
+    # ----------------------------------------------------------- readout
+    def mean_occupancy(self) -> float:
+        """Mean images per dispatched batch (0.0 before any dispatch)."""
+        with self._lock:
+            n_batches = sum(self.occupancy.values())
+            n_images = sum(k * v for k, v in self.occupancy.items())
+        return n_images / n_batches if n_batches else 0.0
+
+    def throughput(self) -> float:
+        """Completed imgs/sec over the first-submit → last-completion
+        window (0.0 until at least one request completed)."""
+        with self._lock:
+            if (self._t_first is None or self._t_last is None
+                    or self._t_last <= self._t_first):
+                return 0.0
+            return self.completed / (self._t_last - self._t_first)
+
+    def snapshot(self) -> dict:
+        """One JSON-ready dict of every signal (latencies in ms)."""
+        with self._lock:
+            occupancy = dict(sorted(self.occupancy.items()))
+            out = {
+                "submitted": self.submitted,
+                "rejected": self.rejected,
+                "completed": self.completed,
+                "failed": self.failed,
+                "queue_depth": self.depth,
+                "queue_depth_peak": self.depth_peak,
+                "occupancy_histogram": {str(k): v
+                                        for k, v in occupancy.items()},
+                "latency_ms": self.latency.summary(scale=1e3),
+            }
+        out["mean_batch_occupancy"] = round(self.mean_occupancy(), 3)
+        out["imgs_per_sec"] = round(self.throughput(), 3)
+        return out

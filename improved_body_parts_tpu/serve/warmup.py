@@ -1,0 +1,49 @@
+"""Startup warmup: precompile every program the configured traffic can
+touch, before the first request arrives.
+
+First-compile of a compact batch program costs tens of seconds on a
+relay-attached chip; paid lazily it lands as a tail-latency spike on the
+first unlucky request in each shape bucket.  Paid here — at startup,
+through the persistent compilation cache (``utils.platform
+.enable_compile_cache``) — the first process of a deployment compiles
+once and every later process loads from the cache in milliseconds.
+
+The unit of work is (bucket shape × batch size):
+``Predictor.enumerate_bucket_shapes`` maps the deployment's expected
+image sizes onto padded lane shapes, and :func:`pow2_batch_sizes` lists
+every chunk size the batcher's binary-decomposition dispatch can emit.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+
+def pow2_batch_sizes(max_batch: int) -> Tuple[int, ...]:
+    """Every power of two ≤ ``max_batch`` — the complete set of chunk
+    sizes ``predict_compact_batch_async``'s binary decomposition can
+    dispatch for any occupancy ≤ ``max_batch``; precompiling exactly
+    these makes every possible flush compile-free."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    return tuple(1 << i for i in range((max_batch).bit_length())
+                 if (1 << i) <= max_batch)
+
+
+def precompile(predictor, image_sizes: Sequence[Tuple[int, int]],
+               max_batch: int = 8, params=None,
+               batch_sizes: Optional[Sequence[int]] = None) -> dict:
+    """Warm one predictor for serving: compile (or cache-load) the
+    compact-batch program for every bucket the given (H, W) image sizes
+    land in, at every batch size ``max_batch``-occupancy dispatch can
+    emit.  Blocks until all executables exist.
+
+    Returns ``{"bucket_shapes", "batch_sizes", "newly_compiled"}`` —
+    ``newly_compiled == 0`` means the predictor was already fully warm
+    (the signal the no-compile-stall test asserts on).
+    """
+    shapes = predictor.enumerate_bucket_shapes(image_sizes, params)
+    sizes = (tuple(batch_sizes) if batch_sizes is not None
+             else pow2_batch_sizes(max_batch))
+    compiled = predictor.precompile_compact(shapes, sizes, params=params)
+    return {"bucket_shapes": shapes, "batch_sizes": sizes,
+            "newly_compiled": compiled}

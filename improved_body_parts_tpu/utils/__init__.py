@@ -1,4 +1,4 @@
-from .meters import AverageMeter, StepTimer
+from .meters import AverageMeter, PercentileMeter, StepTimer
 from .platform import apply_platform_env, devices_with_timeout, force_cpu
 from .precision import bf16_params
 from .profiling import chained_time, profile_trace, timed
@@ -12,7 +12,8 @@ from .visualize import (
     train_batch_overlay,
 )
 
-__all__ = ["AverageMeter", "StepTimer", "apply_platform_env",
+__all__ = ["AverageMeter", "PercentileMeter", "StepTimer",
+           "apply_platform_env",
            "bf16_params", "devices_with_timeout", "force_cpu",
            "chained_time", "profile_trace", "timed",
            "colorize_jet", "export_serialized", "export_stablehlo",

@@ -38,6 +38,8 @@ _HEAVY_MODULES = frozenset({
     "test_gt_device.py",        # 125s: device-GT vs host-label train steps
     "test_oks_and_variants.py", # 116s: every model variant forward
     "test_learning.py",         # 82s: real overfit run
+    "test_serve.py",            # compiles compact batch programs for
+                                # several (bucket x batch-size) combos
 })
 # Individually heavy tests inside otherwise-quick modules.
 _HEAVY_TESTS = frozenset({

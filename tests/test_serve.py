@@ -1,0 +1,373 @@
+"""Serving engine tests (``improved_body_parts_tpu.serve``).
+
+A constant-maps stub predictor (the ``test_predictor`` pattern) isolates
+the batcher's own machinery — shape-bucket coalescing, deadline flush,
+admission/load-shedding, warmup precompile, result routing — from
+network weights; a planted person makes results decodable and
+per-request-distinguishable (different input sizes decode to different
+coordinate scales, so a cross-request mixup cannot go unnoticed).
+"""
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import (
+    InferenceModelParams,
+    default_inference_params,
+    get_config,
+)
+
+CFG = get_config("canonical")
+SK = CFG.skeleton
+SIZE_A = (256, 256)          # lane bucket (256, 256)
+SIZE_B = (192, 256)          # scaled to 256x341 -> lane bucket (256, 384)
+
+
+class StubModel:
+    """Ignores the input image; returns fixed stride-4 maps for whatever
+    spatial size it is given (all forward lanes see the same maps)."""
+
+    def __init__(self, maps):
+        self.maps = maps
+
+    def apply(self, variables, imgs, train=False):
+        import jax.numpy as jnp
+
+        n, h, w, _ = imgs.shape
+        maps = jnp.asarray(self.maps[:h // SK.stride, :w // SK.stride])
+        return [[jnp.broadcast_to(maps, (n, *maps.shape))]]
+
+
+def _person_maps():
+    """Stride-grid GT maps with one planted symmetric person on a 256px
+    canvas (the test_predictor person), tie-broken with tiny noise."""
+    from improved_body_parts_tpu.data.heatmapper import Heatmapper
+
+    h = w = 256
+    joints = np.zeros((1, SK.num_parts, 3), np.float32)
+    joints[:, :, 2] = 2
+    cx = (w - 1) / 2
+
+    def put(name, dx, y):
+        joints[0, SK.parts_dict[name]] = [cx + dx, y, 1]
+
+    put("nose", 0, 40)
+    put("neck", 0, 70)
+    for lr, sgn in (("R", -1), ("L", 1)):
+        put(lr + "sho", sgn * 30, 75)
+        put(lr + "elb", sgn * 42, 110)
+        put(lr + "wri", sgn * 46, 145)
+        put(lr + "hip", sgn * 18, 150)
+        put(lr + "kne", sgn * 20, 195)
+        put(lr + "ank", sgn * 21, 240)
+        put(lr + "eye", sgn * 8, 34)
+        put(lr + "ear", sgn * 14, 38)
+    small = dataclasses.replace(SK, width=w, height=h)
+    maps = Heatmapper(small).create_heatmaps(
+        joints, np.ones(small.grid_shape, np.float32))
+    rng = np.random.default_rng(1)
+    return (maps + rng.uniform(0, 1e-6, maps.shape)).astype(np.float32)
+
+
+def _make_pred(maps, **kw):
+    from improved_body_parts_tpu.infer import Predictor
+
+    params, _ = default_inference_params()
+    model_params = InferenceModelParams(boxsize=256, max_downsample=64)
+    return Predictor(StubModel(maps), {}, SK, params, model_params,
+                     bucket=64, **kw)
+
+
+@pytest.fixture(scope="module")
+def person_maps():
+    return _person_maps()
+
+
+@pytest.fixture(scope="module")
+def warm_pred(person_maps):
+    """One predictor shared by the routing/flush tests (its jitted
+    program cache persists across tests, so compiles are paid once)."""
+    return _make_pred(person_maps)
+
+
+def _reference(pred, img):
+    from improved_body_parts_tpu.infer import decode_compact
+
+    return decode_compact(pred.predict_compact(img), pred.params,
+                          SK, use_native=False)
+
+
+def _assert_same_people(got, want, tol=0.05):
+    assert len(got) == len(want)
+    for (gk, gs), (wk, ws) in zip(
+            sorted(got, key=lambda r: -r[1]),
+            sorted(want, key=lambda r: -r[1])):
+        assert gs == pytest.approx(ws, abs=1e-3)
+        for pg, pw in zip(gk, wk):
+            assert (pg is None) == (pw is None)
+            if pg is not None:
+                assert pg[0] == pytest.approx(pw[0], abs=tol)
+                assert pg[1] == pytest.approx(pw[1], abs=tol)
+
+
+class GatedPredictor:
+    """Delegates to a real predictor but holds every device dispatch at a
+    gate — deterministic control of 'device busy' for shed tests."""
+
+    def __init__(self, inner, gate):
+        self._inner, self._gate = inner, gate
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def predict_compact_async(self, *a, **kw):
+        self._gate.wait()
+        return self._inner.predict_compact_async(*a, **kw)
+
+    def predict_compact_batch_async(self, *a, **kw):
+        self._gate.wait()
+        return self._inner.predict_compact_batch_async(*a, **kw)
+
+
+# --------------------------------------------------------------------- #
+def test_pow2_batch_sizes():
+    from improved_body_parts_tpu.serve import pow2_batch_sizes
+
+    assert pow2_batch_sizes(1) == (1,)
+    assert pow2_batch_sizes(6) == (1, 2, 4)
+    assert pow2_batch_sizes(8) == (1, 2, 4, 8)
+    with pytest.raises(ValueError):
+        pow2_batch_sizes(0)
+
+
+def test_percentile_meter():
+    from improved_body_parts_tpu.utils import PercentileMeter
+
+    m = PercentileMeter(capacity=1000)
+    for v in range(1, 101):
+        m.update(float(v))
+    assert m.count == 100
+    assert m.avg == pytest.approx(50.5)
+    assert m.percentile(50) == pytest.approx(50.5)
+    assert m.percentile(99) == pytest.approx(99.01)
+    s = m.summary(scale=10.0)
+    assert s["count"] == 100 and s["p95"] == pytest.approx(950.5)
+
+    # bounded memory: the reservoir never exceeds its capacity
+    small = PercentileMeter(capacity=8)
+    for v in range(10000):
+        small.update(float(v))
+    assert len(small._samples) == 8 and small.count == 10000
+
+
+def test_batcher_rejects_grid_params(warm_pred):
+    from improved_body_parts_tpu.config import InferenceParams
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    with pytest.raises(ValueError, match="single-scale"):
+        DynamicBatcher(warm_pred,
+                       InferenceParams(scale_search=(0.5, 1.0)))
+
+
+def test_concurrent_submitters_get_their_own_results(warm_pred):
+    """8 threads × mixed sizes: every future must resolve to ITS image's
+    skeletons (sizes decode at different coordinate scales, so routing
+    mixups are visible), across two shape buckets."""
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    imgs = {s: np.zeros((*s, 3), np.uint8) for s in (SIZE_A, SIZE_B)}
+    refs = {s: _reference(warm_pred, im) for s, im in imgs.items()}
+    # the two sizes really decode at different scales (mixups detectable)
+    nose_a = max(refs[SIZE_A], key=lambda r: r[1])[0][0]
+    nose_b = max(refs[SIZE_B], key=lambda r: r[1])[0][0]
+    assert abs(nose_a[0] - nose_b[0]) > 5
+
+    with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=30,
+                        max_queue=64, use_native=False) as server:
+        server.warmup([SIZE_A, SIZE_B], batch_sizes=(1, 2))
+        results = {}
+
+        def client(tid):
+            out = []
+            for i in range(3):
+                size = (SIZE_A, SIZE_B)[(tid + i) % 2]
+                out.append((size, server.submit(imgs[size])))
+            results[tid] = [(s, f.result(timeout=60)) for s, f in out]
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = server.metrics.snapshot()
+
+    for tid, pairs in results.items():
+        for size, got in pairs:
+            _assert_same_people(got, refs[size])
+    assert snap["submitted"] == snap["completed"] == 24
+    assert snap["failed"] == snap["rejected"] == 0
+
+
+def test_deadline_flush_single_straggler(warm_pred):
+    """One lone request must not wait for a full batch: with occupancy 1
+    and max_batch 8 the deadline (or idle-device) flush serves it."""
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    ref = _reference(warm_pred, img)
+    # eager_idle_flush off: completion proves the DEADLINE path flushes
+    with DynamicBatcher(warm_pred, max_batch=8, max_wait_ms=50,
+                        use_native=False,
+                        eager_idle_flush=False) as server:
+        server.warmup([SIZE_A], batch_sizes=(1,))
+        t0 = time.perf_counter()
+        got = server.submit(img).result(timeout=60)
+        waited = time.perf_counter() - t0
+        snap = server.metrics.snapshot()
+    _assert_same_people(got, ref)
+    # flushed by the 50 ms deadline, not by an 8-deep batch that never
+    # arrives (generous bound: warm programs decode in well under 10 s)
+    assert waited < 10.0
+    assert snap["occupancy_histogram"] == {"1": 1}
+
+
+def test_occupancy_accounting_two_buckets(warm_pred):
+    """4 size-A + 3 size-B requests, max_batch=4, deterministic flushes:
+    bucket A flushes full (occupancy 4), bucket B on the deadline
+    (occupancy 3) — the histogram and mean must say exactly that."""
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    imgs = {s: np.zeros((*s, 3), np.uint8) for s in (SIZE_A, SIZE_B)}
+    with DynamicBatcher(warm_pred, max_batch=4, max_wait_ms=300,
+                        use_native=False,
+                        eager_idle_flush=False) as server:
+        server.warmup([SIZE_A, SIZE_B], batch_sizes=(1, 2, 4))
+        futs = [server.submit(imgs[SIZE_A]) for _ in range(4)]
+        futs += [server.submit(imgs[SIZE_B]) for _ in range(3)]
+        for f in futs:
+            f.result(timeout=120)
+        snap = server.metrics.snapshot()
+    assert snap["occupancy_histogram"] == {"3": 1, "4": 1}
+    assert snap["mean_batch_occupancy"] == pytest.approx(3.5)
+    assert snap["completed"] == 7
+
+
+def test_load_shed_fails_fast_and_keeps_serving(warm_pred):
+    """With the admission queue full, submit() must raise
+    ServerOverloaded immediately (no blocking, nothing queued) while
+    everything already admitted still completes."""
+    from improved_body_parts_tpu.serve import (
+        DynamicBatcher, ServerOverloaded)
+
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    ref = _reference(warm_pred, img)
+    gate = threading.Event()
+    gated = GatedPredictor(warm_pred, gate)
+    server = DynamicBatcher(gated, max_batch=1, max_wait_ms=5,
+                            max_queue=2, use_native=False)
+    with server:
+        f1 = server.submit(img)
+        f2 = server.submit(img)
+        # give the dispatcher a beat to park on the gate
+        time.sleep(0.05)
+        t0 = time.perf_counter()
+        with pytest.raises(ServerOverloaded):
+            server.submit(img)
+        assert time.perf_counter() - t0 < 0.5  # fail-FAST, no blocking
+        assert server.metrics.rejected == 1
+        gate.set()  # device 'recovers': in-flight work drains
+        _assert_same_people(f1.result(timeout=60), ref)
+        _assert_same_people(f2.result(timeout=60), ref)
+        # the shed was transient: the server accepts and serves again
+        _assert_same_people(server.submit(img).result(timeout=60), ref)
+    snap = server.metrics.snapshot()
+    assert snap["completed"] == 3 and snap["rejected"] == 1
+    assert snap["queue_depth"] == 0
+
+
+def test_compact_overflow_falls_back_to_full_maps(person_maps):
+    """A request whose peak count overflows the compact top-K capacity
+    must still yield correct skeletons (transparent full-map fallback,
+    the pipeline's documented behavior)."""
+    from improved_body_parts_tpu.infer import decode
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    pred = _make_pred(person_maps, compact_topk=1)
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    res = pred.predict_compact(img)
+    assert bool((res.peaks.count > res.peaks.valid.shape[1]).any()), \
+        "fixture no longer overflows topk=1; tighten it"
+
+    heat, paf, mask, scale = pred.predict_fast(img)
+    want = decode(heat, paf, pred.params, SK, peak_mask=mask,
+                  coord_scale=scale, use_native=False)
+
+    with DynamicBatcher(pred, max_batch=2, max_wait_ms=20,
+                        use_native=False) as server:
+        server.warmup([SIZE_A], batch_sizes=(1, 2))
+        got = server.submit(img).result(timeout=120)
+    _assert_same_people(got, want)
+
+
+def test_warmup_precompiles_every_bucket_program(person_maps):
+    """After warmup, serving traffic over every configured bucket (full
+    batches, pow2 splits, singleton stragglers) must hit only cached
+    programs — the no-compile-stall-on-first-request guarantee, asserted
+    on the predictor's program-cache keys."""
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    pred = _make_pred(person_maps)
+    imgs = {s: np.zeros((*s, 3), np.uint8) for s in (SIZE_A, SIZE_B)}
+    with DynamicBatcher(pred, max_batch=4, max_wait_ms=30,
+                        use_native=False) as server:
+        info = server.warmup([SIZE_A, SIZE_B])
+        assert info["bucket_shapes"] == [(256, 256), (256, 384)]
+        assert info["batch_sizes"] == (1, 2, 4)
+        assert info["newly_compiled"] > 0
+        keys = set(pred._fns)
+
+        # a second warmup is a no-op: everything is already compiled
+        assert server.warmup([SIZE_A, SIZE_B])["newly_compiled"] == 0
+
+        futs = [server.submit(imgs[(SIZE_A, SIZE_B)[i % 2]])
+                for i in range(11)]
+        for f in futs:
+            f.result(timeout=120)
+    # jit-cache hit count: serving added NO programs beyond the warmup
+    # set, so no request paid a compile
+    assert set(pred._fns) == keys
+
+
+@pytest.mark.slow
+def test_serve_bench_cli(tmp_path):
+    """tools/serve_bench.py end-to-end on the tiny config: writes
+    SERVE_BENCH.json with throughput + tail latency + occupancy."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    out = tmp_path / "SERVE_BENCH.json"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "serve_bench.py"),
+         "--config", "tiny", "--sizes", "128", "--boxsize", "128",
+         "--requests", "2", "--clients", "2", "--baseline-clients", "2",
+         "--max-batch", "2", "--rounds", "1", "--planted", "1",
+         "--out", str(out)],
+        check=True, timeout=1500, env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    r = json.loads(out.read_text())
+    assert r["platform"]
+    serve = r["serve_at_peak_load"]
+    for k in ("p50", "p95", "p99"):
+        assert serve["latency_ms"][k] > 0
+    assert serve["imgs_per_sec"] > 0
+    assert serve["mean_batch_occupancy"] >= 1
+    assert r["sequential"]["imgs_per_sec"] > 0
+    assert isinstance(r["batched_beats_sequential"], bool)

@@ -152,7 +152,8 @@ def main():
     # --- arm A: single process, 2-device mesh (topology-parity arm) -----
     ckpt_a = os.path.join(work, "ckpt_single")
     t0 = time.time()
-    if not have_epochs(ckpt_a, args.epochs):
+    ran_single = not have_epochs(ckpt_a, args.epochs)
+    if ran_single:
         run_train(h5, val_h5, ckpt_a, args.epochs,
                   {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
                   log_path=os.path.join(work, "single.log"),
@@ -232,7 +233,8 @@ def main():
     # --- arm B: 2 processes, straight through (no boundary) -------------
     ckpt_b = os.path.join(work, "ckpt_dist_straight")
     t0 = time.time()
-    if not have_epochs(ckpt_b, args.epochs):
+    ran_straight = not have_epochs(ckpt_b, args.epochs)
+    if ran_straight:
         launch_pair(ckpt_b, "_straight", args.epochs, resume=False)
     t_straight = time.time() - t0
     losses_b = epoch_losses(ckpt_b)[:args.epochs]
@@ -242,7 +244,8 @@ def main():
     # --- arm C: 2 processes with a cross-process resume boundary --------
     ckpt_c = os.path.join(work, "ckpt_dist")
     t0 = time.time()
-    if not have_epochs(ckpt_c, args.epochs):
+    ran_dist = not have_epochs(ckpt_c, args.epochs)
+    if ran_dist:
         if not have_epochs(ckpt_c, args.resume_after):
             launch_pair(ckpt_c, "", args.resume_after, resume=False)
         print(f"C 2-process epochs 0..{args.resume_after - 1} done",
@@ -280,13 +283,19 @@ def main():
         "topology_first_epoch_ok": bool(topology_ok),
         "tolerance": args.tolerance,
         "parity_ok": bool(parity_ok),
-        # an arm skipped as already-complete reports null, not a
+        # explicit ran/skipped from the have_epochs check — inferring
+        # skip from a >1s wall-clock threshold would misreport a
+        # genuinely-run sub-second smoke arm as skipped (ADVICE.md)
+        "ran": {"single": ran_single,
+                "two_process_straight": ran_straight,
+                "two_process_resumed": ran_dist},
+        # an arm skipped as already-complete reports null seconds, not a
         # meaningless near-zero reparse time
-        "seconds": {"single": round(t_single, 1) if t_single > 1 else None,
+        "seconds": {"single": round(t_single, 1) if ran_single else None,
                     "two_process_straight": (round(t_straight, 1)
-                                             if t_straight > 1 else None),
+                                             if ran_straight else None),
                     "two_process_resumed": (round(t_dist, 1)
-                                            if t_dist > 1 else None)},
+                                            if ran_dist else None)},
         "protocol": "arm A: 1 process x 2 virtual CPU devices; arms B/C: "
                     "2 processes x 1 device over jax.distributed (Gloo); "
                     "C restarts both ranks from the shared checkpoint "

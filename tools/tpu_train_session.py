@@ -233,9 +233,10 @@ class Session:
                     pre_swa = None
                 if additional > 0:
                     # train.py's SWA loop checkpoints every swa_freq
-                    # epochs — a cadence longer than the stage would
-                    # train epochs whose weights are never saved (and
-                    # the eval would silently score a stale checkpoint)
+                    # epochs (plus a final trailing-epoch save when the
+                    # stage length is not a freq multiple); clamping the
+                    # cadence to the stage keeps the averaging windows
+                    # meaningful for short stages
                     swa_freq = min(swa_freq, additional)
                     self._train([
                         "--config", config, "--swa", "--resume", "auto",
@@ -332,10 +333,12 @@ class Session:
             "train_loss_last": losses[-1] if losses else None,
             "train_loss_curve": losses,
             "checkpoint": latest,
+            # the actual platform, not a hardcoded chip claim: CPU-fallback
+            # artifacts must not carry accelerator provenance (ADVICE.md)
             "protocol": "drawn-person fixture; held-out val (different "
                         "seed); OKS-proxy evaluator (APCHECK.md); real "
                         "train/evaluate CLI mains in-process under one "
-                        "chip claim (tools/tpu_train_session.py)",
+                        f"{platform} session (tools/tpu_train_session.py)",
         }
         if swa_from:
             result.update({"ap_swa": ap_trained, "swa_epochs": swa_epochs,

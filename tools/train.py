@@ -265,6 +265,19 @@ def main():
                                  train_loss, train_loss)
             if is_lead:
                 print(f"epoch {epoch}: SWA checkpoint saved")
+    if epochs and epochs % args.swa_freq:
+        # trailing epochs past the last freq boundary: average and save
+        # them too, or they train but are never part of any checkpoint
+        # and the eval silently scores the older freq-boundary save
+        # (ADVICE.md round 5, tools/tpu_train_session.py stale-checkpoint
+        # guard)
+        state = update_swa(state)
+        swapped = swap_swa_params(state)
+        ckpt.save_checkpoint(cfg.train.checkpoint_dir, swapped, epoch,
+                             train_loss, train_loss)
+        if is_lead:
+            print(f"epoch {epoch}: final SWA checkpoint saved "
+                  f"({epochs % args.swa_freq} trailing epochs)")
     shutdown()
 
 
