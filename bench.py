@@ -132,6 +132,52 @@ def _serve_bench_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _feed_rate_summary(fallback, budget_s):
+    """Run tools/feed_rate.py (sync vs shm-worker input feed rate) and
+    return a compact summary for the bench line, or an {"error"/"skipped"}
+    marker — mirroring the "serve" key's contract.  Subprocess so a feed
+    failure or timeout can never take down the primary metric; bounded by
+    the REMAINING driver budget.  ``IBP_BENCH_FEED=0`` skips it
+    unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_FEED") == "0":
+        return {"skipped": "IBP_BENCH_FEED=0"}
+    if budget_s < 120:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (INPUT_PIPELINE.json has the full run)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="feed_rate_"),
+                       "INPUT_PIPELINE.json")
+    # small corpus, short windows, host-GT only via --max-people default
+    # rows; the committed INPUT_PIPELINE.json carries the full protocol
+    argv = ["--records", "12", "--batch", "4", "--min-seconds", "6",
+            "--workers", "0,2", "--config",
+            "tiny" if fallback else "canonical"]
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "feed_rate.py"),
+             "--out", out] + argv,
+            capture_output=True, timeout=min(420, budget_s), check=True,
+            env=dict(os.environ))
+        with open(out) as f:
+            r = json.load(f)
+        rows = {(row["mode"], row["pipeline"], row["workers"]):
+                row["samples_per_sec"] for row in r["rows"]}
+        sync = rows.get(("host_gt", "sync", 0))
+        shm2 = rows.get(("host_gt", "shm", 2))
+        return {
+            "wire": r.get("wire"),
+            "sync_samples_per_sec": sync,
+            "shm_w2_samples_per_sec": shm2,
+            "shm_vs_sync": (round(shm2 / sync, 2)
+                            if sync and shm2 else None),
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def main():
     import time
 
@@ -192,6 +238,9 @@ def main():
     # computed, so a serve failure can only cost this one extra field
     serve = _serve_bench_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # input feed rate (sync vs shm workers), same budget discipline
+    feed = _feed_rate_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     print(json.dumps({
         # metric name carries the ACTUAL batch (the fallback runs batch 2)
         "metric": f"network_inference_fps_512x512_batch{batch}",
@@ -199,6 +248,7 @@ def main():
         "unit": unit,
         "vs_baseline": round(fps / BASELINE_FPS, 3),
         "serve": serve,
+        "feed": feed,
     }))
 
 

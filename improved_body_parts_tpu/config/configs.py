@@ -257,6 +257,18 @@ class TrainConfig:
     # route the focal loss through the Pallas kernel (ops/pallas_focal.py);
     # off by default — the XLA path is the validated production path
     use_pallas_loss: bool = False
+    # --- input pipeline (data.batches / data.shm_ring) ---
+    # worker transport: "shm" (persistent shared-memory slot ring, the
+    # production default), "pool" (retired spawn-Pool path — its per-sample
+    # pickle bytes made workers 4-6x slower than sync at 512²; kept as an
+    # escape hatch), "sync" (in-process)
+    input_pipeline: str = "shm"
+    # image wire format: "uint8" ships warped uint8 HWC across IPC and
+    # host->device (4x fewer bytes; normalized to [0,1] inside the jitted
+    # step, bit-identical to f32), "f32" is the legacy [0,1] float wire
+    input_wire: str = "uint8"
+    # ring depth in batch slots; 0 = auto (num_workers + 2)
+    input_ring_slots: int = 0
 
 
 @dataclass(frozen=True)

@@ -8,10 +8,12 @@ from .dataset import (
 from .fixture import (build_coco_train_set, build_fixture,
                       build_val_set, draw_person)
 from .heatmapper import Heatmapper, OffsetMapper
+from .shm_ring import ShmRingInput, batch_wire_format
 from .transformer import AugmentParams, Transformer
 
 __all__ = [
-    "CocoPoseDataset", "batches", "convert_joints", "epoch_permutation",
+    "CocoPoseDataset", "ShmRingInput", "batch_wire_format", "batches",
+    "convert_joints", "epoch_permutation",
     "host_shard", "build_fixture", "build_coco_train_set", "build_val_set", "draw_person", "Heatmapper", "OffsetMapper", "AugmentParams",
     "Transformer",
 ]

@@ -12,8 +12,12 @@ seedable, host-shardable pipeline:
   (train_distributed.py:205-213, 231-232);
 - HDF5 handles are opened lazily per process (py_data_iterator.py:41-44).
 
-Outputs are channel-LAST: image (H, W, 3) float32 in [0,1], mask_miss
-(h, w, 1), labels (h, w, num_layers) on the stride-4 grid.
+Outputs are channel-LAST: image (H, W, 3) — float32 in [0,1] on the legacy
+``wire="f32"``, warped uint8 pixels on ``wire="uint8"`` (normalized inside
+the jitted train step) — mask_miss (h, w, 1), labels (h, w, num_layers) on
+the stride-4 grid.  Multi-worker loading goes through the shared-memory
+slot ring (``data.shm_ring``); the spawn-Pool transport is retired but
+kept as ``batches(pipeline="pool")``.
 """
 from __future__ import annotations
 
@@ -99,30 +103,44 @@ class CocoPoseDataset:
         return (img, mask_miss, mask_all, joints,
                 tuple(meta["objpos"][0]), float(meta["scale_provided"][0]))
 
-    def _augmented(self, index: int, epoch: int):
+    def _augmented(self, index: int, epoch: int, wire: str = "f32",
+                   image_out: Optional[np.ndarray] = None):
         img, mask_miss, mask_all, joints, objpos, scale = self.read_raw(index)
         rng = np.random.default_rng((self.seed, epoch, index))
         aug = None if self.augment else AugmentParams.identity()
         return self.transformer.transform(
-            img, mask_miss, mask_all, joints, objpos, scale, aug=aug, rng=rng)
+            img, mask_miss, mask_all, joints, objpos, scale, aug=aug, rng=rng,
+            wire=wire, image_out=image_out)
 
-    def sample(self, index: int, epoch: int = 0
+    def sample(self, index: int, epoch: int = 0, wire: str = "f32",
+               image_out: Optional[np.ndarray] = None
                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Generate one training sample deterministically from
-        (seed, epoch, index)."""
-        img, mask_miss, mask_all, joints = self._augmented(index, epoch)
+        (seed, epoch, index).
+
+        ``wire="uint8"`` returns the image as warped uint8 HWC pixels (the
+        shared-memory pipeline's wire format; the jitted train step
+        normalizes on device, bit-identical to the host f32 wire);
+        ``image_out`` optionally renders the uint8 image in place.
+        """
+        img, mask_miss, mask_all, joints = self._augmented(
+            index, epoch, wire=wire, image_out=image_out)
         labels = self.heatmapper.create_heatmaps(joints, mask_all)
         return img, mask_miss[..., None], labels
 
-    def sample_raw(self, index: int, epoch: int = 0, max_people: int = 16
+    def sample_raw(self, index: int, epoch: int = 0, max_people: int = 16,
+                   wire: str = "f32",
+                   image_out: Optional[np.ndarray] = None
                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Device-GT variant of :meth:`sample`: same deterministic
         augmentation, but returns (image, mask_miss, padded joints,
         mask_all) — labels are synthesized on device inside the train step
         (ops.make_gt_synthesizer).  Padding rows carry visibility 2
         ("absent"); people beyond ``max_people`` are dropped (rare on COCO;
-        raise ``max_people`` if the corpus is denser)."""
-        img, mask_miss, mask_all, joints = self._augmented(index, epoch)
+        raise ``max_people`` if the corpus is denser).  ``wire`` /
+        ``image_out`` as in :meth:`sample`."""
+        img, mask_miss, mask_all, joints = self._augmented(
+            index, epoch, wire=wire, image_out=image_out)
         padded = np.zeros((max_people, joints.shape[1], 3), np.float32)
         padded[:, :, 2] = 2.0
         n = min(len(joints), max_people)
@@ -158,37 +176,81 @@ def host_shard(indices: np.ndarray, process_index: int, process_count: int,
 
 def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
             process_index: int = 0, process_count: int = 1,
-            num_workers: int = 0, prefetch: int = 2, raw_gt: int = 0
-            ) -> Iterator[Tuple[np.ndarray, ...]]:
+            num_workers: int = 0, prefetch: int = 2, raw_gt: int = 0,
+            pipeline: Optional[str] = None, wire: str = "f32",
+            ring_slots: int = 0) -> Iterator[Tuple[np.ndarray, ...]]:
     """Yield batched (images, mask_miss, labels) for one epoch.
 
-    ``num_workers > 0`` generates samples in a spawn-based process pool (the
-    reference's DataLoader workers, train_distributed.py:205-213); 0 is
-    synchronous.  Spawn requires an importable ``__main__`` — from a REPL or
-    stdin script use ``num_workers=0``.
+    ``pipeline`` selects the worker transport (default: ``"shm"`` when
+    ``num_workers > 0``, else ``"sync"``):
 
-    ``prefetch`` batches are in flight in the pool ahead of the consumer, so
-    sample synthesis overlaps the device step instead of blocking between
-    steps (the reference gets this from DataLoader's worker prefetch).
-    Samples are deterministic in (seed, epoch, index), so the overlap cannot
-    change results.
+    - ``"sync"``  in-process sample generation (``num_workers`` ignored);
+    - ``"shm"``   persistent spawn workers rendering into a
+      ``multiprocessing.shared_memory`` slot ring (``data.shm_ring``) —
+      only slot tokens cross process boundaries.  Yields READ-ONLY views
+      valid until the generator advances; ``parallel.device_prefetch``
+      places each batch before advancing.  This transient form spawns a
+      ring per call; loops that run many epochs should hold a
+      ``ShmRingInput`` and call its ``batches(epoch)`` instead;
+    - ``"pool"``  the retired spawn-Pool path (one ``starmap_async``
+      window, every sample pickled through the Pool pipe — measured 4-6x
+      slower than sync at 512²; kept as an escape hatch / A-B reference).
+
+    Spawn-based pipelines require an importable ``__main__`` — from a REPL
+    or stdin script use ``num_workers=0``.
+
+    ``prefetch`` batches are in flight in the pool ahead of the consumer
+    (pool path only; the shm ring's depth is its slot count,
+    ``ring_slots``, default ``num_workers + 2``).  Samples are
+    deterministic in (seed, epoch, index), so no transport can change
+    results: all three produce bit-identical streams on the same wire.
 
     ``raw_gt > 0``: yield (images, mask_miss, joints, mask_all) batches for
     on-device GT synthesis instead of host labels; the value is the
     ``max_people`` padding (``CocoPoseDataset.sample_raw``).
+
+    ``wire="uint8"`` ships images as uint8 HWC — 4x fewer bytes across IPC
+    and host->device — normalized to [0, 1] inside the jitted train step
+    (bit-identical to the f32 wire; ``train.step``).
     """
+    if pipeline is None:
+        pipeline = "shm" if num_workers > 0 else "sync"
+    if pipeline not in ("sync", "shm", "pool"):
+        raise ValueError(f"unknown input pipeline {pipeline!r}; "
+                         "use 'sync', 'shm' or 'pool'")
+    if pipeline != "sync" and num_workers <= 0:
+        pipeline = "sync"
+
+    if pipeline == "shm":
+        from .shm_ring import ShmRingInput
+
+        ring = ShmRingInput(dataset, batch_size, num_workers, raw_gt=raw_gt,
+                            wire=wire, slots=ring_slots)
+        try:
+            # copy out of the ring: this facade keeps batches()'s historical
+            # contract (yielded arrays stay valid indefinitely, list() is
+            # safe).  The zero-copy contract — views valid until advance —
+            # is ShmRingInput.batches(), which the hot paths use directly.
+            for batch in ring.batches(epoch, process_index, process_count):
+                yield tuple(np.copy(x) for x in batch)
+                batch = None  # drop the view before close() unmaps
+        finally:
+            ring.close()
+        return
+
     perm = epoch_permutation(len(dataset), epoch, dataset.seed)
     shard = host_shard(perm, process_index, process_count, batch_size)
 
     def gen(i):
         if raw_gt > 0:
-            return dataset.sample_raw(int(i), epoch, max_people=raw_gt)
-        return dataset.sample(int(i), epoch)
+            return dataset.sample_raw(int(i), epoch, max_people=raw_gt,
+                                      wire=wire)
+        return dataset.sample(int(i), epoch, wire=wire)
 
     def collate(samples):
         return tuple(np.stack(col) for col in zip(*samples))
 
-    if num_workers <= 0:
+    if pipeline == "sync":
         for start in range(0, len(shard), batch_size):
             idxs = shard[start: start + batch_size]
             yield collate([gen(i) for i in idxs])
@@ -213,7 +275,7 @@ def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
         def submit() -> None:
             start = next(starts, None)
             if start is not None:
-                idxs = [(int(i), epoch, *extra)
+                idxs = [(int(i), epoch, wire, *extra)
                         for i in shard[start: start + batch_size]]
                 window.append(pool.starmap_async(worker_fn, idxs))
 
@@ -234,9 +296,10 @@ def _worker_init(h5_path, config, augment, seed):
                                       seed=seed)
 
 
-def _worker_sample(index, epoch):
-    return _WORKER_DATASET.sample(index, epoch)
+def _worker_sample(index, epoch, wire):
+    return _WORKER_DATASET.sample(index, epoch, wire=wire)
 
 
-def _worker_sample_raw(index, epoch, max_people):
-    return _WORKER_DATASET.sample_raw(index, epoch, max_people=max_people)
+def _worker_sample_raw(index, epoch, wire, max_people):
+    return _WORKER_DATASET.sample_raw(index, epoch, max_people=max_people,
+                                      wire=wire)

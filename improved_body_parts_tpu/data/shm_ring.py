@@ -1,0 +1,510 @@
+"""Zero-copy shared-memory input pipeline: persistent workers + a slot ring.
+
+Replaces the spawn-Pool worker path (retired; kept behind
+``batches(pipeline="pool")``) whose per-sample cost was dominated by
+IPC bytes, not CPU: every sample crossed the Pool pipe as ~6 MB of pickled
+fp32 arrays, so workers=1 ran 4-6x SLOWER than synchronous
+(INPUT_PIPELINE.json, PR 1 era).  Here the only things that ever cross a
+queue are slot tokens and index lists:
+
+- one ``multiprocessing.shared_memory`` block holds ``slots`` preallocated
+  batch slots (images / mask_miss / labels-or-joints arrays at fixed batch
+  shape) plus a small int64 seqlock header per slot;
+- persistent spawn workers (one ``CocoPoseDataset`` each, same
+  ``(seed, epoch, index)`` RNG scheme as the synchronous path) receive
+  ``(generation, seq, epoch, batch_idx, slot, indices)`` tasks and render
+  each sample IN PLACE into the slot's rows — ``cv2.warpAffine`` writes
+  the uint8 image directly into shared memory (``image_out``),
+  labels/joints are one row assignment.  No pickling, no copy on collate;
+- the consumer reassembles completions in strict task (``seq``) order
+  (the determinism contract: the sample stream is bit-identical to the
+  synchronous path for any worker count), yields read-only views into the
+  slot, and hands the slot token back when the caller advances the
+  generator — by which point ``parallel.prefetch`` has already placed the
+  batch on device (``shard_batch`` copies; verified non-aliasing).
+  ``batches(epoch)`` runs one epoch; ``stream()`` pipelines tasks across
+  epoch boundaries (no drain bubble between epochs).
+
+Slot-granularity seqlock: each slot's header carries
+``[seq, epoch, batch_idx]``; the worker bumps ``seq`` to odd before
+writing and to even after, and the consumer verifies ``seq`` is even and
+``(epoch, batch_idx)`` match before yielding — a cheap tripwire that turns
+any ownership-protocol violation (a worker writing a slot the consumer
+still holds) into a hard error instead of silently corrupted samples.
+
+Wire format: images cross IPC — and, untouched, the host->device hop — as
+uint8 HWC (4x smaller than fp32); normalization to [0, 1] happens inside
+the jitted train step (``train.step``), bit-identical to the host's
+``astype(float32) / 255``.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import time
+import traceback
+import weakref
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+_HEADER_INTS = 3  # per-slot seqlock header: [seq, epoch, batch_idx]
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def batch_wire_format(config, batch_size: int, raw_gt: int = 0,
+                      wire: str = "uint8"
+                      ) -> Tuple[Tuple[str, ...], Tuple[Tuple[int, ...], ...],
+                                 Tuple[str, ...]]:
+    """(names, shapes, dtypes) of one batch slot.
+
+    ``wire="uint8"`` ships images as uint8 HWC (the default wire; 4x fewer
+    bytes both across IPC and host->device), ``"f32"`` as float32 in [0, 1]
+    (the legacy format).  Masks and labels/joints are float32 either way —
+    in device-GT mode (``raw_gt > 0``) the slot carries only padded joints
+    + masks, as the synchronous path does.
+    """
+    if wire not in ("uint8", "f32"):
+        raise ValueError(f"unknown wire format {wire!r}; use 'uint8' or 'f32'")
+    sk = config.skeleton
+    gh, gw = sk.grid_shape
+    names = ["images", "mask_miss"]
+    shapes = [(batch_size, sk.height, sk.width, 3), (batch_size, gh, gw, 1)]
+    dtypes = ["uint8" if wire == "uint8" else "float32", "float32"]
+    if raw_gt > 0:
+        names += ["joints", "mask_all"]
+        shapes += [(batch_size, raw_gt, sk.num_parts, 3),
+                   (batch_size, gh, gw, 1)]
+        dtypes += ["float32", "float32"]
+    else:
+        names += ["labels"]
+        shapes += [(batch_size, gh, gw, sk.num_layers)]
+        dtypes += ["float32"]
+    return tuple(names), tuple(shapes), tuple(dtypes)
+
+
+def _slot_layout(shapes, dtypes) -> Tuple[List[int], int]:
+    """Field byte offsets within one slot + the aligned slot size."""
+    offsets, off = [], 0
+    for shape, dtype in zip(shapes, dtypes):
+        offsets.append(off)
+        off += _align(int(np.prod(shape)) * np.dtype(dtype).itemsize)
+    return offsets, off
+
+
+def _attach_shm(name: str):
+    """Attach to an existing block without registering it with the (shared)
+    resource_tracker daemon — the consumer owns the block's lifetime, and a
+    worker-side registration would make the tracker double-unlink it at
+    exit (py3.10 has no ``track=False`` yet)."""
+    from multiprocessing import resource_tracker, shared_memory
+
+    orig = resource_tracker.register
+    resource_tracker.register = lambda *a, **kw: None
+    try:
+        return shared_memory.SharedMemory(name=name)
+    finally:
+        resource_tracker.register = orig
+
+
+def _quiet_close(shm) -> None:
+    """Close a SharedMemory mapping, tolerating live buffer exports.
+
+    A view yielded to a consumer (or still referenced by a worker frame)
+    makes ``mmap.close()`` raise BufferError; the mapping is reclaimed by
+    the OS at process exit regardless, so detach the handles to keep
+    ``SharedMemory.__del__`` from retrying and spamming stderr."""
+    try:
+        shm.close()
+    except BufferError:
+        shm._mmap = None  # noqa: SLF001 — freed when the last view dies
+        shm._buf = None   # noqa: SLF001
+
+
+def _slot_views(buf, slots: int, shapes, dtypes, writeable: bool):
+    """header array + per-slot field views into ``buf``."""
+    offsets, slot_bytes = _slot_layout(shapes, dtypes)
+    header_bytes = _align(slots * _HEADER_INTS * 8)
+    header = np.frombuffer(buf, np.int64, slots * _HEADER_INTS
+                           ).reshape(slots, _HEADER_INTS)
+    header.flags.writeable = writeable
+    views = []
+    for s in range(slots):
+        base = header_bytes + s * slot_bytes
+        fields = []
+        for shape, dtype, off in zip(shapes, dtypes, offsets):
+            v = np.frombuffer(buf, np.dtype(dtype), int(np.prod(shape)),
+                              offset=base + off).reshape(shape)
+            v.flags.writeable = writeable
+            fields.append(v)
+        views.append(tuple(fields))
+    return header, views
+
+
+def _ring_worker(worker_id: int, shm_name: str, slots: int, shapes, dtypes,
+                 h5_path: str, config, augment: bool, seed: int, raw_gt: int,
+                 wire: str, task_q, done_q) -> None:
+    """Persistent worker entry (spawn target — module importable, no JAX).
+
+    Renders each task's samples directly into the slot's shared-memory
+    rows under the slot seqlock; only ``("ok"|"err", generation, seq,
+    slot-or-(slot, traceback))`` tokens travel back.
+    """
+    try:
+        try:
+            import cv2
+            cv2.setNumThreads(0)  # one core per worker; no nested pools
+        except Exception:  # noqa: BLE001 — determinism aid only
+            pass
+        try:
+            # deprioritize slightly: when workers oversubscribe the host's
+            # cores, the consumer's placement/handback is the critical
+            # path — starving it stalls the whole ring
+            os.nice(2)
+        except OSError:
+            pass
+        shm = _attach_shm(shm_name)
+    except BaseException:  # noqa: BLE001 — surfaced by start()
+        done_q.put(("init_err", worker_id, -1, traceback.format_exc()))
+        return
+    try:
+        # all numpy views over the mapping live in _worker_loop's frame,
+        # so they are released before the close below
+        _worker_loop(worker_id, shm, slots, shapes, dtypes, h5_path, config,
+                     augment, seed, raw_gt, wire, task_q, done_q)
+    finally:
+        _quiet_close(shm)
+
+
+def _worker_loop(worker_id: int, shm, slots: int, shapes, dtypes,
+                 h5_path: str, config, augment: bool, seed: int, raw_gt: int,
+                 wire: str, task_q, done_q) -> None:
+    try:
+        from .dataset import CocoPoseDataset
+
+        header, views = _slot_views(shm.buf, slots, shapes, dtypes,
+                                    writeable=True)
+        ds = CocoPoseDataset(h5_path, config, augment=augment, seed=seed)
+        done_q.put(("ready", worker_id, -1, -1))
+    except BaseException:  # noqa: BLE001 — surfaced by start()
+        done_q.put(("init_err", worker_id, -1, traceback.format_exc()))
+        return
+    try:
+        while True:
+            task = task_q.get()
+            if task is None:
+                return
+            gen, seq, epoch, batch_idx, slot, idxs = task
+            try:
+                header[slot, 0] += 1  # odd: write in progress
+                fields = views[slot]
+                for row, index in enumerate(idxs):
+                    # bind the row view ONCE: indexing creates a fresh view
+                    # object per evaluation, so an inline
+                    # `img is not fields[0][row]` would always be true and
+                    # re-copy the already-in-place image onto itself
+                    img_row = fields[0][row]
+                    if raw_gt > 0:
+                        img, mm, joints, mask_all = ds.sample_raw(
+                            index, epoch, max_people=raw_gt, wire=wire,
+                            image_out=img_row)
+                        if img is not img_row:
+                            img_row[...] = img
+                        fields[1][row] = mm
+                        fields[2][row] = joints
+                        fields[3][row] = mask_all
+                    else:
+                        img, mm, labels = ds.sample(
+                            index, epoch, wire=wire, image_out=img_row)
+                        if img is not img_row:
+                            img_row[...] = img
+                        fields[1][row] = mm
+                        fields[2][row] = labels
+                header[slot, 1] = epoch
+                header[slot, 2] = batch_idx
+                header[slot, 0] += 1  # even: slot consistent
+                done_q.put(("ok", gen, seq, slot))
+            except Exception:  # noqa: BLE001 — consumer re-raises
+                if header[slot, 0] % 2:
+                    # restore seqlock parity: the slot is reclaimed after
+                    # an error, and a stuck-odd seq would make its next
+                    # (correct) use trip _check_header spuriously
+                    header[slot, 0] += 1
+                done_q.put(("err", gen, seq,
+                            (slot, traceback.format_exc())))
+    finally:
+        ds.close()
+
+
+class ShmRingInput:
+    """Persistent shared-memory ring pipeline over one dataset.
+
+    Construct once (workers spawn, corpus opens, ~seconds) and reuse across
+    epochs — ``batches(epoch)`` is a per-epoch generator with the exact
+    ``data.batches`` yield contract.  Yielded arrays are READ-ONLY views
+    into the ring: they are valid until the generator is advanced (or
+    closed); place them on device or copy before the next ``next()``.
+    ``parallel.device_prefetch`` honours this contract (it places each
+    batch via ``shard_batch`` before advancing the source iterator).
+    """
+
+    def __init__(self, dataset, batch_size: int, num_workers: int,
+                 raw_gt: int = 0, wire: str = "uint8", slots: int = 0,
+                 start_timeout: float = 120.0):
+        if num_workers < 1:
+            raise ValueError("ShmRingInput needs num_workers >= 1; use the "
+                             "synchronous path for in-process loading")
+        import multiprocessing as mp
+        from multiprocessing import shared_memory
+
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.num_workers = num_workers
+        self.raw_gt = raw_gt
+        self.wire = wire
+        self.slots = slots if slots > 0 else num_workers + 2
+        self.names, self.shapes, self.dtypes = batch_wire_format(
+            dataset.config, batch_size, raw_gt=raw_gt, wire=wire)
+        _, slot_bytes = _slot_layout(self.shapes, self.dtypes)
+        total = _align(self.slots * _HEADER_INTS * 8) + self.slots * slot_bytes
+
+        # spawn, not fork: the parent is JAX-multithreaded and fork from a
+        # multithreaded process is a deadlock hazard (same rationale as the
+        # retired Pool path); the ring module imports no JAX so worker
+        # start-up is cheap and happens ONCE, not per epoch
+        ctx = mp.get_context("spawn")
+        self._shm = shared_memory.SharedMemory(create=True, size=total)
+        # pre-fault the whole block now: otherwise every slot's first use
+        # pays its page faults inside the training (or benchmark) window
+        np.frombuffer(self._shm.buf, np.uint8).fill(0)
+        self._header, self._views = _slot_views(
+            self._shm.buf, self.slots, self.shapes, self.dtypes,
+            writeable=False)
+        self._task_q = ctx.Queue()
+        self._done_q = ctx.Queue()
+        self._procs = [
+            ctx.Process(
+                target=_ring_worker, daemon=True,
+                name=f"shm-ring-worker-{i}",
+                args=(i, self._shm.name, self.slots, self.shapes, self.dtypes,
+                      dataset.h5_path, dataset.config, dataset.augment,
+                      dataset.seed, raw_gt, wire, self._task_q, self._done_q))
+            for i in range(num_workers)]
+        self._free: List[int] = list(range(self.slots))
+        self._gen = 0
+        self._closed = False
+        self._finalizer = weakref.finalize(self, ShmRingInput._cleanup,
+                                           self._procs, self._task_q,
+                                           self._shm)
+        try:
+            for p in self._procs:
+                p.start()
+            self._wait_ready(start_timeout)
+        except BaseException:
+            self.close()
+            raise
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _wait_ready(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        ready = 0
+        while ready < self.num_workers:
+            msg = self._next_done(deadline=deadline,
+                                  what="worker start-up")
+            if msg[0] == "ready":
+                ready += 1
+            elif msg[0] == "init_err":
+                raise RuntimeError(
+                    f"input worker {msg[1]} failed to start:\n{msg[3]}")
+            # no epoch tasks can be outstanding yet
+
+    @staticmethod
+    def _cleanup(procs, task_q, shm) -> None:
+        for _ in procs:
+            try:
+                task_q.put_nowait(None)
+            except Exception:  # noqa: BLE001
+                pass
+        for p in procs:
+            p.join(timeout=5.0)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5.0)
+        try:  # unlink FIRST: close() raises while yielded views are alive,
+            shm.unlink()  # and the name must not outlive the pipeline
+        except Exception:  # noqa: BLE001 — already unlinked
+            pass
+        _quiet_close(shm)
+
+    def close(self) -> None:
+        """Stop workers and release the shared-memory block (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        # drop our own buffer exports so the finalizer's close() can
+        # actually unmap (yielded views held by callers are tolerated)
+        self._header = self._views = None
+        self._finalizer()
+
+    def __enter__(self) -> "ShmRingInput":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- the per-epoch generator ------------------------------------------
+
+    def _next_done(self, deadline: Optional[float] = None,
+                   what: str = "the next batch"):
+        """One message off the done queue, surfacing dead workers as a
+        raised error instead of an indefinite hang."""
+        while True:
+            try:
+                return self._done_q.get(timeout=0.5)
+            except queue.Empty:
+                dead = [p for p in self._procs if not p.is_alive()]
+                if dead:
+                    codes = ", ".join(
+                        f"{p.name} exitcode={p.exitcode}" for p in dead)
+                    raise RuntimeError(
+                        f"input worker died while the consumer waited for "
+                        f"{what} ({codes}); the sample it was rendering is "
+                        "lost — restart the pipeline") from None
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(f"timed out waiting for {what}")
+
+    def _check_header(self, slot: int, epoch: int, batch_idx: int) -> None:
+        seq, h_epoch, h_idx = (int(self._header[slot, 0]),
+                               int(self._header[slot, 1]),
+                               int(self._header[slot, 2]))
+        if seq % 2 or (h_epoch, h_idx) != (epoch, batch_idx):
+            raise RuntimeError(
+                f"ring-slot protocol violation: slot {slot} header "
+                f"(seq={seq}, epoch={h_epoch}, batch={h_idx}) does not match "
+                f"the completed task (epoch={epoch}, batch={batch_idx})")
+
+    def _epoch_tasks(self, epoch: int, process_index: int,
+                     process_count: int):
+        """(epoch, batch_idx, indices) task triples for one epoch — the
+        same permutation/shard/batching as the synchronous path."""
+        from .dataset import epoch_permutation, host_shard
+
+        perm = epoch_permutation(len(self.dataset), epoch, self.dataset.seed)
+        shard = host_shard(perm, process_index, process_count,
+                           self.batch_size)
+        for batch_idx, s in enumerate(range(0, len(shard), self.batch_size)):
+            yield epoch, batch_idx, [int(i) for i in
+                                     shard[s: s + self.batch_size]]
+
+    def batches(self, epoch: int, process_index: int = 0,
+                process_count: int = 1) -> Iterator[Tuple[np.ndarray, ...]]:
+        """Yield this host's batches for ``epoch`` in deterministic order.
+
+        Identical stream to ``data.batches(..., num_workers=0)`` on the
+        same wire format: same epoch permutation, same host shard, same
+        per-sample ``(seed, epoch, index)`` RNG, yields in batch order.
+        Worker failures raise (with the worker traceback); an abandoned
+        generator leaves in-flight slots to be reclaimed lazily by the
+        next generator.
+        """
+        return self._run(self._epoch_tasks(epoch, process_index,
+                                           process_count))
+
+    def stream(self, start_epoch: int = 0, process_index: int = 0,
+               process_count: int = 1) -> Iterator[Tuple[np.ndarray, ...]]:
+        """Endless multi-epoch batch stream, pipelined ACROSS epoch
+        boundaries: epoch N+1 tasks enter the ring while N's last batches
+        drain, so workers never idle at the boundary.  Same per-epoch
+        stream as ``batches(N)`` concatenated in epoch order.  Use where
+        the consumer has no epoch-boundary work (throughput benchmarks,
+        pure-feed deployments); per-epoch loops (checkpointing, eval)
+        want ``batches(epoch)``.
+        """
+        def endless():
+            epoch = start_epoch
+            while True:
+                yield from self._epoch_tasks(epoch, process_index,
+                                             process_count)
+                epoch += 1
+
+        return self._run(endless())
+
+    def _run(self, task_iter) -> Iterator[Tuple[np.ndarray, ...]]:
+        """Drive the ring over ``task_iter`` of (epoch, batch_idx,
+        indices), yielding in task order (slot-count batches in flight)."""
+        if self._closed:
+            raise RuntimeError("ShmRingInput is closed")
+        self._gen += 1
+        gen = self._gen
+        pending = iter(task_iter)
+        meta = {}       # seq -> (epoch, batch_idx) of submitted tasks
+        completed = {}  # seq -> slot
+        next_submit = 0
+        next_yield = 0
+        exhausted = False
+
+        def submit() -> bool:
+            nonlocal next_submit, exhausted
+            if exhausted or not self._free:
+                return False
+            task = next(pending, None)
+            if task is None:
+                exhausted = True
+                return False
+            epoch, batch_idx, idxs = task
+            slot = self._free.pop()
+            meta[next_submit] = (epoch, batch_idx)
+            self._task_q.put((gen, next_submit, epoch, batch_idx, slot, idxs))
+            next_submit += 1
+            return True
+
+        try:
+            while True:
+                while submit():
+                    pass
+                while next_yield in completed:
+                    slot = completed.pop(next_yield)
+                    epoch, batch_idx = meta.pop(next_yield)
+                    self._check_header(slot, epoch, batch_idx)
+                    try:
+                        yield self._views[slot]
+                    finally:
+                        # the caller advanced (batch on device / copied) —
+                        # or closed the generator, which raises
+                        # GeneratorExit AT the yield: hand the slot token
+                        # back on BOTH paths, or every abandoned generator
+                        # leaks the slot it was yielding and the ring
+                        # eventually starves
+                        self._free.append(slot)
+                    next_yield += 1
+                    submit()
+                if exhausted and next_yield >= next_submit:
+                    return
+                kind, g, seq, payload = self._next_done(
+                    what=f"batch {meta.get(next_yield, ('?', '?'))[1]} of "
+                         f"epoch {meta.get(next_yield, ('?', '?'))[0]}")
+                if g != gen:  # stale completion (or stale failure) from an
+                    # abandoned generator: reclaim the slot, don't let an
+                    # old epoch's error poison this one
+                    self._free.append(payload if kind == "ok" else payload[0])
+                    continue
+                if kind == "err":
+                    slot, tb = payload
+                    self._free.append(slot)
+                    epoch, batch_idx = meta.pop(seq, ("?", "?"))
+                    raise RuntimeError(
+                        f"input worker failed on batch {batch_idx} of epoch "
+                        f"{epoch}:\n{tb}")
+                completed[seq] = payload
+        finally:
+            # completions already drained off done_q but not yet yielded
+            # have no token left anywhere — with multiple workers batch
+            # n+1 routinely finishes before batch n, so abandoning at the
+            # yield for n would otherwise leak n+1's slot permanently
+            self._free.extend(completed.values())
+            completed.clear()

@@ -24,6 +24,15 @@ import numpy as np
 
 from ..config import SkeletonConfig, TransformParams
 
+# The image normalization constant, shared with the on-device prologue
+# (train.step.normalize_images).  Multiplication by the f32 reciprocal —
+# not division by 255 — on BOTH sides: XLA rewrites division-by-constant
+# into reciprocal multiplication, so dividing on the host would leave the
+# two wire formats 1 ULP apart on 126 of the 256 uint8 values.  With the
+# shared constant the uint8 and f32 wires are bit-identical end to end
+# (exhaustively checked over all 256 values in test_input_pipeline.py).
+IMAGE_NORM_SCALE = np.float32(1.0 / 255.0)
+
 
 @dataclass(frozen=True)
 class AugmentParams:
@@ -113,14 +122,27 @@ class Transformer:
                   mask_all: np.ndarray, joints: np.ndarray,
                   objpos: Tuple[float, float], scale_provided: float,
                   aug: Optional[AugmentParams] = None,
-                  rng: Optional[np.random.Generator] = None):
+                  rng: Optional[np.random.Generator] = None,
+                  wire: str = "f32",
+                  image_out: Optional[np.ndarray] = None):
         """
         :param img: HxWx3 uint8 (BGR, as read by cv2)
         :param mask_miss: HxW uint8, 0 = masked (no annotation)
         :param mask_all: HxW uint8, 255 = person area
         :param joints: (num_people, num_parts, 3) float — x, y, visibility
             (0 hidden / 1 visible / 2 absent, recoded by the corpus builder)
-        :returns: (image, mask_miss, mask_all, joints) — all float32
+        :param wire: image wire format — ``"f32"`` returns the image as
+            float32 in [0, 1] (the legacy contract); ``"uint8"`` returns
+            the warped uint8 pixels untouched, for pipelines that ship
+            uint8 and normalize on device.  The f32 image is EXACTLY
+            ``uint8_image.astype(float32) / 255``, so the two wires are
+            bit-identical after normalization.
+        :param image_out: optional preallocated (height, width, 3)
+            contiguous uint8 array; with ``wire="uint8"`` the warp renders
+            directly into it (zero-copy into, e.g., a shared-memory ring
+            slot) and it is returned as the image.
+        :returns: (image, mask_miss, mask_all, joints) — masks/joints
+            float32; image per ``wire``
         """
         cfg = self.config
         if aug is None:
@@ -137,7 +159,8 @@ class Transformer:
         M, _ = build_affine(aug, objpos, scale_provided, cfg)
 
         size = (cfg.width, cfg.height)
-        img = cv2.warpAffine(img, M, size, flags=cv2.INTER_LINEAR,
+        dst = image_out if wire == "uint8" else None
+        img = cv2.warpAffine(img, M, size, dst=dst, flags=cv2.INTER_LINEAR,
                              borderMode=cv2.BORDER_CONSTANT,
                              borderValue=(124, 127, 127))
         mask_miss = cv2.warpAffine(mask_miss, M, size, flags=cv2.INTER_LINEAR,
@@ -162,7 +185,9 @@ class Transformer:
             left, right = list(cfg.left_parts), list(cfg.right_parts)
             joints[:, left + right, :] = joints[:, right + left, :]
 
-        return (img.astype(np.float32) / 255.0,
+        image = (img if wire == "uint8"
+                 else img.astype(np.float32) * IMAGE_NORM_SCALE)
+        return (image,
                 mask_miss.astype(np.float32) / 255.0,
                 mask_all.astype(np.float32) / 255.0,
                 joints.astype(np.float32))

@@ -85,3 +85,14 @@ def device_prefetch(batches: Iterable, mesh, depth: int = 2,
                 q.get_nowait()
         except queue.Empty:
             pass
+        # join AFTER the drain: the producer's put loops exit on the next
+        # 0.1 s poll once stop is set, so this bounds thread shutdown —
+        # without it an abandoned generator leaks a thread whose `placed`
+        # local pins an in-flight device buffer past the drain (and, for
+        # ring-backed sources, keeps a consumed-slot view alive)
+        thread.join()
+        try:
+            while True:  # anything placed between drain start and stop
+                q.get_nowait()
+        except queue.Empty:
+            pass

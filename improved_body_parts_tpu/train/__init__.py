@@ -9,7 +9,7 @@ from .state import (
     swap_swa_params,
     update_swa,
 )
-from .step import make_eval_step, make_train_step
+from .step import make_eval_step, make_train_step, normalize_images
 
 __all__ = [
     "latest_checkpoint", "restore_checkpoint", "save_checkpoint",
@@ -17,5 +17,5 @@ __all__ = [
     "cyclic_swa_schedule", "step_decay_schedule",
     "TrainState", "create_train_state", "make_optimizer", "start_swa",
     "swap_swa_params", "update_swa",
-    "make_eval_step", "make_train_step",
+    "make_eval_step", "make_train_step", "normalize_images",
 ]

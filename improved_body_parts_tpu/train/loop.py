@@ -52,7 +52,11 @@ def train_epoch(state: TrainState, train_step: Callable,
     print_freq = print_freq or config.train.print_freq
     losses = AverageMeter()
     timer = StepTimer()
-    pending = []  # device losses not yet read back
+    # (device loss, batch size) pairs not yet read back: the loss is left
+    # on device to avoid a per-step sync, but its weight must be recorded
+    # NOW — a trailing partial batch drained after the loop would otherwise
+    # be averaged at the last full batch's weight
+    pending = []
 
     if mesh is not None:
         batches = device_prefetch(batches, mesh, depth=prefetch_depth)
@@ -62,14 +66,14 @@ def train_epoch(state: TrainState, train_step: Callable,
         # joints, mask_all) when the step synthesizes GT on device
         global_batch = batch[0].shape[0]
         state, loss = train_step(state, *batch)
-        pending.append(loss)
+        pending.append((loss, global_batch))
 
         if (step_idx + 1) % print_freq == 0:
             # one device sync per print_freq steps
-            vals = [float(v) for v in pending]
+            vals = [(float(v), bs) for v, bs in pending]
             pending.clear()
-            for v in vals:
-                losses.update(v, global_batch)
+            for v, bs in vals:
+                losses.update(v, bs)
             dt = timer.mark(print_freq)
             if is_lead_host:
                 log_fn(
@@ -77,8 +81,8 @@ def train_epoch(state: TrainState, train_step: Callable,
                     f"loss {losses.val:.6f} ({losses.avg:.6f}) "
                     f"imgs/s {global_batch / max(dt, 1e-9):.1f}")
 
-    for v in pending:
-        losses.update(float(v), global_batch or 1)
+    for v, bs in pending:
+        losses.update(float(v), bs)
     return state, losses.avg
 
 

@@ -26,6 +26,21 @@ from ..ops import multi_task_loss
 from .state import TrainState
 
 
+def normalize_images(images: jnp.ndarray) -> jnp.ndarray:
+    """uint8 wire → float32 in [0, 1] on device; f32 passes through.
+
+    Exactly the host pipeline's normalization: both sides multiply by the
+    SAME f32 reciprocal (``data.transformer.IMAGE_NORM_SCALE`` — see its
+    note on why multiplication, not division), so the two wire formats
+    produce bit-identical network inputs.
+    """
+    if images.dtype == jnp.uint8:
+        from ..data.transformer import IMAGE_NORM_SCALE
+
+        return images.astype(jnp.float32) * IMAGE_NORM_SCALE
+    return images
+
+
 def make_train_step(model, config: Config,
                     optimizer: optax.GradientTransformation,
                     use_focal: bool = True,
@@ -44,6 +59,12 @@ def make_train_step(model, config: Config,
     padded joint coordinates, so only (max_people, parts, 3) + masks cross
     the host→device boundary instead of the (h, w, 50) maps — the
     input-bottleneck path for feeding a pod slice (SURVEY.md §7f).
+
+    Images may arrive as uint8 HWC (the shared-memory pipeline's wire
+    format, ``data.shm_ring`` — 4x fewer host→device bytes): the step
+    normalizes to [0, 1] on device, bit-identical to the host pipeline's
+    ``astype(float32) / 255``.  The dtype is static under jit, so the f32
+    path compiles with no extra ops.
     """
     if device_gt:
         from ..ops.gt_device import make_gt_synthesizer
@@ -52,6 +73,7 @@ def make_train_step(model, config: Config,
 
     def train_step(state: TrainState, images, mask_miss, *gt_args
                    ) -> Tuple[TrainState, jnp.ndarray]:
+        images = normalize_images(images)
         if device_gt:
             joints, mask_all = gt_args
             gt = jax.vmap(synthesize)(joints, mask_all[..., 0])
@@ -102,6 +124,7 @@ def make_eval_step(model, config: Config, use_focal: bool = True) -> Callable:
     (reference: train_distributed.py:327-379 ``test``)."""
 
     def eval_step(state: TrainState, images, mask_miss, gt) -> jnp.ndarray:
+        images = normalize_images(images)
         preds = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             images, train=False)

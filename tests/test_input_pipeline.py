@@ -1,0 +1,335 @@
+"""Shared-memory ring input pipeline (data.shm_ring) + uint8 wire format.
+
+The contracts under test:
+
+- determinism: the shm-worker stream is BIT-identical to the synchronous
+  path for two consecutive epochs, on both wire formats and both label
+  modes — samples are deterministic in (seed, epoch, index) and the ring
+  yields in batch order, so no transport can change results;
+- ring-slot reuse: with fewer slots than batches and a slow consumer the
+  ring wraps repeatedly and every batch is still correct (the seqlock +
+  token handback protocol);
+- failure surfacing: a worker that raises mid-epoch propagates as a
+  RuntimeError carrying the worker traceback, and a hard-killed worker
+  raises instead of hanging the consumer;
+- uint8 wire: on-device ``astype(float32)/255`` normalization is
+  bit-identical to the host's fp32 conversion, end-to-end to equal train
+  losses on the same (seed, epoch) stream.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import get_config
+from improved_body_parts_tpu.data import (
+    CocoPoseDataset,
+    ShmRingInput,
+    batch_wire_format,
+    batches,
+    build_fixture,
+)
+
+CFG = get_config("tiny")
+
+
+@pytest.fixture(scope="module")
+def fixture_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("ring_corpus") / "fixture.h5")
+    n = build_fixture(path, num_images=6, people_per_image=2, seed=2)
+    assert n > 0
+    return path
+
+
+def _collect(it):
+    """Copy every yielded batch out of the ring (views are only valid
+    until the generator advances)."""
+    return [tuple(np.copy(x) for x in b) for b in it]
+
+
+class TestWireFormat:
+    def test_uint8_slot_layout(self):
+        names, shapes, dtypes = batch_wire_format(CFG, 4, wire="uint8")
+        assert names == ("images", "mask_miss", "labels")
+        assert shapes[0] == (4, 128, 128, 3) and dtypes[0] == "uint8"
+        assert dtypes[1] == dtypes[2] == "float32"
+
+    def test_device_gt_ships_joints_not_labels(self):
+        names, shapes, dtypes = batch_wire_format(CFG, 2, raw_gt=6,
+                                                  wire="uint8")
+        assert names == ("images", "mask_miss", "joints", "mask_all")
+        assert shapes[2] == (2, 6, CFG.skeleton.num_parts, 3)
+
+    def test_unknown_wire_rejected(self):
+        with pytest.raises(ValueError, match="wire"):
+            batch_wire_format(CFG, 2, wire="f16")
+
+    def test_sample_wire_uint8_is_prenormalized_f32(self, fixture_path):
+        """The f32 sample is EXACTLY the uint8 sample normalized with the
+        shared IMAGE_NORM_SCALE — the identity the on-device normalization
+        relies on."""
+        from improved_body_parts_tpu.data.transformer import IMAGE_NORM_SCALE
+
+        ds = CocoPoseDataset(fixture_path, CFG, augment=True, seed=5)
+        img8, mm8, lab8 = ds.sample(1, epoch=2, wire="uint8")
+        imgf, mmf, labf = ds.sample(1, epoch=2, wire="f32")
+        assert img8.dtype == np.uint8 and imgf.dtype == np.float32
+        np.testing.assert_array_equal(
+            img8.astype(np.float32) * IMAGE_NORM_SCALE, imgf)
+        np.testing.assert_allclose(img8.astype(np.float32) / 255.0, imgf,
+                                   rtol=1e-6)  # and it IS /255 to 1 ULP
+        np.testing.assert_array_equal(mm8, mmf)
+        np.testing.assert_array_equal(lab8, labf)
+        ds.close()
+
+    def test_image_out_renders_in_place(self, fixture_path):
+        ds = CocoPoseDataset(fixture_path, CFG, augment=True, seed=5)
+        sk = CFG.skeleton
+        out = np.zeros((sk.height, sk.width, 3), np.uint8)
+        img, _, _ = ds.sample(0, epoch=0, wire="uint8", image_out=out)
+        assert img is out
+        ref, _, _ = ds.sample(0, epoch=0, wire="uint8")
+        np.testing.assert_array_equal(out, ref)
+        ds.close()
+
+
+class TestShmRingDeterminism:
+    @pytest.mark.parametrize("wire", ["uint8", "f32"])
+    def test_bit_identical_to_sync_for_two_epochs(self, fixture_path, wire):
+        ds = CocoPoseDataset(fixture_path, CFG, augment=True, seed=11)
+        with ShmRingInput(ds, 2, num_workers=2, wire=wire) as ring:
+            for epoch in (0, 1):
+                sync = list(batches(ds, 2, epoch=epoch, wire=wire))
+                shm = _collect(ring.batches(epoch))
+                assert len(sync) == len(shm) >= 3
+                for a, b in zip(sync, shm):
+                    for x, y in zip(a, b):
+                        assert x.dtype == y.dtype
+                        np.testing.assert_array_equal(x, y)
+        ds.close()
+
+    def test_device_gt_stream_matches_sync(self, fixture_path):
+        ds = CocoPoseDataset(fixture_path, CFG, augment=True, seed=7)
+        sync = list(batches(ds, 2, epoch=1, raw_gt=6, wire="uint8"))
+        with ShmRingInput(ds, 2, num_workers=2, raw_gt=6,
+                          wire="uint8") as ring:
+            shm = _collect(ring.batches(1))
+        assert len(sync) == len(shm)
+        for a, b in zip(sync, shm):
+            assert len(a) == len(b) == 4
+            assert b[2].shape[1] == 6  # max_people padding
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)
+        ds.close()
+
+    def test_facade_defaults_to_shm_and_copies(self, fixture_path):
+        """batches(num_workers>0) routes through the ring but keeps the
+        historical contract: list() is safe (fresh arrays, no slot
+        aliasing)."""
+        ds = CocoPoseDataset(fixture_path, CFG, augment=True, seed=11)
+        sync = list(batches(ds, 2, epoch=0, wire="uint8"))
+        shm = list(batches(ds, 2, epoch=0, num_workers=2, wire="uint8"))
+        for a, b in zip(sync, shm):
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)
+        ds.close()
+
+    def test_stream_is_concatenated_epochs(self, fixture_path):
+        """stream() must equal batches(0) ++ batches(1) ++ ... — the
+        cross-epoch pipelining may never reorder or mix epochs."""
+        from itertools import islice
+
+        ds = CocoPoseDataset(fixture_path, CFG, augment=True, seed=11)
+        per_epoch = [list(batches(ds, 2, epoch=e, wire="uint8"))
+                     for e in (0, 1)]
+        n = sum(len(e) for e in per_epoch)
+        flat = [b for e in per_epoch for b in e]
+        with ShmRingInput(ds, 2, num_workers=2, wire="uint8") as ring:
+            got = _collect(islice(ring.stream(0), n + 1))
+        assert len(got) == n + 1  # endless: runs into epoch 2
+        for a, b in zip(flat, got):
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)
+        ds.close()
+
+    def test_abandoned_epoch_then_fresh_epoch(self, fixture_path):
+        """Abandoning a generator mid-epoch must not corrupt the next
+        epoch: stale in-flight completions are reclaimed by generation
+        tag."""
+        ds = CocoPoseDataset(fixture_path, CFG, augment=True, seed=4)
+        with ShmRingInput(ds, 2, num_workers=2, wire="uint8") as ring:
+            it = ring.batches(0)
+            next(it)
+            it.close()  # abandon with tasks still in flight
+            sync = list(batches(ds, 2, epoch=1, wire="uint8"))
+            shm = _collect(ring.batches(1))
+            for a, b in zip(sync, shm):
+                for x, y in zip(a, b):
+                    np.testing.assert_array_equal(x, y)
+        ds.close()
+
+
+class TestRingProtocol:
+    def test_slot_reuse_under_slow_consumer(self, fixture_path):
+        """1 worker + 2 ring slots over 6 batches: the ring must wrap
+        (pigeonhole) while a consumer slower than the worker holds each
+        yielded view, and every batch must still be bit-correct."""
+        ds = CocoPoseDataset(fixture_path, CFG, augment=True, seed=9)
+        sync = list(batches(ds, 1, epoch=0, wire="uint8"))
+        with ShmRingInput(ds, 1, num_workers=1, wire="uint8",
+                          slots=2) as ring:
+            assert ring.slots == 2 < len(sync)
+            got = []
+            for b in ring.batches(0):
+                time.sleep(0.05)  # let the worker race ahead
+                got.append(tuple(np.copy(x) for x in b))
+        assert len(got) == len(sync) >= 6
+        for a, b in zip(sync, got):
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)
+        ds.close()
+
+    def test_yielded_views_are_read_only(self, fixture_path):
+        ds = CocoPoseDataset(fixture_path, CFG, augment=False)
+        with ShmRingInput(ds, 2, num_workers=1, wire="uint8") as ring:
+            batch = next(ring.batches(0))
+            with pytest.raises(ValueError, match="read-only"):
+                batch[0][...] = 0
+        ds.close()
+
+    @pytest.mark.parametrize("workers", [1, 2])
+    def test_repeated_abandonment_does_not_starve_the_ring(
+            self, fixture_path, workers):
+        """Closing a generator at its suspended yield must hand back BOTH
+        the slot being yielded (GeneratorExit fires AT the yield) and any
+        out-of-order completions already drained into the consumer's
+        buffer — with >1 worker, batch n+1 routinely completes before
+        batch n, so those buffered slots have no token left anywhere
+        else.  Before the fix each abandoned generator leaked 1-2 slots,
+        so more abandons than slots starved the ring into an indefinite
+        wait (observed as a benchmark hang on its 4th interleaved
+        round)."""
+        import threading
+
+        ds = CocoPoseDataset(fixture_path, CFG, augment=True, seed=6)
+        sync = list(batches(ds, 2, epoch=0, wire="uint8"))
+        with ShmRingInput(ds, 2, num_workers=workers, wire="uint8",
+                          slots=3) as ring:
+            for _ in range(2 * ring.slots + 2):  # leak > slots if buggy
+                it = ring.stream(0)
+                next(it)
+                it.close()
+            got, err = [], []
+
+            def consume():
+                try:
+                    got.extend(_collect(ring.batches(0)))
+                except BaseException as e:  # noqa: BLE001
+                    err.append(e)
+
+            t = threading.Thread(target=consume, daemon=True)
+            t.start()
+            t.join(timeout=120.0)
+            assert not t.is_alive(), "ring starved after abandoned streams"
+            assert not err, err
+        assert len(got) == len(sync)
+        for a, b in zip(sync, got):
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(x, y)
+        ds.close()
+
+    def test_worker_exception_raises_with_traceback(self, tmp_path):
+        """A worker failing mid-epoch (its lazy HDF5 open finds the corpus
+        gone) must surface as a RuntimeError carrying the worker
+        traceback, not hang the consumer."""
+        path = str(tmp_path / "doomed.h5")
+        build_fixture(path, num_images=4, seed=0)
+        ds = CocoPoseDataset(path, CFG, augment=False)
+        with ShmRingInput(ds, 2, num_workers=1, wire="uint8") as ring:
+            os.remove(path)  # workers open their own handle lazily
+            with pytest.raises(RuntimeError, match="input worker failed"):
+                _collect(ring.batches(0))
+        ds.close()
+
+    def test_killed_worker_raises_not_hangs(self, fixture_path):
+        """A hard-killed worker (the segfault stand-in) must be detected
+        by the consumer's liveness poll and raised, never an indefinite
+        q.get()."""
+        ds = CocoPoseDataset(fixture_path, CFG, augment=False)
+        with ShmRingInput(ds, 2, num_workers=1, wire="uint8") as ring:
+            it = ring.batches(0)
+            next(it)
+            ring._procs[0].kill()
+            t0 = time.monotonic()
+            with pytest.raises(RuntimeError, match="worker died"):
+                list(it)
+            assert time.monotonic() - t0 < 30.0
+        ds.close()
+
+
+class TestOnDeviceNormalization:
+    def test_uint8_normalization_bitwise_matches_host(self):
+        """Exhaustive over the whole uint8 domain: the jitted device
+        prologue must produce the exact f32 bits the host pipeline
+        produces.  (XLA rewrites division-by-constant into reciprocal
+        multiplication, which is why both sides share the multiplicative
+        IMAGE_NORM_SCALE — plain /255 on the host is 1 ULP off on 126 of
+        the 256 values.)"""
+        import jax
+        import jax.numpy as jnp
+
+        from improved_body_parts_tpu.data.transformer import IMAGE_NORM_SCALE
+        from improved_body_parts_tpu.train import normalize_images
+
+        img = np.arange(256, dtype=np.uint8).reshape(1, 16, 16, 1)
+        img = np.broadcast_to(img, (2, 16, 16, 3)).copy()
+        dev = np.asarray(jax.jit(normalize_images)(jnp.asarray(img)))
+        host = img.astype(np.float32) * IMAGE_NORM_SCALE
+        assert dev.dtype == np.float32
+        np.testing.assert_array_equal(dev, host)  # exact, not allclose
+        np.testing.assert_allclose(host, img.astype(np.float32) / 255.0,
+                                   rtol=1e-7)  # and it IS [0,1] / 255
+
+    def test_f32_passthrough_is_identity(self):
+        import jax.numpy as jnp
+
+        from improved_body_parts_tpu.train import normalize_images
+
+        x = jnp.linspace(0, 1, 12, dtype=jnp.float32).reshape(1, 2, 2, 3)
+        assert normalize_images(x) is x
+
+    @pytest.mark.slow
+    def test_train_step_losses_identical_across_wires(self, fixture_path):
+        """Acceptance: the jitted train step on uint8 batches produces
+        losses IDENTICAL to the fp32 path on the same (seed, epoch)
+        stream."""
+        import jax
+        import jax.numpy as jnp
+
+        from improved_body_parts_tpu.models import build_model
+        from improved_body_parts_tpu.train import (
+            create_train_state,
+            make_optimizer,
+            make_train_step,
+            step_decay_schedule,
+        )
+
+        ds = CocoPoseDataset(fixture_path, CFG, augment=True, seed=3)
+        model = build_model(CFG)
+        opt = make_optimizer(CFG, step_decay_schedule(CFG.train, 2))
+        sample = jnp.zeros((2, CFG.skeleton.height, CFG.skeleton.width, 3))
+
+        losses = {}
+        for wire in ("f32", "uint8"):
+            state = create_train_state(model, CFG, opt,
+                                       jax.random.PRNGKey(0), sample)
+            step = make_train_step(model, CFG, opt, donate=False)
+            ls = []
+            for batch in batches(ds, 2, epoch=0, wire=wire):
+                state, loss = step(state, *batch)
+                ls.append(float(loss))
+            losses[wire] = ls
+        assert losses["f32"] == losses["uint8"]
+        assert all(np.isfinite(v) for v in losses["f32"])
+        ds.close()

@@ -117,7 +117,13 @@ class TestAsyncWorkerPool:
     def test_pool_matches_synchronous_path(self, tmp_path):
         """The windowed async pool must yield bit-identical batches to the
         synchronous path — samples are deterministic in (seed, epoch,
-        index), so overlap cannot change results."""
+        index), so overlap cannot change results.
+
+        ``pipeline="pool"`` is pinned: the retired Pool transport stays an
+        escape hatch and must keep its correctness contract (the facade's
+        workers>0 default is now the shm ring, covered by
+        test_input_pipeline.py).
+        """
         from improved_body_parts_tpu.config import get_config
         from improved_body_parts_tpu.data import CocoPoseDataset, batches
         from improved_body_parts_tpu.data.fixture import build_fixture
@@ -128,7 +134,8 @@ class TestAsyncWorkerPool:
         ds = CocoPoseDataset(path, cfg, augment=True)
 
         sync = list(batches(ds, 2, epoch=0, num_workers=0))
-        pooled = list(batches(ds, 2, epoch=0, num_workers=2, prefetch=3))
+        pooled = list(batches(ds, 2, epoch=0, num_workers=2, prefetch=3,
+                              pipeline="pool"))
         assert len(sync) == len(pooled)
         for (a, b) in zip(sync, pooled):
             for x, y in zip(a, b):
@@ -138,7 +145,7 @@ class TestAsyncWorkerPool:
         # machinery: 4-tuples with padded joints, bit-identical sync vs pool
         sync_raw = list(batches(ds, 2, epoch=0, num_workers=0, raw_gt=6))
         pooled_raw = list(batches(ds, 2, epoch=0, num_workers=2, prefetch=3,
-                                  raw_gt=6))
+                                  raw_gt=6, pipeline="pool"))
         for (a, b) in zip(sync_raw, pooled_raw):
             assert len(a) == len(b) == 4
             assert a[2].shape[1] == 6  # max_people padding

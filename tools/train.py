@@ -31,6 +31,17 @@ def main():
     ap.add_argument("--resume", default=None,
                     help="checkpoint path or 'auto' for the latest")
     ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--input-pipeline", default=None,
+                    choices=("shm", "pool", "sync"),
+                    help="worker transport (default: the config's "
+                         "input_pipeline, normally 'shm' — persistent "
+                         "shared-memory ring workers; 'pool' is the retired "
+                         "pickle-everything Pool path, 'sync' in-process)")
+    ap.add_argument("--wire", default=None, choices=("uint8", "f32"),
+                    help="image wire format (default: the config's "
+                         "input_wire, normally 'uint8' — 4x fewer bytes "
+                         "across IPC and host->device, normalized inside "
+                         "the jitted step)")
     ap.add_argument("--lr", type=float, default=0.0,
                     help="override the config's per-device learning rate "
                          "(the framework-native equivalent of editing the "
@@ -190,10 +201,34 @@ def main():
     eval_step = make_eval_step(model, cfg, use_focal=use_focal)
     is_lead = args.process_id == 0
 
+    pipeline = args.input_pipeline or cfg.train.input_pipeline
+    wire = args.wire or cfg.train.input_wire
+    if args.workers <= 0:
+        pipeline = "sync"
+    train_ring = eval_ring = None
+    if pipeline == "shm":
+        # persistent ring: workers spawn ONCE and serve every epoch (the
+        # whole point — the retired Pool path re-paid pickling per sample;
+        # the transient batches(pipeline="shm") facade re-pays spawn per
+        # epoch)
+        from improved_body_parts_tpu.data import ShmRingInput
+
+        train_ring = ShmRingInput(ds, host_batch, args.workers,
+                                  raw_gt=args.device_gt, wire=wire,
+                                  slots=cfg.train.input_ring_slots)
+        if val_ds is not None:
+            eval_ring = ShmRingInput(val_ds, host_batch, args.workers,
+                                     wire=wire,
+                                     slots=cfg.train.input_ring_slots)
+
     def make_train_batches(epoch):
-        it = batches(ds, host_batch, epoch, args.process_id,
-                     args.num_processes, num_workers=args.workers,
-                     raw_gt=args.device_gt)
+        if train_ring is not None:
+            it = train_ring.batches(epoch, args.process_id,
+                                    args.num_processes)
+        else:
+            it = batches(ds, host_batch, epoch, args.process_id,
+                         args.num_processes, num_workers=args.workers,
+                         raw_gt=args.device_gt, pipeline=pipeline, wire=wire)
         if not (args.debug_overlays and is_lead) or args.device_gt:
             return it
 
@@ -216,10 +251,17 @@ def main():
     make_eval_batches = None
     if val_ds is not None:
         def make_eval_batches(epoch):
+            if eval_ring is not None:
+                return eval_ring.batches(0, args.process_id,
+                                         args.num_processes)
             return batches(val_ds, host_batch, 0, args.process_id,
-                           args.num_processes, num_workers=args.workers)
+                           args.num_processes, num_workers=args.workers,
+                           pipeline=pipeline, wire=wire)
 
     def shutdown():
+        for ring in (train_ring, eval_ring):
+            if ring is not None:
+                ring.close()
         if args.num_processes > 1:
             jax.distributed.shutdown()  # aligned exit across processes
 
