@@ -178,6 +178,54 @@ def _feed_rate_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _telemetry_summary(fallback, budget_s):
+    """Run tools/telemetry_overhead.py (the obs-subsystem overhead check:
+    30 synthetic train steps with the event sink + attribution ON vs OFF,
+    interleaved rounds) and return a compact summary, or an
+    {"error"/"skipped"} marker — the "serve"/"feed" key contract.
+    Subprocess so a telemetry failure can never take down the primary
+    metric; bounded by the REMAINING driver budget.
+    ``IBP_BENCH_TELEMETRY=0`` skips it unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_TELEMETRY") == "0":
+        return {"skipped": "IBP_BENCH_TELEMETRY=0"}
+    if budget_s < 90:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (run tools/telemetry_overhead.py "
+                           "directly for the full check)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="telemetry_oh_"),
+                       "TELEMETRY_OVERHEAD.json")
+    # the tiny config keeps the A/B inside the budget on both backends;
+    # overhead is per-window bookkeeping, so it only SHRINKS relative to
+    # the canonical config's much longer steps
+    argv = ["--config", "tiny", "--steps", "10", "--print-freq", "5",
+            "--rounds", "15"]
+    try:
+        subprocess.run(
+            [sys.executable,
+             os.path.join(here, "tools", "telemetry_overhead.py"),
+             "--out", out] + argv,
+            capture_output=True, timeout=min(600, budget_s), check=True,
+            env=dict(os.environ))
+        with open(out) as f:
+            r = json.load(f)
+        return {
+            "step_ms_off": r["step_ms_off"],
+            "step_ms_on": r["step_ms_on"],
+            "overhead_pct": r["overhead_pct"],
+            "within_budget": r["within_budget"],
+            "off_round_spread_pct": r["off_round_spread_pct"],
+            "split_covers_wall_frac": r["split_covers_wall_frac"],
+            "recompiles_post_warmup": r["recompiles_post_warmup"],
+            "events": r["telemetry_events"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def main():
     import time
 
@@ -241,6 +289,9 @@ def main():
     # input feed rate (sync vs shm workers), same budget discipline
     feed = _feed_rate_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # telemetry overhead (obs/ sink on vs off), same budget discipline
+    telemetry = _telemetry_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     print(json.dumps({
         # metric name carries the ACTUAL batch (the fallback runs batch 2)
         "metric": f"network_inference_fps_512x512_batch{batch}",
@@ -249,6 +300,7 @@ def main():
         "vs_baseline": round(fps / BASELINE_FPS, 3),
         "serve": serve,
         "feed": feed,
+        "telemetry": telemetry,
     }))
 
 

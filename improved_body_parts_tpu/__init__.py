@@ -21,6 +21,9 @@ Subpackages
 - ``infer``     multi-scale flip-ensemble prediction, decoding, COCO evaluation
 - ``serve``     dynamic-batching request serving (shape-bucket coalescing,
                 bounded admission, device-replica round-robin, warmup precompile)
+- ``obs``       unified telemetry: metric registry w/ Prometheus + JSON
+                exposition, JSONL run events, /metrics endpoint, data-wait
+                vs compute attribution, post-warmup recompile detection
 - ``utils``     meters, padding, logging helpers
 """
 

@@ -269,6 +269,18 @@ class TrainConfig:
     input_wire: str = "uint8"
     # ring depth in batch slots; 0 = auto (num_workers + 2)
     input_ring_slots: int = 0
+    # --- telemetry (obs/) ---
+    # structured JSONL run-event sink: "" disables, "auto" writes
+    # <checkpoint_dir>/events.jsonl, anything else is the path itself
+    # (tools/telemetry_report.py folds the stream into a summary)
+    telemetry_sink: str = ""
+    # live /metrics (Prometheus text) + /snapshot (JSON) endpoint:
+    # -1 disables, 0 binds an ephemeral port (logged at startup),
+    # any other value is the port
+    telemetry_port: int = -1
+    # emit every Nth per-print_freq step record (1 = all; the data-wait/
+    # compute split accumulates in counters regardless of sampling)
+    telemetry_sample: int = 1
 
 
 @dataclass(frozen=True)

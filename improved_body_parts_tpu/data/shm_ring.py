@@ -152,7 +152,9 @@ def _ring_worker(worker_id: int, shm_name: str, slots: int, shapes, dtypes,
 
     Renders each task's samples directly into the slot's shared-memory
     rows under the slot seqlock; only ``("ok"|"err", generation, seq,
-    slot-or-(slot, traceback))`` tokens travel back.
+    (slot, worker_id, render_seconds)-or-(slot, traceback))`` tokens
+    travel back — the render time rides along so the consumer can
+    export per-worker render histograms without a second IPC channel.
     """
     try:
         try:
@@ -200,6 +202,7 @@ def _worker_loop(worker_id: int, shm, slots: int, shapes, dtypes,
                 return
             gen, seq, epoch, batch_idx, slot, idxs = task
             try:
+                t_render = time.perf_counter()
                 header[slot, 0] += 1  # odd: write in progress
                 fields = views[slot]
                 for row, index in enumerate(idxs):
@@ -227,7 +230,9 @@ def _worker_loop(worker_id: int, shm, slots: int, shapes, dtypes,
                 header[slot, 1] = epoch
                 header[slot, 2] = batch_idx
                 header[slot, 0] += 1  # even: slot consistent
-                done_q.put(("ok", gen, seq, slot))
+                done_q.put(("ok", gen, seq,
+                            (slot, worker_id,
+                             time.perf_counter() - t_render)))
             except Exception:  # noqa: BLE001 — consumer re-raises
                 if header[slot, 0] % 2:
                     # restore seqlock parity: the slot is reclaimed after
@@ -297,6 +302,9 @@ class ShmRingInput:
         self._free: List[int] = list(range(self.slots))
         self._gen = 0
         self._closed = False
+        self._tele = None          # obs.Registry, via attach_telemetry
+        self._tele_prefix = "input_ring"
+        self._render_hists = {}    # worker_id -> Histogram
         self._finalizer = weakref.finalize(self, ShmRingInput._cleanup,
                                            self._procs, self._task_q,
                                            self._shm)
@@ -340,6 +348,56 @@ class ShmRingInput:
         except Exception:  # noqa: BLE001 — already unlinked
             pass
         _quiet_close(shm)
+
+    def attach_telemetry(self, registry,
+                         prefix: str = "input_ring") -> "ShmRingInput":
+        """Export the ring's internals through an ``obs.Registry``:
+
+        - ``<prefix>_slots_total`` / ``<prefix>_free_slots`` — ring
+          capacity and live free-slot count (a persistently-zero free
+          count means the consumer is the bottleneck, a persistently-full
+          one means the workers are);
+        - ``<prefix>_consumer_stall_seconds_total`` / ``_stalls_total``
+          — time the consumer blocked waiting for a completion with no
+          batch ready to yield (the ring-side twin of the train loop's
+          data-wait counter);
+        - ``<prefix>_render_seconds{worker=N}`` — per-worker render-time
+          histograms (a straggler worker shows up as one shifted
+          distribution, not a mystery in the aggregate);
+        - ``<prefix>_batches_total`` — batches yielded.
+        """
+        self._tele = registry
+        self._tele_prefix = prefix
+        registry.gauge(prefix + "_slots_total", "ring capacity "
+                       "(batch slots)").set(self.slots)
+        # weakref: the registry (often process-global) outlives the
+        # ring, and a closure over self would pin the closed ring for
+        # process lifetime; a dead ring scrapes as 0
+        ref = weakref.ref(self)
+
+        def _free_slots():
+            ring = ref()
+            return len(ring._free) if ring is not None else 0
+
+        registry.gauge(prefix + "_free_slots",
+                       "slots not owned by a worker or in-flight batch",
+                       fn=_free_slots)
+        self._stall_s = registry.counter(
+            prefix + "_consumer_stall_seconds_total",
+            "consumer time blocked on the done queue")
+        self._stalls = registry.counter(prefix + "_consumer_stalls_total")
+        self._batches_total = registry.counter(prefix + "_batches_total")
+        return self
+
+    def _observe_render(self, worker_id: int, render_s: float) -> None:
+        h = self._render_hists.get(worker_id)
+        if h is None:
+            h = self._tele.histogram(
+                self._tele_prefix + "_render_seconds",
+                "per-worker batch render time",
+                labels={"worker": str(worker_id)})
+            self._render_hists[worker_id] = h
+        h.observe(render_s)
 
     def close(self) -> None:
         """Stop workers and release the shared-memory block (idempotent)."""
@@ -443,7 +501,7 @@ class ShmRingInput:
         gen = self._gen
         pending = iter(task_iter)
         meta = {}       # seq -> (epoch, batch_idx) of submitted tasks
-        completed = {}  # seq -> slot
+        completed = {}  # seq -> (slot, worker_id, render_seconds)
         next_submit = 0
         next_yield = 0
         exhausted = False
@@ -468,9 +526,12 @@ class ShmRingInput:
                 while submit():
                     pass
                 while next_yield in completed:
-                    slot = completed.pop(next_yield)
+                    slot, wid, render_s = completed.pop(next_yield)
                     epoch, batch_idx = meta.pop(next_yield)
                     self._check_header(slot, epoch, batch_idx)
+                    if self._tele is not None:
+                        self._observe_render(wid, render_s)
+                        self._batches_total.inc()
                     try:
                         yield self._views[slot]
                     finally:
@@ -485,13 +546,20 @@ class ShmRingInput:
                     submit()
                 if exhausted and next_yield >= next_submit:
                     return
+                t_stall = time.perf_counter() if self._tele is not None \
+                    else 0.0
                 kind, g, seq, payload = self._next_done(
                     what=f"batch {meta.get(next_yield, ('?', '?'))[1]} of "
                          f"epoch {meta.get(next_yield, ('?', '?'))[0]}")
+                if self._tele is not None:
+                    # blocked with nothing ready to yield: the workers
+                    # (or the slot budget) are behind the consumer
+                    self._stall_s.inc(time.perf_counter() - t_stall)
+                    self._stalls.inc()
                 if g != gen:  # stale completion (or stale failure) from an
                     # abandoned generator: reclaim the slot, don't let an
                     # old epoch's error poison this one
-                    self._free.append(payload if kind == "ok" else payload[0])
+                    self._free.append(payload[0])
                     continue
                 if kind == "err":
                     slot, tb = payload
@@ -506,5 +574,5 @@ class ShmRingInput:
             # have no token left anywhere — with multiple workers batch
             # n+1 routinely finishes before batch n, so abandoning at the
             # yield for n would otherwise leak n+1's slot permanently
-            self._free.extend(completed.values())
+            self._free.extend(slot for slot, _, _ in completed.values())
             completed.clear()

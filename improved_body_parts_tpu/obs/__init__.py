@@ -1,0 +1,46 @@
+"""Unified telemetry subsystem.
+
+The reference's observability is an ``AverageMeter`` and a print around
+``cuda.synchronize`` (reference: train_distributed.py:285-298).  This
+package replaces that with one process-wide pipeline every layer shares:
+
+- :mod:`registry` — counters / gauges / percentile histograms / span
+  timers with Prometheus + JSON exposition (``Registry``,
+  ``get_registry``, ``StepPhases`` data-wait/compute attribution);
+- :mod:`events`   — schema-versioned JSONL run-event sink
+  (``EventSink``, ``read_events``, process-default ``set_sink``);
+- :mod:`http`     — background ``/metrics`` + ``/snapshot`` endpoint
+  (``MetricsServer``);
+- :mod:`recompile` — post-warmup XLA recompile detection
+  (``CompileWatch``);
+- :mod:`run`      — the per-run bundle (``RunTelemetry``).
+
+``tools/telemetry_report.py`` folds a run's JSONL stream into a
+human-readable summary with an input-bound vs compute-bound verdict.
+"""
+from .events import (
+    SCHEMA_VERSION,
+    EventSink,
+    NullSink,
+    get_sink,
+    read_events,
+    set_sink,
+)
+from .http import MetricsServer
+from .recompile import COMPILE_EVENT, CompileWatch
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    StepPhases,
+    get_registry,
+)
+from .run import RunTelemetry, resolve_sink_path
+
+__all__ = [
+    "SCHEMA_VERSION", "EventSink", "NullSink", "get_sink", "read_events",
+    "set_sink", "MetricsServer", "COMPILE_EVENT", "CompileWatch",
+    "Counter", "Gauge", "Histogram", "Registry", "StepPhases",
+    "get_registry", "RunTelemetry", "resolve_sink_path",
+]

@@ -1,0 +1,149 @@
+"""Structured JSONL run-event sink.
+
+One append-only file per run (re-running over the same path stacks
+runs; ``tools/telemetry_report.py`` reports the last ``run_start``
+onward); every line is one JSON record:
+
+- the first record is a ``run_start`` header carrying the schema
+  version, wall-clock anchor, pid and caller-supplied run metadata;
+- every record carries ``t`` — seconds since the sink opened, from the
+  MONOTONIC clock, so event spacing survives NTP step adjustments and
+  the report tool can lay a recompile timeline over step records;
+- records are schema-versioned (``SCHEMA_VERSION``): consumers
+  (``tools/telemetry_report.py``) refuse streams from a future schema
+  instead of silently misreading them.
+
+Writes are line-buffered under a lock, so the stream is tail-able while
+the run is live and safe to emit from the train loop, the prefetch
+thread and the serving engine's threads concurrently.  A process-wide
+default sink (:func:`set_sink` / :func:`get_sink`) lets library helpers
+(``utils.profiling.timed``) report through the run's stream instead of
+stdout whenever a run installed one.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+
+def _jsonable(o):
+    """numpy scalars / arrays and anything else json chokes on."""
+    try:
+        import numpy as np
+
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+        if isinstance(o, np.generic):
+            return o.item()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        return float(o)
+    except Exception:  # noqa: BLE001
+        return str(o)
+
+
+class NullSink:
+    """Telemetry disabled: every emit is a no-op (the default sink)."""
+
+    enabled = False
+    path: Optional[str] = None
+
+    def emit(self, event: str, **fields) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "NullSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class EventSink:
+    """Append-only JSONL event stream for one run."""
+
+    enabled = True
+
+    def __init__(self, path: str, run_meta: Optional[Dict] = None):
+        self.path = os.path.abspath(path)
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a", buffering=1)  # line-buffered text
+        self._t0 = time.monotonic()
+        self._closed = False
+        header = {"event": "run_start", "schema": SCHEMA_VERSION, "t": 0.0,
+                  "time_unix": round(time.time(), 3), "pid": os.getpid()}
+        header.update(run_meta or {})
+        self._write(header)
+
+    def _write(self, rec: dict) -> None:
+        line = json.dumps(rec, separators=(",", ":"), default=_jsonable)
+        with self._lock:
+            if not self._closed:
+                self._f.write(line + "\n")
+
+    def emit(self, event: str, **fields) -> None:
+        rec = {"event": event,
+               "t": round(time.monotonic() - self._t0, 6)}
+        rec.update(fields)
+        self._write(rec)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._f.close()
+
+    def __enter__(self) -> "EventSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_sink_lock = threading.Lock()
+_sink = NullSink()
+
+
+def get_sink():
+    """The process's current default sink (``NullSink`` when no run
+    installed one)."""
+    return _sink
+
+
+def set_sink(sink):
+    """Install ``sink`` as the process default; returns the previous
+    sink so callers can restore it (``RunTelemetry`` does)."""
+    global _sink
+    with _sink_lock:
+        prev = _sink
+        _sink = sink if sink is not None else NullSink()
+        return prev
+
+
+def read_events(path: str) -> List[dict]:
+    """Parse a JSONL event stream back into a list of records (blank
+    lines skipped; a torn final line — the writer died mid-record — is
+    dropped rather than raised)."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out

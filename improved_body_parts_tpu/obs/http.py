@@ -1,0 +1,84 @@
+"""Background metrics endpoint: ``/metrics`` + ``/snapshot``.
+
+A daemon-threaded ``ThreadingHTTPServer`` over one :class:`Registry`:
+
+- ``GET /metrics``  → Prometheus text exposition 0.0.4 (scrapeable by a
+  stock Prometheus/victoria agent);
+- ``GET /snapshot`` → the registry's JSON snapshot, plus any
+  caller-supplied ``extra`` dict (e.g. the run's event-sink path).
+
+Port 0 binds an ephemeral port (read it back from ``.port`` / ``.url``);
+the listener binds loopback by default — operators who want it exposed
+front it with whatever ingress their deployment already has.  Serving is
+scrape-time-only work: nothing is computed until a request arrives, so
+an idle endpoint costs one parked thread.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Optional
+
+from .registry import Registry
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    def __init__(self, registry: Registry, port: int = 0,
+                 host: str = "127.0.0.1",
+                 extra: Optional[Callable[[], dict]] = None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        reg = registry
+        extra_fn = extra
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        body = reg.prometheus().encode()
+                        ctype = PROMETHEUS_CONTENT_TYPE
+                    elif path == "/snapshot":
+                        snap = {"metrics": reg.snapshot()}
+                        if extra_fn is not None:
+                            snap.update(extra_fn())
+                        body = json.dumps(snap, indent=2,
+                                          default=str).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404, "use /metrics or /snapshot")
+                        return
+                except Exception as e:  # noqa: BLE001 — a scrape bug
+                    # must 500, not kill the handler thread silently
+                    self.send_error(500, type(e).__name__)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes are not stdout news
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = int(self._server.server_address[1])
+        self.url = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="obs-metrics-http",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "MetricsServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,173 @@
+"""Recompile detection — the classic silent TPU perf killer.
+
+An unexpected XLA compile after warmup (a shape bucket nobody
+precompiled, a weak-type flip, a donated-buffer mismatch) stalls the
+whole pipeline for seconds to minutes while every dashboard still shows
+"training".  The reference has no defense; our serving warmup
+(``serve.warmup.precompile``) only covers the buckets it was told about.
+
+:class:`CompileWatch` hooks ``jax.monitoring``'s duration events —
+``/jax/core/compile/backend_compile_duration`` fires once per actual
+backend (XLA) compile and NOT on compilation-cache hits — counts every
+compile into the registry, and once :meth:`mark_warm` is called (the
+caller's "steady state starts now" signal: after serving warmup, after
+the first train window's readback) every further compile:
+
+- increments ``xla_recompiles_post_warmup_total``;
+- appends to the in-process :attr:`timeline`;
+- emits a visible ``recompile`` event into the run's JSONL sink, which
+  ``tools/telemetry_report.py`` folds into a recompile timeline.
+
+Fallback: on jax builds without ``jax.monitoring`` the hook degrades to
+:meth:`wrap` — wrap a jitted callable and unseen (shape, dtype)
+signatures are flagged as compiles from the call site.  ``wrap`` is a
+no-op layer when the monitoring hook is live, so it is safe to apply
+unconditionally.
+
+jax exposes no per-listener deregistration, so :meth:`uninstall` flips
+the instance inactive (the registered closure becomes a no-op) rather
+than unhooking; idle inactive listeners are a few ns per compile event.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import List, Optional
+
+from .events import NullSink
+from .registry import Registry, get_registry
+
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _abstract_signature(args, kwargs):
+    """Hashable (shape, dtype) signature of every array-like leaf — the
+    same thing jit's tracing cache keys on, minus static args."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype)))
+        else:
+            sig.append((type(leaf).__name__,))
+    return treedef, tuple(sig)
+
+
+class CompileWatch:
+    def __init__(self, registry: Optional[Registry] = None, sink=None):
+        registry = registry if registry is not None else get_registry()
+        self.compiles = registry.counter(
+            "xla_compiles_total", "backend (XLA) compiles this process")
+        self.recompiles = registry.counter(
+            "xla_recompiles_post_warmup_total",
+            "unexpected XLA compiles after mark_warm — each one stalled "
+            "the pipeline")
+        self.compile_seconds = registry.counter(
+            "xla_compile_seconds_total", "wall time spent compiling")
+        self._sink = sink if sink is not None else NullSink()
+        self._lock = threading.Lock()
+        self._warm = False
+        self._active = False
+        self._hooked = False
+        # post-warmup compiles in arrival order (the report's timeline)
+        self.timeline: List[dict] = []
+
+    # ---------------------------------------------------------- lifecycle
+    def install(self) -> "CompileWatch":
+        """Register the ``jax.monitoring`` listener (idempotent).
+
+        The listener closes over a WEAKREF to this watch: jax offers no
+        per-listener removal, so a strong reference would pin each run's
+        watch — and through it the run's registry (reservoir histograms)
+        and sink — for process lifetime in any process that constructs
+        ``RunTelemetry`` repeatedly.  A dead watch's listener survives
+        as an inert no-op closure instead.
+        """
+        import weakref
+
+        with self._lock:
+            if self._active:
+                return self
+            self._active = True
+        try:
+            from jax import monitoring
+
+            ref = weakref.ref(self)
+
+            def _listener(name, secs, **kw):
+                watch = ref()
+                if watch is not None:
+                    watch._on_duration(name, secs, **kw)
+
+            monitoring.register_event_duration_secs_listener(_listener)
+            self._hooked = True
+        except Exception:  # noqa: BLE001 — old jax: wrap() still works
+            self._hooked = False
+        return self
+
+    def uninstall(self) -> None:
+        """Deactivate (the jax-side listener stays registered but
+        no-ops — jax has no per-listener removal)."""
+        with self._lock:
+            self._active = False
+
+    @property
+    def warm(self) -> bool:
+        return self._warm
+
+    # ------------------------------------------------------------ signals
+    def _on_duration(self, name: str, secs: float, **kw) -> None:
+        if self._active and name == COMPILE_EVENT:
+            self._record(float(secs), source="jax.monitoring")
+
+    def _record(self, secs: float, source: str) -> None:
+        self.compiles.inc()
+        self.compile_seconds.inc(secs)
+        with self._lock:
+            warm = self._warm
+        if warm:
+            self.recompiles.inc()
+            ev = {"duration_s": round(secs, 6), "source": source}
+            self.timeline.append(ev)
+            self._sink.emit("recompile", **ev)
+
+    def mark_warm(self, label: str = "") -> None:
+        """Steady state starts now: every compile from here on is
+        unexpected.  Idempotent — the first caller wins, so the train
+        loop can call it every print window."""
+        with self._lock:
+            if self._warm:
+                return
+            self._warm = True
+        self._sink.emit("warmup_complete", label=label,
+                        compiles_during_warmup=self.compiles.value)
+
+    # ------------------------------------------------------------ fallback
+    def wrap(self, fn):
+        """Jit-wrapper compile counter: flags calls whose arg
+        (shape, dtype) signature was never seen — a fresh trace, hence a
+        compile — for jax builds without ``jax.monitoring``.  When the
+        monitoring hook is live this wrapper only tracks signatures (no
+        double counting)."""
+        seen = set()
+        lock = threading.Lock()
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            try:
+                sig = _abstract_signature(args, kwargs)
+            except Exception:  # noqa: BLE001 — unhashable exotic args
+                sig = None
+            if sig is not None:
+                with lock:
+                    fresh = sig not in seen
+                    seen.add(sig)
+                if fresh and self._active and not self._hooked:
+                    self._record(0.0, source="jit-wrapper")
+            return fn(*args, **kwargs)
+
+        return wrapper

@@ -1,0 +1,99 @@
+"""One run's telemetry bundle: registry + JSONL sink + compile watch +
+optional live metrics endpoint, assembled from three config knobs
+(``TrainConfig.telemetry_sink`` / ``telemetry_port`` /
+``telemetry_sample``) or directly by tools.
+
+::
+
+    with RunTelemetry("events.jsonl", http_port=0,
+                      run_meta={"tool": "train"}) as tele:
+        fit(state, step, cfg, make_batches, epochs, telemetry=tele)
+
+Installing the bundle also installs its sink as the process default
+(``obs.events.set_sink``) so library helpers (``utils.profiling.timed``)
+report through the run's stream instead of stdout; ``close()`` restores
+the previous sink.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from .events import EventSink, NullSink, set_sink
+from .http import MetricsServer
+from .recompile import CompileWatch
+from .registry import Registry, StepPhases, get_registry
+
+
+class RunTelemetry:
+    def __init__(self, sink_path: Optional[str] = None,
+                 http_port: Optional[int] = None,
+                 registry: Optional[Registry] = None,
+                 run_meta: Optional[Dict] = None,
+                 step_sample: int = 1,
+                 watch_compiles: bool = True,
+                 install_default_sink: bool = True):
+        self.registry = registry if registry is not None else get_registry()
+        self.sink = (EventSink(sink_path, run_meta=run_meta)
+                     if sink_path else NullSink())
+        self._prev_sink = None
+        self._installed_sink = False
+        if install_default_sink and self.sink.enabled:
+            self._prev_sink = set_sink(self.sink)
+            self._installed_sink = True
+        self.compile_watch = CompileWatch(self.registry, self.sink)
+        if watch_compiles:
+            self.compile_watch.install()
+        # emit every Nth per-print_freq step record (cheap runs keep 1;
+        # multi-week runs can thin the stream without losing the split,
+        # which accumulates in counters regardless)
+        self.step_sample = max(1, int(step_sample))
+        self.server = (MetricsServer(self.registry, port=http_port,
+                                     extra=lambda: {"events": self.sink.path})
+                       if http_port is not None and http_port >= 0 else None)
+        self._phases: Dict[str, StepPhases] = {}
+        self._closed = False
+
+    # ----------------------------------------------------------- accessors
+    def phases(self, prefix: str = "train") -> StepPhases:
+        """Get-or-create the data-wait/compute attribution counters for
+        one consumer loop (train and eval keep separate prefixes)."""
+        p = self._phases.get(prefix)
+        if p is None:
+            p = self._phases[prefix] = StepPhases(self.registry, prefix)
+        return p
+
+    def emit(self, event: str, **fields) -> None:
+        self.sink.emit(event, **fields)
+
+    def mark_warm(self, label: str = "") -> None:
+        self.compile_watch.mark_warm(label)
+
+    # ----------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.server is not None:
+            self.server.close()
+        self.compile_watch.uninstall()
+        if self._installed_sink:
+            set_sink(self._prev_sink)
+        self.sink.close()
+
+    def __enter__(self) -> "RunTelemetry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def resolve_sink_path(configured: str, checkpoint_dir: str) -> Optional[str]:
+    """Map a ``TrainConfig.telemetry_sink`` value to a concrete path:
+    ``""`` → disabled (None), ``"auto"`` → ``<checkpoint_dir>/events.jsonl``,
+    anything else is the path itself."""
+    if not configured:
+        return None
+    if configured == "auto":
+        return os.path.join(checkpoint_dir, "events.jsonl")
+    return configured

@@ -21,7 +21,8 @@ from .mesh import shard_batch
 
 
 def device_prefetch(batches: Iterable, mesh, depth: int = 2,
-                    spatial_shard: bool = False) -> Iterator:
+                    spatial_shard: bool = False,
+                    phase_stats=None) -> Iterator:
     """Yield device-placed (sharded) batches, produced ``depth`` ahead.
 
     Exceptions from the underlying iterable (or from device placement) are
@@ -29,7 +30,22 @@ def device_prefetch(batches: Iterable, mesh, depth: int = 2,
     the training step, KeyboardInterrupt) stops the producer and drains the
     queue so in-flight device buffers are released rather than pinned in
     device memory until process exit.
+
+    ``phase_stats`` (an ``obs.StepPhases``) attributes the consumer's
+    wall clock: time blocked here waiting on the prefetch queue is DATA
+    WAIT (the input pipeline fell behind), time the consumer holds the
+    thread between batches is COMPUTE (device step + dispatch +
+    readback).  The split is the live answer to "why is this step slow"
+    that previously required an offline tools/feed_rate.py rerun.
     """
+    it = _device_prefetch(batches, mesh, depth, spatial_shard)
+    if phase_stats is not None:
+        return phase_stats.attribute(it)
+    return it
+
+
+def _device_prefetch(batches: Iterable, mesh, depth: int,
+                     spatial_shard: bool) -> Iterator:
     if depth < 1:
         for batch in batches:
             yield shard_batch(batch, mesh, spatial_shard)

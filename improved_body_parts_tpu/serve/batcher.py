@@ -96,7 +96,8 @@ class DynamicBatcher:
                  max_queue: int = 64, decode_workers: int = 2,
                  use_native: bool = True, devices: Optional[Sequence] = None,
                  eager_idle_flush: bool = True,
-                 metrics: Optional[ServeMetrics] = None):
+                 metrics: Optional[ServeMetrics] = None,
+                 registry=None):
         from ..infer.predict import trivial_grid
 
         self.predictor = predictor
@@ -120,6 +121,10 @@ class DynamicBatcher:
         # flush behavior deterministic for tests.
         self.eager_idle_flush = eager_idle_flush
         self.metrics = metrics or ServeMetrics()
+        if registry is not None:
+            # one exposition path for serve + train: the batcher's
+            # counters/reservoirs surface on the shared /metrics endpoint
+            self.metrics.register_into(registry)
         self._decode_one = compact_decode_fn(predictor, self.params,
                                              self.skeleton, use_native)
         self._decode_workers = max(1, decode_workers)

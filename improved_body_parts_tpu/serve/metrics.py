@@ -74,6 +74,62 @@ class ServeMetrics:
             self.depth -= 1
             self._t_last = time.perf_counter()
 
+    # --------------------------------------------------------- telemetry
+    def register_into(self, registry, prefix: str = "serve"
+                      ) -> "ServeMetrics":
+        """Export every signal through a shared ``obs.Registry`` so
+        serve and train ride ONE exposition path (``/metrics``).
+
+        Registered as a scrape-time collector rather than mirrored
+        metric objects: the counters already live behind this object's
+        lock, so sampling at scrape time adds zero hot-path cost and
+        can never drift from :meth:`snapshot`.  The collector holds
+        only a weakref — a registry that outlives its batcher (the
+        process-global one) scrapes a dead source as no samples instead
+        of pinning it forever.
+        """
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _collect():
+            m = ref()
+            return m.collect(prefix) if m is not None else []
+
+        registry.register_collector(_collect)
+        return self
+
+    def collect(self, prefix: str = "serve"):
+        """(name, labels, kind, value) samples for ``obs.Registry``."""
+        with self._lock:
+            counts = (("submitted", self.submitted),
+                      ("rejected", self.rejected),
+                      ("completed", self.completed),
+                      ("failed", self.failed))
+            depth, peak = self.depth, self.depth_peak
+            occupancy = dict(self.occupancy)
+            lat = self.latency.summary()   # seconds
+            lat_sum = self.latency.sum
+        samples = [(f"{prefix}_{name}_total", {}, "counter", float(v))
+                   for name, v in counts]
+        samples += [
+            (f"{prefix}_queue_depth", {}, "gauge", float(depth)),
+            (f"{prefix}_queue_depth_peak", {}, "gauge", float(peak)),
+        ]
+        for size, n in sorted(occupancy.items()):
+            samples.append((f"{prefix}_batches_total",
+                            {"size": str(size)}, "counter", float(n)))
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            samples.append((f"{prefix}_latency_seconds",
+                            {"quantile": q}, "gauge", lat[key]))
+        samples += [
+            (f"{prefix}_latency_seconds_sum", {}, "counter", lat_sum),
+            (f"{prefix}_latency_seconds_count", {}, "counter",
+             float(lat["count"])),
+            (f"{prefix}_imgs_per_sec", {}, "gauge", self.throughput()),
+        ]
+        return samples
+
     # ----------------------------------------------------------- readout
     def mean_occupancy(self) -> float:
         """Mean images per dispatched batch (0.0 before any dispatch)."""

@@ -40,7 +40,8 @@ def train_epoch(state: TrainState, train_step: Callable,
                 mesh=None, print_freq: Optional[int] = None,
                 is_lead_host: bool = True,
                 log_fn: Callable[[str], None] = print,
-                prefetch_depth: int = 2
+                prefetch_depth: int = 2,
+                telemetry=None
                 ) -> Tuple[TrainState, float]:
     """Run one epoch; returns (state, mean loss).
 
@@ -48,6 +49,12 @@ def train_epoch(state: TrainState, train_step: Callable,
     (images, mask_miss, joints, mask_all) when ``train_step`` was built
     with ``device_gt=True`` — this host's shard of the global batch when
     running multi-host.
+
+    ``telemetry`` (an ``obs.RunTelemetry``) turns each print window into
+    a structured ``train_step`` event — loss, step time, imgs/s, and the
+    data-wait vs compute split measured inside ``device_prefetch`` — and
+    marks the compile watch warm after the first window's readback (the
+    first sync that proves every steady-state program compiled).
     """
     print_freq = print_freq or config.train.print_freq
     losses = AverageMeter()
@@ -58,8 +65,21 @@ def train_epoch(state: TrainState, train_step: Callable,
     # be averaged at the last full batch's weight
     pending = []
 
+    phases = telemetry.phases("train") if telemetry is not None else None
     if mesh is not None:
-        batches = device_prefetch(batches, mesh, depth=prefetch_depth)
+        batches = device_prefetch(batches, mesh, depth=prefetch_depth,
+                                  phase_stats=phases)
+    elif phases is not None:
+        batches = phases.attribute(batches)
+    if telemetry is not None:
+        g_loss = telemetry.registry.gauge(
+            "train_loss", "windowed loss readback (losses.val)")
+        g_ips = telemetry.registry.gauge(
+            "train_imgs_per_sec", "window throughput")
+        h_step = telemetry.registry.histogram(
+            "train_step_seconds", "per-step wall time (window mean)")
+        window_t0 = phases.totals()
+        windows = 0
     global_batch = None
     for step_idx, batch in enumerate(batches):
         # batch is (images, mask_miss, labels) — or (images, mask_miss,
@@ -75,25 +95,80 @@ def train_epoch(state: TrainState, train_step: Callable,
             for v, bs in vals:
                 losses.update(v, bs)
             dt = timer.mark(print_freq)
+            if telemetry is not None:
+                # the readback above blocked until the device drained:
+                # every steady-state program is compiled from here on
+                telemetry.mark_warm("first train window readback")
+                wait, hold = phases.totals()
+                d_wait = wait - window_t0[0]
+                d_hold = hold - window_t0[1]
+                window_t0 = (wait, hold)
+                imgs_s = global_batch / max(dt, 1e-9)
+                g_loss.set(losses.val)
+                g_ips.set(imgs_s)
+                h_step.observe(dt)
+                windows += 1
+                if windows % telemetry.step_sample == 0:
+                    telemetry.emit(
+                        "train_step", epoch=epoch, step=step_idx + 1,
+                        loss=round(losses.val, 6),
+                        loss_avg=round(losses.avg, 6),
+                        step_s=round(dt, 6),
+                        imgs_per_sec=round(imgs_s, 2),
+                        data_wait_s=round(d_wait, 6),
+                        compute_s=round(d_hold, 6))
             if is_lead_host:
                 log_fn(
                     f"==> Epoch [{epoch}][{step_idx + 1}] "
                     f"loss {losses.val:.6f} ({losses.avg:.6f}) "
                     f"imgs/s {global_batch / max(dt, 1e-9):.1f}")
 
+    n_tail = len(pending)
     for v, bs in pending:
         losses.update(float(v), bs)
+    if telemetry is not None and n_tail:
+        # trailing partial window (epochs shorter than print_freq would
+        # otherwise emit NOTHING — and never mark the compile watch warm)
+        telemetry.mark_warm("epoch-end readback")
+        dt = timer.mark(n_tail)
+        wait, hold = phases.totals()
+        telemetry.emit(
+            "train_step", epoch=epoch, step=step_idx + 1,
+            loss=round(losses.val, 6), loss_avg=round(losses.avg, 6),
+            step_s=round(dt, 6),
+            imgs_per_sec=round(global_batch / max(dt, 1e-9), 2),
+            data_wait_s=round(wait - window_t0[0], 6),
+            compute_s=round(hold - window_t0[1], 6),
+            partial_window=n_tail)
     return state, losses.avg
 
 
 def eval_epoch(state: TrainState, eval_step: Callable, batches: Iterable,
-               mesh=None, prefetch_depth: int = 2) -> float:
+               mesh=None, prefetch_depth: int = 2,
+               readback_freq: int = 32) -> float:
+    """Eval pass; returns the sample-weighted mean loss.
+
+    Like ``train_epoch``, the per-batch device losses are BUFFERED
+    (device scalars are a few bytes each) and read back in windows: a
+    per-batch ``float(loss)`` would sync the device every step,
+    serializing host placement against the eval dispatch and defeating
+    ``device_prefetch`` for the whole pass.  The window
+    (``readback_freq``) also bounds async dispatch: without any sync a
+    host faster than the device would enqueue the entire epoch, every
+    unexecuted step pinning its input batch in device memory.
+    """
     losses = AverageMeter()
     if mesh is not None:
         batches = device_prefetch(batches, mesh, depth=prefetch_depth)
+    pending = []
     for batch in batches:
-        loss = eval_step(state, *batch)
-        losses.update(float(loss), batch[0].shape[0])
+        pending.append((eval_step(state, *batch), batch[0].shape[0]))
+        if len(pending) >= readback_freq:
+            for loss, bs in pending:
+                losses.update(float(loss), bs)
+            pending.clear()
+    for loss, bs in pending:
+        losses.update(float(loss), bs)
     return losses.avg
 
 
@@ -105,7 +180,8 @@ def fit(state: TrainState, train_step: Callable, config: Config,
         is_lead_host: bool = True,
         checkpoint_dir: Optional[str] = None,
         log_fn: Callable[[str], None] = print,
-        best_loss: float = float("inf")) -> TrainState:
+        best_loss: float = float("inf"),
+        telemetry=None) -> TrainState:
     """Multi-epoch driver with per-epoch rank-0 checkpoint + log
     (reference: train_distributed.py:300-324, 441-444).
 
@@ -118,7 +194,7 @@ def fit(state: TrainState, train_step: Callable, config: Config,
     for epoch in range(start_epoch, start_epoch + epochs):
         state, train_loss = train_epoch(
             state, train_step, make_batches(epoch), config, epoch, mesh=mesh,
-            is_lead_host=is_lead_host, log_fn=log_fn)
+            is_lead_host=is_lead_host, log_fn=log_fn, telemetry=telemetry)
         if is_lead_host:
             _log_line(checkpoint_dir,
                       f"\nEpoch {epoch}\ttrain_loss: {train_loss}")
@@ -128,10 +204,16 @@ def fit(state: TrainState, train_step: Callable, config: Config,
         # checkpoint.save_checkpoint)
         ckpt.save_checkpoint(checkpoint_dir, state, epoch, train_loss,
                              best_loss)
+        val_loss = None
         if eval_step is not None and make_eval_batches is not None:
             val_loss = eval_epoch(state, eval_step, make_eval_batches(epoch),
                                   mesh=mesh)
             if is_lead_host:
                 _log_line(checkpoint_dir, f"\tval_loss: {val_loss}")
                 log_fn(f"Epoch {epoch} val_loss {val_loss:.6f}")
+        if telemetry is not None:
+            fields = {"epoch": epoch, "train_loss": round(train_loss, 6)}
+            if val_loss is not None:
+                fields["val_loss"] = round(val_loss, 6)
+            telemetry.emit("epoch", **fields)
     return state

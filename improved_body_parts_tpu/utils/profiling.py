@@ -28,9 +28,16 @@ def profile_trace(log_dir: str) -> Iterator[None]:
 
 @contextlib.contextmanager
 def timed(label: str, meter: Optional[AverageMeter] = None,
-          sync_value=None) -> Iterator[None]:
+          sync_value=None, log_fn=None) -> Iterator[None]:
     """Wall-clock a block; pass a jax array as ``sync_value`` to block on
-    device completion first (the cuda.synchronize analogue)."""
+    device completion first (the cuda.synchronize analogue).
+
+    The report goes to the process's telemetry event sink when a run
+    installed one (``obs.events.set_sink`` / ``obs.RunTelemetry``) as a
+    structured ``timed`` record; otherwise to ``log_fn`` (default:
+    ``print``) — so library code stops writing to stdout the moment a
+    run turns telemetry on, without every call site changing.
+    """
     import jax
 
     t0 = time.perf_counter()
@@ -40,7 +47,13 @@ def timed(label: str, meter: Optional[AverageMeter] = None,
     dt = time.perf_counter() - t0
     if meter is not None:
         meter.update(dt)
-    print(f"[{label}] {dt * 1000:.2f} ms")
+    from ..obs.events import get_sink
+
+    sink = get_sink()
+    if sink.enabled:
+        sink.emit("timed", label=label, duration_s=round(dt, 6))
+    else:
+        (log_fn or print)(f"[{label}] {dt * 1000:.2f} ms")
 
 
 def chained_time(forward, variables, x, iters: int = 50, warmup: int = 2

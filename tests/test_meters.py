@@ -1,0 +1,160 @@
+"""``utils.meters`` + ``serve.metrics`` contracts (ISSUE 3 satellite).
+
+The telemetry subsystem leans on these primitives from every thread in
+the process, so their determinism and conservation properties get
+pinned here: reservoir-eviction determinism past capacity, summary
+scaling, multi-step timer batching, and counter conservation under a
+multi-threaded hammer.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.utils.meters import (
+    AverageMeter,
+    PercentileMeter,
+    StepTimer,
+)
+
+
+class TestPercentileMeter:
+    def test_reservoir_eviction_is_deterministic_past_capacity(self):
+        """Two identically-seeded meters fed the same >capacity stream
+        must hold the SAME reservoir — eviction choices come from the
+        meter's own seeded RNG, nothing ambient (what keeps A/B bench
+        runs and tests reproducible)."""
+        cap = 64
+        a = PercentileMeter(capacity=cap, seed=7)
+        b = PercentileMeter(capacity=cap, seed=7)
+        rng = np.random.default_rng(0)
+        stream = rng.uniform(0, 100, cap * 20)  # 20x capacity
+        for v in stream:
+            a.update(float(v))
+            b.update(float(v))
+        assert a._samples == b._samples  # identical eviction history
+        for q in (0, 10, 50, 90, 95, 99, 100):
+            assert a.percentile(q) == b.percentile(q)
+        # a different seed takes a different eviction path
+        c = PercentileMeter(capacity=cap, seed=8)
+        for v in stream:
+            c.update(float(v))
+        assert c._samples != a._samples
+        # exact accumulators are seed-independent
+        assert c.count == a.count == len(stream)
+        assert c.sum == pytest.approx(a.sum)
+
+    def test_reservoir_estimates_track_the_stream(self):
+        m = PercentileMeter(capacity=512, seed=3)
+        for v in np.linspace(0.0, 1.0, 10_000):
+            m.update(float(v))
+        assert m.percentile(50) == pytest.approx(0.5, abs=0.05)
+        assert m.percentile(95) == pytest.approx(0.95, abs=0.05)
+        assert m.avg == pytest.approx(0.5, abs=1e-6)  # exact, not sampled
+
+    def test_summary_scale(self):
+        m = PercentileMeter(capacity=16, seed=0)
+        for v in (0.001, 0.002, 0.003, 0.004):
+            m.update(v)
+        s = m.summary(scale=1e3)  # seconds -> milliseconds
+        assert s["count"] == 4          # count is NOT scaled
+        assert s["mean"] == pytest.approx(2.5)
+        assert s["p50"] == pytest.approx(2.5)
+        assert s["p99"] == pytest.approx(m.percentile(99) * 1e3)
+        unscaled = m.summary()
+        assert unscaled["mean"] == pytest.approx(0.0025)
+
+    def test_empty_meter(self):
+        m = PercentileMeter()
+        assert m.percentile(99) == 0.0
+        assert m.summary(scale=1e3) == {
+            "count": 0, "mean": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+
+
+class TestStepTimer:
+    def test_mark_with_multi_step_batching(self):
+        """mark(n) reports per-step time over an n-step window and
+        weights the meter by n — the train loop's throttled-readback
+        contract (one sync per print_freq steps)."""
+        timer = StepTimer()
+        time.sleep(0.05)
+        dt = timer.mark(5)
+        assert 0.05 / 5 <= dt <= 0.5 / 5
+        assert timer.meter.count == 5
+        assert timer.meter.val == pytest.approx(dt)
+        # the window resets: a second mark times only its own window
+        time.sleep(0.02)
+        dt2 = timer.mark(2)
+        assert 0.02 / 2 <= dt2 <= 0.5 / 2
+        assert timer.meter.count == 7
+        assert timer.meter.avg == pytest.approx(
+            (dt * 5 + dt2 * 2) / 7)
+
+    def test_mark_zero_steps_guard(self):
+        timer = StepTimer()
+        assert timer.mark(0) >= 0.0  # max(steps, 1), no ZeroDivision
+
+
+class TestAverageMeter:
+    def test_weighted_running_average(self):
+        m = AverageMeter()
+        m.update(1.0, 3)
+        m.update(5.0, 1)
+        assert m.val == 5.0
+        assert m.avg == pytest.approx(2.0)
+        m.reset()
+        assert (m.val, m.sum, m.count, m.avg) == (0.0, 0.0, 0, 0.0)
+
+
+class TestServeMetricsConcurrency:
+    def test_eight_thread_hammer_conserves_counts(self):
+        """8 threads drive the full submit→{complete|fail} lifecycle
+        concurrently (plus rejects and a tail of in-flight requests);
+        afterwards submitted == completed + failed + depth must hold
+        EXACTLY — a lost update under the lock would break the serving
+        engine's admission accounting (the bounded semaphore mirrors
+        these counts)."""
+        from improved_body_parts_tpu.serve.metrics import ServeMetrics
+
+        m = ServeMetrics()
+        threads_n, ops = 8, 300
+        leave_inflight = 2   # per thread: submitted but never finished
+        rejects = 5          # per thread
+        barrier = threading.Barrier(threads_n)
+
+        def hammer(tid):
+            barrier.wait()   # maximal interleaving
+            for i in range(ops):
+                m.on_submit()
+                m.on_dispatch((tid + i) % 4 + 1)
+                if i % 3 == 0:
+                    m.on_fail()
+                else:
+                    m.on_complete(0.001 * (i % 7))
+            for _ in range(rejects):
+                m.on_reject()
+            for _ in range(leave_inflight):
+                m.on_submit()
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(threads_n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        total = threads_n * (ops + leave_inflight)
+        assert m.submitted == total
+        assert m.rejected == threads_n * rejects
+        assert m.depth == threads_n * leave_inflight
+        assert m.submitted == m.completed + m.failed + m.depth
+        assert m.depth_peak >= m.depth
+        assert m.failed == threads_n * len(range(0, ops, 3))
+        # the latency reservoir saw exactly the completions
+        assert m.latency.count == m.completed
+        # occupancy histogram counts every dispatch
+        assert sum(m.occupancy.values()) == threads_n * ops
+        snap = m.snapshot()
+        assert snap["queue_depth"] == m.depth
+        assert snap["latency_ms"]["count"] == m.completed

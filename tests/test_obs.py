@@ -1,0 +1,530 @@
+"""Telemetry subsystem tests (``improved_body_parts_tpu.obs``).
+
+Covers the registry's exposition contracts (Prometheus text + JSON
+snapshot), the JSONL event sink's schema/ordering guarantees, the
+data-wait vs compute attribution split, post-warmup recompile
+detection through ``jax.monitoring`` AND the jit-wrapper fallback, the
+live metrics endpoint, the train-loop integration (structured step
+records whose split sums to the loop wall), the eval-epoch deferred
+readback, ``timed``'s sink routing, and the telemetry report's
+bottleneck verdicts.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.obs import (
+    SCHEMA_VERSION,
+    CompileWatch,
+    EventSink,
+    MetricsServer,
+    NullSink,
+    Registry,
+    RunTelemetry,
+    StepPhases,
+    get_sink,
+    read_events,
+    set_sink,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every non-comment exposition line: name{labels} value
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+(e[+-]?\d+)?$")
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram(self):
+        r = Registry()
+        c = r.counter("requests_total", "help")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        assert r.counter("requests_total") is c  # get-or-create
+
+        g = r.gauge("depth")
+        g.set(7)
+        assert g.value == 7.0
+        gf = r.gauge("free", fn=lambda: 11)
+        assert gf.value == 11.0
+
+        h = r.histogram("lat_seconds")
+        for i in range(200):
+            h.observe(i / 100.0)
+        s = h.summary()
+        assert s["count"] == 200 and 0.9 < s["p50"] < 1.1
+
+    def test_labels_are_distinct_metrics(self):
+        r = Registry()
+        a = r.counter("work_total", labels={"worker": "0"})
+        b = r.counter("work_total", labels={"worker": "1"})
+        assert a is not b
+        a.inc(3)
+        assert b.value == 0.0
+
+    def test_kind_clash_raises(self):
+        r = Registry()
+        r.counter("x_total")
+        with pytest.raises(TypeError):
+            r.gauge("x_total")
+
+    def test_span_timer(self):
+        r = Registry()
+        with r.span("block"):
+            time.sleep(0.01)
+        s = r.histogram("block_seconds").summary()
+        assert s["count"] == 1 and s["mean"] >= 0.009
+
+    def test_prometheus_exposition_is_valid(self):
+        r = Registry()
+        r.counter("a_total", "counts a").inc(2)
+        r.gauge("b", labels={"x": "1"}).set(0.5)
+        h = r.histogram("c_seconds")
+        h.observe(1.0)
+        r.register_collector(lambda: [("d_total", {}, "counter", 4.0)])
+        text = r.prometheus()
+        types = {}
+        for line in text.strip().splitlines():
+            if line.startswith("# TYPE"):
+                _, _, name, kind = line.split()
+                assert name not in types, f"duplicate TYPE for {name}"
+                types[name] = kind
+            elif not line.startswith("#"):
+                assert _PROM_LINE.match(line), f"malformed line: {line!r}"
+        assert types["a_total"] == "counter"
+        assert types["b"] == "gauge"
+        assert types["c_seconds"] == "summary"
+        assert types["d_total"] == "counter"
+        # the summary's sum/count ride under the family, no TYPE of
+        # their own
+        assert "c_seconds_sum" not in types
+        assert "c_seconds_sum 1.0" in text
+
+    def test_snapshot_is_json_ready(self):
+        r = Registry()
+        r.counter("a_total").inc()
+        r.histogram("h_seconds").observe(0.5)
+        snap = json.loads(json.dumps(r.snapshot()))
+        assert snap["a_total"] == 1.0
+        assert snap["h_seconds"]["count"] == 1
+
+    def test_broken_collector_cannot_kill_exposition(self):
+        r = Registry()
+        r.counter("good_total").inc()
+
+        def bad():
+            raise RuntimeError("collector died")
+
+        r.register_collector(bad)
+        assert "good_total" in r.prometheus()
+        assert "good_total" in r.snapshot()
+
+
+class TestEventSink:
+    def test_header_schema_and_monotonic_t(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        with EventSink(p, run_meta={"tool": "test"}) as sink:
+            sink.emit("a", x=1)
+            sink.emit("b", arr=np.float32(2.5))
+        evs = read_events(p)
+        assert evs[0]["event"] == "run_start"
+        assert evs[0]["schema"] == SCHEMA_VERSION
+        assert evs[0]["tool"] == "test"
+        ts = [e["t"] for e in evs]
+        assert ts == sorted(ts) and ts[0] == 0.0
+        assert evs[2]["arr"] == 2.5  # numpy scalar serialized
+
+    def test_default_sink_install_and_restore(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        base = get_sink()
+        sink = EventSink(p)
+        prev = set_sink(sink)
+        try:
+            assert get_sink() is sink
+        finally:
+            set_sink(prev)
+        assert get_sink() is base
+        sink.close()
+        sink.emit("after_close")  # must not raise
+
+    def test_timed_routes_to_sink_not_stdout(self, tmp_path, capsys):
+        from improved_body_parts_tpu.utils.profiling import timed
+
+        p = str(tmp_path / "ev.jsonl")
+        sink = EventSink(p)
+        prev = set_sink(sink)
+        try:
+            with timed("span"):
+                pass
+        finally:
+            set_sink(prev)
+            sink.close()
+        assert capsys.readouterr().out == ""
+        evs = read_events(p)
+        assert evs[-1]["event"] == "timed" and evs[-1]["label"] == "span"
+        # without a sink, the stdout fallback still reports
+        with timed("loud"):
+            pass
+        assert "[loud]" in capsys.readouterr().out
+
+
+class TestStepPhases:
+    def test_split_attributes_producer_vs_consumer(self):
+        r = Registry()
+        phases = StepPhases(r, prefix="t")
+
+        def slow_producer():
+            for _ in range(3):
+                time.sleep(0.02)
+                yield 1
+
+        t0 = time.perf_counter()
+        for _ in phases.attribute(slow_producer()):
+            time.sleep(0.01)  # consumer compute
+        wall = time.perf_counter() - t0
+        wait, hold = phases.totals()
+        assert wait > hold  # producer was the bottleneck
+        assert 0.05 <= wait <= wall
+        assert 0.025 <= hold <= wall
+        # the split covers the loop's wall time
+        assert (wait + hold) / wall > 0.9
+        assert phases.batches.value == 3
+
+
+class TestMetricsServer:
+    def test_metrics_and_snapshot_endpoints(self):
+        r = Registry()
+        r.counter("hits_total").inc(5)
+        with MetricsServer(r, port=0, extra=lambda: {"run": "x"}) as srv:
+            body = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=10).read().decode()
+            assert "hits_total 5.0" in body
+            snap = json.loads(urllib.request.urlopen(
+                srv.url + "/snapshot", timeout=10).read())
+            assert snap["metrics"]["hits_total"] == 5.0
+            assert snap["run"] == "x"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(srv.url + "/nope", timeout=10)
+
+    def test_serve_metrics_share_the_exposition_path(self):
+        """ServeMetrics registers into the registry as a collector: the
+        batcher's counters surface on the same /metrics endpoint as
+        everything else (the ISSUE's one-exposition-path requirement)."""
+        from improved_body_parts_tpu.serve.metrics import ServeMetrics
+
+        r = Registry()
+        m = ServeMetrics().register_into(r)
+        for _ in range(4):
+            m.on_submit()
+        m.on_dispatch(3)
+        m.on_dispatch(1)
+        m.on_complete(0.05)
+        m.on_fail()
+        with MetricsServer(r, port=0) as srv:
+            body = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=10).read().decode()
+        assert "serve_submitted_total 4.0" in body
+        assert "serve_completed_total 1.0" in body
+        assert "serve_failed_total 1.0" in body
+        assert "serve_queue_depth 2.0" in body
+        assert 'serve_batches_total{size="3"} 1.0' in body
+        assert 'serve_latency_seconds{quantile="0.5"}' in body
+        for line in body.strip().splitlines():
+            if not line.startswith("#"):
+                assert _PROM_LINE.match(line), f"malformed: {line!r}"
+
+
+class TestCompileWatch:
+    def test_monitoring_hook_detects_post_warmup_recompile(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        p = str(tmp_path / "ev.jsonl")
+        sink = EventSink(p)
+        watch = CompileWatch(Registry(), sink).install()
+        try:
+            f = jax.jit(lambda x: x * 3 + 1)
+            f(jnp.ones((4,)))
+            assert watch.compiles.value >= 1
+            watch.mark_warm("test")
+            f(jnp.ones((4,)))  # cache hit: not a recompile
+            assert watch.recompiles.value == 0
+            f(jnp.ones((6,)))  # new shape: real XLA compile
+            assert watch.recompiles.value >= 1
+        finally:
+            watch.uninstall()
+            sink.close()
+        evs = read_events(p)
+        kinds = [e["event"] for e in evs]
+        assert "warmup_complete" in kinds and "recompile" in kinds
+        rc = next(e for e in evs if e["event"] == "recompile")
+        assert rc["source"] == "jax.monitoring"
+        assert watch.timeline and watch.timeline[0]["duration_s"] >= 0
+
+    def test_uninstalled_watch_stops_counting(self):
+        import jax
+        import jax.numpy as jnp
+
+        watch = CompileWatch(Registry()).install()
+        watch.mark_warm()
+        watch.uninstall()
+        jax.jit(lambda x: x - 7)(jnp.ones((3,)))
+        assert watch.recompiles.value == 0
+
+    def test_jit_wrapper_fallback(self):
+        """Without jax.monitoring (old jax), wrap() flags unseen
+        (shape, dtype) signatures as compiles from the call site."""
+        watch = CompileWatch(Registry())
+        watch._active = True   # installed, but monitoring unavailable
+        watch._hooked = False
+        f = watch.wrap(lambda x: x + 1)
+        f(np.ones((3,), np.float32))
+        assert watch.compiles.value == 1
+        watch.mark_warm()
+        f(np.ones((3,), np.float32))      # seen signature
+        assert watch.recompiles.value == 0
+        f(np.ones((3,), np.float64))      # same shape, new dtype
+        f(np.ones((5,), np.float32))      # new shape
+        assert watch.recompiles.value == 2
+        assert all(e["source"] == "jit-wrapper" for e in watch.timeline)
+
+
+class TestTrainLoopTelemetry:
+    def _run_epoch(self, tmp_path, n_batches=12, print_freq=4):
+        from improved_body_parts_tpu.config import get_config
+        from improved_body_parts_tpu.train.loop import train_epoch
+
+        p = str(tmp_path / "ev.jsonl")
+        tele = RunTelemetry(p, registry=Registry(), step_sample=1,
+                            watch_compiles=False)
+
+        def batches():
+            for _ in range(n_batches):
+                yield (np.ones((2, 8, 8, 3), np.float32),)
+
+        def step(state, imgs):
+            time.sleep(0.002)
+            return state, np.float32(0.5)
+
+        t0 = time.perf_counter()
+        _, avg = train_epoch(None, step, batches(),
+                             get_config("tiny"), 3,
+                             print_freq=print_freq, telemetry=tele,
+                             log_fn=lambda s: None)
+        wall = time.perf_counter() - t0
+        tele.close()
+        return avg, read_events(p), wall
+
+    def test_step_records_and_split(self, tmp_path):
+        avg, evs, wall = self._run_epoch(tmp_path)
+        assert abs(avg - 0.5) < 1e-6
+        recs = [e for e in evs if e["event"] == "train_step"]
+        assert len(recs) == 3  # 12 batches / print_freq 4
+        for e in recs:
+            assert e["epoch"] == 3
+            assert e["loss"] == pytest.approx(0.5)
+            assert e["step_s"] > 0 and e["imgs_per_sec"] > 0
+            assert e["data_wait_s"] >= 0 and e["compute_s"] >= 0
+        # the attributed split covers ~all of the loop's wall time
+        covered = sum(e["data_wait_s"] + e["compute_s"] for e in recs)
+        assert covered / wall > 0.75
+        assert any(e["event"] == "warmup_complete" for e in evs)
+
+    def test_fit_emits_epoch_events(self, tmp_path):
+        from improved_body_parts_tpu.config import get_config
+        from improved_body_parts_tpu.train import loop as L
+
+        p = str(tmp_path / "ev.jsonl")
+        tele = RunTelemetry(p, registry=Registry(), watch_compiles=False)
+        cfg = get_config("tiny")
+
+        def make_batches(epoch):
+            def gen():
+                for _ in range(2):
+                    yield (np.ones((1, 8, 8, 3), np.float32),)
+            return gen()
+
+        def step(state, imgs):
+            return state, np.float32(1.5)
+
+        saved = []
+        orig = L.ckpt.save_checkpoint
+        L.ckpt.save_checkpoint = lambda *a, **k: saved.append(a)
+        try:
+            L.fit(None, step, cfg, make_batches, epochs=2,
+                  checkpoint_dir=str(tmp_path / "ck"),
+                  log_fn=lambda s: None, telemetry=tele)
+        finally:
+            L.ckpt.save_checkpoint = orig
+        tele.close()
+        eps = [e for e in read_events(p) if e["event"] == "epoch"]
+        assert [e["epoch"] for e in eps] == [0, 1]
+        assert all(e["train_loss"] == pytest.approx(1.5) for e in eps)
+
+
+class TestEvalEpochBuffering:
+    def test_readback_deferred_to_end(self):
+        """eval_epoch must not float() per step (a device sync that
+        defeats device_prefetch) — every readback happens after the
+        last batch was consumed."""
+        from improved_body_parts_tpu.train.loop import eval_epoch
+
+        consumed = [0]
+        float_calls = []
+
+        class Loss:
+            def __float__(self):
+                float_calls.append(consumed[0])
+                return 2.0
+
+        def batches():
+            for i in range(5):
+                consumed[0] = i
+                yield (np.ones((2, 4, 4, 3), np.float32),)
+
+        avg = eval_epoch(None, lambda s, *b: Loss(), batches())
+        assert avg == pytest.approx(2.0)
+        assert len(float_calls) == 5
+        assert all(c == 4 for c in float_calls), float_calls
+
+
+class TestShmRingTelemetry:
+    def test_ring_exports_render_and_occupancy(self, tmp_path):
+        from improved_body_parts_tpu.config import get_config
+        from improved_body_parts_tpu.data import CocoPoseDataset
+        from improved_body_parts_tpu.data.fixture import build_fixture
+        from improved_body_parts_tpu.data.shm_ring import ShmRingInput
+
+        cfg = get_config("tiny")
+        h5 = str(tmp_path / "fix.h5")
+        build_fixture(h5, num_images=6, people_per_image=1, seed=0)
+        ds = CocoPoseDataset(h5, cfg, augment=False, seed=0)
+        r = Registry()
+        with ShmRingInput(ds, batch_size=2, num_workers=1) as ring:
+            ring.attach_telemetry(r)
+            n = sum(1 for _ in ring.batches(0))
+        assert n == 3
+        snap = r.snapshot()
+        assert snap["input_ring_batches_total"] == 3.0
+        assert snap["input_ring_slots_total"] == ring.slots
+        # all slots handed back once the epoch drained
+        assert snap["input_ring_free_slots"] == ring.slots
+        render = snap['input_ring_render_seconds{worker="0"}']
+        assert render["count"] == 3 and render["mean"] > 0
+        assert snap["input_ring_consumer_stalls_total"] >= 0
+
+
+class TestRunTelemetryBundle:
+    def test_resolve_sink_path(self):
+        from improved_body_parts_tpu.obs import resolve_sink_path
+
+        assert resolve_sink_path("", "ck") is None
+        assert resolve_sink_path("auto", "ck") == os.path.join(
+            "ck", "events.jsonl")
+        assert resolve_sink_path("x.jsonl", "ck") == "x.jsonl"
+
+    def test_bundle_wires_sink_server_watch(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        with RunTelemetry(p, http_port=0, registry=Registry(),
+                          run_meta={"tool": "t"}) as tele:
+            assert get_sink() is tele.sink  # default sink installed
+            tele.emit("ping")
+            url = tele.server.url
+            snap = json.loads(urllib.request.urlopen(
+                url + "/snapshot", timeout=10).read())
+            assert snap["events"] == tele.sink.path
+        assert isinstance(get_sink(), NullSink)  # restored on close
+        assert [e["event"] for e in read_events(p)] == ["run_start",
+                                                        "ping"]
+
+    def test_disabled_bundle_is_inert(self):
+        tele = RunTelemetry(None, registry=Registry(),
+                            watch_compiles=False)
+        assert not tele.sink.enabled and tele.server is None
+        tele.emit("dropped")  # no-op
+        tele.close()
+
+
+class TestTelemetryReport:
+    def _write_stream(self, path, wait, hold, recompile=False):
+        with EventSink(path, run_meta={"tool": "t", "config": "c"}) as s:
+            for i in range(4):
+                s.emit("train_step", epoch=0, step=(i + 1) * 10,
+                       loss=1.0, loss_avg=1.0, step_s=0.1,
+                       imgs_per_sec=40.0, data_wait_s=wait / 4,
+                       compute_s=hold / 4)
+            s.emit("warmup_complete", label="t")
+            if recompile:
+                s.emit("recompile", duration_s=2.5,
+                       source="jax.monitoring")
+            s.emit("epoch", epoch=0, train_loss=1.0, val_loss=2.0)
+
+    def _report(self, events_path, tmp_path):
+        out = str(tmp_path / "report.json")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "telemetry_report.py"),
+             events_path, "--json", out],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr
+        with open(out) as f:
+            return proc.stdout, json.load(f)
+
+    def test_compute_bound_verdict(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        self._write_stream(p, wait=0.02, hold=0.38)
+        text, summary = self._report(p, tmp_path)
+        assert summary["verdict"] == "compute-bound"
+        assert summary["windows"] == 4
+        assert summary["attribution"]["data_wait_frac"] == \
+            pytest.approx(0.05)
+        assert summary["recompiles_post_warmup"] == 0
+        assert "compute-bound" in text
+
+    def test_input_bound_verdict_and_recompile_timeline(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        self._write_stream(p, wait=0.3, hold=0.1, recompile=True)
+        text, summary = self._report(p, tmp_path)
+        assert summary["verdict"] == "input-bound"
+        assert summary["recompiles_post_warmup"] == 1
+        assert summary["recompile_timeline"][0]["duration_s"] == 2.5
+        assert summary["epochs"][-1]["val_loss"] == 2.0
+        assert "input-bound" in text and "recompiles after warmup: 1" \
+            in text
+
+    def test_stacked_runs_report_the_last(self, tmp_path):
+        """The sink appends, so a resume/retry over the same path stacks
+        runs — the report must cover only the LAST run_start onward,
+        not blend two runs' windows and warmup markers."""
+        p = str(tmp_path / "ev.jsonl")
+        self._write_stream(p, wait=0.3, hold=0.1, recompile=True)
+        self._write_stream(p, wait=0.02, hold=0.38)  # appends run 2
+        text, summary = self._report(p, tmp_path)
+        assert summary["previous_runs_in_file"] == 1
+        assert summary["windows"] == 4          # run 2 only, not 8
+        assert summary["verdict"] == "compute-bound"
+        assert summary["recompiles_post_warmup"] == 0  # run 1's dropped
+        assert "earlier run" in text
+
+    def test_future_schema_refused(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps({"event": "run_start",
+                                "schema": SCHEMA_VERSION + 1,
+                                "t": 0.0}) + "\n")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "telemetry_report.py"), p],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode != 0
+        assert "schema" in proc.stderr

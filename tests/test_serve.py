@@ -371,3 +371,48 @@ def test_serve_bench_cli(tmp_path):
     assert serve["mean_batch_occupancy"] >= 1
     assert r["sequential"]["imgs_per_sec"] > 0
     assert isinstance(r["batched_beats_sequential"], bool)
+
+
+def test_metrics_endpoint_serves_batcher_under_load(warm_pred):
+    """Acceptance (ISSUE 3): a live /metrics endpoint serves valid
+    Prometheus text exposition for a DynamicBatcher under concurrent
+    load, through the shared obs.Registry path."""
+    import re
+    import urllib.request
+
+    from improved_body_parts_tpu.obs import MetricsServer, Registry
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    reg = Registry()
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=30,
+                        max_queue=64, use_native=False,
+                        registry=reg) as server, \
+            MetricsServer(reg, port=0) as srv:
+        server.warmup([SIZE_A], batch_sizes=(1, 2))
+
+        def client():
+            for _ in range(3):
+                server.submit(img).result(timeout=60)
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        # scrape WHILE load is in flight — the endpoint must hold up
+        mid = urllib.request.urlopen(srv.url + "/metrics",
+                                     timeout=10).read().decode()
+        assert "serve_submitted_total" in mid
+        for t in threads:
+            t.join()
+        body = urllib.request.urlopen(srv.url + "/metrics",
+                                      timeout=10).read().decode()
+
+    line_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+$")
+    for line in body.strip().splitlines():
+        if not line.startswith("#"):
+            assert line_re.match(line), f"malformed exposition: {line!r}"
+    assert "serve_submitted_total 12.0" in body
+    assert "serve_completed_total 12.0" in body
+    assert 'serve_latency_seconds{quantile="0.99"}' in body
+    assert "serve_imgs_per_sec" in body

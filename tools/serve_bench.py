@@ -109,7 +109,8 @@ def bench_sequential(pred, decode_one, images, n_clients, requests,
             "latency_ms": lat_summary(lats)}
 
 
-def make_server(pred, params, args, use_native, n_clients, devices=None):
+def make_server(pred, params, args, use_native, n_clients, devices=None,
+                registry=None):
     from improved_body_parts_tpu.serve import DynamicBatcher
 
     # auto: one decode lane per client, but never more threads than
@@ -121,7 +122,8 @@ def make_server(pred, params, args, use_native, n_clients, devices=None):
                           max_queue=args.max_queue,
                           decode_workers=workers,
                           eager_idle_flush=not args.occupancy_first,
-                          use_native=use_native, devices=devices)
+                          use_native=use_native, devices=devices,
+                          registry=registry)
 
 
 def run_serve_slice(server, images, n_clients, requests):
@@ -211,6 +213,14 @@ def main():
                          "(data-parallel serving). 0 = all visible "
                          "devices; on a CPU host, N > 1 creates N "
                          "virtual host devices")
+    ap.add_argument("--telemetry-sink", default="auto",
+                    help="JSONL event stream for the run ('auto' = "
+                         "<out>_events.jsonl next to --out, 'none' "
+                         "disables); the path lands in the output JSON "
+                         "as telemetry_events")
+    ap.add_argument("--telemetry-port", type=int, default=-1,
+                    help="serve /metrics + /snapshot live during the "
+                         "bench (0 = ephemeral port, -1 off)")
     ap.add_argument("--out", default="SERVE_BENCH.json")
     args = ap.parse_args()
 
@@ -278,7 +288,24 @@ def main():
     images = [im for s in sizes for im in synth_images(4, s, rng)]
     size_list = [(s, s) for s in sizes]
 
+    from improved_body_parts_tpu.obs import Registry, RunTelemetry
+
+    sink_path = None
+    if args.telemetry_sink not in ("none", ""):
+        sink_path = (os.path.splitext(args.out)[0] + "_events.jsonl"
+                     if args.telemetry_sink == "auto"
+                     else args.telemetry_sink)
+    telemetry = RunTelemetry(
+        sink_path, registry=Registry(),
+        http_port=(args.telemetry_port if args.telemetry_port >= 0
+                   else None),
+        run_meta={"tool": "serve_bench", "config": args.config,
+                  "platform": platform})
+    if telemetry.server is not None:
+        print(f"telemetry: {telemetry.server.url}/metrics", flush=True)
+
     report = {"platform": platform, "config": args.config, "sizes": sizes,
+              "telemetry_events": sink_path,
               "serve_devices": len(serve_devices),
               "occupancy_first": bool(args.occupancy_first),
               "note": "closed-loop clients; verdict rounds interleave the "
@@ -320,6 +347,10 @@ def main():
                           devices=serve_devices)
         report["serve"].append(arm)
         flush()
+        telemetry.emit("serve_arm", clients=n,
+                       imgs_per_sec=arm["imgs_per_sec"],
+                       p95_ms=arm["latency_ms"]["p95"],
+                       mean_batch_occupancy=arm["mean_batch_occupancy"])
         print(f"serve x{n}: {arm['imgs_per_sec']} imgs/s "
               f"p95={arm['latency_ms']['p95']}ms "
               f"occupancy={arm['mean_batch_occupancy']}", flush=True)
@@ -330,9 +361,16 @@ def main():
     # whichever arm happened to run in the bad minute
     n_peak = max(int(c) for c in args.clients.split(","))
     seq_rounds, serve_rounds = [], []
+    # the verdict server registers into the run registry: its counters/
+    # latency reservoir surface on /metrics (when --telemetry-port is
+    # set) alongside the recompile watch — one exposition path
     with make_server(pred, params, args, use_native, n_peak,
-                     devices=serve_devices) as server:
+                     devices=serve_devices,
+                     registry=telemetry.registry) as server:
         server.warmup(size_list)
+        # every bucket x batch-size program is compiled: any compile
+        # from here on is the silent recompile stall the watch exists for
+        telemetry.mark_warm("serve warmup precompile")
         for _ in range(max(1, args.rounds)):
             seq_rounds.append(bench_sequential(
                 pred, decode_one, images, args.baseline_clients,
@@ -367,6 +405,15 @@ def main():
                     report["sequential_overlapped"]["imgs_per_sec"],
                     report["sequential_concurrent"]["imgs_per_sec"])
     report["beats_all_sequential_baselines"] = bool(serve_fps > strongest)
+    # post-warmup compiles during the verdict rounds would mean the
+    # precompile missed a shape the traffic actually hit
+    report["recompiles_post_warmup"] = int(
+        telemetry.compile_watch.recompiles.value)
+    telemetry.emit("serve_verdict", sequential_imgs_per_sec=seq_fps,
+                   serve_imgs_per_sec=serve_fps,
+                   batched_beats_sequential=report[
+                       "batched_beats_sequential"])
+    telemetry.close()
     flush()
     print(json.dumps({"batched_beats_sequential":
                       report["batched_beats_sequential"],

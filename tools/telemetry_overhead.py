@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Telemetry overhead check: the sink must cost <2% of step time.
+
+Runs the REAL ``train_epoch`` loop (jitted step, ``device_prefetch``,
+throttled readback) over synthetic batches twice per round — telemetry
+OFF, then ON (JSONL sink + data-wait/compute attribution + compile
+watch + registry gauges) — in interleaved ABBA rounds (the arm order
+flips each round) so host-load drift hits both arms equally, with no
+systematic penalty for whichever arm runs second.
+
+The verdict is the MEDIAN of PAIRED per-window ratios: each
+print_freq-step window of an ON epoch is ratioed against the same-index
+window of the temporally-adjacent OFF epoch, and the median over all
+pairs is the overhead.  Estimator selection was empirical, on a
+cpu-shares-throttled host whose round-to-round spread on IDENTICAL code
+reached 2.5x: whole-epoch minima mis-ranked an A/A comparison by 21%,
+while the paired-window median read the same A/A at ~2% and a true
+OFF/ON at ~0% — pairing cancels load drift (adjacent windows see
+correlated throttling) and the median discards burst-inflated pairs.
+If the verdict still exceeds the budget, one adaptive retry doubles the
+round count before the final answer (noise shrinks with samples; real
+overhead would not).  Window minima and per-round epoch times are
+reported alongside.  Also verifies the ON arm's event stream actually
+parses and its wait+compute split covers the epoch wall time.
+
+Registered as the ``"telemetry"`` key in bench.py
+(``IBP_BENCH_TELEMETRY=0`` skips).
+
+    python tools/telemetry_overhead.py            # 10 steps x 15 rounds
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OVERHEAD_BUDGET_PCT = 2.0
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", default="tiny")
+    ap.add_argument("--steps", type=int, default=10,
+                    help="train steps per arm per round — SHORT epochs "
+                         "keep paired windows temporally adjacent, so "
+                         "cpu-shares throttle bursts hit both arms of a "
+                         "pair (validated: 10x15 reads a loaded host "
+                         "within ±2%% where 30x5 spread 4-8%%)")
+    ap.add_argument("--rounds", type=int, default=15,
+                    help="interleaved off/on rounds; more rounds = more "
+                         "window pairs = tighter noise immunity (a "
+                         "shared-core host's spread on identical code "
+                         "can be several times the true overhead)")
+    ap.add_argument("--print-freq", type=int, default=5)
+    ap.add_argument("--out", default="TELEMETRY_OVERHEAD.json")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero when the overhead budget is blown")
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.utils import apply_platform_env
+    apply_platform_env()
+
+    import jax
+    import numpy as np
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.obs import Registry, RunTelemetry, read_events
+    from improved_body_parts_tpu.parallel import make_mesh, replicated
+    from improved_body_parts_tpu.train import (
+        create_train_state, make_optimizer, make_train_step,
+        step_decay_schedule)
+    from improved_body_parts_tpu.train.loop import train_epoch
+
+    cfg = get_config(args.config)
+    model = build_model(cfg)
+    mesh = make_mesh()
+    n_dev = int(mesh.devices.size)
+    batch = max(cfg.train.batch_size_per_device, 1) * n_dev
+    size = cfg.skeleton.height
+    grid = size // cfg.skeleton.stride
+    rng = np.random.default_rng(0)
+
+    imgs = rng.uniform(0, 1, (batch, size, size, 3)).astype(np.float32)
+    labels = rng.uniform(
+        0, 1, (batch, grid, grid, cfg.skeleton.num_layers)
+    ).astype(np.float32)
+    mask = np.ones((batch, grid, grid, 1), np.float32)
+
+    def batches(ticks=None):
+        for _ in range(args.steps):
+            if ticks is not None:
+                ticks.append(time.perf_counter())
+            yield (imgs, mask, labels)
+
+    opt = make_optimizer(cfg, step_decay_schedule(cfg.train,
+                                                  steps_per_epoch=100))
+    state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
+                               imgs[:1])
+    state = jax.device_put(state, replicated(mesh))
+    step = make_train_step(model, cfg, opt)
+    quiet = lambda s: None  # noqa: E731 — stdout must stay one JSON line
+
+    # untimed compile pass (both arms reuse the same compiled program)
+    state, _ = train_epoch(state, step, batches(), cfg, 0, mesh=mesh,
+                           print_freq=args.print_freq, log_fn=quiet)
+
+    events_path = os.path.join(tempfile.mkdtemp(prefix="telemetry_oh_"),
+                               "events.jsonl")
+    tele = RunTelemetry(events_path, registry=Registry(),
+                        run_meta={"tool": "telemetry_overhead",
+                                  "config": args.config})
+
+    def run_arm(telemetry, epochs, windows):
+        """One epoch; appends its per-print_freq-window step times (the
+        batch-iterator tick deltas — identical apparatus in both arms)
+        as one list, and the whole-epoch per-step time."""
+        nonlocal state, on_wall
+        ticks = []
+        t0 = time.perf_counter()
+        state, _ = train_epoch(state, step, batches(ticks), cfg, 1,
+                               mesh=mesh, print_freq=args.print_freq,
+                               log_fn=quiet, telemetry=telemetry)
+        t1 = time.perf_counter()
+        ticks.append(t1)
+        w = args.print_freq
+        windows.append([(ticks[i + w] - ticks[i]) / w
+                        for i in range(0, len(ticks) - w, w)])
+        epochs.append((t1 - t0) / args.steps)
+        if telemetry is tele:
+            on_wall += t1 - t0
+
+    off, on = [], []          # per-epoch step time, per round
+    off_w, on_w = [], []      # per-round lists of window step times
+    on_wall = 0.0
+
+    def measure(rounds, round0):
+        for i in range(round0, round0 + rounds):
+            # ABBA order: alternate which arm goes first each round, so
+            # a monotonic host-load ramp cannot systematically penalize
+            # one arm
+            order = [(off, off_w, None), (on, on_w, tele)]
+            if i % 2:
+                order.reverse()
+            for epochs, windows, t in order:
+                run_arm(t, epochs, windows)
+        ratios = [b / a
+                  for ar, br in zip(off_w, on_w)
+                  for a, b in zip(ar, br)]
+        return (statistics.median(ratios) - 1.0) * 100.0, len(ratios)
+
+    rounds = max(1, args.rounds)
+    overhead_pct, pairs = measure(rounds, 0)
+    retried = False
+    if overhead_pct >= OVERHEAD_BUDGET_PCT:
+        # over budget: noise shrinks with samples, real overhead would
+        # not — double the evidence once before concluding
+        retried = True
+        overhead_pct, pairs = measure(rounds, rounds)
+    tele.close()
+
+    flat_off = [v for ws in off_w for v in ws]
+    flat_on = [v for ws in on_w for v in ws]
+    step_off = min(flat_off)
+    step_on = min(flat_on)
+
+    # the ON arm's stream must parse, and its attributed split must
+    # cover the loop's wall time (the report's verdict depends on it)
+    events = read_events(events_path)
+    records = [e for e in events if e.get("event") == "train_step"]
+    wait = sum(e["data_wait_s"] for e in records)
+    hold = sum(e["compute_s"] for e in records)
+    split_cover = (wait + hold) / on_wall if on_wall else 0.0
+
+    report = {
+        "config": args.config,
+        "steps": args.steps,
+        "rounds": args.rounds,
+        "estimator": "median of paired per-window on/off ratios "
+                     "(ABBA rounds; see module docstring)",
+        "overhead_pct": round(overhead_pct, 3),
+        "budget_pct": OVERHEAD_BUDGET_PCT,
+        "within_budget": bool(overhead_pct < OVERHEAD_BUDGET_PCT),
+        "window_pairs": pairs,
+        "retried": retried,
+        "step_ms_off": round(step_off * 1e3, 3),   # best window per arm
+        "step_ms_on": round(step_on * 1e3, 3),
+        "step_ms_off_median": round(
+            statistics.median(flat_off) * 1e3, 3),
+        "step_ms_on_median": round(statistics.median(flat_on) * 1e3, 3),
+        "per_round_off_ms": [round(v * 1e3, 3) for v in off],
+        "per_round_on_ms": [round(v * 1e3, 3) for v in on],
+        # the OFF arm's own round-to-round spread: the measurement noise
+        # floor indicator — identical code has been measured spreading
+        # 2-2.5x round-to-round on a shared-core host
+        "off_round_spread_pct": round(
+            (max(off) - min(off)) / min(off) * 100.0, 2),
+        "telemetry_events": events_path,
+        "events_parsed": len(events),
+        "step_records": len(records),
+        "split_covers_wall_frac": round(split_cover, 4),
+        "recompiles_post_warmup": sum(
+            1 for e in events if e.get("event") == "recompile"),
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    if args.strict and not report["within_budget"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

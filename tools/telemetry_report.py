@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Fold a run's JSONL telemetry stream into a human-readable summary.
+
+Reads the event stream written by ``obs.EventSink`` (tools/train.py
+``--telemetry-sink``, tools/serve_bench.py, tools/telemetry_overhead.py)
+and reports:
+
+- step time (mean / p50 / p95 / max) and imgs/s over the run;
+- the **bottleneck verdict**: the data-wait vs device-compute split
+  accumulated inside ``parallel.prefetch`` — *input-bound* means the
+  chips starved waiting for batches (fix: more ring workers, see
+  TRAINING.md §5b), *compute-bound* means the input pipeline kept up
+  and the step itself is the frontier;
+- the recompile timeline: every post-warmup XLA compile
+  (``obs.CompileWatch``), each one a silent multi-second pipeline stall;
+- epoch losses, ``timed`` span records, and serve snapshots when
+  present.
+
+    python tools/telemetry_report.py checkpoints/events.jsonl
+    python tools/telemetry_report.py events.jsonl --json report.json
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# above this fraction of attributed wall time spent waiting on data the
+# run is input-bound; below half of it, compute-bound; between, mixed
+INPUT_BOUND_FRAC = 0.4
+
+
+def _pct(xs, q):
+    """Exact percentile of a full sample list (a PercentileMeter at
+    >=len capacity evicts nothing, so its estimate is exact — one
+    quantile implementation shared with the /metrics endpoint)."""
+    from improved_body_parts_tpu.utils.meters import PercentileMeter
+
+    m = PercentileMeter(capacity=max(len(xs), 1))
+    for v in xs:
+        m.update(v)
+    return m.percentile(q)
+
+
+def summarize(events):
+    """Machine-readable summary dict of one parsed event stream.
+
+    The sink appends, so a re-run over the same ``auto`` path (resume /
+    retry) stacks runs in one file: the summary covers the LAST run —
+    everything from the final ``run_start`` header on — and records how
+    many earlier runs were skipped.
+    """
+    from improved_body_parts_tpu.obs import SCHEMA_VERSION
+
+    starts = [i for i, e in enumerate(events)
+              if e.get("event") == "run_start"]
+    previous_runs = max(len(starts) - 1, 0)
+    if starts:
+        events = events[starts[-1]:]
+    header = events[0] if starts else {}
+    schema = header.get("schema", 0)
+    if schema > SCHEMA_VERSION:
+        raise SystemExit(
+            f"event stream schema {schema} is newer than this tool's "
+            f"{SCHEMA_VERSION}; refusing to misread it — update the repo")
+
+    steps = [e for e in events if e.get("event") == "train_step"]
+    epochs = [e for e in events if e.get("event") == "epoch"]
+    recompiles = [e for e in events if e.get("event") == "recompile"]
+    warm = next((e for e in events
+                 if e.get("event") == "warmup_complete"), None)
+    timed = [e for e in events if e.get("event") == "timed"]
+    serve = [e for e in events if e.get("event", "").startswith("serve")]
+
+    step_s = [e["step_s"] for e in steps if "step_s" in e]
+    imgs_s = [e["imgs_per_sec"] for e in steps if "imgs_per_sec" in e]
+    wait = sum(e.get("data_wait_s", 0.0) for e in steps)
+    hold = sum(e.get("compute_s", 0.0) for e in steps)
+    attributed = wait + hold
+    wait_frac = wait / attributed if attributed else 0.0
+
+    if not attributed:
+        verdict = "unknown (no attributed step records)"
+    elif wait_frac >= INPUT_BOUND_FRAC:
+        verdict = "input-bound"
+    elif wait_frac >= INPUT_BOUND_FRAC / 2:
+        verdict = "mixed (input pressure)"
+    else:
+        verdict = "compute-bound"
+
+    out = {
+        "run": {k: header.get(k) for k in
+                ("schema", "time_unix", "pid", "tool", "config")
+                if k in header or k == "schema"},
+        "previous_runs_in_file": previous_runs,
+        "windows": len(steps),
+        "step_seconds": {
+            "mean": sum(step_s) / len(step_s) if step_s else 0.0,
+            "p50": _pct(step_s, 50), "p95": _pct(step_s, 95),
+            "max": max(step_s) if step_s else 0.0,
+        },
+        "imgs_per_sec": {
+            "mean": sum(imgs_s) / len(imgs_s) if imgs_s else 0.0,
+            "last": imgs_s[-1] if imgs_s else 0.0,
+        },
+        "attribution": {
+            "data_wait_s": round(wait, 6),
+            "compute_s": round(hold, 6),
+            "data_wait_frac": round(wait_frac, 4),
+            "compute_frac": round(1.0 - wait_frac, 4) if attributed else 0.0,
+        },
+        "verdict": verdict,
+        "warmup_complete_t": warm.get("t") if warm else None,
+        "recompiles_post_warmup": len(recompiles),
+        "recompile_timeline": [
+            {"t": e.get("t"), "duration_s": e.get("duration_s"),
+             "source": e.get("source")} for e in recompiles],
+        "epochs": [{"epoch": e.get("epoch"),
+                    "train_loss": e.get("train_loss"),
+                    **({"val_loss": e["val_loss"]} if "val_loss" in e
+                       else {})} for e in epochs],
+        "timed_spans": len(timed),
+        "serve_events": len(serve),
+    }
+    return out
+
+
+def render(summary):
+    """Human-readable report text."""
+    s = summary
+    lines = []
+    run = s["run"]
+    lines.append("== telemetry report ==")
+    lines.append(f"run: tool={run.get('tool', '?')} "
+                 f"config={run.get('config', '?')} pid={run.get('pid')}")
+    if s.get("previous_runs_in_file"):
+        lines.append(f"(file holds {s['previous_runs_in_file']} earlier "
+                     "run(s); reporting the last)")
+    st = s["step_seconds"]
+    lines.append(
+        f"steps: {s['windows']} windows | step "
+        f"{st['mean'] * 1e3:.1f} ms mean / {st['p50'] * 1e3:.1f} p50 / "
+        f"{st['p95'] * 1e3:.1f} p95 / {st['max'] * 1e3:.1f} max | "
+        f"{s['imgs_per_sec']['mean']:.1f} imgs/s mean")
+    a = s["attribution"]
+    lines.append(
+        f"attribution: data-wait {a['data_wait_s']:.2f} s "
+        f"({a['data_wait_frac'] * 100:.1f}%) vs compute "
+        f"{a['compute_s']:.2f} s ({a['compute_frac'] * 100:.1f}%)")
+    lines.append(f"verdict: {s['verdict']}")
+    if s["verdict"] == "input-bound":
+        lines.append("  -> the device starved on input; add ring workers "
+                     "(tools/feed_rate.py sizes them, TRAINING.md 5b)")
+    elif s["verdict"].startswith("compute"):
+        lines.append("  -> input kept up; the step itself is the "
+                     "frontier (tools/train_bench.py / perf_audit.py)")
+    n_rc = s["recompiles_post_warmup"]
+    if s["warmup_complete_t"] is None:
+        lines.append("recompiles: warmup never marked (short/aborted run)")
+    elif n_rc == 0:
+        lines.append("recompiles after warmup: 0 (steady state held)")
+    else:
+        lines.append(f"recompiles after warmup: {n_rc} — each one is a "
+                     "silent pipeline stall:")
+        for e in s["recompile_timeline"][:20]:
+            lines.append(f"  t={e['t']:.2f}s  {e['duration_s']:.3f}s "
+                         f"({e['source']})")
+        if n_rc > 20:
+            lines.append(f"  ... {n_rc - 20} more")
+    if s["epochs"]:
+        last = s["epochs"][-1]
+        lines.append(f"epochs: {len(s['epochs'])} | last train_loss "
+                     f"{last.get('train_loss')}"
+                     + (f" val_loss {last['val_loss']}"
+                        if "val_loss" in last else ""))
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("events", help="JSONL event stream "
+                                   "(obs.EventSink output)")
+    ap.add_argument("--json", default=None,
+                    help="also write the machine-readable summary here")
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.obs import read_events
+
+    events = read_events(args.events)
+    if not events:
+        raise SystemExit(f"no events parsed from {args.events}")
+    summary = summarize(events)
+    print(render(summary))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2)
+        print(f"\nwrote {args.json}")
+
+
+if __name__ == "__main__":
+    main()

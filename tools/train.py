@@ -56,7 +56,9 @@ def main():
     ap.add_argument("--swa-lr-max", type=float, default=1e-5,
                     help="cyclic LR peak (train_distributed_SWA.py:365)")
     ap.add_argument("--swa-lr-min", type=float, default=1e-6)
-    ap.add_argument("--print-freq", type=int, default=10)
+    ap.add_argument("--print-freq", type=int, default=None,
+                    help="metric-readback window in steps (default: the "
+                         "config's print_freq)")
     ap.add_argument("--device-gt", type=int, default=0, metavar="MAX_PEOPLE",
                     help="synthesize GT heatmaps ON DEVICE inside the train "
                          "step from padded joints (value = max people per "
@@ -70,6 +72,15 @@ def main():
                     help="seed for parameter init and the data-pipeline "
                          "RNG ((seed, epoch, index) scheme) — vary for "
                          "seed-replicated runs")
+    ap.add_argument("--telemetry-sink", default=None,
+                    help="JSONL run-event stream (default: the config's "
+                         "telemetry_sink; 'auto' = <checkpoint_dir>/"
+                         "events.jsonl, '' disables). Fold it with "
+                         "tools/telemetry_report.py")
+    ap.add_argument("--telemetry-port", type=int, default=None,
+                    help="live /metrics (Prometheus) + /snapshot (JSON) "
+                         "endpoint port (default: the config's "
+                         "telemetry_port; 0 = ephemeral, -1 disables)")
     # multi-host (jax.distributed)
     ap.add_argument("--coordinator", default=None)
     ap.add_argument("--num-processes", type=int, default=1)
@@ -101,7 +112,7 @@ def main():
         # user believe they fine-tuned at that rate
         raise SystemExit("--lr does not apply to the SWA stage; use "
                          "--swa-lr-max/--swa-lr-min instead")
-    if args.checkpoint_dir or args.lr:
+    if args.checkpoint_dir or args.lr or args.print_freq:
         import dataclasses
 
         overrides = {}
@@ -109,7 +120,53 @@ def main():
             overrides["checkpoint_dir"] = args.checkpoint_dir
         if args.lr:
             overrides["learning_rate_per_device"] = args.lr
+        if args.print_freq:
+            # fit()/train_epoch read config.train.print_freq; a silently
+            # ignored --print-freq also silences the per-window telemetry
+            # records on epochs shorter than the default window
+            overrides["print_freq"] = args.print_freq
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **overrides))
+
+    from improved_body_parts_tpu.obs import RunTelemetry, resolve_sink_path
+
+    sink_cfg = (args.telemetry_sink if args.telemetry_sink is not None
+                else cfg.train.telemetry_sink)
+    sink_path = resolve_sink_path(sink_cfg, cfg.train.checkpoint_dir)
+    if sink_path and args.process_id > 0:
+        # one stream per process: co-located processes appending to the
+        # shared "auto" path would interleave run_start headers with
+        # different t=0 baselines and garble the report
+        sink_path += f".p{args.process_id}"
+    tele_port = (args.telemetry_port if args.telemetry_port is not None
+                 else cfg.train.telemetry_port)
+    if args.process_id > 0:
+        # the endpoint is lead-host-only: a fixed --telemetry-port would
+        # EADDRINUSE-crash every co-located non-lead process at startup
+        tele_port = -1
+    telemetry = None
+    if sink_path or tele_port >= 0:
+        telemetry = RunTelemetry(
+            sink_path, http_port=(tele_port if tele_port >= 0 else None),
+            run_meta={"tool": "train", "config": args.config,
+                      "seed": args.seed, "process_id": args.process_id},
+            step_sample=cfg.train.telemetry_sample)
+        if telemetry.server is not None:
+            print(f"telemetry: {telemetry.server.url}/metrics")
+    if args.process_id == 0:
+        # run manifest: link the checkpoint dir to its event stream so
+        # artifacts and telemetry cross-reference (bench.py does the same)
+        os.makedirs(cfg.train.checkpoint_dir, exist_ok=True)
+        import json
+
+        with open(os.path.join(cfg.train.checkpoint_dir, "RUN.json"),
+                  "w") as f:
+            json.dump({"tool": "train", "config": args.config,
+                       "argv": sys.argv[1:],
+                       "telemetry_events": sink_path,
+                       "telemetry_port": (telemetry.server.port
+                                          if telemetry is not None
+                                          and telemetry.server is not None
+                                          else None)}, f, indent=2)
 
     train_h5 = args.train_h5 or cfg.train.hdf5_train_data
     val_h5 = args.val_h5 or cfg.train.hdf5_val_data
@@ -220,6 +277,11 @@ def main():
             eval_ring = ShmRingInput(val_ds, host_batch, args.workers,
                                      wire=wire,
                                      slots=cfg.train.input_ring_slots)
+        if telemetry is not None:
+            train_ring.attach_telemetry(telemetry.registry)
+            if eval_ring is not None:
+                eval_ring.attach_telemetry(telemetry.registry,
+                                           prefix="eval_input_ring")
 
     def make_train_batches(epoch):
         if train_ring is not None:
@@ -262,6 +324,8 @@ def main():
         for ring in (train_ring, eval_ring):
             if ring is not None:
                 ring.close()
+        if telemetry is not None:
+            telemetry.close()
         if args.num_processes > 1:
             jax.distributed.shutdown()  # aligned exit across processes
 
@@ -273,7 +337,7 @@ def main():
         fit(state, train_step, cfg, make_train_batches, epochs,
             start_epoch=start_epoch, mesh=mesh, eval_step=eval_step,
             make_eval_batches=make_eval_batches, is_lead_host=is_lead,
-            best_loss=best_loss)
+            best_loss=best_loss, telemetry=telemetry)
         shutdown()
         return
 
@@ -292,7 +356,7 @@ def main():
     for epoch in range(start_epoch, start_epoch + epochs):
         state, train_loss = train_epoch(
             state, train_step, make_train_batches(epoch), cfg, epoch,
-            mesh=mesh, is_lead_host=is_lead)
+            mesh=mesh, is_lead_host=is_lead, telemetry=telemetry)
         if is_lead:
             # same append-only epoch log fit() writes (reference logs its
             # SWA epochs too, train_distributed_SWA.py) — without it the
