@@ -62,6 +62,38 @@ def _backend_ready(timeout_s):
         return False
 
 
+def _provenance():
+    """Host/build identity stamped into the bench line so BENCH_*.json
+    artifacts are comparable across hosts and commits: a 53 imgs/s line
+    from a 2-core cpu-shares container and one from a full host look
+    identical without it."""
+    import platform as _platform
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=here, capture_output=True,
+            text=True, timeout=10).stdout.strip() or None
+    except Exception:  # noqa: BLE001 — not a checkout / no git
+        sha = None
+    try:
+        import jax
+
+        jax_version = jax.__version__
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — provenance must never kill the line
+        jax_version = backend = None
+    return {
+        "git_sha": sha,
+        "jax_version": jax_version,
+        "backend": backend,
+        "platform": _platform.platform(),
+        "python": _platform.python_version(),
+        "cpu_count": os.cpu_count(),
+    }
+
+
 def _audited_onchip_note():
     """The last audited on-chip figure, read from the audit artifact at
     runtime so the fallback line can never go stale when the audit is
@@ -301,6 +333,7 @@ def main():
         "serve": serve,
         "feed": feed,
         "telemetry": telemetry,
+        "provenance": _provenance(),
     }))
 
 

@@ -281,6 +281,19 @@ class TrainConfig:
     # emit every Nth per-print_freq step record (1 = all; the data-wait/
     # compute split accumulates in counters regardless of sampling)
     telemetry_sample: int = 1
+    # span-trace export (obs/trace.py -> Chrome/Perfetto trace_event
+    # JSON): "" disables the export ("auto" still records into the
+    # in-memory ring whenever the sink is on), "auto" writes
+    # <checkpoint_dir>/trace.json, anything else is the path itself
+    # (tools/trace_report.py converts + summarizes)
+    telemetry_trace: str = ""
+    # run-health sentinel policy on a divergent window (non-finite loss
+    # or grad norm): "warn" records and keeps training, "halt" raises
+    # obs.DivergenceError out of the loop, "skip_step" drops the update
+    # INSIDE the jitted step (extends the abnormal_loss_thre select)
+    on_divergence: str = "warn"
+    # grad-norm ceiling for the sentinel; 0 = finiteness checks only
+    health_grad_norm_limit: float = 0.0
 
 
 @dataclass(frozen=True)

@@ -152,9 +152,13 @@ def _ring_worker(worker_id: int, shm_name: str, slots: int, shapes, dtypes,
 
     Renders each task's samples directly into the slot's shared-memory
     rows under the slot seqlock; only ``("ok"|"err", generation, seq,
-    (slot, worker_id, render_seconds)-or-(slot, traceback))`` tokens
-    travel back — the render time rides along so the consumer can
-    export per-worker render histograms without a second IPC channel.
+    (slot, worker_id, render_seconds, render_start_monotonic)-or-
+    (slot, traceback))`` tokens travel back — the render time AND its
+    absolute ``time.monotonic()`` start stamp ride along so the consumer
+    can export per-worker render histograms and place each render as a
+    span on the run's trace timeline (CLOCK_MONOTONIC is system-wide, so
+    a worker-process stamp lands correctly among consumer-side spans)
+    without a second IPC channel.
     """
     try:
         try:
@@ -202,7 +206,9 @@ def _worker_loop(worker_id: int, shm, slots: int, shapes, dtypes,
                 return
             gen, seq, epoch, batch_idx, slot, idxs = task
             try:
-                t_render = time.perf_counter()
+                # monotonic, not perf_counter: the stamp crosses the
+                # process boundary and must share the consumer's clock
+                t_render = time.monotonic()
                 header[slot, 0] += 1  # odd: write in progress
                 fields = views[slot]
                 for row, index in enumerate(idxs):
@@ -232,7 +238,7 @@ def _worker_loop(worker_id: int, shm, slots: int, shapes, dtypes,
                 header[slot, 0] += 1  # even: slot consistent
                 done_q.put(("ok", gen, seq,
                             (slot, worker_id,
-                             time.perf_counter() - t_render)))
+                             time.monotonic() - t_render, t_render)))
             except Exception:  # noqa: BLE001 — consumer re-raises
                 if header[slot, 0] % 2:
                     # restore seqlock parity: the slot is reclaimed after
@@ -497,11 +503,16 @@ class ShmRingInput:
         indices), yielding in task order (slot-count batches in flight)."""
         if self._closed:
             raise RuntimeError("ShmRingInput is closed")
+        # consumer-side import (workers import this module too and must
+        # stay lean); the process tracer is installed by RunTelemetry
+        from ..obs.trace import get_tracer
+
+        trace = get_tracer()
         self._gen += 1
         gen = self._gen
         pending = iter(task_iter)
         meta = {}       # seq -> (epoch, batch_idx) of submitted tasks
-        completed = {}  # seq -> (slot, worker_id, render_seconds)
+        completed = {}  # seq -> (slot, worker_id, render_s, t_start_mono)
         next_submit = 0
         next_yield = 0
         exhausted = False
@@ -526,9 +537,17 @@ class ShmRingInput:
                 while submit():
                     pass
                 while next_yield in completed:
-                    slot, wid, render_s = completed.pop(next_yield)
+                    slot, wid, render_s, t_start = completed.pop(next_yield)
                     epoch, batch_idx = meta.pop(next_yield)
                     self._check_header(slot, epoch, batch_idx)
+                    if trace.enabled:
+                        # the worker's absolute monotonic start stamp
+                        # places its render among consumer-side spans
+                        trace.add_span_abs(
+                            "render", t_start, render_s,
+                            track=f"ring-worker-{wid}",
+                            args={"slot": slot, "epoch": epoch,
+                                  "batch": batch_idx})
                     if self._tele is not None:
                         self._observe_render(wid, render_s)
                         self._batches_total.inc()
@@ -574,5 +593,5 @@ class ShmRingInput:
             # have no token left anywhere — with multiple workers batch
             # n+1 routinely finishes before batch n, so abandoning at the
             # yield for n would otherwise leak n+1's slot permanently
-            self._free.extend(slot for slot, _, _ in completed.values())
+            self._free.extend(slot for slot, *_ in completed.values())
             completed.clear()

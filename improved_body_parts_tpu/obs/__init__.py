@@ -9,14 +9,24 @@ package replaces that with one process-wide pipeline every layer shares:
   ``get_registry``, ``StepPhases`` data-wait/compute attribution);
 - :mod:`events`   — schema-versioned JSONL run-event sink
   (``EventSink``, ``read_events``, process-default ``set_sink``);
-- :mod:`http`     — background ``/metrics`` + ``/snapshot`` endpoint
-  (``MetricsServer``);
+- :mod:`trace`    — lock-cheap ring-buffered span recorder with
+  Chrome/Perfetto ``trace_event`` export (``TraceRecorder``,
+  process-default ``set_tracer``);
+- :mod:`http`     — background ``/metrics`` + ``/snapshot`` +
+  ``/healthz`` endpoint (``MetricsServer``);
 - :mod:`recompile` — post-warmup XLA recompile detection
   (``CompileWatch``);
+- :mod:`memory`   — per-device HBM gauges, run watermark and OOM
+  forensics (``DeviceMemory``);
+- :mod:`health`   — loss/grad-norm divergence sentinel with a
+  configurable ``warn|halt|skip_step`` policy (``HealthSentinel``,
+  ``DivergenceError``);
 - :mod:`run`      — the per-run bundle (``RunTelemetry``).
 
 ``tools/telemetry_report.py`` folds a run's JSONL stream into a
-human-readable summary with an input-bound vs compute-bound verdict.
+human-readable summary with an input-bound vs compute-bound verdict;
+``tools/trace_report.py`` turns its span trace into a
+``.perfetto.json`` plus a text critical-path summary.
 """
 from .events import (
     SCHEMA_VERSION,
@@ -26,9 +36,12 @@ from .events import (
     read_events,
     set_sink,
 )
+from .health import POLICIES, DivergenceError, HealthSentinel
 from .http import MetricsServer
+from .memory import DeviceMemory
 from .recompile import COMPILE_EVENT, CompileWatch
 from .registry import (
+    INPUT_BOUND_FRAC,
     Counter,
     Gauge,
     Histogram,
@@ -37,10 +50,19 @@ from .registry import (
     get_registry,
 )
 from .run import RunTelemetry, resolve_sink_path
+from .trace import (
+    NullTraceRecorder,
+    TraceRecorder,
+    get_tracer,
+    set_tracer,
+)
 
 __all__ = [
     "SCHEMA_VERSION", "EventSink", "NullSink", "get_sink", "read_events",
     "set_sink", "MetricsServer", "COMPILE_EVENT", "CompileWatch",
     "Counter", "Gauge", "Histogram", "Registry", "StepPhases",
     "get_registry", "RunTelemetry", "resolve_sink_path",
+    "POLICIES", "DivergenceError", "HealthSentinel", "DeviceMemory",
+    "NullTraceRecorder", "TraceRecorder", "get_tracer", "set_tracer",
+    "INPUT_BOUND_FRAC",
 ]

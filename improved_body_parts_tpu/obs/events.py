@@ -23,12 +23,28 @@ stdout whenever a run installed one.
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
 from typing import Dict, List, Optional
 
 SCHEMA_VERSION = 1
+
+
+def _definan(o):
+    """Map non-finite floats to their string names ('nan'/'inf'/'-inf'),
+    recursively.  ``json.dumps`` would emit bare ``NaN``/``Infinity``
+    tokens — not JSON — and the records most likely to carry them (a
+    diverged loss) are exactly the ones a strict consumer (jq, Go, JS)
+    must be able to parse."""
+    if isinstance(o, float) and not math.isfinite(o):
+        return repr(o)
+    if isinstance(o, dict):
+        return {k: _definan(v) for k, v in o.items()}
+    if isinstance(o, (list, tuple)):
+        return [_definan(v) for v in o]
+    return o
 
 
 def _jsonable(o):
@@ -53,6 +69,7 @@ class NullSink:
 
     enabled = False
     path: Optional[str] = None
+    t0: Optional[float] = None
 
     def emit(self, event: str, **fields) -> None:
         pass
@@ -79,7 +96,9 @@ class EventSink:
             os.makedirs(d, exist_ok=True)
         self._lock = threading.Lock()
         self._f = open(self.path, "a", buffering=1)  # line-buffered text
-        self._t0 = time.monotonic()
+        # public: the run's monotonic anchor — obs.trace.TraceRecorder
+        # shares it so span ts and event t are the same axis
+        self.t0 = time.monotonic()
         self._closed = False
         header = {"event": "run_start", "schema": SCHEMA_VERSION, "t": 0.0,
                   "time_unix": round(time.time(), 3), "pid": os.getpid()}
@@ -87,14 +106,20 @@ class EventSink:
         self._write(header)
 
     def _write(self, rec: dict) -> None:
-        line = json.dumps(rec, separators=(",", ":"), default=_jsonable)
+        try:
+            line = json.dumps(rec, separators=(",", ":"),
+                              default=_jsonable, allow_nan=False)
+        except ValueError:  # non-finite float somewhere in the record
+            line = json.dumps(_definan(rec), separators=(",", ":"),
+                              default=lambda o: _definan(_jsonable(o)),
+                              allow_nan=True)
         with self._lock:
             if not self._closed:
                 self._f.write(line + "\n")
 
     def emit(self, event: str, **fields) -> None:
         rec = {"event": event,
-               "t": round(time.monotonic() - self._t0, 6)}
+               "t": round(time.monotonic() - self.t0, 6)}
         rec.update(fields)
         self._write(rec)
 

@@ -1,11 +1,21 @@
-"""Background metrics endpoint: ``/metrics`` + ``/snapshot``.
+"""Background metrics endpoint: ``/metrics`` + ``/snapshot`` + ``/healthz``.
 
 A daemon-threaded ``ThreadingHTTPServer`` over one :class:`Registry`:
 
 - ``GET /metrics``  → Prometheus text exposition 0.0.4 (scrapeable by a
   stock Prometheus/victoria agent);
 - ``GET /snapshot`` → the registry's JSON snapshot, plus any
-  caller-supplied ``extra`` dict (e.g. the run's event-sink path).
+  caller-supplied ``extra`` dict (e.g. the run's event-sink path);
+- ``GET /healthz``  → the run-health state from the caller-supplied
+  ``health`` callable (``obs.health.HealthSentinel.state``): HTTP 200
+  with ``{"status": "ok", ...}`` while healthy, 503 once the latest
+  window diverged — the contract a stock load-balancer / liveness probe
+  expects.  Without a health source the route answers 200/"ok" (the
+  endpoint being up is the only health there is).
+
+``HEAD`` is answered for every route with the same status and headers
+and no body — LB probes default to HEAD, and an unanswered method must
+not read as an unhealthy backend.
 
 Port 0 binds an ephemeral port (read it back from ``.port`` / ``.url``);
 the listener binds loopback by default — operators who want it exposed
@@ -27,38 +37,59 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 class MetricsServer:
     def __init__(self, registry: Registry, port: int = 0,
                  host: str = "127.0.0.1",
-                 extra: Optional[Callable[[], dict]] = None):
+                 extra: Optional[Callable[[], dict]] = None,
+                 health: Optional[Callable[[], dict]] = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         reg = registry
         extra_fn = extra
+        health_fn = health
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 — http.server API
+            def _handle(self):
                 path = self.path.split("?", 1)[0]
                 try:
                     if path == "/metrics":
+                        code = 200
                         body = reg.prometheus().encode()
                         ctype = PROMETHEUS_CONTENT_TYPE
                     elif path == "/snapshot":
                         snap = {"metrics": reg.snapshot()}
                         if extra_fn is not None:
                             snap.update(extra_fn())
+                        code = 200
                         body = json.dumps(snap, indent=2,
                                           default=str).encode()
                         ctype = "application/json"
+                    elif path == "/healthz":
+                        state = (dict(health_fn()) if health_fn is not None
+                                 else {"status": "ok"})
+                        code = 200 if state.get("status", "ok") == "ok" \
+                            else 503
+                        body = json.dumps(state, indent=2,
+                                          default=str).encode()
+                        ctype = "application/json"
                     else:
-                        self.send_error(404, "use /metrics or /snapshot")
+                        # send_error handles HEAD itself (headers, no body)
+                        self.send_error(
+                            404, "use /metrics, /snapshot or /healthz")
                         return
                 except Exception as e:  # noqa: BLE001 — a scrape bug
                     # must 500, not kill the handler thread silently
                     self.send_error(500, type(e).__name__)
                     return
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
-                self.wfile.write(body)
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                self._handle()
+
+            def do_HEAD(self):  # noqa: N802 — LB probes default to HEAD
+                self._handle()
 
             def log_message(self, *args):  # scrapes are not stdout news
                 pass

@@ -1,0 +1,141 @@
+"""Per-device HBM accounting + OOM forensics.
+
+Large-batch TPU training dies on exactly one resource before any other:
+device memory — and XLA's OOM message names the allocation that tipped
+the scale, not the buffers that filled it.  :class:`DeviceMemory` makes
+the fill visible while the run is healthy and names the occupants when
+it is not:
+
+- **Live gauges** — ``device_bytes_in_use{device=N}`` /
+  ``device_peak_bytes{device=N}`` straight off
+  ``jax.Device.memory_stats()`` (allocator truth, scrape-time only), and
+  ``device_watermark_bytes{device=N}``: the highest ``bytes_in_use``
+  *sampled this run* — the number to compare against the device limit
+  when sizing a batch, distinct from the allocator's process-lifetime
+  peak.
+- **Event-stream samples** — the train loop calls :meth:`sample` at
+  step-window boundaries (the cadence every other window signal uses),
+  so the JSONL stream shows memory growth against loss/step-time on the
+  same ``t`` axis.
+- **OOM forensics** — :meth:`forensics` walks ``jax.live_arrays()`` and
+  groups live buffers by (shape, dtype): the train loop's exception path
+  emits the top occupants as a ``memory_forensics`` event, so a
+  RESOURCE_EXHAUSTED post-mortem starts from "what was resident", not
+  from re-running with a profiler attached.
+
+Backends without allocator stats (CPU: ``memory_stats()`` returns
+``None``) degrade gracefully: :meth:`sample` reports nothing, registers
+nothing, and costs one attribute call per device — the no-op contract
+that lets every call site run unconditionally.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .events import NullSink
+from .registry import Registry, get_registry
+
+
+class DeviceMemory:
+    """HBM accounting for every visible device through one registry."""
+
+    def __init__(self, registry: Optional[Registry] = None, sink=None):
+        self.registry = registry if registry is not None else get_registry()
+        self._sink = sink if sink is not None else NullSink()
+        self._watermark: Dict[str, int] = {}
+        # None until the first sample proves stats present/absent
+        self.supported: Optional[bool] = None
+
+    # ---------------------------------------------------------- sampling
+    def sample(self, emit: bool = False, **fields) -> Dict[str, dict]:
+        """Read every device's allocator stats; update gauges and the
+        per-run watermark; optionally emit a ``memory`` event carrying
+        the per-device numbers plus ``fields`` (epoch/step).  Returns
+        ``{device_id: {bytes_in_use, peak_bytes, watermark_bytes,
+        bytes_limit?}}`` — empty on statless backends (the graceful
+        no-op: nothing registered, nothing emitted)."""
+        try:
+            import jax
+
+            devices = jax.devices()
+        except Exception:  # noqa: BLE001 — no backend, no accounting
+            return {}
+        per_dev: Dict[str, dict] = {}
+        for d in devices:
+            try:
+                stats = d.memory_stats()
+            except Exception:  # noqa: BLE001 — backend without stats
+                stats = None
+            if not stats:
+                continue
+            dev = str(d.id)
+            in_use = int(stats.get("bytes_in_use", 0))
+            peak = int(stats.get("peak_bytes_in_use", 0))
+            mark = max(self._watermark.get(dev, 0), in_use)
+            self._watermark[dev] = mark
+            labels = {"device": dev}
+            self.registry.gauge(
+                "device_bytes_in_use", "allocator bytes currently live",
+                labels=labels).set(in_use)
+            self.registry.gauge(
+                "device_peak_bytes", "allocator lifetime peak bytes",
+                labels=labels).set(peak)
+            self.registry.gauge(
+                "device_watermark_bytes",
+                "highest bytes_in_use sampled this run",
+                labels=labels).set(mark)
+            rec = {"bytes_in_use": in_use, "peak_bytes": peak,
+                   "watermark_bytes": mark}
+            if "bytes_limit" in stats:
+                rec["bytes_limit"] = int(stats["bytes_limit"])
+            per_dev[dev] = rec
+        self.supported = bool(per_dev)
+        if emit and per_dev:
+            self._sink.emit("memory", devices=per_dev, **fields)
+        return per_dev
+
+    # --------------------------------------------------------- forensics
+    def forensics(self, top: int = 15) -> dict:
+        """Largest live device buffers grouped by (shape, dtype).
+
+        Works on every backend (``jax.live_arrays`` tracks the arrays
+        themselves, not allocator internals), so the CPU tests exercise
+        the exact code path an HBM OOM takes.
+        """
+        try:
+            import jax
+
+            arrays = jax.live_arrays()
+        except Exception:  # noqa: BLE001 — old jax / no backend
+            return {"live_arrays": 0, "live_bytes": 0, "largest": []}
+        groups: Dict[tuple, List[int]] = {}
+        total = 0
+        for a in arrays:
+            try:
+                nbytes = int(a.size) * a.dtype.itemsize
+                key = (tuple(a.shape), str(a.dtype))
+            except Exception:  # noqa: BLE001 — deleted mid-walk
+                continue
+            g = groups.setdefault(key, [0, 0])
+            g[0] += 1
+            g[1] += nbytes
+            total += nbytes
+        largest = sorted(groups.items(), key=lambda kv: -kv[1][1])[:top]
+        return {
+            "live_arrays": len(arrays),
+            "live_bytes": total,
+            "largest": [
+                {"shape": list(shape), "dtype": dtype, "count": count,
+                 "bytes": nbytes}
+                for (shape, dtype), (count, nbytes) in largest],
+        }
+
+    def emit_forensics(self, reason: str = "", **fields) -> dict:
+        """Emit the forensics report (plus current device stats) into
+        the event stream; the train loop's exception path calls this so
+        an OOM'd run's last record names the resident buffers."""
+        report = self.forensics()
+        report["devices"] = self.sample()
+        self._sink.emit("memory_forensics", reason=reason, **report,
+                        **fields)
+        return report

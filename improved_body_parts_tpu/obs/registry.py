@@ -291,6 +291,14 @@ class Registry:
         return out
 
 
+# the fraction of consumer wall time spent waiting on data above which a
+# run is INPUT-BOUND — the one verdict threshold shared by every
+# consumer of the StepPhases split (tools/telemetry_report.py,
+# tools/trace_report.py), so the two reports can never contradict each
+# other about the same run
+INPUT_BOUND_FRAC = 0.4
+
+
 class StepPhases:
     """Data-wait vs device-compute attribution for a consumer loop.
 
@@ -321,8 +329,15 @@ class StepPhases:
 
     def attribute(self, iterable: Iterable) -> Iterator:
         def gen():
+            from .trace import get_tracer
+
             it = iter(iterable)
             while True:
+                # the process tracer can be (re)installed mid-run; one
+                # global read per batch keeps the split and the timeline
+                # in lockstep without plumbing
+                trace = get_tracer()
+                tr0 = trace.now() if trace.enabled else 0.0
                 t0 = time.perf_counter()
                 try:
                     item = next(it)
@@ -332,10 +347,15 @@ class StepPhases:
                 t1 = time.perf_counter()
                 self.wait.inc(t1 - t0)
                 self.batches.inc()
+                if trace.enabled:
+                    tr1 = trace.now()
+                    trace.add_span_rel("data_wait", tr0, tr1 - tr0)
                 self._open_t = t1
                 yield item
                 self._open_t = None
                 self.hold.inc(time.perf_counter() - t1)
+                if trace.enabled:
+                    trace.add_span_rel("compute", tr1, trace.now() - tr1)
 
         return gen()
 

@@ -1,18 +1,25 @@
 """One run's telemetry bundle: registry + JSONL sink + compile watch +
-optional live metrics endpoint, assembled from three config knobs
+span trace + device-memory accounting + run-health sentinel + optional
+live metrics endpoint, assembled from the config knobs
 (``TrainConfig.telemetry_sink`` / ``telemetry_port`` /
-``telemetry_sample``) or directly by tools.
+``telemetry_sample`` / ``telemetry_trace`` / ``on_divergence``) or
+directly by tools.
 
 ::
 
     with RunTelemetry("events.jsonl", http_port=0,
+                      trace_path="trace.json",
                       run_meta={"tool": "train"}) as tele:
         fit(state, step, cfg, make_batches, epochs, telemetry=tele)
 
 Installing the bundle also installs its sink as the process default
 (``obs.events.set_sink``) so library helpers (``utils.profiling.timed``)
-report through the run's stream instead of stdout; ``close()`` restores
-the previous sink.
+report through the run's stream instead of stdout, and its span recorder
+as the process default tracer (``obs.trace.set_tracer``) so
+instrumentation sites the bundle is never plumbed to — the shm-ring
+consumer, the prefetch producer thread, the serving engine — land on the
+same timeline; ``close()`` restores both, saves the trace (when a path
+was configured) and emits a ``trace_export`` event pointing at it.
 """
 from __future__ import annotations
 
@@ -20,9 +27,12 @@ import os
 from typing import Dict, Optional
 
 from .events import EventSink, NullSink, set_sink
+from .health import HealthSentinel
 from .http import MetricsServer
+from .memory import DeviceMemory
 from .recompile import CompileWatch
 from .registry import Registry, StepPhases, get_registry
+from .trace import NullTraceRecorder, TraceRecorder, set_tracer
 
 
 class RunTelemetry:
@@ -32,7 +42,12 @@ class RunTelemetry:
                  run_meta: Optional[Dict] = None,
                  step_sample: int = 1,
                  watch_compiles: bool = True,
-                 install_default_sink: bool = True):
+                 install_default_sink: bool = True,
+                 trace_path: Optional[str] = None,
+                 trace: Optional[bool] = None,
+                 trace_capacity: int = 65536,
+                 on_divergence: str = "warn",
+                 grad_norm_limit: float = 0.0):
         self.registry = registry if registry is not None else get_registry()
         self.sink = (EventSink(sink_path, run_meta=run_meta)
                      if sink_path else NullSink())
@@ -44,15 +59,41 @@ class RunTelemetry:
         self.compile_watch = CompileWatch(self.registry, self.sink)
         if watch_compiles:
             self.compile_watch.install()
+        # span recorder: on when a trace path was configured or (by
+        # default) whenever the sink is — an in-memory ring is cheap and
+        # keeps the overhead A/B honest about what a real run pays;
+        # trace=False forces it off, trace=True forces it on
+        trace_on = (trace if trace is not None
+                    else bool(trace_path) or self.sink.enabled)
+        self.trace = (TraceRecorder(capacity=trace_capacity,
+                                    t0=self.sink.t0)
+                      if trace_on else NullTraceRecorder())
+        self.trace_path = trace_path
+        self._prev_tracer = None
+        self._installed_tracer = False
+        if self.trace.enabled:
+            self._prev_tracer = set_tracer(self.trace)
+            self._installed_tracer = True
+        # device-memory accounting (graceful no-op on statless backends)
+        self.memory = DeviceMemory(self.registry, self.sink)
+        # run-health sentinel; its state backs the endpoint's /healthz
+        self.health = HealthSentinel(self.registry, self.sink,
+                                     policy=on_divergence,
+                                     grad_norm_limit=grad_norm_limit)
         # emit every Nth per-print_freq step record (cheap runs keep 1;
         # multi-week runs can thin the stream without losing the split,
         # which accumulates in counters regardless)
         self.step_sample = max(1, int(step_sample))
         self.server = (MetricsServer(self.registry, port=http_port,
-                                     extra=lambda: {"events": self.sink.path})
+                                     extra=self._server_extra,
+                                     health=self.health.state)
                        if http_port is not None and http_port >= 0 else None)
         self._phases: Dict[str, StepPhases] = {}
         self._closed = False
+
+    def _server_extra(self) -> dict:
+        return {"events": self.sink.path, "trace": self.trace_path,
+                "health": self.health.state()}
 
     # ----------------------------------------------------------- accessors
     def phases(self, prefix: str = "train") -> StepPhases:
@@ -77,6 +118,15 @@ class RunTelemetry:
         if self.server is not None:
             self.server.close()
         self.compile_watch.uninstall()
+        if self.trace.enabled and self.trace_path:
+            # count via the ring's length — events() would serialize the
+            # whole ring a second time just to be len()'d
+            n = self.trace.recorded
+            path = self.trace.save(self.trace_path)
+            self.sink.emit("trace_export", path=path, events=n,
+                           dropped=self.trace.dropped)
+        if self._installed_tracer:
+            set_tracer(self._prev_tracer)
         if self._installed_sink:
             set_sink(self._prev_sink)
         self.sink.close()
@@ -88,12 +138,15 @@ class RunTelemetry:
         self.close()
 
 
-def resolve_sink_path(configured: str, checkpoint_dir: str) -> Optional[str]:
-    """Map a ``TrainConfig.telemetry_sink`` value to a concrete path:
-    ``""`` → disabled (None), ``"auto"`` → ``<checkpoint_dir>/events.jsonl``,
-    anything else is the path itself."""
+def resolve_sink_path(configured: str, checkpoint_dir: str,
+                      default_name: str = "events.jsonl"
+                      ) -> Optional[str]:
+    """Map a ``TrainConfig.telemetry_sink`` / ``telemetry_trace`` value
+    to a concrete path: ``""`` → disabled (None), ``"auto"`` →
+    ``<checkpoint_dir>/<default_name>``, anything else is the path
+    itself."""
     if not configured:
         return None
     if configured == "auto":
-        return os.path.join(checkpoint_dir, "events.jsonl")
+        return os.path.join(checkpoint_dir, default_name)
     return configured

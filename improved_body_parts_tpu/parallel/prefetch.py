@@ -17,6 +17,7 @@ import queue
 import threading
 from typing import Iterable, Iterator
 
+from ..obs.trace import get_tracer
 from .mesh import shard_batch
 
 
@@ -57,9 +58,14 @@ def _device_prefetch(batches: Iterable, mesh, depth: int,
     stop = threading.Event()
 
     def producer():
+        # spans land on this thread's own track ("device-prefetch"), so
+        # the timeline shows host->device placement riding under the
+        # consumer's compute spans — the overlap this thread exists for
+        trace = get_tracer()
         try:
             for batch in batches:
-                placed = shard_batch(batch, mesh, spatial_shard)
+                with trace.span("shard_batch"):
+                    placed = shard_batch(batch, mesh, spatial_shard)
                 while not stop.is_set():
                     try:
                         q.put(placed, timeout=0.1)

@@ -36,6 +36,7 @@ Design:
 """
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -46,11 +47,15 @@ import numpy as np
 
 from ..config import InferenceParams, SkeletonConfig
 from ..infer.pipeline import compact_decode_fn
+from ..obs.trace import get_tracer
 from .metrics import ServeMetrics
 from .warmup import precompile
 
 _STOP = object()
 _KICK = object()   # device went idle — wake the dispatcher to flush
+# process-wide request ids: the trace keys each request's async span and
+# submit->execute flow arrow on these (next() is atomic under the GIL)
+_RID = itertools.count(1)
 
 
 class ServerOverloaded(RuntimeError):
@@ -61,13 +66,14 @@ class ServerOverloaded(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("image", "future", "t_submit", "finished")
+    __slots__ = ("image", "future", "t_submit", "finished", "rid")
 
     def __init__(self, image: np.ndarray):
         self.image = image
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.finished = False  # server-side once-flag (see _finish)
+        self.rid = next(_RID)  # trace flow/async-span key
 
 
 class DynamicBatcher:
@@ -223,6 +229,15 @@ class DynamicBatcher:
                 f"{self.max_queue} requests in flight (max_queue); "
                 "retry with backoff")
         req = _Request(image_bgr)
+        trace = get_tracer()
+        if trace.enabled:
+            # one async span per request (enqueue -> fulfilment) plus a
+            # flow arrow from this submit to the batch that executes it:
+            # in Perfetto, batching fan-in is N arrows converging on one
+            # `execute` slice
+            trace.async_begin("request", req.rid, cat="serve",
+                             args={"shape": list(np.shape(image_bgr))})
+            trace.flow_start("serve_req", req.rid)
         self.metrics.on_submit()
         self._queue.put(req)
         if not self._running:
@@ -330,6 +345,11 @@ class DynamicBatcher:
             for r in reqs:
                 self._finish(r, error=e)
             return
+        trace = get_tracer()
+        if trace.enabled:
+            # dispatcher-track marker: when the bucket left coalescing
+            trace.instant("dispatch", args={"batch": len(reqs),
+                                            "replica": idx})
         self.metrics.on_dispatch(len(reqs))
         with self._in_flight_lock:
             self._in_flight[idx] += 1
@@ -348,6 +368,8 @@ class DynamicBatcher:
             if item is _STOP:
                 return
             reqs, resolve = item
+            trace = get_tracer()
+            t_exec = trace.now() if trace.enabled else 0.0
             try:
                 results = resolve()
             except Exception as e:  # noqa: BLE001 — delivered per request
@@ -355,6 +377,15 @@ class DynamicBatcher:
                 for r in reqs:
                     self._finish(r, error=e)
                 continue
+            if trace.enabled:
+                trace.add_span_rel("execute", t_exec,
+                                   trace.now() - t_exec,
+                                   args={"batch": len(reqs),
+                                         "replica": idx})
+                for r in reqs:
+                    # arrowheads bind to the execute slice (ts at its
+                    # start): each admitted request's flow ends here
+                    trace.flow_finish("serve_req", r.rid, ts=t_exec)
             self._batch_done(idx)
             for r, res in zip(reqs, results):
                 try:
@@ -374,7 +405,9 @@ class DynamicBatcher:
 
     def _decode_and_finish(self, req: _Request, res) -> None:
         try:
-            self._finish(req, result=self._decode_one(res, req.image))
+            with get_tracer().span("decode", args={"rid": req.rid}):
+                result = self._decode_one(res, req.image)
+            self._finish(req, result=result)
         except Exception as e:  # noqa: BLE001 — delivered per request
             self._finish(req, error=e)
 
@@ -389,6 +422,10 @@ class DynamicBatcher:
             if req.finished:
                 return
             req.finished = True
+        trace = get_tracer()
+        if trace.enabled:
+            trace.async_end("request", req.rid, cat="serve",
+                            args={"error": error is not None})
         try:
             if error is not None:
                 self.metrics.on_fail()

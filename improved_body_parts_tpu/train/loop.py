@@ -15,6 +15,7 @@ reference's throttled all-reduce + cuda.synchronize
 """
 from __future__ import annotations
 
+import math
 import os
 from typing import Callable, Iterable, Optional, Tuple
 
@@ -22,6 +23,7 @@ import jax
 import numpy as np
 
 from ..config import Config
+from ..obs.health import DivergenceError
 from ..parallel.prefetch import device_prefetch
 from ..utils import AverageMeter, StepTimer
 from . import checkpoint as ckpt
@@ -54,15 +56,26 @@ def train_epoch(state: TrainState, train_step: Callable,
     a structured ``train_step`` event — loss, step time, imgs/s, and the
     data-wait vs compute split measured inside ``device_prefetch`` — and
     marks the compile watch warm after the first window's readback (the
-    first sync that proves every steady-state program compiled).
+    first sync that proves every steady-state program compiled).  Each
+    window additionally records a ``step_window`` trace span (whose
+    data-wait/compute children come from ``StepPhases``), samples the
+    per-device HBM gauges into the stream, and feeds the run-health
+    sentinel: a step built with ``make_train_step(health=True)`` returns
+    (state, loss, grad_norm) and the extra scalar is read back HERE, at
+    the window sync that already happens — under the ``halt`` policy a
+    divergent window raises :class:`obs.DivergenceError`.  Any other
+    exception out of the loop triggers the OOM-forensics dump (largest
+    live device buffers by shape/dtype) into the event stream before
+    re-raising.
     """
     print_freq = print_freq or config.train.print_freq
     losses = AverageMeter()
     timer = StepTimer()
-    # (device loss, batch size) pairs not yet read back: the loss is left
-    # on device to avoid a per-step sync, but its weight must be recorded
-    # NOW — a trailing partial batch drained after the loop would otherwise
-    # be averaged at the last full batch's weight
+    # (device loss, batch size, device grad-norm-or-None) triples not yet
+    # read back: the scalars are left on device to avoid a per-step sync,
+    # but the weight must be recorded NOW — a trailing partial batch
+    # drained after the loop would otherwise be averaged at the last full
+    # batch's weight
     pending = []
 
     phases = telemetry.phases("train") if telemetry is not None else None
@@ -71,6 +84,7 @@ def train_epoch(state: TrainState, train_step: Callable,
                                   phase_stats=phases)
     elif phases is not None:
         batches = phases.attribute(batches)
+    trace = telemetry.trace if telemetry is not None else None
     if telemetry is not None:
         g_loss = telemetry.registry.gauge(
             "train_loss", "windowed loss readback (losses.val)")
@@ -80,66 +94,130 @@ def train_epoch(state: TrainState, train_step: Callable,
             "train_step_seconds", "per-step wall time (window mean)")
         window_t0 = phases.totals()
         windows = 0
+        w_trace_t0 = trace.now() if trace.enabled else 0.0
     global_batch = None
-    for step_idx, batch in enumerate(batches):
-        # batch is (images, mask_miss, labels) — or (images, mask_miss,
-        # joints, mask_all) when the step synthesizes GT on device
-        global_batch = batch[0].shape[0]
-        state, loss = train_step(state, *batch)
-        pending.append((loss, global_batch))
 
-        if (step_idx + 1) % print_freq == 0:
-            # one device sync per print_freq steps
-            vals = [(float(v), bs) for v, bs in pending]
-            pending.clear()
-            for v, bs in vals:
-                losses.update(v, bs)
-            dt = timer.mark(print_freq)
-            if telemetry is not None:
-                # the readback above blocked until the device drained:
-                # every steady-state program is compiled from here on
-                telemetry.mark_warm("first train window readback")
-                wait, hold = phases.totals()
-                d_wait = wait - window_t0[0]
-                d_hold = hold - window_t0[1]
-                window_t0 = (wait, hold)
-                imgs_s = global_batch / max(dt, 1e-9)
-                g_loss.set(losses.val)
-                g_ips.set(imgs_s)
-                h_step.observe(dt)
-                windows += 1
-                if windows % telemetry.step_sample == 0:
-                    telemetry.emit(
-                        "train_step", epoch=epoch, step=step_idx + 1,
-                        loss=round(losses.val, 6),
-                        loss_avg=round(losses.avg, 6),
-                        step_s=round(dt, 6),
-                        imgs_per_sec=round(imgs_s, 2),
-                        data_wait_s=round(d_wait, 6),
-                        compute_s=round(d_hold, 6))
-            if is_lead_host:
-                log_fn(
-                    f"==> Epoch [{epoch}][{step_idx + 1}] "
-                    f"loss {losses.val:.6f} ({losses.avg:.6f}) "
-                    f"imgs/s {global_batch / max(dt, 1e-9):.1f}")
+    def window_health(vals):
+        """Summarize one window for the sentinel: the first non-finite
+        loss (else the last), the first non-finite grad norm (else the
+        window max) — a single check per window, worst case wins."""
+        w_losses = [v for v, _, _ in vals]
+        loss_h = next((v for v in w_losses if not math.isfinite(v)),
+                      w_losses[-1])
+        gns = [float(g) for _, _, g in vals if g is not None]
+        if not gns:
+            return loss_h, None
+        return loss_h, next((g for g in gns if not math.isfinite(g)),
+                            max(gns))
 
-    n_tail = len(pending)
-    for v, bs in pending:
-        losses.update(float(v), bs)
-    if telemetry is not None and n_tail:
-        # trailing partial window (epochs shorter than print_freq would
-        # otherwise emit NOTHING — and never mark the compile watch warm)
-        telemetry.mark_warm("epoch-end readback")
-        dt = timer.mark(n_tail)
+    def close_window(vals, n_steps, step_no, dt, partial=False):
+        """Everything one readback window owes the telemetry bundle —
+        warm mark, split diff, gauges, trace span, stream record, health
+        check, memory sample — ONE implementation for the in-loop and
+        trailing-partial sites, so a new window signal cannot be added
+        to one and silently lost from the other."""
+        nonlocal window_t0, windows, w_trace_t0
+        # the readback that produced `vals` blocked until the device
+        # drained: every steady-state program is compiled from here on
+        telemetry.mark_warm("epoch-end readback" if partial
+                            else "first train window readback")
         wait, hold = phases.totals()
-        telemetry.emit(
-            "train_step", epoch=epoch, step=step_idx + 1,
-            loss=round(losses.val, 6), loss_avg=round(losses.avg, 6),
-            step_s=round(dt, 6),
-            imgs_per_sec=round(global_batch / max(dt, 1e-9), 2),
-            data_wait_s=round(wait - window_t0[0], 6),
-            compute_s=round(hold - window_t0[1], 6),
-            partial_window=n_tail)
+        d_wait = wait - window_t0[0]
+        d_hold = hold - window_t0[1]
+        window_t0 = (wait, hold)
+        imgs_s = global_batch / max(dt, 1e-9)
+        g_loss.set(losses.val)
+        g_ips.set(imgs_s)
+        h_step.observe(dt)
+        if trace.enabled:
+            # own track: a window closes mid-hold (at this readback), so
+            # on the consumer's track it would PARTIALLY overlap the
+            # boundary batch's compute span — invalid (non-nested)
+            # slices that trace viewers flag; a dedicated lane tiles
+            # cleanly above the phase spans instead
+            t_now = trace.now()
+            span_args = {"epoch": epoch, "step": step_no,
+                         "loss": round(losses.val, 6)}
+            if partial:
+                span_args["partial"] = n_steps
+            trace.add_span_rel("step_window", w_trace_t0,
+                               t_now - w_trace_t0, track="train-windows",
+                               args=span_args)
+            w_trace_t0 = t_now
+        windows += 1
+        # a trailing partial window always emits (an epoch shorter than
+        # print_freq would otherwise emit NOTHING); full windows honor
+        # the step_sample thinning
+        if partial or windows % telemetry.step_sample == 0:
+            fields = dict(
+                epoch=epoch, step=step_no,
+                loss=round(losses.val, 6), loss_avg=round(losses.avg, 6),
+                step_s=round(dt, 6), imgs_per_sec=round(imgs_s, 2),
+                data_wait_s=round(d_wait, 6), compute_s=round(d_hold, 6))
+            if partial:
+                fields["partial_window"] = n_steps
+            telemetry.emit("train_step", **fields)
+        loss_h, gn_h = window_health(vals)
+        # may raise DivergenceError (on_divergence=halt)
+        telemetry.health.check(loss_h, gn_h, step=step_no, epoch=epoch)
+        telemetry.memory.sample(emit=True, epoch=epoch, step=step_no)
+
+    try:
+        for step_idx, batch in enumerate(batches):
+            # batch is (images, mask_miss, labels) — or (images,
+            # mask_miss, joints, mask_all) when the step synthesizes GT
+            # on device
+            global_batch = batch[0].shape[0]
+            out = train_step(state, *batch)
+            if len(out) == 3:  # health-instrumented step
+                state, loss, gnorm = out
+            else:
+                (state, loss), gnorm = out, None
+            pending.append((loss, global_batch, gnorm))
+
+            if (step_idx + 1) % print_freq == 0:
+                # one device sync per print_freq steps
+                vals = [(float(v), bs, g) for v, bs, g in pending]
+                pending.clear()
+                for v, bs, _ in vals:
+                    losses.update(v, bs)
+                dt = timer.mark(print_freq)
+                if telemetry is not None:
+                    # may raise DivergenceError (on_divergence=halt)
+                    close_window(vals, print_freq, step_idx + 1, dt)
+                if is_lead_host:
+                    log_fn(
+                        f"==> Epoch [{epoch}][{step_idx + 1}] "
+                        f"loss {losses.val:.6f} ({losses.avg:.6f}) "
+                        f"imgs/s {global_batch / max(dt, 1e-9):.1f}")
+
+        n_tail = len(pending)
+        tail_vals = [(float(v), bs, g) for v, bs, g in pending]
+        pending.clear()
+        for v, bs, _ in tail_vals:
+            losses.update(v, bs)
+        if telemetry is not None and n_tail:
+            # trailing partial window (epochs shorter than print_freq
+            # would otherwise emit NOTHING — and never mark the compile
+            # watch warm)
+            close_window(tail_vals, n_tail, step_idx + 1,
+                         timer.mark(n_tail), partial=True)
+    except Exception as e:
+        if telemetry is not None and not isinstance(e, DivergenceError):
+            # the step loop died — name the resident device buffers
+            # before unwinding (an HBM OOM post-mortem's first question);
+            # a sentinel halt carries its own diagnosis and skips this.
+            # Best-effort: a failing emit (ENOSPC is CORRELATED with
+            # OOM-era runs) must not replace the original exception
+            try:
+                msg = str(e)
+                telemetry.memory.emit_forensics(
+                    reason=f"{type(e).__name__}: {msg[:300]}", epoch=epoch,
+                    oom=("RESOURCE_EXHAUSTED" in msg
+                         or "out of memory" in msg.lower()))
+            except Exception:  # noqa: BLE001 — diagnostics only
+                pass
+        raise
     return state, losses.avg
 
 

@@ -46,8 +46,20 @@ def make_train_step(model, config: Config,
                     use_focal: bool = True,
                     donate: bool = True,
                     freeze_bn: bool = False,
-                    device_gt: bool = False) -> Callable:
+                    device_gt: bool = False,
+                    health: bool = False) -> Callable:
     """Build the jitted (state, images, mask_miss, gt) -> (state, loss) step.
+
+    ``health=True`` additionally returns the global gradient norm —
+    (state, loss, grad_norm) — ONE extra scalar per step for the
+    run-health sentinel (``obs.health``), left on device and read back
+    only at the train loop's existing window readback, so divergence
+    detection adds no syncs.  Under
+    ``config.train.on_divergence == "skip_step"`` the abnormal-batch
+    select below additionally requires a finite grad norm (and one
+    within ``config.train.health_grad_norm_limit`` when set), so a
+    divergent update never reaches the parameters — the branchless
+    on-device extension of the reference's gradient-explosion rescue.
 
     ``freeze_bn=True`` runs BatchNorm on its running averages without
     updating them — the SWA fine-tuning mode (reference:
@@ -105,6 +117,17 @@ def make_train_step(model, config: Config,
         new_params = optax.apply_updates(state.params, updates)
 
         ok = jnp.isfinite(loss) & (loss <= config.train.abnormal_loss_thre)
+        # the skip_step gate keys off the CONFIG alone: the policy is a
+        # training-semantics promise and must hold for every caller of
+        # make_train_step, not just the ones that asked for the health
+        # return value — `health` controls only the extra output
+        if health or config.train.on_divergence == "skip_step":
+            gnorm = optax.global_norm(grads)
+            if config.train.on_divergence == "skip_step":
+                gok = jnp.isfinite(gnorm)
+                if config.train.health_grad_norm_limit > 0:
+                    gok &= gnorm <= config.train.health_grad_norm_limit
+                ok &= gok
 
         def keep(new, old):
             return jax.tree.map(lambda a, b: jnp.where(ok, a, b), new, old)
@@ -114,6 +137,8 @@ def make_train_step(model, config: Config,
             batch_stats=keep(new_bs, state.batch_stats),
             opt_state=keep(new_opt, state.opt_state),
             step=state.step + 1)
+        if health:
+            return state, loss, gnorm
         return state, loss
 
     return jax.jit(train_step, donate_argnums=(0,) if donate else ())

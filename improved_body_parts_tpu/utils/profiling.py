@@ -16,14 +16,30 @@ from .meters import AverageMeter
 
 @contextlib.contextmanager
 def profile_trace(log_dir: str) -> Iterator[None]:
-    """Capture a device trace viewable in TensorBoard / xprof."""
+    """Capture a device trace viewable in TensorBoard / xprof.
+
+    The capture window is reported into the process's telemetry event
+    stream (``trace_start`` / ``trace_stop`` records carrying the log
+    dir) whenever a run installed a sink — XLA profiler captures are
+    heavyweight and rare, and without the records they sit orphaned on
+    disk with nothing in the run's history saying when (or whether) one
+    was taken."""
+    import os
+
     import jax
 
+    from ..obs.events import get_sink
+
+    log_dir = os.path.abspath(log_dir)
+    t0 = time.perf_counter()
+    get_sink().emit("trace_start", log_dir=log_dir)
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+        get_sink().emit("trace_stop", log_dir=log_dir,
+                        duration_s=round(time.perf_counter() - t0, 6))
 
 
 @contextlib.contextmanager

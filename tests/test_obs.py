@@ -8,12 +8,24 @@ live metrics endpoint, the train-loop integration (structured step
 records whose split sums to the loop wall), the eval-epoch deferred
 readback, ``timed``'s sink routing, and the telemetry report's
 bottleneck verdicts.
+
+The second floor (this PR): the span trace — one fully instrumented
+dry-run (shm-ring workers + device_prefetch + health-checked train
+windows + dynamic-batcher serving) exporting a structurally valid
+Chrome/Perfetto ``trace_event`` timeline, the metric-name lint over
+everything that dry-run registered, ``tools/trace_report.py``, the
+``/healthz`` + HEAD endpoint contract and its error paths, the
+run-health sentinel's three divergence policies (including the
+skip_step gate inside a real jitted step), device-memory accounting's
+graceful CPU no-op and the train loop's OOM-forensics exception hook.
 """
 import json
+import math
 import os
 import re
 import subprocess
 import sys
+import threading
 import time
 import urllib.request
 
@@ -23,12 +35,16 @@ import pytest
 from improved_body_parts_tpu.obs import (
     SCHEMA_VERSION,
     CompileWatch,
+    DeviceMemory,
+    DivergenceError,
     EventSink,
+    HealthSentinel,
     MetricsServer,
     NullSink,
     Registry,
     RunTelemetry,
     StepPhases,
+    TraceRecorder,
     get_sink,
     read_events,
     set_sink,
@@ -528,3 +544,646 @@ class TestTelemetryReport:
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         assert proc.returncode != 0
         assert "schema" in proc.stderr
+
+
+class TestTraceRecorder:
+    def test_span_and_export_schema(self):
+        tr = TraceRecorder(capacity=64)
+        with tr.span("work", args={"k": 1}):
+            time.sleep(0.005)
+        tr.instant("mark", track="other")
+        tr.async_begin("req", 7, cat="serve")
+        tr.async_end("req", 7, cat="serve")
+        exp = tr.export()
+        evs = exp["traceEvents"]
+        x = next(e for e in evs if e["ph"] == "X")
+        assert x["name"] == "work" and x["dur"] >= 4000  # µs
+        assert x["args"] == {"k": 1}
+        b = next(e for e in evs if e["ph"] == "b")
+        assert b["id"] == 7 and b["cat"] == "serve"
+        # track metadata labels both threads' tracks
+        names = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert "other" in names
+        assert exp["otherData"]["dropped_events"] == 0
+
+    def test_ring_bounds_memory_and_counts_drops(self):
+        tr = TraceRecorder(capacity=10)
+        for i in range(25):
+            tr.add_span_rel("s", i * 1e-3, 1e-4)
+        assert len(tr.events()) == 10
+        assert tr.dropped == 15
+        assert tr.export()["otherData"]["dropped_events"] == 15
+
+    def test_abs_spans_share_the_monotonic_axis(self):
+        """A worker-process monotonic stamp must land at the same ts a
+        consumer-side span taken at that moment would."""
+        tr = TraceRecorder()
+        stamp = time.monotonic()          # "another process's" clock
+        rel = tr.now()
+        tr.add_span_abs("render", stamp, 0.001, track="w0")
+        ev = tr.events()[0]
+        assert ev["ts"] == pytest.approx(rel * 1e6, abs=5e3)  # within 5 ms
+
+    def test_parent_before_child_ordering(self):
+        tr = TraceRecorder()
+        tr.add_span_rel("child", 1.0, 0.2)
+        tr.add_span_rel("parent", 1.0, 1.0)   # same start, longer
+        names = [e["name"] for e in tr.events()]
+        assert names == ["parent", "child"]
+
+
+def _fake_predictor():
+    """Minimal batcher-compatible predictor: constant results, no jax —
+    isolates the serve-side trace/metrics plumbing from compiled compact
+    programs (test_serve.py owns those)."""
+    from improved_body_parts_tpu.config import default_inference_params, get_config
+
+    params, _ = default_inference_params()
+
+    class FakePredictor:
+        pass
+
+    FakePredictor.params = params
+    FakePredictor.skeleton = get_config("tiny").skeleton
+    FakePredictor.compact_lane_shape = lambda self, img, prm: (256, 256)
+    FakePredictor.predict_compact_async = \
+        lambda self, img, **kw: (lambda: "one")
+
+    def _batch(self, imgs, **kw):
+        n = len(imgs)
+        time.sleep(0.002)
+        return lambda: ["res"] * n
+
+    FakePredictor.predict_compact_batch_async = _batch
+    FakePredictor.device_replica = lambda self, d: self
+    return FakePredictor()
+
+
+@pytest.fixture(scope="module")
+def instrumented_run(tmp_path_factory):
+    """ONE fully instrumented dry-run shared by the trace/lint/report
+    tests (ring spawn + windows cost seconds; pay once): shm-ring worker
+    renders, device_prefetch placement, health-checked train windows and
+    dynamic-batcher serving, all recording into a single RunTelemetry
+    whose trace exports at close."""
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.data import CocoPoseDataset
+    from improved_body_parts_tpu.data.fixture import build_fixture
+    from improved_body_parts_tpu.data.shm_ring import ShmRingInput
+    from improved_body_parts_tpu.parallel import make_mesh
+    from improved_body_parts_tpu.serve import DynamicBatcher
+    from improved_body_parts_tpu.train.loop import train_epoch
+
+    tmp = tmp_path_factory.mktemp("obs_run")
+    ev_path = str(tmp / "events.jsonl")
+    trace_path = str(tmp / "trace.json")
+    cfg = get_config("tiny")
+    h5 = str(tmp / "fix.h5")
+    build_fixture(h5, num_images=16, people_per_image=1, seed=0)
+    ds = CocoPoseDataset(h5, cfg, augment=False, seed=0)
+    registry = Registry()
+    tele = RunTelemetry(ev_path, registry=registry, trace_path=trace_path,
+                        run_meta={"tool": "test"}, watch_compiles=False)
+
+    def step(state, *batch):
+        time.sleep(0.002)
+        # health-instrumented signature: (state, loss, grad_norm)
+        return state, np.float32(0.5), np.float32(1.25)
+
+    mesh = make_mesh()
+    with ShmRingInput(ds, batch_size=8, num_workers=1) as ring:
+        ring.attach_telemetry(registry)
+        train_epoch(None, step, ring.batches(0), cfg, 0, mesh=mesh,
+                    print_freq=1, telemetry=tele, log_fn=lambda s: None)
+
+    batcher = DynamicBatcher(_fake_predictor(), max_batch=4,
+                             max_wait_ms=5, registry=registry)
+    batcher._decode_one = lambda res, img: [res]  # skip real decode
+    img = np.zeros((64, 64, 3), np.uint8)
+    with batcher:
+        futs = [batcher.submit(img) for _ in range(5)]
+        for f in futs:
+            # "res" via the batch program, "one" via the singleton
+            # flush (an idle device flushes a lone request eagerly)
+            assert f.result(timeout=30) in (["res"], ["one"])
+    tele.memory.sample(emit=True)  # CPU: must be a graceful no-op
+    tele.close()
+    with open(trace_path) as f:
+        trace = json.load(f)
+    return {"registry": registry, "events": read_events(ev_path),
+            "trace": trace, "trace_path": trace_path}
+
+
+class TestTraceIntegration:
+    def test_perfetto_trace_event_schema(self, instrumented_run):
+        """The export is a structurally valid Chrome trace_event stream
+        (what Perfetto's JSON importer requires) containing the
+        worker-render, prefetch, step and serve-request spans."""
+        evs = instrumented_run["trace"]["traceEvents"]
+        assert evs
+        for ev in evs:
+            assert isinstance(ev["name"], str) and ev["name"]
+            assert ev["ph"] in {"M", "X", "i", "b", "e", "s", "f"}
+            assert isinstance(ev["pid"], int)
+            assert isinstance(ev["tid"], int)
+            if ev["ph"] == "M":
+                continue
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0
+            if ev["ph"] in ("b", "e", "s", "f"):
+                assert "id" in ev and ev["cat"]
+        names = {e["name"] for e in evs}
+        assert {"render", "shard_batch", "data_wait", "compute",
+                "step_window", "request", "execute", "decode"} <= names
+        # every admitted request's async span opened and closed
+        opens = [e for e in evs if e["ph"] == "b" and e["name"] == "request"]
+        closes = [e for e in evs if e["ph"] == "e" and e["name"] == "request"]
+        assert len(opens) == len(closes) == 5
+        assert {e["id"] for e in opens} == {e["id"] for e in closes}
+        # tracks are labeled: the worker process and prefetch thread
+        tracks = {e["args"]["name"] for e in evs
+                  if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert "device-prefetch" in tracks
+        assert any(t.startswith("ring-worker-") for t in tracks)
+
+    def test_step_windows_cover_their_phase_children(self,
+                                                     instrumented_run):
+        """step_window spans live on their own `train-windows` lane (on
+        the consumer's track they would partially overlap the boundary
+        batch's compute span — invalid non-nested slices) and each
+        data_wait/compute child STARTS inside some window; every window
+        contains phase work."""
+        evs = instrumented_run["trace"]["traceEvents"]
+        tracks = {e["args"]["name"]: e["tid"] for e in evs
+                  if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert "train-windows" in tracks
+        windows = [e for e in evs if e["name"] == "step_window"]
+        assert len(windows) >= 2
+        assert all(w["tid"] == tracks["train-windows"] for w in windows)
+        kids = [e for e in evs if e["name"] in ("data_wait", "compute")]
+        assert kids
+        assert len({k["tid"] for k in kids}) == 1  # one consumer track
+        last_end = max(w["ts"] + w["dur"] for w in windows)
+        for k in kids:
+            if k["ts"] >= last_end - 1:
+                continue  # the tail hold after the final window closed
+            assert any(w["ts"] - 1 <= k["ts"] <= w["ts"] + w["dur"] + 1
+                       for w in windows), (k["name"], k["ts"])
+        for w in windows:
+            assert any(w["ts"] - 1 <= k["ts"] <= w["ts"] + w["dur"] + 1
+                       for k in kids), ("empty window", w["ts"])
+
+    def test_slices_nest_strictly_per_track(self, instrumented_run):
+        """Perfetto flags partially-overlapping X slices on one track:
+        on every track, any two slices must be disjoint or nested."""
+        evs = instrumented_run["trace"]["traceEvents"]
+        eps = 10.0  # µs — stamp rounding slack
+        by_tid = {}
+        for e in evs:
+            if e["ph"] == "X":
+                by_tid.setdefault(e["tid"], []).append(e)
+        assert by_tid
+        for tid, slices in by_tid.items():
+            stack = []
+            for s in sorted(slices, key=lambda e: (e["ts"],
+                                                   -e.get("dur", 0.0))):
+                end = s["ts"] + s["dur"]
+                while stack and s["ts"] >= stack[-1] - eps:
+                    stack.pop()
+                if stack:  # open parent: must contain this slice
+                    assert end <= stack[-1] + eps, \
+                        (tid, s["name"], s["ts"], end, stack[-1])
+                stack.append(end)
+
+    def test_trace_export_event_links_the_stream(self, instrumented_run):
+        te = [e for e in instrumented_run["events"]
+              if e["event"] == "trace_export"]
+        assert len(te) == 1
+        assert te[0]["path"] == instrumented_run["trace_path"]
+        assert te[0]["events"] > 0 and te[0]["dropped"] == 0
+
+    def test_health_heartbeat_in_stream(self, instrumented_run):
+        hs = [e for e in instrumented_run["events"]
+              if e["event"] == "health"]
+        assert len(hs) >= 2  # one per readback window
+        assert all(h["status"] == "ok" for h in hs)
+        assert hs[0]["grad_norm"] == pytest.approx(1.25)
+
+    def test_trace_report_tool(self, instrumented_run, tmp_path):
+        out = str(tmp_path / "out.perfetto.json")
+        sj = str(tmp_path / "summary.json")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "trace_report.py"),
+             instrumented_run["trace_path"], "--out", out, "--json", sj],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "critical path" in proc.stdout
+        assert "verdict:" in proc.stdout
+        with open(sj) as f:
+            summary = json.load(f)
+        assert summary["step_windows"]["count"] >= 2
+        # the three-way verdict shared with telemetry_report
+        assert summary["verdict"] in ("input-bound",
+                                      "mixed (input pressure)",
+                                      "compute-bound")
+        assert summary["serve"]["requests"] == 5
+        assert summary["serve"]["unfinished"] == 0
+        assert "render" in summary["by_name"]
+        with open(out) as f:
+            pf = json.load(f)
+        assert pf["traceEvents"]
+        # normalized output still passes the tool's own validator
+        proc2 = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "trace_report.py"), out,
+             "--out", str(tmp_path / "round2.json")],
+            capture_output=True, text=True, timeout=120)
+        assert proc2.returncode == 0, proc2.stderr
+        assert "invalid" not in proc2.stderr
+
+    def test_trace_report_refuses_garbage(self, tmp_path):
+        p = str(tmp_path / "bad.json")
+        with open(p, "w") as f:
+            json.dump({"traceEvents": [{"nonsense": 1}, 7]}, f)
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "trace_report.py"), p],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode != 0
+        assert "0 structurally valid" in proc.stderr
+
+
+class TestMetricNameLint:
+    """The ISSUE's CI satellite: walk every name the fully instrumented
+    dry-run registered and enforce Prometheus naming rules, so a bad
+    name fails tier-1 instead of a production scrape."""
+
+    NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+    def test_every_registered_name_is_prometheus_legal(
+            self, instrumented_run):
+        registry = instrumented_run["registry"]
+        seen = 0
+        # _flat() is the exposition walk itself — lint what /metrics
+        # would actually serve, collectors included
+        for name, labels, kind, value, help in registry._flat():
+            seen += 1
+            assert self.NAME_RE.match(name), f"illegal metric name {name!r}"
+            for k in labels:
+                assert self.LABEL_RE.match(str(k)), \
+                    f"illegal label {k!r} on {name}"
+            if kind == "counter":
+                # _total per convention; a summary's _sum/_count
+                # components are counters too and keep their suffixes
+                assert name.endswith(("_total", "_sum", "_count")), \
+                    f"counter {name!r} lacks the _total suffix"
+        # the dry-run registered the whole stack: train loop, phases,
+        # ring, serve collector, health — a thin walk means the fixture
+        # lost instrumentation
+        assert seen > 25, f"only {seen} samples registered"
+
+    def test_counter_objects_strictly_end_with_total(
+            self, instrumented_run):
+        from improved_body_parts_tpu.obs.registry import Counter
+
+        counters = [m for m in
+                    instrumented_run["registry"]._metrics.values()
+                    if isinstance(m, Counter)]
+        assert counters
+        for c in counters:
+            assert c.name.endswith("_total"), c.name
+
+
+class TestHealthz:
+    def test_healthz_flips_with_the_sentinel(self):
+        r = Registry()
+        hs = HealthSentinel(r, policy="warn")
+        with MetricsServer(r, port=0, health=hs.state) as srv:
+            hs.check(1.0, 0.5, step=1)
+            resp = urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+            body = json.loads(resp.read())
+            assert resp.status == 200 and body["status"] == "ok"
+            assert body["checks"] == 1
+            hs.check(float("nan"), 0.5, step=2)  # forced NaN loss
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+            assert ei.value.code == 503
+            sick = json.loads(ei.value.read())
+            assert sick["status"] == "diverged"
+            assert sick["last"]["reasons"] == ["loss_not_finite"]
+            hs.check(1.0, 0.5, step=3)  # probe contract: recovers
+            resp = urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+            healed = json.loads(resp.read())
+            assert resp.status == 200
+            assert healed["ever_diverged"] is True
+
+    def test_healthz_without_sentinel_is_ok(self):
+        with MetricsServer(Registry(), port=0) as srv:
+            resp = urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+            assert resp.status == 200
+            assert json.loads(resp.read())["status"] == "ok"
+
+
+class TestHttpErrorPaths:
+    def test_unknown_route_is_404(self):
+        with MetricsServer(Registry(), port=0) as srv:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/nope", timeout=10)
+            assert ei.value.code == 404
+
+    def test_head_is_answered_with_get_headers_and_no_body(self):
+        r = Registry()
+        r.counter("hits_total").inc(3)
+        with MetricsServer(r, port=0, health=lambda: {"status": "ok"}) \
+                as srv:
+            for route in ("/metrics", "/snapshot", "/healthz"):
+                get = urllib.request.urlopen(srv.url + route, timeout=10)
+                get_body = get.read()
+                head = urllib.request.urlopen(
+                    urllib.request.Request(srv.url + route, method="HEAD"),
+                    timeout=10)
+                assert head.status == get.status == 200
+                assert head.read() == b""
+                assert int(head.headers["Content-Length"]) == len(get_body)
+            # an unknown route over HEAD must 404, not kill the handler
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    urllib.request.Request(srv.url + "/nope",
+                                           method="HEAD"), timeout=10)
+            assert ei.value.code == 404
+
+    def test_scrape_bug_returns_500_and_handler_survives(self):
+        class BrokenRegistry(Registry):
+            def prometheus(self):
+                raise RuntimeError("scrape bug")
+
+        r = BrokenRegistry()
+        r.counter("ok_total").inc()
+        with MetricsServer(r, port=0) as srv:
+            for _ in range(2):  # repeatable, not a one-shot dead thread
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(srv.url + "/metrics",
+                                           timeout=10)
+                assert ei.value.code == 500
+            # the server (and its snapshot path) still serves
+            snap = json.loads(urllib.request.urlopen(
+                srv.url + "/snapshot", timeout=10).read())
+            assert snap["metrics"]["ok_total"] == 1.0
+
+    def test_concurrent_scrape_during_registry_mutation(self):
+        r = Registry()
+        stop = threading.Event()
+        errors = []
+
+        def mutate(tag):
+            i = 0
+            try:
+                while not stop.is_set():
+                    r.counter(f"dyn_{tag}_{i % 40}_total").inc()
+                    r.gauge(f"g_{tag}_{i % 40}").set(i)
+                    r.histogram(f"h_{tag}_{i % 10}_seconds").observe(0.01)
+                    i += 1
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=mutate, args=(t,), daemon=True)
+                   for t in range(2)]
+        with MetricsServer(r, port=0) as srv:
+            for t in threads:
+                t.start()
+            try:
+                for _ in range(15):
+                    body = urllib.request.urlopen(
+                        srv.url + "/metrics", timeout=10).read().decode()
+                    for line in body.strip().splitlines():
+                        if not line.startswith("#"):
+                            assert _PROM_LINE.match(line), line
+                    json.loads(urllib.request.urlopen(
+                        srv.url + "/snapshot", timeout=10).read())
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=10)
+        assert not errors
+
+
+class TestHealthSentinelPolicies:
+    def test_warn_records_and_continues(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        sink = EventSink(p)
+        hs = HealthSentinel(Registry(), sink, policy="warn")
+        assert hs.check(1.0, 2.0, step=1)
+        assert not hs.check(float("nan"), 2.0, step=2)
+        assert not hs.check(1.0, float("inf"), step=3)
+        assert hs.check(0.5, 1.0, step=4)
+        sink.close()
+        st = hs.state()
+        assert st["status"] == "ok" and st["divergences"] == 2
+        hv = [e for e in read_events(p) if e["event"] == "health"]
+        assert [e["status"] for e in hv] == ["ok", "diverged",
+                                             "diverged", "ok"]
+        assert hv[2]["reasons"] == ["grad_norm_not_finite"]
+
+    def test_grad_norm_limit(self):
+        hs = HealthSentinel(Registry(), policy="warn", grad_norm_limit=10)
+        assert hs.check(1.0, 9.9)
+        assert not hs.check(1.0, 11.0)
+        assert hs.state()["last"]["reasons"] == ["grad_norm_over_limit"]
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError):
+            HealthSentinel(Registry(), policy="explode")
+
+    def test_halt_raises_out_of_the_train_loop(self, tmp_path):
+        from improved_body_parts_tpu.config import get_config
+        from improved_body_parts_tpu.train.loop import train_epoch
+
+        p = str(tmp_path / "ev.jsonl")
+        tele = RunTelemetry(p, registry=Registry(), watch_compiles=False,
+                            on_divergence="halt")
+        calls = [0]
+
+        def step(state, *batch):
+            calls[0] += 1
+            loss = float("nan") if calls[0] == 3 else 0.5
+            return state, np.float32(loss), np.float32(1.0)
+
+        def batches():
+            for _ in range(6):
+                yield (np.ones((2, 8, 8, 3), np.float32),)
+
+        with pytest.raises(DivergenceError, match="halt"):
+            train_epoch(None, step, batches(), get_config("tiny"), 0,
+                        print_freq=1, telemetry=tele,
+                        log_fn=lambda s: None)
+        tele.close()
+        evs = read_events(p)
+        hv = [e for e in evs if e["event"] == "health"]
+        assert [e["status"] for e in hv] == ["ok", "ok", "diverged"]
+        assert calls[0] == 3  # halted AT the divergent window
+        # a sentinel halt is a diagnosis, not an OOM — no forensics spam
+        assert not any(e["event"] == "memory_forensics" for e in evs)
+
+    def test_skip_step_gate_inside_the_jitted_step(self):
+        """The skip_step policy is enforced on device: with the window's
+        grad norm past the limit, the branchless select keeps the
+        previous parameters; the identical step under `warn` applies the
+        update.  (A NaN loss is already dropped by the abnormal-loss
+        select regardless of policy — the grad-norm limit is what
+        distinguishes the policies, so that is what the test drives.)"""
+        import dataclasses
+
+        import jax
+
+        from improved_body_parts_tpu.config import get_config
+        from improved_body_parts_tpu.models import build_model
+        from improved_body_parts_tpu.train import (
+            create_train_state, make_optimizer, make_train_step,
+            step_decay_schedule)
+
+        base = get_config("tiny")
+        # 64px keeps the two compiles cheap; any real batch's grad norm
+        # exceeds the absurd 1e-12 limit, so skip_step must hold params
+        cfg = base.replace(
+            skeleton=dataclasses.replace(base.skeleton, width=64,
+                                         height=64),
+            train=dataclasses.replace(base.train,
+                                      on_divergence="skip_step",
+                                      health_grad_norm_limit=1e-12))
+        model = build_model(cfg)
+        opt = make_optimizer(cfg, step_decay_schedule(cfg.train, 10))
+        rng = np.random.default_rng(0)
+        imgs = rng.uniform(0, 1, (1, 64, 64, 3)).astype(np.float32)
+        grid = 64 // cfg.skeleton.stride
+        labels = rng.uniform(
+            0, 1, (1, grid, grid, cfg.skeleton.num_layers)
+        ).astype(np.float32)
+        mask = np.ones((1, grid, grid, 1), np.float32)
+        state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
+                                   imgs)
+
+        def leaf(s):
+            return np.asarray(
+                jax.tree_util.tree_leaves(s.params)[0])
+
+        before = leaf(state)
+        step_skip = make_train_step(model, cfg, opt, health=True,
+                                    donate=False)
+        new_state, loss, gnorm = step_skip(state, imgs, mask, labels)
+        assert math.isfinite(float(loss)) and float(gnorm) > 1e-12
+        np.testing.assert_array_equal(leaf(new_state), before)
+
+        cfg_warn = cfg.replace(train=dataclasses.replace(
+            cfg.train, on_divergence="warn"))
+        step_warn = make_train_step(model, cfg_warn, opt, health=True,
+                                    donate=False)
+        new_state2, loss2, gnorm2 = step_warn(state, imgs, mask, labels)
+        assert float(loss2) == pytest.approx(float(loss))
+        assert float(gnorm2) == pytest.approx(float(gnorm), rel=1e-5)
+        assert np.abs(leaf(new_state2) - before).max() > 0
+
+        # the gate is a CONFIG promise, independent of the health output:
+        # a caller who never asked for the extra scalar (health=False,
+        # the default everywhere outside tools/train.py) still gets the
+        # policy enforced — and keeps the 2-tuple return contract
+        step_plain = make_train_step(model, cfg, opt, donate=False)
+        out = step_plain(state, imgs, mask, labels)
+        assert len(out) == 2
+        np.testing.assert_array_equal(leaf(out[0]), before)
+
+
+class TestDeviceMemory:
+    def test_cpu_sample_is_a_graceful_noop(self):
+        r = Registry()
+        dm = DeviceMemory(r)
+        assert dm.sample(emit=True) == {}  # no stats on the CPU backend
+        assert dm.supported is False
+        assert not any("device_bytes" in k for k in r.snapshot())
+
+    def test_forensics_groups_live_buffers_by_shape_dtype(self):
+        import jax.numpy as jnp
+
+        keep = [jnp.ones((17, 3), jnp.float32) for _ in range(3)]
+        rep = DeviceMemory(Registry()).forensics(top=50)
+        assert rep["live_arrays"] >= 3
+        mine = [g for g in rep["largest"]
+                if g["shape"] == [17, 3] and g["dtype"] == "float32"]
+        assert mine and mine[0]["count"] >= 3
+        assert mine[0]["bytes"] == mine[0]["count"] * 17 * 3 * 4
+        del keep
+
+    def test_train_loop_exception_emits_forensics(self, tmp_path):
+        from improved_body_parts_tpu.config import get_config
+        from improved_body_parts_tpu.train.loop import train_epoch
+
+        p = str(tmp_path / "ev.jsonl")
+        tele = RunTelemetry(p, registry=Registry(), watch_compiles=False)
+
+        def step(state, *batch):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory "
+                               "allocating 2.0GiB")
+
+        def batches():
+            yield (np.ones((2, 8, 8, 3), np.float32),)
+
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            train_epoch(None, step, batches(), get_config("tiny"), 1,
+                        print_freq=1, telemetry=tele,
+                        log_fn=lambda s: None)
+        tele.close()
+        fx = [e for e in read_events(p) if e["event"] == "memory_forensics"]
+        assert len(fx) == 1
+        assert fx[0]["oom"] is True and fx[0]["epoch"] == 1
+        assert "RuntimeError" in fx[0]["reason"]
+        assert isinstance(fx[0]["largest"], list)
+
+
+class TestProfileTraceEvents:
+    def test_capture_window_lands_in_the_sink(self, tmp_path, monkeypatch):
+        """profile_trace must leave trace_start/trace_stop records in
+        the run's stream so XLA captures are discoverable from it."""
+        import jax
+
+        from improved_body_parts_tpu.utils.profiling import profile_trace
+
+        started, stopped = [], []
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda d: started.append(d))
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            lambda: stopped.append(True))
+        p = str(tmp_path / "ev.jsonl")
+        sink = EventSink(p)
+        prev = set_sink(sink)
+        try:
+            with profile_trace(str(tmp_path / "xla")):
+                time.sleep(0.002)
+        finally:
+            set_sink(prev)
+            sink.close()
+        assert started and stopped
+        evs = read_events(p)
+        assert [e["event"] for e in evs[1:]] == ["trace_start",
+                                                 "trace_stop"]
+        assert evs[1]["log_dir"] == str(tmp_path / "xla")
+        assert evs[2]["log_dir"] == evs[1]["log_dir"]
+        assert evs[2]["duration_s"] >= 0.002
+
+
+class TestBenchProvenance:
+    def test_bench_line_carries_host_identity(self):
+        sys.path.insert(0, REPO)
+        import bench
+
+        prov = bench._provenance()
+        assert set(prov) >= {"git_sha", "jax_version", "backend",
+                             "platform", "cpu_count"}
+        assert isinstance(prov["cpu_count"], int) and prov["cpu_count"] >= 1
+        assert prov["platform"]
+        # inside the repo checkout the SHA must resolve
+        assert prov["git_sha"] and re.match(r"^[0-9a-f]{40}$",
+                                            prov["git_sha"])
+        assert json.dumps(prov)  # JSON-ready, always

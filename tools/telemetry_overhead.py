@@ -1,10 +1,12 @@
 #!/usr/bin/env python
-"""Telemetry overhead check: the sink must cost <2% of step time.
+"""Telemetry overhead check: the full stack must cost <2% of step time.
 
 Runs the REAL ``train_epoch`` loop (jitted step, ``device_prefetch``,
 throttled readback) over synthetic batches twice per round — telemetry
 OFF, then ON (JSONL sink + data-wait/compute attribution + compile
-watch + registry gauges) — in interleaved ABBA rounds (the arm order
+watch + registry gauges + span tracing + window memory sampling + the
+health-sentinel step variant's on-device grad-norm scalar) — in
+interleaved ABBA rounds (the arm order
 flips each round) so host-load drift hits both arms equally, with no
 systematic penalty for whichever arm runs second.
 
@@ -69,7 +71,8 @@ def main():
 
     from improved_body_parts_tpu.config import get_config
     from improved_body_parts_tpu.models import build_model
-    from improved_body_parts_tpu.obs import Registry, RunTelemetry, read_events
+    from improved_body_parts_tpu.obs import (
+        Registry, RunTelemetry, read_events, set_tracer)
     from improved_body_parts_tpu.parallel import make_mesh, replicated
     from improved_body_parts_tpu.train import (
         create_train_state, make_optimizer, make_train_step,
@@ -102,11 +105,20 @@ def main():
     state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
                                imgs[:1])
     state = jax.device_put(state, replicated(mesh))
-    step = make_train_step(model, cfg, opt)
+    # the ON arm runs the FULL instrumented stack — the health-sentinel
+    # step variant (one extra on-device scalar: the global grad norm),
+    # span tracing, window memory sampling — so the verdict prices what
+    # a real telemetry-on run pays, not just the sink
+    step_off = make_train_step(model, cfg, opt)
+    step_on = make_train_step(model, cfg, opt, health=True)
     quiet = lambda s: None  # noqa: E731 — stdout must stay one JSON line
 
-    # untimed compile pass (both arms reuse the same compiled program)
-    state, _ = train_epoch(state, step, batches(), cfg, 0, mesh=mesh,
+    # untimed compile pass for BOTH programs (each arm then reuses its
+    # compiled step; alternating donation across the two is fine — every
+    # call donates the current state and returns the next)
+    state, _ = train_epoch(state, step_off, batches(), cfg, 0, mesh=mesh,
+                           print_freq=args.print_freq, log_fn=quiet)
+    state, _ = train_epoch(state, step_on, batches(), cfg, 0, mesh=mesh,
                            print_freq=args.print_freq, log_fn=quiet)
 
     events_path = os.path.join(tempfile.mkdtemp(prefix="telemetry_oh_"),
@@ -121,10 +133,20 @@ def main():
         as one list, and the whole-epoch per-step time."""
         nonlocal state, on_wall
         ticks = []
+        step = step_on if telemetry is tele else step_off
+        # the bundle installs its tracer process-wide (that is the
+        # feature: unplumbed sites like the prefetch producer find it);
+        # the OFF arm must not record through it or the A/B loses part
+        # of the very cost it prices
+        prev_tracer = set_tracer(None) if telemetry is None else None
         t0 = time.perf_counter()
-        state, _ = train_epoch(state, step, batches(ticks), cfg, 1,
-                               mesh=mesh, print_freq=args.print_freq,
-                               log_fn=quiet, telemetry=telemetry)
+        try:
+            state, _ = train_epoch(state, step, batches(ticks), cfg, 1,
+                                   mesh=mesh, print_freq=args.print_freq,
+                                   log_fn=quiet, telemetry=telemetry)
+        finally:
+            if telemetry is None:
+                set_tracer(prev_tracer)
         t1 = time.perf_counter()
         ticks.append(t1)
         w = args.print_freq
@@ -161,6 +183,8 @@ def main():
         # not — double the evidence once before concluding
         retried = True
         overhead_pct, pairs = measure(rounds, rounds)
+    trace_spans = tele.trace.recorded
+    health_state = tele.health.state()
     tele.close()
 
     flat_off = [v for ws in off_w for v in ws]
@@ -202,6 +226,12 @@ def main():
         "telemetry_events": events_path,
         "events_parsed": len(events),
         "step_records": len(records),
+        # the ON arm runs the whole second-floor stack; prove it did
+        "trace_spans": trace_spans,
+        "health_checks": health_state["checks"],
+        "health_status": health_state["status"],
+        "memory_samples": sum(
+            1 for e in events if e.get("event") == "memory"),
         "split_covers_wall_frac": round(split_cover, 4),
         "recompiles_post_warmup": sum(
             1 for e in events if e.get("event") == "recompile"),

@@ -27,8 +27,10 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # above this fraction of attributed wall time spent waiting on data the
-# run is input-bound; below half of it, compute-bound; between, mixed
-INPUT_BOUND_FRAC = 0.4
+# run is input-bound; below half of it, compute-bound; between, mixed.
+# The threshold lives in obs.registry so tools/trace_report.py's verdict
+# over the same split can never drift from this one.
+from improved_body_parts_tpu.obs.registry import INPUT_BOUND_FRAC  # noqa: E402
 
 
 def _pct(xs, q):

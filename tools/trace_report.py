@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Fold a run's span trace into a ``.perfetto.json`` + critical-path text.
+
+Input is the Chrome ``trace_event`` JSON that ``obs.trace.TraceRecorder``
+exports (``RunTelemetry(trace_path=...)`` writes it at close; the
+``trace_export`` event in the run's JSONL stream points at it).  This
+tool:
+
+- validates the events structurally (every record needs name/ph/ts/
+  pid/tid; complete spans need a non-negative ``dur``) and refuses a
+  file with no valid events — a truncated or hand-damaged trace should
+  fail loudly here, not render as an empty mystery in the UI;
+- writes a normalized ``<input>.perfetto.json`` (events sorted
+  parent-before-child) that loads directly at https://ui.perfetto.dev
+  or ``chrome://tracing``;
+- prints a text critical-path summary so the common questions — where
+  did the wall time go, which phase dominated the step windows, what
+  did serving's fan-in look like — are answered without opening a UI:
+
+  - per-span-name aggregates (count, total/mean/max);
+  - the step-window account: data-wait vs compute totals and the same
+    >=40%-wait input-bound verdict ``tools/telemetry_report.py`` uses;
+  - serve requests: count, latency spread from the async begin/end
+    pairs, mean batch occupancy from the ``execute`` spans.
+
+    python tools/trace_report.py checkpoints/trace.json
+    python tools/trace_report.py trace.json --json summary.json
+"""
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# ONE threshold for both reporting tools (imported, not copied — see
+# obs.registry): the fraction of the attributed split spent waiting on
+# data above which the run is input-bound
+from improved_body_parts_tpu.obs.registry import INPUT_BOUND_FRAC  # noqa: E402
+
+_REQUIRED = ("name", "ph", "ts", "pid", "tid")
+
+
+def _load_events(path):
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        events = data.get("traceEvents")
+        other = data.get("otherData", {})
+    else:
+        events, other = data, {}
+    if not isinstance(events, list):
+        raise SystemExit(f"{path}: no traceEvents array — not a Chrome "
+                         "trace_event file")
+    valid, invalid = [], 0
+    for ev in events:
+        if not isinstance(ev, dict):
+            invalid += 1
+            continue
+        if ev.get("ph") == "M":  # metadata: no timestamp by spec
+            if "name" not in ev or "pid" not in ev:
+                invalid += 1
+                continue
+        elif any(k not in ev for k in _REQUIRED) or (
+                ev["ph"] == "X" and ev.get("dur", -1) < 0):
+            invalid += 1
+            continue
+        valid.append(ev)
+    if not valid:
+        raise SystemExit(f"{path}: 0 structurally valid trace events "
+                         f"({invalid} invalid) — refusing to report")
+    return valid, invalid, other
+
+
+def _verdict(wait_frac):
+    """EXACTLY tools/telemetry_report.py's three-way reading of the same
+    split — including the mixed band — so the two tools can never
+    disagree about one run."""
+    if wait_frac >= INPUT_BOUND_FRAC:
+        return "input-bound"
+    if wait_frac >= INPUT_BOUND_FRAC / 2:
+        return "mixed (input pressure)"
+    return "compute-bound"
+
+
+def _track_names(events):
+    return {ev.get("tid"): ev.get("args", {}).get("name", str(ev.get("tid")))
+            for ev in events
+            if ev["ph"] == "M" and ev["name"] == "thread_name"
+            and "tid" in ev}
+
+
+def summarize(events, other):
+    spans = [e for e in events if e["ph"] == "X"]
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s["dur"] / 1e3)  # ms
+    names = {
+        name: {"count": len(ds), "total_ms": round(sum(ds), 3),
+               "mean_ms": round(statistics.fmean(ds), 3),
+               "max_ms": round(max(ds), 3)}
+        for name, ds in sorted(by_name.items(),
+                               key=lambda kv: -sum(kv[1]))}
+
+    windows = by_name.get("step_window", [])
+    wait = sum(by_name.get("data_wait", []))
+    hold = sum(by_name.get("compute", []))
+    split = wait + hold
+    verdict = None
+    if split > 0:
+        verdict = _verdict(wait / split)
+
+    # serve lifecycle: latency from async begin/end pairs keyed by id,
+    # occupancy from the execute spans' batch args
+    opened, lat_ms = {}, []
+    for e in events:
+        if e.get("cat") == "serve" and e["name"] == "request":
+            if e["ph"] == "b":
+                opened[e.get("id")] = e["ts"]
+            elif e["ph"] == "e" and e.get("id") in opened:
+                lat_ms.append((e["ts"] - opened.pop(e["id"])) / 1e3)
+    batches = [e.get("args", {}).get("batch") for e in spans
+               if e["name"] == "execute"]
+    batches = [b for b in batches if b]
+    serve = None
+    if lat_ms or batches:
+        serve = {
+            "requests": len(lat_ms) + len(opened),
+            "unfinished": len(opened),
+            "latency_ms": ({"mean": round(statistics.fmean(lat_ms), 3),
+                            "max": round(max(lat_ms), 3)}
+                           if lat_ms else None),
+            "execute_batches": len(batches),
+            "mean_batch_occupancy": (round(statistics.fmean(batches), 3)
+                                     if batches else None),
+        }
+
+    return {
+        "events": len(events),
+        "spans": len(spans),
+        "dropped_events": int(other.get("dropped_events", 0)),
+        "tracks": sorted(_track_names(events).values()),
+        "by_name": names,
+        "step_windows": {
+            "count": len(windows),
+            "total_ms": round(sum(windows), 3),
+            "data_wait_ms": round(wait, 3),
+            "compute_ms": round(hold, 3),
+            "data_wait_frac": (round(wait / split, 4) if split else None),
+        },
+        "verdict": verdict,
+        "serve": serve,
+    }
+
+
+def render_text(summary):
+    lines = [f"trace: {summary['spans']} spans / {summary['events']} "
+             f"events on {len(summary['tracks'])} tracks"
+             + (f" ({summary['dropped_events']} dropped by the ring)"
+                if summary["dropped_events"] else "")]
+    sw = summary["step_windows"]
+    if sw["count"]:
+        lines.append(
+            f"step windows: {sw['count']}  data_wait "
+            f"{sw['data_wait_ms']:.1f} ms  compute "
+            f"{sw['compute_ms']:.1f} ms  wait_frac "
+            f"{sw['data_wait_frac']:.0%}" if sw["data_wait_frac"]
+            is not None else f"step windows: {sw['count']}")
+    if summary["verdict"]:
+        lines.append(f"verdict: {summary['verdict']}")
+    if summary["serve"]:
+        sv = summary["serve"]
+        lines.append(
+            f"serve: {sv['requests']} requests over "
+            f"{sv['execute_batches']} batches"
+            + (f", mean occupancy {sv['mean_batch_occupancy']}"
+               if sv["mean_batch_occupancy"] else "")
+            + (f", latency mean {sv['latency_ms']['mean']:.1f} ms "
+               f"max {sv['latency_ms']['max']:.1f} ms"
+               if sv["latency_ms"] else ""))
+    lines.append("critical path (total span time, desc):")
+    for name, st in list(summary["by_name"].items())[:10]:
+        lines.append(f"  {name:<14} {st['total_ms']:>10.1f} ms  "
+                     f"x{st['count']}  mean {st['mean_ms']:.2f}  "
+                     f"max {st['max_ms']:.2f}")
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="trace JSON written by RunTelemetry "
+                    "(see the run's trace_export event)")
+    ap.add_argument("--out", default=None,
+                    help="normalized Perfetto output path (default: "
+                         "<trace>.perfetto.json)")
+    ap.add_argument("--json", default=None,
+                    help="also write the summary dict to this path")
+    args = ap.parse_args()
+
+    events, invalid, other = _load_events(args.trace)
+    if invalid:
+        print(f"warning: dropped {invalid} structurally invalid events",
+              file=sys.stderr)
+    # parent-before-child: ts ascending, longer span first on ties
+    body = sorted((e for e in events if e["ph"] != "M"),
+                  key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+    meta = [e for e in events if e["ph"] == "M"]
+    # suffix-strip only a TRAILING .json: multi-process traces are named
+    # trace.json.pN (tools/train.py), and rsplit would collapse every
+    # process's default output onto the lead host's file
+    stem = args.trace[:-5] if args.trace.endswith(".json") else args.trace
+    out_path = args.out or stem + ".perfetto.json"
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": meta + body, "displayTimeUnit": "ms",
+                   "otherData": other}, f)
+
+    summary = summarize(events, other)
+    summary["perfetto"] = out_path
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=2)
+    print(render_text(summary))
+    print(f"perfetto export: {out_path} (open at https://ui.perfetto.dev)")
+
+
+if __name__ == "__main__":
+    main()

@@ -79,8 +79,22 @@ def main():
                          "tools/telemetry_report.py")
     ap.add_argument("--telemetry-port", type=int, default=None,
                     help="live /metrics (Prometheus) + /snapshot (JSON) "
-                         "endpoint port (default: the config's "
-                         "telemetry_port; 0 = ephemeral, -1 disables)")
+                         "+ /healthz endpoint port (default: the "
+                         "config's telemetry_port; 0 = ephemeral, "
+                         "-1 disables)")
+    ap.add_argument("--telemetry-trace", default=None,
+                    help="span-trace export path (default: the config's "
+                         "telemetry_trace; 'auto' = <checkpoint_dir>/"
+                         "trace.json, '' disables). Open the export at "
+                         "ui.perfetto.dev or fold it with "
+                         "tools/trace_report.py")
+    ap.add_argument("--on-divergence", default=None,
+                    choices=("warn", "halt", "skip_step"),
+                    help="run-health sentinel policy on a non-finite "
+                         "loss/grad-norm window (default: the config's "
+                         "on_divergence): warn = record and continue, "
+                         "halt = stop the run, skip_step = drop the "
+                         "update inside the jitted step")
     # multi-host (jax.distributed)
     ap.add_argument("--coordinator", default=None)
     ap.add_argument("--num-processes", type=int, default=1)
@@ -112,7 +126,8 @@ def main():
         # user believe they fine-tuned at that rate
         raise SystemExit("--lr does not apply to the SWA stage; use "
                          "--swa-lr-max/--swa-lr-min instead")
-    if args.checkpoint_dir or args.lr or args.print_freq:
+    if (args.checkpoint_dir or args.lr or args.print_freq
+            or args.on_divergence):
         import dataclasses
 
         overrides = {}
@@ -125,6 +140,11 @@ def main():
             # ignored --print-freq also silences the per-window telemetry
             # records on epochs shorter than the default window
             overrides["print_freq"] = args.print_freq
+        if args.on_divergence:
+            # folded into the config (not just the sentinel) because the
+            # skip_step policy is enforced INSIDE the jitted step, which
+            # reads config.train.on_divergence at trace time
+            overrides["on_divergence"] = args.on_divergence
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **overrides))
 
     from improved_body_parts_tpu.obs import RunTelemetry, resolve_sink_path
@@ -137,19 +157,36 @@ def main():
         # shared "auto" path would interleave run_start headers with
         # different t=0 baselines and garble the report
         sink_path += f".p{args.process_id}"
+    trace_cfg = (args.telemetry_trace if args.telemetry_trace is not None
+                 else cfg.train.telemetry_trace)
+    trace_path = resolve_sink_path(trace_cfg, cfg.train.checkpoint_dir,
+                                   default_name="trace.json")
+    if trace_path and args.process_id > 0:
+        trace_path += f".p{args.process_id}"  # one timeline per process
     tele_port = (args.telemetry_port if args.telemetry_port is not None
                  else cfg.train.telemetry_port)
+    # PROCESS-SYMMETRIC decision, taken from argv/config only (before
+    # any per-process override): the health-instrumented step compiles a
+    # DIFFERENT program, so every host of a multi-process run must make
+    # the same choice — and a non-warn divergence policy needs the
+    # sentinel running even when no sink/endpoint was configured, or
+    # `--on-divergence halt` would be accepted and silently unenforced
+    telemetry_wanted = bool(sink_path or trace_path or tele_port >= 0
+                            or cfg.train.on_divergence != "warn")
     if args.process_id > 0:
         # the endpoint is lead-host-only: a fixed --telemetry-port would
         # EADDRINUSE-crash every co-located non-lead process at startup
         tele_port = -1
     telemetry = None
-    if sink_path or tele_port >= 0:
+    if telemetry_wanted:
         telemetry = RunTelemetry(
             sink_path, http_port=(tele_port if tele_port >= 0 else None),
             run_meta={"tool": "train", "config": args.config,
                       "seed": args.seed, "process_id": args.process_id},
-            step_sample=cfg.train.telemetry_sample)
+            step_sample=cfg.train.telemetry_sample,
+            trace_path=trace_path,
+            on_divergence=cfg.train.on_divergence,
+            grad_norm_limit=cfg.train.health_grad_norm_limit)
         if telemetry.server is not None:
             print(f"telemetry: {telemetry.server.url}/metrics")
     if args.process_id == 0:
@@ -163,6 +200,8 @@ def main():
             json.dump({"tool": "train", "config": args.config,
                        "argv": sys.argv[1:],
                        "telemetry_events": sink_path,
+                       "telemetry_trace": trace_path,
+                       "on_divergence": cfg.train.on_divergence,
                        "telemetry_port": (telemetry.server.port
                                           if telemetry is not None
                                           and telemetry.server is not None
@@ -251,10 +290,15 @@ def main():
         print("--debug-overlays needs host-side labels; "
               "skipped under --device-gt")
     use_focal = not args.no_focal
+    # health scalar (global grad norm) exactly when the bundle runs —
+    # `telemetry_wanted` is process-symmetric, so all hosts compile the
+    # same step program; read back only at window readbacks
+    with_health = telemetry_wanted
     # SWA freezes BatchNorm (train_distributed_SWA.py:219-221)
     train_step = make_train_step(model, cfg, optimizer, use_focal=use_focal,
                                  freeze_bn=args.swa,
-                                 device_gt=args.device_gt > 0)
+                                 device_gt=args.device_gt > 0,
+                                 health=with_health)
     eval_step = make_eval_step(model, cfg, use_focal=use_focal)
     is_lead = args.process_id == 0
 
@@ -333,58 +377,66 @@ def main():
     # second alignment: resume/restore and step-function setup add more
     # per-host skew before the first step's collective execution
     barrier("pre_train_loop")
-    if not args.swa:
-        fit(state, train_step, cfg, make_train_batches, epochs,
-            start_epoch=start_epoch, mesh=mesh, eval_step=eval_step,
-            make_eval_batches=make_eval_batches, is_lead_host=is_lead,
-            best_loss=best_loss, telemetry=telemetry)
-        shutdown()
-        return
+    # try/finally, not sequential calls: a crash — and especially a
+    # sentinel halt (obs.DivergenceError) — must still close telemetry
+    # (the ONLY place the span trace is saved; losing trace.json on the
+    # very run that diverged would defeat the forensics), stop the ring
+    # workers, and keep the multi-host jax.distributed exit aligned
+    try:
+        if not args.swa:
+            fit(state, train_step, cfg, make_train_batches, epochs,
+                start_epoch=start_epoch, mesh=mesh, eval_step=eval_step,
+                make_eval_batches=make_eval_batches, is_lead_host=is_lead,
+                best_loss=best_loss, telemetry=telemetry)
+            return
 
-    # SWA fine-tune: average params every swa_freq epochs, swap averaged
-    # params in for the checkpoint (reference: train_distributed_SWA.py:403-435)
-    from improved_body_parts_tpu.train import checkpoint as ckpt
-    from improved_body_parts_tpu.train.loop import train_epoch
+        # SWA fine-tune: average params every swa_freq epochs, swap
+        # averaged params in for the checkpoint (reference:
+        # train_distributed_SWA.py:403-435)
+        from improved_body_parts_tpu.train import checkpoint as ckpt
+        from improved_body_parts_tpu.train.loop import _log_line, train_epoch
 
-    if resumed_swa:
-        # SWA checkpoints are saved swapped (params=averaged,
-        # swa_params=live SGD weights); swap back to continue training from
-        # the live weights while keeping the running average intact.
-        # (start_swa already ran above when entering SWA fresh.)
-        state = swap_swa_params(state)
-    from improved_body_parts_tpu.train.loop import _log_line
-    for epoch in range(start_epoch, start_epoch + epochs):
-        state, train_loss = train_epoch(
-            state, train_step, make_train_batches(epoch), cfg, epoch,
-            mesh=mesh, is_lead_host=is_lead, telemetry=telemetry)
-        if is_lead:
-            # same append-only epoch log fit() writes (reference logs its
-            # SWA epochs too, train_distributed_SWA.py) — without it the
-            # SWA stage leaves no loss provenance for the artifacts
-            _log_line(cfg.train.checkpoint_dir,
-                      f"\nEpoch {epoch}\ttrain_loss: {train_loss}")
-        if (epoch - start_epoch + 1) % args.swa_freq == 0:
+        if resumed_swa:
+            # SWA checkpoints are saved swapped (params=averaged,
+            # swa_params=live SGD weights); swap back to continue training
+            # from the live weights while keeping the running average
+            # intact.  (start_swa already ran above when entering SWA
+            # fresh.)
+            state = swap_swa_params(state)
+        for epoch in range(start_epoch, start_epoch + epochs):
+            state, train_loss = train_epoch(
+                state, train_step, make_train_batches(epoch), cfg, epoch,
+                mesh=mesh, is_lead_host=is_lead, telemetry=telemetry)
+            if is_lead:
+                # same append-only epoch log fit() writes (reference logs
+                # its SWA epochs too, train_distributed_SWA.py) — without
+                # it the SWA stage leaves no loss provenance for the
+                # artifacts
+                _log_line(cfg.train.checkpoint_dir,
+                          f"\nEpoch {epoch}\ttrain_loss: {train_loss}")
+            if (epoch - start_epoch + 1) % args.swa_freq == 0:
+                state = update_swa(state)
+                # collective save (orbax barriers across processes)
+                swapped = swap_swa_params(state)
+                ckpt.save_checkpoint(cfg.train.checkpoint_dir, swapped,
+                                     epoch, train_loss, train_loss)
+                if is_lead:
+                    print(f"epoch {epoch}: SWA checkpoint saved")
+        if epochs and epochs % args.swa_freq:
+            # trailing epochs past the last freq boundary: average and
+            # save them too, or they train but are never part of any
+            # checkpoint and the eval silently scores the older
+            # freq-boundary save (ADVICE.md round 5,
+            # tools/tpu_train_session.py stale-checkpoint guard)
             state = update_swa(state)
-            # collective save (orbax barriers across processes)
             swapped = swap_swa_params(state)
             ckpt.save_checkpoint(cfg.train.checkpoint_dir, swapped, epoch,
                                  train_loss, train_loss)
             if is_lead:
-                print(f"epoch {epoch}: SWA checkpoint saved")
-    if epochs and epochs % args.swa_freq:
-        # trailing epochs past the last freq boundary: average and save
-        # them too, or they train but are never part of any checkpoint
-        # and the eval silently scores the older freq-boundary save
-        # (ADVICE.md round 5, tools/tpu_train_session.py stale-checkpoint
-        # guard)
-        state = update_swa(state)
-        swapped = swap_swa_params(state)
-        ckpt.save_checkpoint(cfg.train.checkpoint_dir, swapped, epoch,
-                             train_loss, train_loss)
-        if is_lead:
-            print(f"epoch {epoch}: final SWA checkpoint saved "
-                  f"({epochs % args.swa_freq} trailing epochs)")
-    shutdown()
+                print(f"epoch {epoch}: final SWA checkpoint saved "
+                      f"({epochs % args.swa_freq} trailing epochs)")
+    finally:
+        shutdown()
 
 
 if __name__ == "__main__":
