@@ -258,6 +258,49 @@ def _telemetry_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _ckpt_summary(fallback, budget_s):
+    """Run tools/ckpt_bench.py (sync vs async epoch-boundary checkpoint
+    stall on a real multi-epoch fit, + bit-identity + write/eval overlap
+    from the span trace) and return a compact summary, or an
+    {"error"/"skipped"} marker — the "serve"/"feed"/"telemetry" key
+    contract.  Subprocess so a checkpoint failure can never take down
+    the primary metric; bounded by the REMAINING driver budget.
+    ``IBP_BENCH_CKPT=0`` skips it unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_CKPT") == "0":
+        return {"skipped": "IBP_BENCH_CKPT=0"}
+    if budget_s < 120:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (CKPT_BENCH.json has the full run)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="ckpt_bench_"),
+                       "CKPT_BENCH.json")
+    # tiny config either way: the stall is host-side (snapshot vs full
+    # Orbax write), so the verdict transfers; the committed
+    # CKPT_BENCH.json carries the full-protocol run
+    argv = ["--config", "tiny", "--rounds", "2", "--epochs", "2"]
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "ckpt_bench.py"),
+             "--out", out] + argv,
+            capture_output=True, timeout=min(600, budget_s), check=True,
+            env=dict(os.environ))
+        with open(out) as f:
+            r = json.load(f)
+        return {
+            "sync_stall_ms": r["sync_stall_ms_mean"],
+            "async_stall_ms": r["async_stall_ms_mean"],
+            "stall_reduction": r["stall_reduction"],
+            "meets_target": r["meets_target"],
+            "bit_identical_restore": r["bit_identical_restore"],
+            "write_overlaps_step_or_eval": r["write_overlaps_step_or_eval"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def main():
     import time
 
@@ -324,6 +367,9 @@ def main():
     # telemetry overhead (obs/ sink on vs off), same budget discipline
     telemetry = _telemetry_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # epoch-boundary checkpoint stall (sync vs async), same discipline
+    ckpt = _ckpt_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     print(json.dumps({
         # metric name carries the ACTUAL batch (the fallback runs batch 2)
         "metric": f"network_inference_fps_512x512_batch{batch}",
@@ -333,6 +379,7 @@ def main():
         "serve": serve,
         "feed": feed,
         "telemetry": telemetry,
+        "ckpt": ckpt,
         "provenance": _provenance(),
     }))
 

@@ -248,6 +248,29 @@ class TrainConfig:
     max_grad_norm: float = 0.0        # 0 disables (flag kept; ref has it disabled)
     print_freq: int = 10
     checkpoint_dir: str = "checkpoints"
+    # --- checkpointing cadence + async manager (train.checkpoint) ---
+    # save on epochs divisible by N (1 = every epoch); the FINAL epoch
+    # of a fit always saves regardless (same always-ship rule as the
+    # trailing SWA checkpoint).  Keyed on the ABSOLUTE epoch number —
+    # resume-stable and aligned with milestone_every below
+    save_freq: int = 1
+    # run the val pass on epochs divisible by N (1 = every epoch, final
+    # always); absolute-epoch-based like save_freq, so multi-process
+    # collectives stay aligned
+    eval_freq: int = 1
+    # snapshot-then-background-write checkpointing (CheckpointManager):
+    # the train loop blocks only on the device->host drain, the Orbax
+    # write/commit overlap eval + the next epoch.  False = the fully
+    # synchronous legacy path (the sync arm of tools/ckpt_bench.py)
+    async_checkpoint: bool = True
+    # retention GC over COMMITTED checkpoints: keep the last N epoch
+    # dirs (0 = keep everything, GC off) ...
+    keep_last_n: int = 0
+    # ... plus the best checkpoint by the recorded metric (val_loss when
+    # a val pass runs, else train loss) ...
+    keep_best: bool = True
+    # ... plus every epoch divisible by K (0 = no milestones)
+    milestone_every: int = 0
     hdf5_train_data: str = "data/dataset/coco_train_dataset512.h5"
     hdf5_val_data: str = "data/dataset/coco_val_dataset512.h5"
     # normalization convention: True = divide by global batch (distributed

@@ -1,4 +1,12 @@
-from .checkpoint import latest_checkpoint, restore_checkpoint, save_checkpoint
+from .checkpoint import (
+    CheckpointManager,
+    is_committed,
+    latest_checkpoint,
+    read_commit_meta,
+    restore_checkpoint,
+    restore_latest,
+    save_checkpoint,
+)
 from .loop import eval_epoch, fit, train_epoch
 from .schedule import cyclic_swa_schedule, step_decay_schedule
 from .state import (
@@ -12,7 +20,9 @@ from .state import (
 from .step import make_eval_step, make_train_step, normalize_images
 
 __all__ = [
-    "latest_checkpoint", "restore_checkpoint", "save_checkpoint",
+    "CheckpointManager", "is_committed", "latest_checkpoint",
+    "read_commit_meta", "restore_checkpoint", "restore_latest",
+    "save_checkpoint",
     "eval_epoch", "fit", "train_epoch",
     "cyclic_swa_schedule", "step_decay_schedule",
     "TrainState", "create_train_state", "make_optimizer", "start_swa",

@@ -1,14 +1,53 @@
-"""Orbax checkpointing.
+"""Orbax checkpointing: sync helpers + the async donation-safe manager.
 
 Replaces the reference's torch.save dict {weights, optimizer_weight,
 train_loss, epoch} and its resume-time 'module.' key remapping
 (reference: train.py:149-162, train_distributed.py:149-197, 304-324) — under
 functional params there is nothing to remap.
+
+The epoch boundary used to be the last fully serial host-side stall in
+the training path: ``save_checkpoint`` materialized the entire canonical
+state (129M params + SGD momentum + batch_stats + the SWA shadow ≈
+1.5 GB) and blocked the train loop on the whole Orbax write.
+:class:`CheckpointManager` splits the save the way Orbax's own
+``AsyncCheckpointer`` does:
+
+- **snapshot** (caller thread, the only blocked part): enqueue
+  ``copy_to_host_async`` on every device leaf FIRST — all D2H transfers
+  go in flight together — then drain them into host arrays.  This is
+  bandwidth-bound (~100 ms for the canonical state over PCIe), not
+  serialization-bound (seconds).  The drain must complete before
+  returning: the next epoch's first step DONATES the state buffers, and
+  a donated ``jax.Array`` raises on any later host read (verified on
+  jax 0.4.37 — ``copy_to_host_async`` does not cache the host value
+  past deletion), so "return as soon as transfers are enqueued" is only
+  safe once the enqueued transfers have landed in host memory.
+- **serialize + commit** (background writer thread): the Orbax write,
+  then an atomic ``COMMIT.json`` marker with the run metadata, then
+  retention GC.  A checkpoint without its marker is either in flight or
+  the debris of a killed run; ``restore_latest``/``latest_checkpoint``
+  skip it, and GC never deletes it.
+
+COLLECTIVE CONTRACT under multi-process JAX (unchanged from the sync
+path): orbax synchronizes all processes during save (and writes once,
+from the primary host) — every process must enter the save, not just
+rank 0, or the barrier never completes and the checkpoint is lost
+(observed on a 2-process Gloo run).  With the manager the barrier moves
+onto each process's writer thread; ``save()``'s wait-for-previous keeps
+the per-process save sequences aligned, and the save/skip decision in
+``loop.fit`` is epoch-number-based, i.e. process-symmetric.  Only the
+lead host writes commit markers and runs GC (the marker names a
+checkpoint on the shared filesystem; N processes writing it would race).
 """
 from __future__ import annotations
 
+import json
+import math
 import os
-from typing import Any, Dict, Optional
+import shutil
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -16,27 +55,57 @@ import orbax.checkpoint as ocp
 
 from .state import TrainState
 
+COMMIT_MARKER = "COMMIT.json"
+COMMIT_FORMAT = 1
 
-def _to_host(tree):
-    return jax.tree.map(np.asarray, tree)
+
+def snapshot_to_host(tree):
+    """Donation-safe host snapshot of a (possibly device-resident) pytree.
+
+    Phase 1 enqueues ``copy_to_host_async`` on every ``jax.Array`` leaf —
+    all transfers are in flight before any is waited on, so the blocked
+    time is the max single transfer, not the sum.  Phase 2 drains each
+    into a host ``np.ndarray`` the snapshot OWNS.  On the CPU backend
+    ``np.asarray`` returns a zero-copy view of the device buffer; a view
+    is NOT donation-safe, so those leaves are copied.  The external
+    reference a view holds *usually* blocks donation reuse, but for a
+    donated executable loaded from the persistent compilation cache
+    (jax 0.4.37, multi-device host platform — exactly the test harness)
+    the step writes its output in place THROUGH the still-referenced
+    buffer without even marking the array deleted, silently corrupting
+    every aliased leaf of an in-flight checkpoint.  One host memcpy per
+    save is the price of a snapshot that is immutable by construction on
+    every backend (accelerators already pay it: their ``np.asarray`` IS
+    the D2H copy and comes back owning its memory, so no second copy).
+    """
+    def start(x):
+        if isinstance(x, jax.Array):
+            try:
+                x.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — committed/deleted edge; the
+                pass           # drain below surfaces any real failure
+        return x
+
+    jax.tree.map(start, tree)
+
+    def drain(x):
+        arr = np.asarray(x)
+        if isinstance(x, jax.Array) and not arr.flags.owndata:
+            arr = arr.copy()  # zero-copy view of a donatable device buffer
+        return arr
+
+    return jax.tree.map(drain, tree)
 
 
-def save_checkpoint(directory: str, state: TrainState, epoch: int,
-                    train_loss: float, best_loss: float) -> str:
-    """Write checkpoint ``<directory>/epoch_<N>`` and return its path.
-
-    COLLECTIVE under multi-process JAX: orbax synchronizes all processes
-    during save (and writes once, from the primary host) — every process
-    must call this, not just rank 0, or the barrier never completes and
-    the checkpoint is lost (observed on a 2-process Gloo run)."""
-    path = os.path.abspath(os.path.join(directory, f"epoch_{epoch}"))
-    payload = {
-        "params": _to_host(state.params),
-        "batch_stats": _to_host(state.batch_stats),
-        "opt_state": _to_host(state.opt_state),
+def _payload(state: TrainState, epoch: int, train_loss: float,
+             best_loss: float) -> Dict[str, Any]:
+    """The checkpoint dict (device leaves still on device)."""
+    return {
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
         "step": int(state.step),
-        "swa_params": (_to_host(state.swa_params)
-                       if state.swa_params is not None else None),
+        "swa_params": state.swa_params,
         "swa_count": (int(state.swa_count)
                       if state.swa_count is not None else None),
         "swa_start_step": (int(state.swa_start_step)
@@ -45,8 +114,103 @@ def save_checkpoint(directory: str, state: TrainState, epoch: int,
         "train_loss": float(train_loss),
         "best_loss": float(best_loss),
     }
+
+
+def _tree_bytes(tree) -> int:
+    return int(sum(getattr(leaf, "nbytes", 0)
+                   for leaf in jax.tree.leaves(tree)))
+
+
+def _marker_meta(epoch: int, train_loss: float, best_loss: float,
+                 payload_bytes: int, **extra) -> Dict[str, Any]:
+    """The commit marker's base schema — ONE construction site for both
+    the sync and the async save paths, so the schema cannot drift."""
+    meta = {
+        "format": COMMIT_FORMAT, "epoch": epoch,
+        "train_loss": float(train_loss), "best_loss": float(best_loss),
+        "metric": "train_loss", "metric_value": float(train_loss),
+        "payload_bytes": int(payload_bytes),
+    }
+    meta.update(extra)
+    return meta
+
+
+def _write_marker(path: str, meta: Dict[str, Any]) -> None:
+    """Atomic commit: the marker appears complete or not at all (tmp +
+    ``os.replace`` — a crash mid-commit can never leave a torn marker
+    that parses as committed).  STRICT JSON like every obs record: a
+    non-finite loss (first-save best_loss=inf, a NaN-diverged run under
+    --on-divergence warn) becomes its string name, never a bare
+    ``NaN``/``Infinity`` token a strict consumer cannot parse."""
+    from ..obs.events import _definan
+
+    marker = os.path.join(path, COMMIT_MARKER)
+    tmp = marker + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(_definan(meta), f, indent=2, allow_nan=False)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, marker)
+
+
+def is_committed(path: str) -> bool:
+    """True when ``path`` carries a commit marker (written strictly after
+    the Orbax write finished)."""
+    return os.path.isfile(os.path.join(path, COMMIT_MARKER))
+
+
+def _inflight_stamp(directory: str, epoch: int) -> str:
+    """Sidecar path marking ``epoch_<N>`` as being written by the commit
+    protocol.  Written (lead host) BEFORE the Orbax write starts, removed
+    strictly AFTER the commit marker lands.  A sidecar, not a file inside
+    the entry, because ``force=True`` recreates the entry directory.
+
+    Why it exists: in a directory with no markers at all (a pre-protocol
+    legacy workdir) ``latest_checkpoint`` accepts unmarked entries so old
+    runs keep resuming — but the FIRST new-protocol save into such a
+    directory, killed mid-write, would then be accepted too.  The stamp
+    survives the kill and keeps exactly that partial entry out of the
+    legacy fallback."""
+    return os.path.join(directory, f".inflight_epoch_{epoch}")
+
+
+def read_commit_meta(path: str) -> Optional[Dict[str, Any]]:
+    """The commit marker's metadata, or None (uncommitted / pre-marker
+    legacy checkpoint / torn marker)."""
+    try:
+        with open(os.path.join(path, COMMIT_MARKER)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def save_checkpoint(directory: str, state: TrainState, epoch: int,
+                    train_loss: float, best_loss: float) -> str:
+    """Synchronous save of ``<directory>/epoch_<N>`` (snapshot + Orbax
+    write + commit marker in the caller thread); returns the path.
+
+    COLLECTIVE under multi-process JAX — see the module docstring.  The
+    async path is :class:`CheckpointManager`; this stays as the simple
+    API (tools/synth_ap.py's fresh-baseline checkpoints, tests, and the
+    sync arm of tools/ckpt_bench.py).
+    """
+    path = os.path.abspath(os.path.join(directory, f"epoch_{epoch}"))
+    host = snapshot_to_host(_payload(state, epoch, train_loss, best_loss))
+    lead = jax.process_index() == 0
+    stamp = _inflight_stamp(os.path.dirname(path), epoch)
+    if lead:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        open(stamp, "w").close()
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, payload, force=True)
+    ckptr.save(path, host, force=True)
+    if lead:
+        _write_marker(path, _marker_meta(
+            epoch, train_loss, best_loss, _tree_bytes(host),
+            time_unix=round(time.time(), 3)))
+        try:
+            os.remove(stamp)
+        except OSError:
+            pass
     return path
 
 
@@ -58,6 +222,11 @@ def restore_checkpoint(path: str, state: Optional[TrainState] = None
     Orbax serializes custom pytree nodes (optax namedtuple states) as plain
     containers; with a ``state`` template we re-impose the original structure
     on the restored leaves so ``optimizer.update`` keeps working.
+
+    ``meta`` prefers the commit marker's fields when present: the marker
+    is written (and possibly amended) AFTER validation ran, so its
+    ``best_loss``/``metric`` reflect the val-keyed best tracking, while
+    the payload's copy is the provisional value known at save kickoff.
     """
     ckptr = ocp.PyTreeCheckpointer()
     payload = ckptr.restore(os.path.abspath(path))
@@ -97,19 +266,408 @@ def restore_checkpoint(path: str, state: Optional[TrainState] = None
                         else None),
     )
     meta = {k: payload[k] for k in ("epoch", "train_loss", "best_loss")}
+    marker = read_commit_meta(path)
+    if marker:
+        for k in ("best_loss", "metric", "metric_value"):
+            if k in marker:
+                meta[k] = marker[k]
     return restored, meta
 
 
-def latest_checkpoint(directory: str) -> Optional[str]:
-    if not os.path.isdir(directory):
-        return None
-    epochs = []
+def _epoch_dirs(directory: str):
+    """(epoch, abs path) for every ``epoch_<N>`` entry, unsorted."""
+    out = []
     for name in os.listdir(directory):
         if name.startswith("epoch_"):
             try:
-                epochs.append((int(name.split("_")[1]), name))
+                out.append((int(name.split("_")[1]),
+                            os.path.join(directory, name)))
             except ValueError:
                 continue
-    if not epochs:
+    return out
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    """Newest *restorable* checkpoint under ``directory``.
+
+    Restorable = committed (carries ``COMMIT.json``).  When NO entry in
+    the directory carries a marker the whole directory predates the
+    commit protocol (pre-marker runs, imported reference weights) and
+    every ``epoch_<N>`` entry is accepted — the old behavior, so
+    existing workdirs keep resuming — EXCEPT entries carrying an
+    in-flight stamp (a new-protocol save killed before its marker could
+    land; see :func:`_inflight_stamp`).  In a marked directory an
+    unmarked entry is exactly an in-flight or killed-mid-write save and
+    is skipped (``--resume auto`` lands on the last committed epoch with
+    no manual directory surgery).
+    """
+    if not os.path.isdir(directory):
         return None
-    return os.path.join(directory, max(epochs)[1])
+    entries = _epoch_dirs(directory)
+    if not entries:
+        return None
+    any_committed = any(is_committed(p) for _, p in entries)
+    candidates = ([(e, p) for e, p in entries if is_committed(p)]
+                  if any_committed else
+                  [(e, p) for e, p in entries
+                   if not os.path.exists(_inflight_stamp(directory, e))])
+    if not candidates:
+        return None
+    return max(candidates)[1]
+
+
+def restore_latest(directory: str, state: Optional[TrainState] = None):
+    """``restore_checkpoint(latest_checkpoint(directory))`` — the resume
+    entry point (``tools/train.py --resume auto``).  Returns None when
+    the directory holds no committed (or legacy) checkpoint."""
+    path = latest_checkpoint(directory)
+    if path is None:
+        return None
+    return restore_checkpoint(path, state)
+
+
+class CheckpointManager:
+    """Async, donation-safe, crash-safe per-epoch checkpointing.
+
+    ::
+
+        manager = CheckpointManager(ckpt_dir, keep_last_n=3)
+        for epoch ...:
+            state, train_loss = train_epoch(...)
+            manager.save(state, epoch, train_loss, best_loss)  # ~snapshot only
+            val_loss = eval_epoch(...)       # overlaps the in-flight write
+            manager.record_metric(epoch, "val_loss", val_loss, best_loss)
+        manager.close()                      # flush the pending write
+
+    ``save()`` blocks only on (a) the previous save's write — the
+    wait-barrier that keeps multi-process save sequences aligned and
+    bounds dirty state to one epoch — and (b) the device→host snapshot
+    drain (see :func:`snapshot_to_host`).  Serialization, the Orbax
+    write, the commit marker and retention GC run on a background writer
+    thread; a writer failure is re-raised from the next ``save()`` /
+    ``wait()`` so a broken disk cannot silently eat every checkpoint.
+
+    Retention: ``keep_last_n`` (0 keeps everything), plus the best
+    checkpoint by recorded metric when ``keep_best``, plus every epoch
+    divisible by ``milestone_every`` when set.  GC only ever deletes
+    COMMITTED checkpoints — an in-flight or killed-mid-write directory
+    is never touched (it is invisible to ``latest_checkpoint`` anyway).
+
+    The writer prefers Orbax's ``AsyncCheckpointer`` (its tensorstore
+    writes parallelize internally; ``wait_until_finished`` is called on
+    the same writer thread, so commit-marker ordering is unchanged) and
+    falls back to a plain ``PyTreeCheckpointer`` when unavailable.
+
+    Observability (all through the process defaults, so an installed
+    ``obs.RunTelemetry`` picks the manager up with zero plumbing):
+    ``snapshot``/``serialize``/``commit`` trace spans on their own
+    ``checkpoint`` track, ``checkpoint_seconds{phase=...}`` histograms,
+    ``checkpoint_bytes``/``checkpoints_retained`` gauges, and one
+    ``checkpoint`` sink event per commit.
+    """
+
+    def __init__(self, directory: str, *, async_save: bool = True,
+                 keep_last_n: int = 0, keep_best: bool = True,
+                 milestone_every: int = 0, is_lead_host: bool = True,
+                 registry=None, _commit_delay_s: float = 0.0):
+        self.directory = os.path.abspath(directory)
+        self.async_save = bool(async_save)
+        self.keep_last_n = int(keep_last_n)
+        self.keep_best = bool(keep_best)
+        self.milestone_every = int(milestone_every)
+        self.is_lead_host = bool(is_lead_host)
+        # metrics registry: the process-wide default unless a run plumbs
+        # its own (tests; the default is what /metrics exposes)
+        self._reg = registry
+        # fault-injection seam for the kill-during-write tests: sleep
+        # between the Orbax write and the commit marker, the window a
+        # real crash would leave a complete-but-uncommitted directory
+        self._commit_delay_s = float(_commit_delay_s)
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        # epoch -> (metric name, value) (keep-best input; the name
+        # matters — see _gc); rebuilt from existing commit markers so
+        # retention stays correct across a resume
+        self._metric: Dict[int, Tuple[str, float]] = {}
+        # epoch -> metadata recorded after the save was kicked off
+        # (val_loss lands mid-write); merged into the marker at commit,
+        # or amended into an already-written marker
+        self._pending_meta: Dict[int, Dict[str, Any]] = {}
+        self._committed: set = set()
+        # per-save train-loop blocked seconds (tools/ckpt_bench.py reads
+        # this — it IS the number the async split is meant to shrink)
+        self.blocked_seconds: list = []
+        os.makedirs(self.directory, exist_ok=True)
+        for epoch, path in _epoch_dirs(self.directory):
+            meta = read_commit_meta(path)
+            if meta is not None:
+                self._committed.add(epoch)
+                self._metric[epoch] = (
+                    str(meta.get("metric", "train_loss")),
+                    float(meta.get("metric_value",
+                                   meta.get("train_loss", 0.0))))
+        try:
+            if jax.process_count() > 1:
+                # multi-process: stay on the cross-process-validated
+                # PyTreeCheckpointer barrier path (the 2-process Gloo
+                # run in DIST_DRIVE.json); our writer thread still
+                # provides the overlap
+                raise RuntimeError("multi-process -> pytree writer")
+            self._writer = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+            self._writer_kind = "orbax_async"
+        except Exception:  # noqa: BLE001 — older orbax without the async
+            self._writer = None                    # machinery
+            self._writer_kind = "pytree_thread"
+
+    @classmethod
+    def from_config(cls, directory: str, train_cfg,
+                    is_lead_host: bool = True) -> "CheckpointManager":
+        """Build from ``TrainConfig`` knobs (``async_checkpoint``,
+        ``keep_last_n``, ``keep_best``, ``milestone_every``)."""
+        return cls(directory,
+                   async_save=getattr(train_cfg, "async_checkpoint", True),
+                   keep_last_n=getattr(train_cfg, "keep_last_n", 0),
+                   keep_best=getattr(train_cfg, "keep_best", True),
+                   milestone_every=getattr(train_cfg, "milestone_every", 0),
+                   is_lead_host=is_lead_host)
+
+    # ------------------------------------------------------------- save
+    def save(self, state: TrainState, epoch: int, train_loss: float,
+             best_loss: float) -> str:
+        """Kick off the save of ``epoch``; returns its (future) path.
+
+        Blocks on the previous save's write + the snapshot drain only
+        (async mode); the Orbax write and commit happen in background.
+        COLLECTIVE: every process must call this for the same epochs.
+        """
+        from ..obs.trace import get_tracer
+
+        t_start = time.perf_counter()
+        self.wait()  # barrier before the next save (re-raises writer errors)
+        wait_s = time.perf_counter() - t_start
+        tracer = get_tracer()
+        t0 = time.perf_counter()
+        with tracer.span("snapshot", track="checkpoint",
+                         args={"epoch": epoch}):
+            host = snapshot_to_host(
+                _payload(state, epoch, train_loss, best_loss))
+        snapshot_s = time.perf_counter() - t0
+        nbytes = _tree_bytes(host)
+        path = os.path.join(self.directory, f"epoch_{epoch}")
+        base_meta = _marker_meta(epoch, train_loss, best_loss, nbytes,
+                                 **{"async": self.async_save})
+        timings = {"wait_s": wait_s, "snapshot_s": snapshot_s}
+        if self.is_lead_host:
+            # in-flight stamp BEFORE the write starts: keeps a killed
+            # partial out of the legacy resume fallback (removed
+            # strictly after the commit marker lands)
+            open(_inflight_stamp(self.directory, epoch), "w").close()
+        if self.async_save:
+            self._thread = threading.Thread(
+                target=self._write_and_commit,
+                args=(path, host, epoch, base_meta, timings),
+                name="ckpt-writer", daemon=True)
+            self._thread.start()
+            blocked = time.perf_counter() - t_start
+        else:
+            self._write_and_commit(path, host, epoch, base_meta, timings)
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            blocked = time.perf_counter() - t_start
+        self.blocked_seconds.append(blocked)
+        self._observe("blocked", blocked)
+        self._observe("snapshot", snapshot_s)
+        self._registry().gauge(
+            "checkpoint_bytes",
+            "host-snapshot size of the last checkpoint payload").set(nbytes)
+        return path
+
+    def record_metric(self, epoch: int, name: str, value: float,
+                      best_loss: Optional[float] = None) -> None:
+        """Attach the post-eval metric to ``epoch``'s checkpoint.
+
+        Called AFTER validation finished — i.e. possibly while (or after)
+        the write commits, since eval overlaps the write.  The metadata
+        lands in the commit marker either way: merged at commit time if
+        the writer has not committed yet, or amended into the marker
+        atomically if it has.  Also feeds keep-best retention.
+        """
+        meta = {"metric": str(name), "metric_value": float(value)}
+        if best_loss is not None:
+            meta["best_loss"] = float(best_loss)
+        # the commit transition (merge pending -> write marker -> mark
+        # committed) happens atomically under the same lock in
+        # _write_and_commit, so exactly one of these branches fires and
+        # an amend can never read a marker that is still being written
+        with self._lock:
+            self._metric[epoch] = (str(name), float(value))
+            if epoch not in self._committed:
+                self._pending_meta.setdefault(epoch, {}).update(meta)
+            elif self.is_lead_host:
+                path = os.path.join(self.directory, f"epoch_{epoch}")
+                marker = read_commit_meta(path) or {}
+                marker.update(meta)
+                _write_marker(path, marker)
+
+    def wait(self) -> None:
+        """Join the in-flight write; re-raise its failure.  Call between
+        a save and anything that needs the checkpoint on disk, and at
+        fit exit (a sentinel halt must still flush the pending write)."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def close(self) -> None:
+        """Flush the in-flight write, then release the orbax async
+        writer's background machinery (it owns a commit thread that
+        outlives the manager otherwise).  Terminal — a save after close
+        would fall back to the plain pytree writer."""
+        self.wait()
+        writer, self._writer = self._writer, None
+        if writer is not None:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # an exception is already unwinding: flush, but don't let a
+        # writer failure mask it
+        if exc and exc[0] is not None:
+            try:
+                self.wait()
+            except Exception:  # noqa: BLE001
+                pass
+        else:
+            self.close()
+
+    # ------------------------------------------------------- background
+    def _write_and_commit(self, path: str, host_tree, epoch: int,
+                          base_meta: Dict[str, Any],
+                          timings: Dict[str, float]) -> None:
+        from ..obs.events import get_sink
+        from ..obs.trace import get_tracer
+
+        tracer = get_tracer()
+        try:
+            t0 = time.perf_counter()
+            with tracer.span("serialize", track="checkpoint",
+                             args={"epoch": epoch}):
+                if self._writer is not None:
+                    # orbax's async machinery parallelizes the tensorstore
+                    # writes; waiting HERE (the writer thread) keeps the
+                    # marker strictly after the write
+                    self._writer.save(path, host_tree, force=True)
+                    self._writer.wait_until_finished()
+                else:
+                    ocp.PyTreeCheckpointer().save(path, host_tree,
+                                                  force=True)
+            serialize_s = time.perf_counter() - t0
+            if self._commit_delay_s:
+                time.sleep(self._commit_delay_s)
+            t0 = time.perf_counter()
+            with tracer.span("commit", track="checkpoint",
+                             args={"epoch": epoch}):
+                with self._lock:
+                    # atomic commit transition (see record_metric): the
+                    # marker is on disk before the epoch reads as
+                    # committed, so a concurrent record_metric either
+                    # lands in the pending merge or amends a complete
+                    # marker — never a half-written one
+                    meta = dict(base_meta)
+                    meta.update(self._pending_meta.pop(epoch, {}))
+                    meta["time_unix"] = round(time.time(), 3)
+                    if self.is_lead_host:
+                        _write_marker(path, meta)
+                        try:
+                            os.remove(_inflight_stamp(self.directory,
+                                                      epoch))
+                        except OSError:
+                            pass
+                    self._committed.add(epoch)
+                    self._metric.setdefault(
+                        epoch, (str(meta["metric"]),
+                                float(meta["metric_value"])))
+                retained = self._gc()
+            commit_s = time.perf_counter() - t0
+            self._observe("serialize", serialize_s)
+            self._observe("commit", commit_s)
+            get_sink().emit(
+                "checkpoint", epoch=epoch, path=path,
+                bytes=base_meta["payload_bytes"],
+                wait_s=round(timings["wait_s"], 6),
+                snapshot_s=round(timings["snapshot_s"], 6),
+                serialize_s=round(serialize_s, 6),
+                commit_s=round(commit_s, 6),
+                retained=retained, writer=self._writer_kind,
+                async_save=self.async_save)
+        except BaseException as e:  # noqa: BLE001 — surfaced on the
+            self._error = e         # caller thread by wait()/next save()
+
+    # -------------------------------------------------------- retention
+    def _gc(self) -> int:
+        """Delete committed checkpoints outside the retention set; never
+        touches uncommitted (in-flight / killed partial) directories.
+        Returns the retained-committed count.  Lead host only."""
+        entries = _epoch_dirs(self.directory)
+        committed = {e: p for e, p in entries if is_committed(p)}
+        if not self.is_lead_host or self.keep_last_n <= 0:
+            n = len(committed)
+            self._retained_gauge().set(n)
+            return n
+        keep = set(sorted(committed)[-self.keep_last_n:])
+        if self.keep_best:
+            with self._lock:
+                scored = {e: nv for e, nv in self._metric.items()
+                          if e in committed}
+            # never rank val_loss-scored epochs against train_loss-scored
+            # ones (train loss is systematically lower — under
+            # eval_freq>1 a raw min() would crown a non-validated epoch
+            # and GC the checkpoint that actually generalizes): when ANY
+            # committed epoch carries a val score, best is best-by-val.
+            # Non-finite scores (a diverged epoch under --on-divergence
+            # warn) never compete — every NaN comparison is False, so a
+            # NaN would WIN min() and keep-best would protect exactly
+            # the diverged checkpoint
+            scored = {e: (n, v) for e, (n, v) in scored.items()
+                      if math.isfinite(v)}
+            val = {e: v for e, (n, v) in scored.items() if n == "val_loss"}
+            pool = val or {e: v for e, (n, v) in scored.items()}
+            if pool:
+                keep.add(min(pool, key=pool.get))
+        if self.milestone_every > 0:
+            keep.update(e for e in committed
+                        if e % self.milestone_every == 0)
+        for e, p in committed.items():
+            if e not in keep:
+                shutil.rmtree(p, ignore_errors=True)
+        self._retained_gauge().set(len(keep))
+        return len(keep)
+
+    # ------------------------------------------------------------- obs
+    def _registry(self):
+        if self._reg is not None:
+            return self._reg
+        from ..obs.registry import get_registry
+
+        return get_registry()
+
+    def _retained_gauge(self):
+        return self._registry().gauge(
+            "checkpoints_retained",
+            "committed checkpoints kept after retention GC")
+
+    def _observe(self, phase: str, seconds: float) -> None:
+        self._registry().histogram(
+            "checkpoint_seconds",
+            "checkpoint phase durations (blocked = train-loop stall)",
+            labels={"phase": phase}).observe(seconds)

@@ -259,39 +259,122 @@ def fit(state: TrainState, train_step: Callable, config: Config,
         checkpoint_dir: Optional[str] = None,
         log_fn: Callable[[str], None] = print,
         best_loss: float = float("inf"),
-        telemetry=None) -> TrainState:
-    """Multi-epoch driver with per-epoch rank-0 checkpoint + log
+        telemetry=None,
+        checkpoint_manager=None) -> TrainState:
+    """Multi-epoch driver with async per-epoch checkpoint + log
     (reference: train_distributed.py:300-324, 441-444).
 
     ``make_batches(epoch)`` returns that epoch's (shuffled) batch iterable —
     the epoch-seeded permutation replaces DistributedSampler.set_epoch
     (train_distributed.py:231-232).  Pass the restored checkpoint's
     ``best_loss`` on resume so the metadata keeps tracking the true best.
+
+    The epoch boundary is no longer serial: the checkpoint save is
+    *kicked off* (``CheckpointManager.save`` blocks only on the
+    device→host snapshot drain), then validation runs WHILE the Orbax
+    write commits in background; the manager's wait-barrier before the
+    next save (and at fit exit, crash or not) bounds in-flight state to
+    one epoch.  ``config.train.save_freq`` / ``eval_freq`` thin the
+    cadence; the FINAL epoch always saves (the same always-ship rule as
+    the trailing SWA checkpoint), and the save/eval decisions are
+    epoch-number-based, i.e. process-symmetric — the collective
+    save/eval entries stay aligned across a multi-process run.
+
+    ``best_loss`` is keyed on **val_loss whenever an eval pass ran**
+    (falling back to train loss) — keep-best retention then keeps the
+    checkpoint that actually generalizes — and the metric used is
+    recorded in the checkpoint's commit metadata
+    (``CheckpointManager.record_metric``; the marker is amended after
+    eval since the write it describes may already have committed).
+
+    Pass ``checkpoint_manager`` to share one manager across stages
+    (``tools/train.py`` owns it alongside SWA); otherwise fit builds one
+    from the config's ``async_checkpoint``/retention knobs and flushes
+    it on exit.
     """
+    from ..obs.trace import get_tracer
+
     checkpoint_dir = checkpoint_dir or config.train.checkpoint_dir
-    for epoch in range(start_epoch, start_epoch + epochs):
-        state, train_loss = train_epoch(
-            state, train_step, make_batches(epoch), config, epoch, mesh=mesh,
-            is_lead_host=is_lead_host, log_fn=log_fn, telemetry=telemetry)
-        if is_lead_host:
-            _log_line(checkpoint_dir,
-                      f"\nEpoch {epoch}\ttrain_loss: {train_loss}")
-        best_loss = min(best_loss, train_loss)
-        # collective: orbax barriers across processes and writes once from
-        # the primary host — every process participates (see
-        # checkpoint.save_checkpoint)
-        ckpt.save_checkpoint(checkpoint_dir, state, epoch, train_loss,
-                             best_loss)
-        val_loss = None
-        if eval_step is not None and make_eval_batches is not None:
-            val_loss = eval_epoch(state, eval_step, make_eval_batches(epoch),
-                                  mesh=mesh)
+    tr = config.train
+    owns_manager = checkpoint_manager is None
+    manager = checkpoint_manager
+    if manager is None:
+        manager = ckpt.CheckpointManager.from_config(
+            checkpoint_dir, tr, is_lead_host=is_lead_host)
+    save_freq = max(1, int(getattr(tr, "save_freq", 1) or 1))
+    eval_freq = max(1, int(getattr(tr, "eval_freq", 1) or 1))
+    last_epoch = start_epoch + epochs - 1
+    try:
+        for epoch in range(start_epoch, start_epoch + epochs):
+            state, train_loss = train_epoch(
+                state, train_step, make_batches(epoch), config, epoch,
+                mesh=mesh, is_lead_host=is_lead_host, log_fn=log_fn,
+                telemetry=telemetry)
             if is_lead_host:
-                _log_line(checkpoint_dir, f"\tval_loss: {val_loss}")
-                log_fn(f"Epoch {epoch} val_loss {val_loss:.6f}")
-        if telemetry is not None:
-            fields = {"epoch": epoch, "train_loss": round(train_loss, 6)}
-            if val_loss is not None:
-                fields["val_loss"] = round(val_loss, 6)
-            telemetry.emit("epoch", **fields)
+                _log_line(checkpoint_dir,
+                          f"\nEpoch {epoch}\ttrain_loss: {train_loss}")
+            # cadence keys on the ABSOLUTE epoch number: resume-stable
+            # (which epochs save does not depend on where the previous
+            # run was interrupted) and aligned with retention's
+            # milestone_every, which also keys on absolute epochs —
+            # nth-since-start would make --save-freq 5 --milestone-every
+            # 10 never save a milestone
+            do_save = epoch % save_freq == 0 or epoch == last_epoch
+            do_eval = (eval_step is not None
+                       and make_eval_batches is not None
+                       and (epoch % eval_freq == 0 or epoch == last_epoch))
+            if do_save:
+                # collective kickoff: orbax barriers across processes and
+                # writes once from the primary host — every process
+                # participates (see checkpoint.CheckpointManager); only
+                # the snapshot drain blocks here, the write overlaps the
+                # eval below (and epoch+1's steps)
+                manager.save(state, epoch, train_loss, best_loss)
+            val_loss = None
+            if do_eval:
+                with get_tracer().span("eval_epoch", track="eval",
+                                       args={"epoch": epoch}):
+                    val_loss = eval_epoch(state, eval_step,
+                                          make_eval_batches(epoch),
+                                          mesh=mesh)
+                if is_lead_host:
+                    _log_line(checkpoint_dir, f"\tval_loss: {val_loss}")
+                    log_fn(f"Epoch {epoch} val_loss {val_loss:.6f}")
+            # best is keyed on the validation loss whenever a val pass
+            # ran — train loss only as the fallback.  The watermark only
+            # folds in COMPARABLE values: with eval configured but
+            # thinned away this epoch (eval_freq>1), the epoch's train
+            # loss is systematically lower than any val loss and would
+            # contaminate the val-loss watermark permanently (it resumes
+            # through the checkpoint metadata)
+            has_eval = eval_step is not None and make_eval_batches is not None
+            metric_name, metric = (("val_loss", val_loss)
+                                   if val_loss is not None
+                                   else ("train_loss", train_loss))
+            if val_loss is not None or not has_eval:
+                best_loss = min(best_loss, metric)
+            if do_save:
+                manager.record_metric(epoch, metric_name, metric, best_loss)
+            if telemetry is not None:
+                fields = {"epoch": epoch, "train_loss": round(train_loss, 6)}
+                if val_loss is not None:
+                    fields["val_loss"] = round(val_loss, 6)
+                if do_save:
+                    fields["saved"] = True
+                telemetry.emit("epoch", **fields)
+    except BaseException:
+        # a sentinel halt (obs.DivergenceError) or any crash must still
+        # flush the in-flight write — the run that just died is exactly
+        # the one whose last checkpoint matters — without letting a
+        # writer failure mask the original exception
+        try:
+            manager.close() if owns_manager else manager.wait()
+        except Exception:  # noqa: BLE001 — diagnostics-only path
+            pass
+        raise
+    # fit-exit barrier: the trailing write lands (and its failure
+    # surfaces) before fit returns; a fit-owned manager also releases
+    # its orbax async writer (a caller-owned one stays open for the
+    # caller's next stage)
+    manager.close() if owns_manager else manager.wait()
     return state

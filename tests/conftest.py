@@ -40,6 +40,8 @@ _HEAVY_MODULES = frozenset({
     "test_learning.py",         # 82s: real overfit run
     "test_serve.py",            # compiles compact batch programs for
                                 # several (bucket x batch-size) combos
+    "test_checkpoint_async.py", # real donated train-step compile + a
+                                # SIGKILLed subprocess + many orbax writes
 })
 # Individually heavy tests inside otherwise-quick modules.
 _HEAVY_TESTS = frozenset({

@@ -1,0 +1,563 @@
+"""Async donation-safe checkpointing (``train.checkpoint.CheckpointManager``).
+
+Covers the PR-5 contracts: async-vs-sync bit-identity, donation-safety
+under a real donated jitted train step, kill-during-write crash
+recovery (the resume path ``tools/train.py --resume auto`` takes —
+``latest_checkpoint`` — lands on the last COMMITTED checkpoint with no
+manual directory surgery), retention GC keeping exactly
+{last-N, best, milestones}, optax-namedtuple + SWA structure
+reimposition through the async path, save_freq/eval_freq cadence and
+val-keyed best tracking in ``fit``.
+"""
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import get_config
+from improved_body_parts_tpu.train.checkpoint import (
+    CheckpointManager,
+    is_committed,
+    latest_checkpoint,
+    read_commit_meta,
+    restore_checkpoint,
+    restore_latest,
+    save_checkpoint,
+)
+from improved_body_parts_tpu.train.state import TrainState
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _dummy_state(v=1.0, step=0):
+    return TrainState(params={"w": jnp.full((16, 16), v),
+                              "b": {"k": jnp.arange(8.0) + v}},
+                      batch_stats={"m": jnp.zeros((4,)) + v},
+                      opt_state=(),
+                      step=jnp.asarray(step, jnp.int32))
+
+
+def _rich_state():
+    """A state with everything the canonical flagship checkpoints: real
+    optax chain state (namedtuples), batch stats and the SWA shadow."""
+    from improved_body_parts_tpu.train import (make_optimizer, start_swa,
+                                               step_decay_schedule)
+
+    cfg = get_config("tiny")
+    params = {"conv": {"kernel": jnp.linspace(-1, 1, 48).reshape(4, 4, 3),
+                       "bias": jnp.arange(3.0)},
+              "bn": {"scale": jnp.ones((3,))}}
+    opt = make_optimizer(cfg, step_decay_schedule(cfg.train, 4))
+    state = TrainState(params=params,
+                       batch_stats={"mean": jnp.full((3,), 0.25)},
+                       opt_state=opt.init(params),
+                       step=jnp.asarray(7, jnp.int32))
+    return start_swa(state), opt
+
+
+class TestBitIdentity:
+    def test_async_and_sync_saves_restore_identical(self, tmp_path):
+        state, _ = _rich_state()
+        sync_path = save_checkpoint(str(tmp_path / "sync"), state, 3,
+                                    train_loss=1.5, best_loss=1.2)
+        with CheckpointManager(str(tmp_path / "async")) as m:
+            async_path = m.save(state, 3, train_loss=1.5, best_loss=1.2)
+        a = restore_checkpoint(sync_path)
+        b = restore_checkpoint(async_path)
+        assert jax.tree.structure(a) == jax.tree.structure(b)
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            la, lb = np.asarray(la), np.asarray(lb)
+            assert la.dtype == lb.dtype
+            assert np.array_equal(la, lb)
+        assert is_committed(sync_path) and is_committed(async_path)
+
+
+class TestDonationSafety:
+    def test_snapshot_survives_next_epochs_donated_step(self, tmp_path):
+        """Epoch N's snapshot must be readable AFTER epoch N+1's first
+        step donated (and thereby deleted) the state buffers, while the
+        write is still in flight — the exact hazard the blocking
+        snapshot drain exists for."""
+        from improved_body_parts_tpu.models import PoseNet
+        from improved_body_parts_tpu.train import (create_train_state,
+                                                   make_optimizer,
+                                                   make_train_step,
+                                                   step_decay_schedule)
+
+        cfg = get_config("canonical")
+        cfg = cfg.replace(
+            model=cfg.model.__class__(nstack=2, inp_dim=16, increase=8,
+                                      hourglass_depth=2, se_reduction=4),
+            train=cfg.train.__class__(scale_weight=(0.5, 1.0, 2.0),
+                                      nstack_weight=(1.0, 1.0)))
+        model = PoseNet(nstack=2, inp_dim=16,
+                        oup_dim=cfg.skeleton.num_layers, increase=8,
+                        hourglass_depth=2, se_reduction=4,
+                        dtype=jnp.float32)
+        opt = make_optimizer(cfg, step_decay_schedule(cfg.train, 4))
+        state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
+                                   jnp.zeros((2, 32, 32, 3)))
+        expected = jax.tree.map(lambda x: np.asarray(x).copy(),
+                                state.params)
+
+        rng = np.random.default_rng(0)
+        images = np.asarray(rng.uniform(0, 1, (2, 32, 32, 3)), np.float32)
+        labels = np.asarray(
+            rng.uniform(0, 1, (2, 8, 8, cfg.skeleton.num_layers)),
+            np.float32)
+        mask = np.ones((2, 8, 8, 1), np.float32)
+        step = make_train_step(model, cfg, opt)  # donate=True (default)
+        # Warm the compiled step on a throwaway copy so the real call
+        # below EXECUTES inside the in-flight-write window instead of
+        # spending it tracing/compiling (which would quietly let the
+        # writer finish first and test nothing).
+        warm = jax.tree.map(
+            lambda x: jnp.array(x, copy=True) if isinstance(x, jax.Array)
+            else x, state)
+        step(warm, images, mask, labels)[1].block_until_ready()
+
+        # commit delay keeps the background write in flight across the
+        # donated step — the snapshot, not the device state, must feed it
+        mgr = CheckpointManager(str(tmp_path), _commit_delay_s=1.0)
+        mgr.save(state, 0, train_loss=2.0, best_loss=2.0)
+
+        new_state, loss = step(state, images, mask, labels)
+        assert np.isfinite(float(loss))
+        # the donation REALLY happened: the old buffers are gone (the
+        # snapshot owns its host memory, so nothing pins them — a
+        # zero-copy snapshot here gets silently overwritten in place by
+        # this very step when the executable comes from the persistent
+        # compilation cache, which is exactly what this test caught)
+        assert all(leaf.is_deleted()
+                   for leaf in jax.tree.leaves(state.params))
+
+        mgr.close()
+        payload = restore_checkpoint(os.path.join(str(tmp_path),
+                                                  "epoch_0"))
+        restored = payload["params"]
+        assert jax.tree.structure(restored) == jax.tree.structure(expected)
+        for got, want in zip(jax.tree.leaves(restored),
+                             jax.tree.leaves(expected)):
+            assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+_KILL_CHILD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax.numpy as jnp
+from improved_body_parts_tpu.train.checkpoint import CheckpointManager
+from improved_body_parts_tpu.train.state import TrainState
+
+def st(v):
+    return TrainState(params={{"w": jnp.full((64, 64), v)}}, batch_stats={{}},
+                      opt_state=(), step=jnp.asarray(0, jnp.int32))
+
+d = sys.argv[1]
+m = CheckpointManager(d)
+m.save(st(1.0), 0, train_loss=1.0, best_loss=1.0)
+m.wait()                                   # epoch_0 committed
+print("EPOCH0_COMMITTED", flush=True)
+# epoch_1: the writer sleeps between the Orbax write and the commit
+# marker — the exact window a crashing host leaves a complete-looking
+# but uncommitted directory
+m2 = CheckpointManager(d, _commit_delay_s=600)
+m2.save(st(2.0), 1, train_loss=0.5, best_loss=0.5)
+print("WRITE_IN_FLIGHT", flush=True)
+time.sleep(600)
+"""
+
+
+class TestKillDuringWrite:
+    def test_resume_lands_on_last_committed(self, tmp_path):
+        """A run SIGKILLed mid-write resumes from the last committed
+        checkpoint via the same lookup ``tools/train.py --resume auto``
+        performs — no manual directory surgery on the killed dir."""
+        d = str(tmp_path / "ck")
+        script = tmp_path / "child.py"
+        script.write_text(_KILL_CHILD.format(repo=REPO))
+        proc = subprocess.Popen(
+            [sys.executable, str(script), d],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        try:
+            # wait until the epoch_1 Orbax write landed on disk (the
+            # commit marker is held back by the fault-injection delay)
+            deadline = time.time() + 120
+            e1 = os.path.join(d, "epoch_1")
+            while time.time() < deadline:
+                if os.path.isdir(e1) and os.listdir(e1):
+                    break
+                if proc.poll() is not None:
+                    out, err = proc.communicate()
+                    pytest.fail(f"child died early:\n{out}\n{err}")
+                time.sleep(0.05)
+            else:
+                pytest.fail("epoch_1 write never appeared")
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+        # the killed write is on disk but uncommitted; resume skips it
+        assert os.path.isdir(e1)
+        assert not is_committed(e1)
+        latest = latest_checkpoint(d)
+        assert latest == os.path.join(d, "epoch_0")
+        restored = restore_latest(d)
+        assert float(np.asarray(restored["params"]["w"])[0, 0]) == 1.0
+        assert restored["epoch"] == 0
+
+        # re-saving epoch 1 after the resume overwrites the debris and
+        # commits — the run continues with zero surgery
+        m = CheckpointManager(d)
+        m.save(_dummy_state(3.0), 1, train_loss=0.4, best_loss=0.4)
+        m.close()
+        assert latest_checkpoint(d) == e1
+        assert is_committed(e1)
+
+
+class TestCommitVisibility:
+    def test_in_flight_save_invisible_until_commit(self, tmp_path):
+        d = str(tmp_path)
+        m = CheckpointManager(d)
+        m.save(_dummy_state(1.0), 0, 1.0, 1.0)
+        m.wait()
+        m2 = CheckpointManager(d, _commit_delay_s=1.5)
+        m2.save(_dummy_state(2.0), 1, 0.5, 0.5)
+        e1 = os.path.join(d, "epoch_1")
+        deadline = time.time() + 60
+        while not (os.path.isdir(e1) and os.listdir(e1)):
+            assert time.time() < deadline
+            time.sleep(0.02)
+        # written but uncommitted: still invisible to resume
+        assert latest_checkpoint(d) == os.path.join(d, "epoch_0")
+        m2.close()
+        assert is_committed(e1)
+        assert latest_checkpoint(d) == e1
+
+    def test_marker_strict_json_on_nonfinite(self, tmp_path):
+        """The marker follows the repo's strict-JSON convention
+        (obs/events._definan): a first-save best_loss=inf or a
+        NaN-diverged loss becomes its string name, never a bare
+        NaN/Infinity token a strict consumer cannot parse."""
+        save_checkpoint(str(tmp_path), _dummy_state(), 0,
+                        train_loss=float("nan"), best_loss=float("inf"))
+        with open(os.path.join(str(tmp_path), "epoch_0",
+                               "COMMIT.json")) as f:
+            raw = f.read()
+        assert "NaN" not in raw and "Infinity" not in raw
+        meta = json.loads(raw)
+        assert meta["train_loss"] == "nan"
+        assert meta["best_loss"] == "inf"
+
+    def test_inflight_stamp_guards_legacy_fallback(self, tmp_path):
+        """A marker-less legacy workdir accepts unmarked entries — but a
+        NEW-protocol save killed mid-write into that directory leaves an
+        in-flight stamp, so the partial can never become the legacy
+        fallback's max()."""
+        from improved_body_parts_tpu.train.checkpoint import _inflight_stamp
+
+        d = str(tmp_path)
+        for e in (0, 1):  # pre-protocol entries: no markers anywhere
+            os.makedirs(os.path.join(d, f"epoch_{e}"))
+        # a new save killed between the stamp and the commit marker
+        os.makedirs(os.path.join(d, "epoch_5"))
+        open(_inflight_stamp(d, 5), "w").close()
+        assert latest_checkpoint(d) == os.path.join(d, "epoch_1")
+        # once some epoch commits, marked-directory rules take over
+        m = CheckpointManager(d)
+        m.save(_dummy_state(), 6, 1.0, 1.0)
+        m.close()
+        assert latest_checkpoint(d) == os.path.join(d, "epoch_6")
+        # a completed save leaves no stamp behind
+        assert not os.path.exists(_inflight_stamp(d, 6))
+
+    def test_legacy_unmarked_directory_still_resumes(self, tmp_path):
+        """A checkpoint dir from BEFORE the commit protocol (no marker
+        anywhere) keeps the old resume behavior; the strict skip only
+        applies once any entry carries a marker."""
+        import orbax.checkpoint as ocp
+
+        legacy = os.path.join(str(tmp_path), "epoch_4")
+        ocp.PyTreeCheckpointer().save(legacy, {"w": np.ones(3)}, force=True)
+        assert latest_checkpoint(str(tmp_path)) == legacy
+        # a committed save supersedes; the legacy dir stays restorable
+        # by path but the directory is now in strict (marked) mode
+        m = CheckpointManager(str(tmp_path))
+        m.save(_dummy_state(), 5, 1.0, 1.0)
+        m.close()
+        assert latest_checkpoint(str(tmp_path)).endswith("epoch_5")
+
+    def test_writer_failure_surfaces_on_wait(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+
+        class Boom:
+            def save(self, *a, **k):
+                raise OSError("disk gone")
+
+            def wait_until_finished(self):
+                pass
+
+        m._writer = Boom()
+        m.save(_dummy_state(), 0, 1.0, 1.0)
+        with pytest.raises(OSError, match="disk gone"):
+            m.wait()
+
+
+class TestRetention:
+    def test_gc_keeps_exactly_last_best_milestones(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_last_n=2, keep_best=True,
+                              milestone_every=4)
+        for e in range(10):
+            m.save(_dummy_state(float(e)), e,
+                   train_loss=10.0 - e, best_loss=10.0 - e)
+            # epoch 3 is the best by val loss; everyone else worse
+            m.record_metric(e, "val_loss", 0.1 if e == 3 else 5.0 + e)
+        m.close()
+        kept = sorted(int(n.split("_")[1]) for n in os.listdir(str(tmp_path))
+                      if n.startswith("epoch_"))
+        # last-2 {8,9} ∪ best {3} ∪ milestones {0,4,8}
+        assert kept == [0, 3, 4, 8, 9]
+        assert all(is_committed(os.path.join(str(tmp_path), f"epoch_{e}"))
+                   for e in kept)
+
+    def test_gc_never_deletes_uncommitted(self, tmp_path):
+        d = str(tmp_path)
+        # a fake in-flight/killed dir with NO marker, epoch far in the past
+        partial = os.path.join(d, "epoch_0")
+        os.makedirs(partial)
+        with open(os.path.join(partial, "junk"), "w") as f:
+            f.write("partial")
+        m = CheckpointManager(d, keep_last_n=1, keep_best=False)
+        for e in (1, 2, 3):
+            m.save(_dummy_state(), e, 1.0, 1.0)
+        m.close()
+        # GC pruned committed 1 and 2, kept 3, and never touched the
+        # uncommitted debris
+        kept = sorted(n for n in os.listdir(d) if n.startswith("epoch_"))
+        assert kept == ["epoch_0", "epoch_3"]
+
+    def test_keep_best_prefers_val_scored_epochs(self, tmp_path):
+        """Under eval_freq>1 saves mix train-scored and val-scored
+        epochs; train loss is systematically lower, so ranking them in
+        one min() would crown a non-validated epoch and GC the
+        checkpoint that actually generalizes.  Best = best-by-val
+        whenever any committed epoch carries a val score."""
+        m = CheckpointManager(str(tmp_path), keep_last_n=1, keep_best=True)
+        metrics = {0: ("train_loss", 0.01), 1: ("val_loss", 3.0),
+                   2: ("val_loss", 2.0), 3: ("train_loss", 0.05),
+                   4: ("val_loss", 5.0)}
+        for e in range(5):
+            m.save(_dummy_state(), e, 1.0, 1.0)
+            m.record_metric(e, *metrics[e])
+        m.close()
+        kept = sorted(int(n.split("_")[1]) for n in os.listdir(str(tmp_path))
+                      if n.startswith("epoch_"))
+        # last-1 {4} ∪ best-by-VAL {2} — NOT the train-scored epoch 0
+        assert kept == [2, 4]
+
+    def test_keep_best_ignores_nonfinite_scores(self, tmp_path):
+        """Every NaN comparison is False, so a NaN metric would WIN
+        min() — keep-best would protect exactly the diverged checkpoint
+        (--on-divergence warn records the NaN) and GC the true best."""
+        m = CheckpointManager(str(tmp_path), keep_last_n=2, keep_best=True)
+        metrics = {0: float("nan"), 1: 0.5, 2: 2.0, 3: 2.0, 4: 2.0}
+        for e in range(5):
+            m.save(_dummy_state(), e, 1.0, 1.0)
+            m.record_metric(e, "val_loss", metrics[e])
+        m.close()
+        kept = sorted(int(n.split("_")[1]) for n in os.listdir(str(tmp_path))
+                      if n.startswith("epoch_"))
+        # last-2 {3,4} ∪ best {1} — NOT the NaN-scored epoch 0
+        assert kept == [1, 3, 4]
+
+    def test_retention_state_rebuilt_across_resume(self, tmp_path):
+        """Keep-best must survive a process restart: the best metric is
+        rebuilt from the commit markers, not process memory."""
+        d = str(tmp_path)
+        m = CheckpointManager(d, keep_last_n=1, keep_best=True)
+        for e in range(3):
+            m.save(_dummy_state(), e, 1.0, 1.0)
+            m.record_metric(e, "val_loss", 0.1 if e == 1 else 9.0)
+        m.close()
+        # fresh manager (a resumed run) saves more epochs; epoch 1 must
+        # still be protected as best
+        m2 = CheckpointManager(d, keep_last_n=1, keep_best=True)
+        for e in (3, 4):
+            m2.save(_dummy_state(), e, 1.0, 1.0)
+            m2.record_metric(e, "val_loss", 9.0)
+        m2.close()
+        kept = sorted(int(n.split("_")[1]) for n in os.listdir(d)
+                      if n.startswith("epoch_"))
+        assert kept == [1, 4]
+
+
+class TestStructureRoundtrip:
+    def test_optax_and_swa_structure_through_async_path(self, tmp_path):
+        state, opt = _rich_state()
+        with CheckpointManager(str(tmp_path)) as m:
+            path = m.save(state, 2, train_loss=1.0, best_loss=1.0)
+        restored, meta = restore_checkpoint(path, state)
+        assert (jax.tree.structure(restored.opt_state)
+                == jax.tree.structure(state.opt_state))
+        assert int(restored.swa_count) == int(state.swa_count)
+        assert int(restored.swa_start_step) == int(state.swa_start_step)
+        for got, want in zip(jax.tree.leaves(restored.swa_params),
+                             jax.tree.leaves(state.swa_params)):
+            assert np.array_equal(np.asarray(got), np.asarray(want))
+        # the reimposed namedtuple structure must still drive an update
+        grads = jax.tree.map(jnp.ones_like, restored.params)
+        updates, _ = opt.update(grads, restored.opt_state, restored.params)
+        assert jax.tree.structure(updates) == jax.tree.structure(
+            restored.params)
+
+
+class TestFitCadenceAndBest:
+    def _run_fit(self, tmp_path, save_freq, eval_freq, with_eval=True):
+        from improved_body_parts_tpu.train.loop import fit
+
+        cfg = get_config("tiny")
+        cfg = cfg.replace(train=dataclasses.replace(
+            cfg.train, save_freq=save_freq, eval_freq=eval_freq,
+            checkpoint_dir=str(tmp_path)))
+        train_losses = [1.0, 0.9, 0.8, 0.7, 0.6]
+        current = [0]
+
+        def make_batches(epoch):
+            current[0] = epoch
+
+            def gen():
+                for _ in range(2):
+                    yield (np.ones((1, 8, 8, 3), np.float32),)
+            return gen()
+
+        state = _dummy_state()
+
+        def step(s, imgs):
+            return s, np.float32(train_losses[current[0]])
+
+        eval_step = (lambda s, imgs: np.float32(0.25)) if with_eval else None
+        make_eval = ((lambda epoch: iter([(np.ones((1, 8, 8, 3),
+                                                   np.float32),)]))
+                     if with_eval else None)
+        fit(state, step, cfg, make_batches, epochs=5,
+            eval_step=eval_step, make_eval_batches=make_eval,
+            log_fn=lambda s: None)
+        return cfg
+
+    def test_save_freq_and_final_always_saves(self, tmp_path):
+        self._run_fit(tmp_path, save_freq=2, eval_freq=5)
+        saved = sorted(int(n.split("_")[1])
+                       for n in os.listdir(str(tmp_path))
+                       if n.startswith("epoch_"))
+        # absolute epochs divisible by 2 + the final epoch always
+        assert saved == [0, 2, 4]
+        # epochs without a val pass key best on train loss (eval_freq=5
+        # hits epoch 0 only before the final)...
+        m2 = read_commit_meta(os.path.join(str(tmp_path), "epoch_2"))
+        assert m2["metric"] == "train_loss"
+        assert m2["metric_value"] == pytest.approx(0.8)
+        assert m2["best_loss"] == pytest.approx(0.25)  # epoch 0's val
+        # ...epochs with one key best on VAL loss, recording which
+        # metric was used
+        m4 = read_commit_meta(os.path.join(str(tmp_path), "epoch_4"))
+        assert m4["metric"] == "val_loss"
+        assert m4["metric_value"] == pytest.approx(0.25)
+        assert m4["best_loss"] == pytest.approx(0.25)
+
+    def test_every_epoch_evals_best_is_val(self, tmp_path):
+        self._run_fit(tmp_path, save_freq=1, eval_freq=1)
+        for e in range(5):
+            meta = read_commit_meta(
+                os.path.join(str(tmp_path), f"epoch_{e}"))
+            assert meta["metric"] == "val_loss"
+            assert meta["best_loss"] == pytest.approx(0.25)
+
+    def test_best_watermark_not_contaminated_by_train_loss(self, tmp_path):
+        """With eval configured but thinned (eval_freq>1), an epoch
+        without a val pass must NOT fold its (systematically lower)
+        train loss into best_loss — the contaminated watermark would
+        resume through the checkpoint metadata and no val pass could
+        ever beat it."""
+        from improved_body_parts_tpu.train.loop import fit
+
+        cfg = get_config("tiny")
+        cfg = cfg.replace(train=dataclasses.replace(
+            cfg.train, save_freq=1, eval_freq=4,
+            checkpoint_dir=str(tmp_path)))
+
+        def make_batches(epoch):
+            def gen():
+                yield (np.ones((1, 8, 8, 3), np.float32),)
+            return gen()
+
+        fit(_dummy_state(),
+            lambda s, imgs: (s, np.float32(0.01)),  # train far below val
+            cfg, make_batches, epochs=3,
+            eval_step=lambda s, imgs: np.float32(0.25),
+            make_eval_batches=lambda e: iter(
+                [(np.ones((1, 8, 8, 3), np.float32),)]),
+            log_fn=lambda s: None)
+        # evals hit epochs 0 and 2 (final); epoch 1 is train-scored but
+        # its best_loss stays the val watermark
+        m1 = read_commit_meta(os.path.join(str(tmp_path), "epoch_1"))
+        assert m1["metric"] == "train_loss"
+        assert m1["metric_value"] == pytest.approx(0.01)
+        assert m1["best_loss"] == pytest.approx(0.25)
+        m2 = read_commit_meta(os.path.join(str(tmp_path), "epoch_2"))
+        assert m2["best_loss"] == pytest.approx(0.25)
+
+    def test_no_eval_falls_back_to_train_loss(self, tmp_path):
+        self._run_fit(tmp_path, save_freq=1, eval_freq=1, with_eval=False)
+        meta = read_commit_meta(os.path.join(str(tmp_path), "epoch_4"))
+        assert meta["metric"] == "train_loss"
+        assert meta["best_loss"] == pytest.approx(0.6)
+
+
+class TestObsIntegration:
+    def test_checkpoint_spans_metrics_and_events(self, tmp_path):
+        from improved_body_parts_tpu.obs import RunTelemetry
+        from improved_body_parts_tpu.obs.events import read_events
+        from improved_body_parts_tpu.obs.registry import Registry
+
+        ev = str(tmp_path / "ev.jsonl")
+        reg = Registry()
+        tele = RunTelemetry(ev, registry=reg, watch_compiles=False)
+        try:
+            with CheckpointManager(str(tmp_path / "ck"), keep_last_n=1,
+                                   registry=reg) as m:
+                for e in range(2):
+                    m.save(_dummy_state(float(e)), e, 1.0 - e * 0.1, 1.0)
+                    m.record_metric(e, "val_loss", 0.5)
+        finally:
+            tele.close()
+        evs = read_events(ev)
+        cks = [e for e in evs if e["event"] == "checkpoint"]
+        assert [c["epoch"] for c in cks] == [0, 1]
+        for c in cks:
+            assert c["bytes"] > 0
+            assert c["serialize_s"] >= 0 and c["commit_s"] >= 0
+            assert c["async_save"] is True
+        # keep_last_n=1 keeps epoch 1; keep-best protects epoch 0 (both
+        # metrics tie at 0.5, min-epoch wins) -> 2 retained
+        assert cks[-1]["retained"] == 2
+        snap = reg.snapshot()
+        assert snap["checkpoint_bytes"] > 0
+        assert snap["checkpoints_retained"] == 2.0
+        assert snap['checkpoint_seconds{phase="blocked"}']["count"] == 2
+        assert snap['checkpoint_seconds{phase="serialize"}']["count"] == 2
+        # the spans landed on their own named track
+        spans = [e for e in tele.trace.events()
+                 if e["name"] in ("snapshot", "serialize", "commit")]
+        assert {e["name"] for e in spans} == {"snapshot", "serialize",
+                                              "commit"}

@@ -372,14 +372,33 @@ class TestTrainLoopTelemetry:
             return state, np.float32(1.5)
 
         saved = []
-        orig = L.ckpt.save_checkpoint
-        L.ckpt.save_checkpoint = lambda *a, **k: saved.append(a)
+
+        class StubManager:
+            @classmethod
+            def from_config(cls, *a, **k):
+                return cls()
+
+            def save(self, state, epoch, train_loss, best_loss):
+                saved.append((epoch, train_loss))
+
+            def record_metric(self, *a, **k):
+                pass
+
+            def wait(self):
+                pass
+
+            def close(self):
+                pass
+
+        orig = L.ckpt.CheckpointManager
+        L.ckpt.CheckpointManager = StubManager
         try:
             L.fit(None, step, cfg, make_batches, epochs=2,
                   checkpoint_dir=str(tmp_path / "ck"),
                   log_fn=lambda s: None, telemetry=tele)
         finally:
-            L.ckpt.save_checkpoint = orig
+            L.ckpt.CheckpointManager = orig
+        assert [e for e, _ in saved] == [0, 1]
         tele.close()
         eps = [e for e in read_events(p) if e["event"] == "epoch"]
         assert [e["epoch"] for e in eps] == [0, 1]

@@ -5,13 +5,22 @@ with tools.import_torch_checkpoint, and compares every stack/scale output of
 the two frameworks on the same input — the strongest architecture-fidelity
 check available: identical numerics, not just identical parameter counts.
 """
+import os
 import sys
 import types
 
 import numpy as np
 import pytest
 
+# module-level guards so a host without torch OR without the reference
+# checkout COLLECTS cleanly (skips) instead of erroring: the parity
+# fixture imports the reference's torch PoseNet from /root/reference,
+# which only exists on hosts provisioned with the upstream repo
 torch = pytest.importorskip("torch")
+if not os.path.isfile("/root/reference/models/posenet.py"):
+    pytest.skip("reference repo not available at /root/reference "
+                "(forward-parity needs the upstream torch PoseNet)",
+                allow_module_level=True)
 
 
 @pytest.fixture(scope="module")

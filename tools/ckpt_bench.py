@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Epoch-boundary checkpoint stall: sync vs async, on a real fit().
+
+The last serial host-side stall in the training path was the per-epoch
+checkpoint: the loop blocked on the whole Orbax write (snapshot +
+serialize + fsync) before the next epoch could start.  The async
+``CheckpointManager`` blocks only on the device→host snapshot drain and
+commits in background, overlapping validation and the next epoch's
+steps.  This benchmark measures exactly that number — **train-loop
+blocked seconds per save** (``CheckpointManager.blocked_seconds``) — on
+a real multi-epoch ``fit`` of the canonical-shape tiny config (model +
+SGD momentum + batch_stats + the SWA shadow, the full flagship state
+CONTENT at test width), in interleaved ABBA rounds per the
+serve_bench/feed_rate protocol so host-load drift hits both arms
+equally.  The verdict is the median over per-round stall ratios.
+
+Also verifies the two paths are INTERCHANGEABLE (an async-saved and a
+sync-saved checkpoint of the same state restore bit-identical leaves)
+and, from an instrumented run's span trace, that the ``serialize`` /
+``commit`` spans actually overlap subsequent ``step_window`` / eval
+spans — the timeline proof that the write left the loop's critical
+path.
+
+Registered as the ``"ckpt"`` key in bench.py (``IBP_BENCH_CKPT=0``
+skips; budget-aware).
+
+    python tools/ckpt_bench.py                    # 3 rounds x 3 epochs
+    python tools/ckpt_bench.py --rounds 5 --epochs 4 --steps 6
+"""
+import argparse
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STALL_REDUCTION_TARGET = 5.0
+
+
+def _spans(events, names):
+    """(start_us, end_us, name) for every complete X span named in
+    ``names`` from a trace_event list."""
+    out = []
+    for e in events:
+        if e.get("ph") == "X" and e.get("name") in names:
+            out.append((e["ts"], e["ts"] + e.get("dur", 0.0), e["name"]))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", default="tiny",
+                    help="model/config under test (tiny = the flagship "
+                         "IMHN shape family at test width; the state "
+                         "carries params + momentum + batch_stats + the "
+                         "SWA shadow either way)")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="interleaved sync/async rounds (ABBA order)")
+    ap.add_argument("--epochs", type=int, default=3,
+                    help="fit epochs per arm per round — every epoch "
+                         "boundary is one measured save")
+    ap.add_argument("--steps", type=int, default=12,
+                    help="train steps per epoch — enough wall time for "
+                         "the background write to hide behind (real "
+                         "epochs are minutes; epochs shorter than the "
+                         "write re-expose it as wait time at the next "
+                         "save, which the stall number honestly counts)")
+    ap.add_argument("--eval-steps", type=int, default=4)
+    ap.add_argument("--print-freq", type=int, default=2)
+    ap.add_argument("--out", default="CKPT_BENCH.json")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero when the stall-reduction target "
+                         "or bit-identity fails")
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.utils import apply_platform_env
+    apply_platform_env()
+
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.obs import Registry, RunTelemetry
+    from improved_body_parts_tpu.parallel import make_mesh, replicated
+    from improved_body_parts_tpu.train import (
+        CheckpointManager, create_train_state, make_eval_step,
+        make_optimizer, make_train_step, read_commit_meta,
+        restore_checkpoint, save_checkpoint, start_swa,
+        step_decay_schedule)
+    from improved_body_parts_tpu.train.loop import fit
+
+    cfg = get_config(args.config)
+    cfg = cfg.replace(train=dataclasses.replace(
+        cfg.train, print_freq=args.print_freq))
+    model = build_model(cfg)
+    mesh = make_mesh()
+    n_dev = int(mesh.devices.size)
+    batch = max(cfg.train.batch_size_per_device, 1) * n_dev
+    size = cfg.skeleton.height
+    grid = size // cfg.skeleton.stride
+    rng = np.random.default_rng(0)
+
+    imgs = rng.uniform(0, 1, (batch, size, size, 3)).astype(np.float32)
+    labels = rng.uniform(
+        0, 1, (batch, grid, grid, cfg.skeleton.num_layers)
+    ).astype(np.float32)
+    mask = np.ones((batch, grid, grid, 1), np.float32)
+
+    def make_batches(epoch):
+        def gen():
+            for _ in range(args.steps):
+                yield (imgs, mask, labels)
+        return gen()
+
+    def make_eval_batches(epoch):
+        def gen():
+            for _ in range(args.eval_steps):
+                yield (imgs, mask, labels)
+        return gen()
+
+    opt = make_optimizer(cfg, step_decay_schedule(cfg.train,
+                                                  steps_per_epoch=100))
+    state0 = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
+                                imgs[:1])
+    # the canonical checkpoint CONTENT: params + SGD momentum +
+    # batch_stats + the SWA shadow (what the flagship run serializes)
+    state0 = start_swa(state0)
+    # master host copy: each arm re-places it fresh — the fit arms run a
+    # DONATED step, which consumes the device buffers
+    master = jax.tree.map(lambda x: np.asarray(x).copy(), state0)
+    payload_bytes = int(sum(
+        getattr(leaf, "nbytes", 0) for leaf in jax.tree.leaves(master)))
+
+    train_step = make_train_step(model, cfg, opt)  # donate=True (default)
+    eval_step = make_eval_step(model, cfg)
+    quiet = lambda s: None  # noqa: E731 — stdout stays one JSON line
+
+    work = tempfile.mkdtemp(prefix="ckpt_bench_")
+
+    def run_arm(async_save, tag, telemetry=None):
+        """One fit; returns the manager's per-save blocked seconds."""
+        d = os.path.join(work, tag)
+        shutil.rmtree(d, ignore_errors=True)
+        manager = CheckpointManager(d, async_save=async_save)
+        state = jax.device_put(master, replicated(mesh))
+        fit(state, train_step, cfg, make_batches, args.epochs, mesh=mesh,
+            eval_step=eval_step, make_eval_batches=make_eval_batches,
+            checkpoint_dir=d, log_fn=quiet, telemetry=telemetry,
+            checkpoint_manager=manager)
+        manager.close()
+        return manager.blocked_seconds, d
+
+    # untimed warmup: compiles the donated train step + eval step and
+    # pays orbax's first-save setup for both arms
+    run_arm(False, "warm_sync")
+    run_arm(True, "warm_async")
+
+    sync_rounds, async_rounds = [], []
+    for i in range(max(1, args.rounds)):
+        # ABBA: alternate which arm goes first so a host-load ramp
+        # cannot systematically penalize one arm (serve_bench protocol)
+        order = [(False, sync_rounds), (True, async_rounds)]
+        if i % 2:
+            order.reverse()
+        for async_save, sink in order:
+            blocked, _ = run_arm(async_save,
+                                 f"r{i}_{'async' if async_save else 'sync'}")
+            sink.append(blocked)
+
+    sync_flat = [v for r in sync_rounds for v in r]
+    async_flat = [v for r in async_rounds for v in r]
+    per_round_ratio = [statistics.mean(s) / max(statistics.mean(a), 1e-9)
+                       for s, a in zip(sync_rounds, async_rounds)]
+    reduction = statistics.median(per_round_ratio)
+
+    # ---- interchangeability: one state, both paths, identical leaves
+    sync_path = save_checkpoint(os.path.join(work, "ident_sync"), state0,
+                                0, 1.0, 1.0)
+    with CheckpointManager(os.path.join(work, "ident_async")) as m:
+        async_path = m.save(state0, 0, 1.0, 1.0)
+    a, b = restore_checkpoint(sync_path), restore_checkpoint(async_path)
+    bit_identical = (
+        jax.tree.structure(a) == jax.tree.structure(b)
+        and all(np.asarray(x).dtype == np.asarray(y).dtype
+                and np.array_equal(np.asarray(x), np.asarray(y))
+                for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))))
+
+    # ---- instrumented run: the trace must SHOW the write off the
+    # critical path (serialize/commit overlapping later step/eval spans)
+    ev_path = os.path.join(work, "events.jsonl")
+    trace_path = os.path.join(work, "trace.json")
+    tele = RunTelemetry(ev_path, registry=Registry(),
+                        run_meta={"tool": "ckpt_bench"},
+                        trace_path=trace_path, watch_compiles=False)
+    try:
+        run_arm(True, "instrumented", telemetry=tele)
+        trace_events = tele.trace.events()
+    finally:
+        tele.close()
+    writes = _spans(trace_events, {"serialize", "commit"})
+    targets = _spans(trace_events, {"step_window", "eval_epoch",
+                                    "data_wait", "compute"})
+    overlaps = sum(
+        1 for w0, w1, _ in writes
+        for t0, t1, _ in targets
+        if t0 > w0 and t0 < w1)  # a LATER span started inside the write
+    snapshots = _spans(trace_events, {"snapshot"})
+
+    report = {
+        "config": args.config,
+        "protocol": "real multi-epoch fit (donated jitted step, eval "
+                     "overlap) per arm; interleaved ABBA rounds; stall = "
+                     "CheckpointManager.blocked_seconds per save; "
+                     "verdict = median per-round sync/async ratio",
+        "rounds": args.rounds,
+        "epochs_per_arm": args.epochs,
+        "steps_per_epoch": args.steps,
+        "payload_bytes": payload_bytes,
+        "saves_per_arm": len(sync_rounds[0]) if sync_rounds else 0,
+        "sync_stall_ms_mean": round(statistics.mean(sync_flat) * 1e3, 3),
+        "sync_stall_ms_median": round(
+            statistics.median(sync_flat) * 1e3, 3),
+        "async_stall_ms_mean": round(statistics.mean(async_flat) * 1e3, 3),
+        "async_stall_ms_median": round(
+            statistics.median(async_flat) * 1e3, 3),
+        "per_round_stall_reduction": [round(r, 2) for r in per_round_ratio],
+        "stall_reduction": round(reduction, 2),
+        "stall_reduction_target": STALL_REDUCTION_TARGET,
+        "meets_target": bool(reduction >= STALL_REDUCTION_TARGET),
+        "bit_identical_restore": bool(bit_identical),
+        "write_spans": len(writes),
+        "snapshot_spans": len(snapshots),
+        "write_overlapping_later_spans": overlaps,
+        "write_overlaps_step_or_eval": bool(overlaps > 0),
+        "trace": trace_path,
+        "telemetry_events": ev_path,
+        "host_note": f"cpu_count={os.cpu_count()}, "
+                     f"backend={jax.default_backend()}",
+        "commit_meta_sample": read_commit_meta(async_path),
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    if args.strict and not (report["meets_target"]
+                            and report["bit_identical_restore"]):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

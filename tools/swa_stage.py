@@ -68,10 +68,14 @@ def main():
              "--train-h5", h5, "--checkpoint-dir", ckpt_dir,
              "--workers", "0", "--seed", str(args.seed)], timeout=21600)
 
-    from improved_body_parts_tpu.train.checkpoint import latest_checkpoint
+    from improved_body_parts_tpu.train.checkpoint import (latest_checkpoint,
+                                                          read_commit_meta)
 
+    # latest_checkpoint only returns COMMITTED checkpoints now — a stage
+    # killed mid-write can no longer hand a partial directory to the eval
     latest = latest_checkpoint(ckpt_dir)
     assert latest, f"no checkpoint under {ckpt_dir} after the SWA stage"
+    ckpt_meta = read_commit_meta(latest)
     print(f"evaluating SWA checkpoint {latest}", flush=True)
     out = run_cli([os.path.join(REPO, "tools", "evaluate.py"), "--config",
                    args.config, "--checkpoint", latest, "--anno", anno,
@@ -83,6 +87,13 @@ def main():
     result = {"config": args.config, "seed": args.seed,
               "swa_epochs": args.epochs, "swa_freq": args.swa_freq,
               "ap_swa": ap_swa, "checkpoint": latest,
+              # checkpoint provenance from the commit marker (None for a
+              # pre-marker legacy dir): which epoch/metric the evaluated
+              # weights actually carry
+              "checkpoint_meta": ({k: ckpt_meta[k] for k in
+                                   ("epoch", "train_loss", "metric",
+                                    "metric_value") if k in ckpt_meta}
+                                  if ckpt_meta else None),
               "protocol": "tools/train.py --swa --resume auto (cyclic LR "
                           "1e-5->1e-6, frozen BN, averaged swap) -> "
                           "tools/evaluate.py --compact --oks-proxy on the "

@@ -122,7 +122,8 @@ class Session:
                   val_images=24, people=2, seed=0, val_seed=12345,
                   crowd=False, hard=False, mask_extras=True, device_gt=0,
                   lr=0.0, workdir=None, fresh_baseline=True,
-                  swa_from=None, swa_epochs=5, swa_freq=5, base_artifact=None):
+                  swa_from=None, swa_epochs=5, swa_freq=5, base_artifact=None,
+                  keep_last_n=0, milestone_every=0):
         """Mirror of tools/synth_ap.py's protocol, in-process.
 
         ``swa_from`` = an existing run's workdir: continue its checkpoint
@@ -283,6 +284,15 @@ class Session:
                     argv += ["--lr", lr]
                 if device_gt:
                     argv += ["--device-gt", device_gt]
+                # retention GC for the big-state runs (the 512² flagship
+                # checkpoint is ~1.5 GB/epoch): keep last-N + best +
+                # milestones; crash-resume is unaffected — it counts from
+                # the latest checkpoint (always kept) and the append-only
+                # epoch log, and GC only ever deletes COMMITTED dirs
+                if keep_last_n:
+                    argv += ["--keep-last-n", keep_last_n]
+                if milestone_every:
+                    argv += ["--milestone-every", milestone_every]
                 self._train(argv)
         train_s = round(time.time() - t0, 1)
 
@@ -388,7 +398,7 @@ class Session:
             self.art("SYNTH_AP_CANONICAL_TPU.json"), config=config,
             epochs=a.canonical_epochs, canvas=canvas,
             train_images=a.canonical_images, val_images=24,
-            device_gt=8, seed=0)
+            device_gt=8, seed=0, keep_last_n=3, milestone_every=10)
 
     def run_hard(self):
         a = self.args

@@ -59,6 +59,28 @@ def main():
     ap.add_argument("--print-freq", type=int, default=None,
                     help="metric-readback window in steps (default: the "
                          "config's print_freq)")
+    ap.add_argument("--save-freq", type=int, default=None,
+                    help="checkpoint on epochs divisible by N (default: "
+                         "the config's save_freq, normally 1); the final "
+                         "epoch always saves")
+    ap.add_argument("--eval-freq", type=int, default=None,
+                    help="run the val pass on epochs divisible by N "
+                         "(default: the config's eval_freq, normally 1); "
+                         "the final epoch always evals")
+    ap.add_argument("--sync-checkpoint", action="store_true",
+                    help="disable async checkpointing (the train loop "
+                         "then blocks on the full Orbax write each save "
+                         "— the legacy behavior; tools/ckpt_bench.py "
+                         "measures the difference)")
+    ap.add_argument("--keep-last-n", type=int, default=None,
+                    help="retention GC: keep only the last N committed "
+                         "checkpoints, plus the best and milestones "
+                         "(default: the config's keep_last_n; 0 keeps "
+                         "everything)")
+    ap.add_argument("--milestone-every", type=int, default=None,
+                    help="retention GC: additionally keep every epoch "
+                         "divisible by K (default: the config's "
+                         "milestone_every; 0 disables)")
     ap.add_argument("--device-gt", type=int, default=0, metavar="MAX_PEOPLE",
                     help="synthesize GT heatmaps ON DEVICE inside the train "
                          "step from padded joints (value = max people per "
@@ -113,9 +135,10 @@ def main():
     from improved_body_parts_tpu.parallel import (
         barrier, initialize_distributed, make_mesh, replicated)
     from improved_body_parts_tpu.train import (
-        create_train_state, cyclic_swa_schedule, fit, latest_checkpoint,
-        make_eval_step, make_optimizer, make_train_step, restore_checkpoint,
-        start_swa, step_decay_schedule, swap_swa_params, update_swa)
+        CheckpointManager, create_train_state, cyclic_swa_schedule, fit,
+        latest_checkpoint, make_eval_step, make_optimizer, make_train_step,
+        restore_checkpoint, start_swa, step_decay_schedule, swap_swa_params,
+        update_swa)
 
     initialize_distributed(args.coordinator, args.num_processes,
                            args.process_id)
@@ -127,7 +150,9 @@ def main():
         raise SystemExit("--lr does not apply to the SWA stage; use "
                          "--swa-lr-max/--swa-lr-min instead")
     if (args.checkpoint_dir or args.lr or args.print_freq
-            or args.on_divergence):
+            or args.on_divergence or args.save_freq or args.eval_freq
+            or args.sync_checkpoint or args.keep_last_n is not None
+            or args.milestone_every is not None):
         import dataclasses
 
         overrides = {}
@@ -145,6 +170,19 @@ def main():
             # skip_step policy is enforced INSIDE the jitted step, which
             # reads config.train.on_divergence at trace time
             overrides["on_divergence"] = args.on_divergence
+        # checkpoint cadence/retention fold into the config so fit() and
+        # the SWA stage read ONE source of truth (and the save decision
+        # stays process-symmetric — it derives from argv/config only)
+        if args.save_freq:
+            overrides["save_freq"] = args.save_freq
+        if args.eval_freq:
+            overrides["eval_freq"] = args.eval_freq
+        if args.sync_checkpoint:
+            overrides["async_checkpoint"] = False
+        if args.keep_last_n is not None:
+            overrides["keep_last_n"] = args.keep_last_n
+        if args.milestone_every is not None:
+            overrides["milestone_every"] = args.milestone_every
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **overrides))
 
     from improved_body_parts_tpu.obs import RunTelemetry, resolve_sink_path
@@ -364,7 +402,24 @@ def main():
                            args.num_processes, num_workers=args.workers,
                            pipeline=pipeline, wire=wire)
 
+    # ONE checkpoint manager for both stages (fit and SWA): async
+    # snapshot + background Orbax write + atomic commit markers +
+    # retention GC, from the config knobs (process-symmetric)
+    manager = CheckpointManager.from_config(cfg.train.checkpoint_dir,
+                                            cfg.train, is_lead_host=is_lead)
+
     def shutdown():
+        # flush the in-flight checkpoint write FIRST: its commit event
+        # must land in the sink before telemetry closes, and the ring
+        # teardown must not outrun a write that still reads host
+        # buffers.  Best-effort — the happy paths already surfaced
+        # writer errors via fit's / the SWA loop's wait(); a failure
+        # HERE must not mask the exception this finally is unwinding
+        try:
+            manager.close()
+        except Exception as e:  # noqa: BLE001
+            print(f"checkpoint flush failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
         for ring in (train_ring, eval_ring):
             if ring is not None:
                 ring.close()
@@ -387,13 +442,13 @@ def main():
             fit(state, train_step, cfg, make_train_batches, epochs,
                 start_epoch=start_epoch, mesh=mesh, eval_step=eval_step,
                 make_eval_batches=make_eval_batches, is_lead_host=is_lead,
-                best_loss=best_loss, telemetry=telemetry)
+                best_loss=best_loss, telemetry=telemetry,
+                checkpoint_manager=manager)
             return
 
         # SWA fine-tune: average params every swa_freq epochs, swap
         # averaged params in for the checkpoint (reference:
         # train_distributed_SWA.py:403-435)
-        from improved_body_parts_tpu.train import checkpoint as ckpt
         from improved_body_parts_tpu.train.loop import _log_line, train_epoch
 
         if resumed_swa:
@@ -416,10 +471,11 @@ def main():
                           f"\nEpoch {epoch}\ttrain_loss: {train_loss}")
             if (epoch - start_epoch + 1) % args.swa_freq == 0:
                 state = update_swa(state)
-                # collective save (orbax barriers across processes)
+                # collective ASYNC save (orbax barriers across processes
+                # on the writer threads; manager.save blocks only on the
+                # snapshot drain, the write overlaps the next SWA epochs)
                 swapped = swap_swa_params(state)
-                ckpt.save_checkpoint(cfg.train.checkpoint_dir, swapped,
-                                     epoch, train_loss, train_loss)
+                manager.save(swapped, epoch, train_loss, train_loss)
                 if is_lead:
                     print(f"epoch {epoch}: SWA checkpoint saved")
         if epochs and epochs % args.swa_freq:
@@ -430,11 +486,13 @@ def main():
             # tools/tpu_train_session.py stale-checkpoint guard)
             state = update_swa(state)
             swapped = swap_swa_params(state)
-            ckpt.save_checkpoint(cfg.train.checkpoint_dir, swapped, epoch,
-                                 train_loss, train_loss)
+            manager.save(swapped, epoch, train_loss, train_loss)
             if is_lead:
                 print(f"epoch {epoch}: final SWA checkpoint saved "
                       f"({epochs % args.swa_freq} trailing epochs)")
+        # surface a trailing writer failure HERE, on the happy path —
+        # shutdown()'s flush is best-effort by design
+        manager.wait()
     finally:
         shutdown()
 
