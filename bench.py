@@ -301,6 +301,55 @@ def _ckpt_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _chaos_summary(fallback, budget_s):
+    """Run tools/chaos_train.py (the elastic-training fault-injection
+    harness: randomized kills of a real supervised fit, relaunch until
+    the epoch target lands) and return a compact summary, or an
+    {"error"/"skipped"} marker — the "serve"/"feed"/"telemetry"/"ckpt"
+    key contract.  Subprocess so a chaos failure can never take down
+    the primary metric; bounded by the REMAINING driver budget.
+    ``IBP_BENCH_CHAOS=0`` skips it unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_CHAOS") == "0":
+        return {"skipped": "IBP_BENCH_CHAOS=0"}
+    if budget_s < 240:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (CHAOS.json has the full sweep)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="chaos_train_"),
+                       "CHAOS.json")
+    # short sweep, no control arm: the bench key checks the recovery
+    # machinery end to end (kill -> classify -> resume-on-last-committed
+    # -> no leaks); the committed CHAOS.json carries the full 8-kill
+    # randomized sweep WITH the bit-match against an uninterrupted
+    # control run.  Tiny config either way — chaos exercises the
+    # supervisor, not the model.
+    argv = ["--config", "tiny", "--kills", "3", "--epochs", "2",
+            "--no-control"]
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "chaos_train.py"),
+             "--out", out] + argv,
+            capture_output=True, timeout=min(900, budget_s), check=True,
+            env=dict(os.environ))
+        with open(out) as f:
+            r = json.load(f)
+        return {
+            "ok": r["ok"],
+            "completed": r["completed"],
+            "injections_done": r["injections_done"],
+            "segments_total": r["segments_total"],
+            "all_resumes_on_last_committed":
+                r["all_resumes_on_last_committed"],
+            "leaked_pids_total": r["leaked_pids_total"],
+            "writer_thread_leaked": r["writer_thread_leaked"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def main():
     import time
 
@@ -370,6 +419,10 @@ def main():
     # epoch-boundary checkpoint stall (sync vs async), same discipline
     ckpt = _ckpt_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # elastic-training fault injection (kill/resume/leak sweep), same
+    # discipline
+    chaos = _chaos_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     print(json.dumps({
         # metric name carries the ACTUAL batch (the fallback runs batch 2)
         "metric": f"network_inference_fps_512x512_batch{batch}",
@@ -380,6 +433,7 @@ def main():
         "feed": feed,
         "telemetry": telemetry,
         "ckpt": ckpt,
+        "chaos": chaos,
         "provenance": _provenance(),
     }))
 

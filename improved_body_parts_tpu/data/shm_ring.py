@@ -52,6 +52,14 @@ _HEADER_INTS = 3  # per-slot seqlock header: [seq, epoch, batch_idx]
 _ALIGN = 64
 
 
+class WorkerDied(RuntimeError):
+    """An input worker process died while the consumer waited.
+
+    Fatal by default (the historical contract: fail loudly, never hang);
+    under ``ShmRingInput(supervise=True)`` the consumer catches it and
+    rebuilds the ring instead — see :meth:`ShmRingInput._rebuild`."""
+
+
 def _align(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
 
@@ -147,7 +155,7 @@ def _slot_views(buf, slots: int, shapes, dtypes, writeable: bool):
 
 def _ring_worker(worker_id: int, shm_name: str, slots: int, shapes, dtypes,
                  h5_path: str, config, augment: bool, seed: int, raw_gt: int,
-                 wire: str, task_q, done_q) -> None:
+                 wire: str, task_q, done_q, parent_pid: int = 0) -> None:
     """Persistent worker entry (spawn target — module importable, no JAX).
 
     Renders each task's samples directly into the slot's shared-memory
@@ -181,14 +189,15 @@ def _ring_worker(worker_id: int, shm_name: str, slots: int, shapes, dtypes,
         # all numpy views over the mapping live in _worker_loop's frame,
         # so they are released before the close below
         _worker_loop(worker_id, shm, slots, shapes, dtypes, h5_path, config,
-                     augment, seed, raw_gt, wire, task_q, done_q)
+                     augment, seed, raw_gt, wire, task_q, done_q,
+                     parent_pid)
     finally:
         _quiet_close(shm)
 
 
 def _worker_loop(worker_id: int, shm, slots: int, shapes, dtypes,
                  h5_path: str, config, augment: bool, seed: int, raw_gt: int,
-                 wire: str, task_q, done_q) -> None:
+                 wire: str, task_q, done_q, parent_pid: int = 0) -> None:
     try:
         from .dataset import CocoPoseDataset
 
@@ -201,7 +210,19 @@ def _worker_loop(worker_id: int, shm, slots: int, shapes, dtypes,
         return
     try:
         while True:
-            task = task_q.get()
+            try:
+                task = task_q.get(timeout=2.0)
+            except queue.Empty:
+                # orphan watchdog: a SIGKILLed consumer (preemption,
+                # OOM-killer, the chaos harness) runs no cleanup and
+                # never sends the poison pill — daemon=True only helps
+                # on orderly interpreter exit.  A reparented worker
+                # would otherwise block on this queue forever, which is
+                # exactly the "leaked ring workers" the chaos harness
+                # asserts against.
+                if parent_pid and os.getppid() != parent_pid:
+                    return
+                continue
             if task is None:
                 return
             gen, seq, epoch, batch_idx, slot, idxs = task
@@ -265,7 +286,8 @@ class ShmRingInput:
 
     def __init__(self, dataset, batch_size: int, num_workers: int,
                  raw_gt: int = 0, wire: str = "uint8", slots: int = 0,
-                 start_timeout: float = 120.0):
+                 start_timeout: float = 120.0, supervise: bool = False,
+                 max_rebuilds: int = 3):
         if num_workers < 1:
             raise ValueError("ShmRingInput needs num_workers >= 1; use the "
                              "synchronous path for in-process loading")
@@ -277,6 +299,16 @@ class ShmRingInput:
         self.num_workers = num_workers
         self.raw_gt = raw_gt
         self.wire = wire
+        # supervise=True: a dead worker triggers a ring REBUILD (all
+        # workers respawned, lost tasks re-rendered, the stream resumes
+        # bit-identically) instead of the fatal WorkerDied — the elastic
+        # training mode (tools/train.py --supervised).  max_rebuilds
+        # bounds CONSECUTIVE rebuilds with no yielded batch in between,
+        # so a deterministically-crashing worker cannot respawn forever.
+        self.supervise = bool(supervise)
+        self.max_rebuilds = int(max_rebuilds)
+        self._consecutive_rebuilds = 0
+        self.rebuilds_total = 0
         self.slots = slots if slots > 0 else num_workers + 2
         self.names, self.shapes, self.dtypes = batch_wire_format(
             dataset.config, batch_size, raw_gt=raw_gt, wire=wire)
@@ -288,6 +320,8 @@ class ShmRingInput:
         # retired Pool path); the ring module imports no JAX so worker
         # start-up is cheap and happens ONCE, not per epoch
         ctx = mp.get_context("spawn")
+        self._ctx = ctx
+        self._start_timeout = float(start_timeout)
         self._shm = shared_memory.SharedMemory(create=True, size=total)
         # pre-fault the whole block now: otherwise every slot's first use
         # pays its page faults inside the training (or benchmark) window
@@ -297,22 +331,19 @@ class ShmRingInput:
             writeable=False)
         self._task_q = ctx.Queue()
         self._done_q = ctx.Queue()
-        self._procs = [
-            ctx.Process(
-                target=_ring_worker, daemon=True,
-                name=f"shm-ring-worker-{i}",
-                args=(i, self._shm.name, self.slots, self.shapes, self.dtypes,
-                      dataset.h5_path, dataset.config, dataset.augment,
-                      dataset.seed, raw_gt, wire, self._task_q, self._done_q))
-            for i in range(num_workers)]
+        self._procs = [self._make_worker(i) for i in range(num_workers)]
         self._free: List[int] = list(range(self.slots))
         self._gen = 0
         self._closed = False
+        # mutable holder so the finalizer tracks the CURRENT task queue
+        # across supervised rebuilds (which replace both queues)
+        self._qholder = [self._task_q]
         self._tele = None          # obs.Registry, via attach_telemetry
         self._tele_prefix = "input_ring"
         self._render_hists = {}    # worker_id -> Histogram
+        self._rebuilds_counter = None
         self._finalizer = weakref.finalize(self, ShmRingInput._cleanup,
-                                           self._procs, self._task_q,
+                                           self._procs, self._qholder,
                                            self._shm)
         try:
             for p in self._procs:
@@ -323,6 +354,18 @@ class ShmRingInput:
             raise
 
     # -- lifecycle ---------------------------------------------------------
+
+    def _make_worker(self, worker_id: int):
+        """One (unstarted) worker process — shared by the initial spawn
+        and the supervised rebuild's respawn."""
+        ds = self.dataset
+        return self._ctx.Process(
+            target=_ring_worker, daemon=True,
+            name=f"shm-ring-worker-{worker_id}",
+            args=(worker_id, self._shm.name, self.slots, self.shapes,
+                  self.dtypes, ds.h5_path, ds.config, ds.augment, ds.seed,
+                  self.raw_gt, self.wire, self._task_q, self._done_q,
+                  os.getpid()))
 
     def _wait_ready(self, timeout: float) -> None:
         deadline = time.monotonic() + timeout
@@ -338,7 +381,8 @@ class ShmRingInput:
             # no epoch tasks can be outstanding yet
 
     @staticmethod
-    def _cleanup(procs, task_q, shm) -> None:
+    def _cleanup(procs, qholder, shm) -> None:
+        task_q = qholder[0]
         for _ in procs:
             try:
                 task_q.put_nowait(None)
@@ -393,6 +437,9 @@ class ShmRingInput:
             "consumer time blocked on the done queue")
         self._stalls = registry.counter(prefix + "_consumer_stalls_total")
         self._batches_total = registry.counter(prefix + "_batches_total")
+        self._rebuilds_counter = registry.counter(
+            prefix + "_rebuilds_total",
+            "supervised ring rebuilds after a worker death")
         return self
 
     def _observe_render(self, worker_id: int, render_s: float) -> None:
@@ -435,7 +482,7 @@ class ShmRingInput:
                 if dead:
                     codes = ", ".join(
                         f"{p.name} exitcode={p.exitcode}" for p in dead)
-                    raise RuntimeError(
+                    raise WorkerDied(
                         f"input worker died while the consumer waited for "
                         f"{what} ({codes}); the sample it was rendering is "
                         "lost — restart the pipeline") from None
@@ -451,6 +498,118 @@ class ShmRingInput:
                 f"ring-slot protocol violation: slot {slot} header "
                 f"(seq={seq}, epoch={h_epoch}, batch={h_idx}) does not match "
                 f"the completed task (epoch={epoch}, batch={batch_idx})")
+
+    def _rebuild(self, meta, completed, gen: int, why: str) -> None:
+        """Supervised recovery from a dead worker: rebuild the whole ring
+        in place and re-render the lost tasks.
+
+        Stop-the-world by design — partial recovery (respawn only the
+        dead worker) would leave live workers mid-render on slots whose
+        ownership the consumer can no longer prove, and the seqlock can
+        only detect that corruption, not prevent it.  Sequence:
+
+        1. terminate + join EVERY worker (after this, nothing writes the
+           shared block);
+        2. drain the done queue — completions that landed before the
+           stop are valid rendered batches and are kept;
+        3. drain the task queue — tasks nobody picked up would otherwise
+           be rendered twice after resubmission;
+        4. rebuild the free-slot list from first principles: every slot
+           not held by a kept completion is free (the dead worker's slot
+           comes back here);
+        5. respawn all workers and resubmit the lost tasks under the
+           SAME seq numbers — the in-order yield logic never notices the
+           failure, so the stream stays bit-identical to the synchronous
+           path.
+
+        Consecutive rebuilds with no yielded batch in between are
+        bounded by ``max_rebuilds`` — a worker that dies
+        deterministically on the same sample must surface as an error,
+        not an infinite respawn loop.
+        """
+        self._consecutive_rebuilds += 1
+        self.rebuilds_total += 1
+        if self._consecutive_rebuilds > self.max_rebuilds:
+            raise RuntimeError(
+                f"input ring rebuilt {self._consecutive_rebuilds - 1} "
+                "consecutive times without yielding a batch "
+                f"(max_rebuilds={self.max_rebuilds}); the worker failure "
+                f"looks deterministic — last: {why}")
+        t0 = time.perf_counter()
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=10.0)
+        # drain completions that raced the stop: current-generation "ok"
+        # tokens are finished batches (the data is in the slot and no
+        # worker can touch it now); anything else is reclaimed by the
+        # free-list rebuild below.  A killed writer can leave a torn
+        # pickle in the pipe — tolerated, the batch is simply re-counted
+        # as lost.
+        while True:
+            try:
+                kind, g, seq, payload = self._done_q.get(timeout=0.2)
+            except queue.Empty:
+                break
+            except Exception:  # noqa: BLE001 — torn write from the kill
+                continue
+            if kind == "ok" and g == gen and seq in meta:
+                completed[seq] = payload
+        # REPLACE both queues instead of reusing them: a worker killed
+        # mid-``get``/mid-``put`` dies holding the queue's shared lock,
+        # and every later operation on that queue (the respawned
+        # workers' get, their ready handshake) deadlocks forever — the
+        # documented terminate-vs-Queue hazard.  Replacing also discards
+        # any unpicked tasks still buffered in the old feeder thread, so
+        # a resubmitted task can never be rendered twice.
+        old_task_q, old_done_q = self._task_q, self._done_q
+        self._task_q = self._ctx.Queue()
+        self._done_q = self._ctx.Queue()
+        self._qholder[0] = self._task_q
+        for q in (old_task_q, old_done_q):
+            try:
+                q.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        held = {payload[0] for payload in completed.values()}
+        self._free = [s for s in range(self.slots) if s not in held]
+        # restore seqlock parity on every reclaimed slot: a worker
+        # SIGKILLed MID-WRITE leaves its slot's seq odd, and the
+        # respawned worker's parity-based bumps would then publish the
+        # re-rendered batch under an odd (apparently in-progress) seq —
+        # tripping _check_header on a perfectly good batch.  Every
+        # worker is dead here, so the consumer owns the block
+        # exclusively and the direct fix is race-free.
+        fix = np.frombuffer(self._shm.buf, np.int64,
+                            self.slots * _HEADER_INTS
+                            ).reshape(self.slots, _HEADER_INTS)
+        for s in self._free:
+            if fix[s, 0] % 2:
+                fix[s, 0] += 1
+        del fix  # release the buffer export before any later close()
+        lost = sorted(seq for seq in meta if seq not in completed)
+        for i in range(self.num_workers):
+            self._procs[i] = self._make_worker(i)
+            self._procs[i].start()
+        self._wait_ready(self._start_timeout)
+        for seq in lost:
+            epoch, batch_idx, idxs, _ = meta[seq]
+            slot = self._free.pop()
+            meta[seq] = (epoch, batch_idx, idxs, slot)
+            self._task_q.put((gen, seq, epoch, batch_idx, slot, idxs))
+        dt = time.perf_counter() - t0
+        if self._tele is not None and self._rebuilds_counter is not None:
+            self._rebuilds_counter.inc()
+        from ..obs.events import get_sink
+
+        get_sink().emit("ring_rebuild", reason=why[:500],
+                        lost_tasks=len(lost), kept_completions=len(held),
+                        rebuild_s=round(dt, 3),
+                        consecutive=self._consecutive_rebuilds)
 
     def _epoch_tasks(self, epoch: int, process_index: int,
                      process_count: int):
@@ -472,9 +631,11 @@ class ShmRingInput:
         Identical stream to ``data.batches(..., num_workers=0)`` on the
         same wire format: same epoch permutation, same host shard, same
         per-sample ``(seed, epoch, index)`` RNG, yields in batch order.
-        Worker failures raise (with the worker traceback); an abandoned
-        generator leaves in-flight slots to be reclaimed lazily by the
-        next generator.
+        Worker failures raise (with the worker traceback) — except a
+        *dead* worker under ``supervise=True``, which triggers a ring
+        rebuild (:meth:`_rebuild`) and the stream continues, still
+        bit-identical.  An abandoned generator leaves in-flight slots to
+        be reclaimed lazily by the next generator.
         """
         return self._run(self._epoch_tasks(epoch, process_index,
                                            process_count))
@@ -511,7 +672,9 @@ class ShmRingInput:
         self._gen += 1
         gen = self._gen
         pending = iter(task_iter)
-        meta = {}       # seq -> (epoch, batch_idx) of submitted tasks
+        # seq -> (epoch, batch_idx, indices, slot): everything needed to
+        # RE-render a task whose worker died (the supervised rebuild)
+        meta = {}
         completed = {}  # seq -> (slot, worker_id, render_s, t_start_mono)
         next_submit = 0
         next_yield = 0
@@ -527,7 +690,7 @@ class ShmRingInput:
                 return False
             epoch, batch_idx, idxs = task
             slot = self._free.pop()
-            meta[next_submit] = (epoch, batch_idx)
+            meta[next_submit] = (epoch, batch_idx, idxs, slot)
             self._task_q.put((gen, next_submit, epoch, batch_idx, slot, idxs))
             next_submit += 1
             return True
@@ -538,7 +701,7 @@ class ShmRingInput:
                     pass
                 while next_yield in completed:
                     slot, wid, render_s, t_start = completed.pop(next_yield)
-                    epoch, batch_idx = meta.pop(next_yield)
+                    epoch, batch_idx, _, _ = meta.pop(next_yield)
                     self._check_header(slot, epoch, batch_idx)
                     if trace.enabled:
                         # the worker's absolute monotonic start stamp
@@ -562,14 +725,22 @@ class ShmRingInput:
                         # eventually starves
                         self._free.append(slot)
                     next_yield += 1
+                    self._consecutive_rebuilds = 0  # real progress
                     submit()
                 if exhausted and next_yield >= next_submit:
                     return
                 t_stall = time.perf_counter() if self._tele is not None \
                     else 0.0
-                kind, g, seq, payload = self._next_done(
-                    what=f"batch {meta.get(next_yield, ('?', '?'))[1]} of "
-                         f"epoch {meta.get(next_yield, ('?', '?'))[0]}")
+                try:
+                    kind, g, seq, payload = self._next_done(
+                        what=f"batch "
+                             f"{meta.get(next_yield, ('?', '?'))[1]} of "
+                             f"epoch {meta.get(next_yield, ('?', '?'))[0]}")
+                except WorkerDied as e:
+                    if not self.supervise:
+                        raise
+                    self._rebuild(meta, completed, gen, str(e))
+                    continue
                 if self._tele is not None:
                     # blocked with nothing ready to yield: the workers
                     # (or the slot budget) are behind the consumer
@@ -583,7 +754,7 @@ class ShmRingInput:
                 if kind == "err":
                     slot, tb = payload
                     self._free.append(slot)
-                    epoch, batch_idx = meta.pop(seq, ("?", "?"))
+                    epoch, batch_idx = meta.pop(seq, ("?", "?", 0, 0))[:2]
                     raise RuntimeError(
                         f"input worker failed on batch {batch_idx} of epoch "
                         f"{epoch}:\n{tb}")

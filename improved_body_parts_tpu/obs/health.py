@@ -86,6 +86,15 @@ class HealthSentinel:
         self._status = "ok"
         self._ever_diverged = False
         self._last: dict = {}
+        # named extra state sources merged into the /healthz body (the
+        # run supervisor reports running/draining/backing-off here)
+        self._extra: dict = {}
+
+    def set_extra(self, name: str, fn) -> None:
+        """Merge ``{name: fn()}`` into every :meth:`state` — how other
+        subsystems (``train.supervisor``) surface their state on the
+        same ``/healthz`` body without a second endpoint."""
+        self._extra[str(name)] = fn
 
     # ------------------------------------------------------------ checks
     def check(self, loss: float, grad_norm: Optional[float] = None,
@@ -144,7 +153,7 @@ class HealthSentinel:
     def state(self) -> dict:
         """JSON-ready overall state — the ``/healthz`` body."""
         with self._lock:
-            return {
+            out = {
                 "status": self._status,
                 "policy": self.policy,
                 "grad_norm_limit": self.grad_norm_limit or None,
@@ -153,3 +162,9 @@ class HealthSentinel:
                 "ever_diverged": self._ever_diverged,
                 "last": dict(self._last),
             }
+        for name, fn in self._extra.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # noqa: BLE001 — a probe body must
+                out[name] = f"error: {type(e).__name__}"  # never 500
+        return out

@@ -4,12 +4,16 @@ from .mesh import (
     batch_spec,
     initialize_distributed,
     make_mesh,
+    mesh_topology,
     replicated,
+    reshard_replicated,
     shard_batch,
+    topology_mismatch,
 )
 from .prefetch import device_prefetch
 
 __all__ = [
     "barrier", "batch_sharding", "batch_spec", "device_prefetch",
-    "initialize_distributed", "make_mesh", "replicated", "shard_batch",
+    "initialize_distributed", "make_mesh", "mesh_topology", "replicated",
+    "reshard_replicated", "shard_batch", "topology_mismatch",
 ]

@@ -14,7 +14,7 @@ Mesh axes:
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -75,6 +75,87 @@ def make_mesh(data: Optional[int] = None, model: int = 1,
     assert data * model <= n, f"need {data * model} devices, have {n}"
     arr = np.asarray(devices[: data * model]).reshape(data, model)
     return Mesh(arr, axis_names=("data", "model"))
+
+
+def mesh_topology(mesh: Optional[Mesh] = None) -> Dict:
+    """JSON-able description of the device layout a run executes on.
+
+    Stamped into every checkpoint's ``COMMIT.json`` so a restart on a
+    *different* layout (a respawned spot slice with fewer chips, a
+    single-host debug resume of a pod checkpoint) is DETECTED at restore
+    time — not discovered as a cryptic sharding error deep inside the
+    first donated step.  ``train.supervisor`` compares this against the
+    restart's mesh via :func:`topology_mismatch`.
+    """
+    devices = jax.devices()
+    topo = {
+        "process_count": int(jax.process_count()),
+        "device_count": len(devices),
+        "platform": devices[0].platform if devices else None,
+    }
+    if mesh is not None:
+        topo["mesh_devices"] = int(mesh.devices.size)
+        topo["mesh_axes"] = {str(name): int(size) for name, size in
+                             zip(mesh.axis_names, mesh.devices.shape)}
+    return topo
+
+
+def topology_mismatch(stamped: Optional[Dict], mesh: Mesh,
+                      process_count: Optional[int] = None
+                      ) -> Optional[Dict[str, Tuple]]:
+    """Compare a checkpoint's stamped topology against the current one.
+
+    Returns ``{field: (stamped, current)}`` for every differing field, or
+    None when the layouts match (or the checkpoint predates the stamp —
+    a legacy checkpoint carries no topology and nothing can be checked).
+    Platform changes (tpu -> cpu) are reported too: numerically legal
+    after a reshard, but the operator should know their resume is not
+    running where the checkpoint was trained.
+    """
+    if not stamped:
+        return None
+    current = mesh_topology(mesh)
+    if process_count is not None:
+        current["process_count"] = int(process_count)
+    diff = {}
+    for key in ("process_count", "device_count", "platform",
+                "mesh_devices", "mesh_axes"):
+        if key in stamped and key in current \
+                and stamped[key] != current[key]:
+            diff[key] = (stamped[key], current[key])
+    return diff or None
+
+
+def reshard_replicated(tree, mesh: Mesh):
+    """Place a (restored, host-resident) state pytree onto ``mesh`` with
+    replicated sharding — the reshard-on-restore step for topology
+    changes.
+
+    Params/optimizer state are replicated under this repo's pure
+    data-parallel regime, so "resharding" to a different device count is
+    a re-placement: every leaf is broadcast to the new mesh's devices,
+    and placement failures surface HERE, at restore time, instead of as
+    a cryptic sharding error inside the first compiled step.
+
+    Call this ONLY when the topology actually changed (the new mesh
+    then forces a fresh step compile).  Re-placing restored host leaves
+    onto an UNCHANGED mesh hands committed arrays to a donated
+    executable loaded from the persistent compilation cache, which the
+    jax 0.4.37 CPU backend corrupts: the outputs jax returns were never
+    written (NaN losses from the second resumed step on) and the
+    executable's in-place writes land in buffers the runtime already
+    handed out (SIGSEGV mid-epoch).  Found end-to-end by
+    tools/chaos_train.py and reproduced deterministically; the
+    unchanged-topology resume keeps host leaves and lets the jit entry
+    place them — the path plain ``--resume auto`` has always taken.
+    ``may_alias=False`` keeps the placed leaves runtime-owned copies
+    rather than adoptions of the checkpoint reader's host buffers
+    (defense in depth against the same in-place-write quirk the save
+    path documents in ``train.checkpoint.snapshot_to_host``).
+    """
+    sharding = replicated(mesh)
+    return jax.tree.map(
+        lambda x: jax.device_put(x, sharding, may_alias=False), tree)
 
 
 def batch_spec(spatial_shard: bool = False) -> P:

@@ -150,6 +150,11 @@ class DynamicBatcher:
         self._dispatcher: Optional[threading.Thread] = None
         self._fetchers: "list[threading.Thread]" = []
         self._running = False
+        self._draining = False
+        # every admitted, unfinished request — what the bounded-deadline
+        # drain fails explicitly instead of stranding (guarded by
+        # _finish_lock, the same lock that makes _finish exactly-once)
+        self._inflight_reqs: "set[_Request]" = set()
         # per-replica batches dispatched whose device results are not yet
         # fetched — the dispatcher's "is a device idle" signal for idle
         # flushes and its least-loaded routing key
@@ -176,21 +181,50 @@ class DynamicBatcher:
             t.start()
         return self
 
-    def stop(self) -> None:
-        """Drain everything admitted, then shut down.  Every future
-        returned by :meth:`submit` before the stop completes."""
+    @property
+    def draining(self) -> bool:
+        """True once a graceful stop began: new submits are rejected
+        with :class:`ServerOverloaded` while admitted work drains."""
+        return self._draining
+
+    def stop(self, drain_timeout_s: Optional[float] = None) -> None:
+        """Graceful drain, then shut down.
+
+        Admission closes FIRST (new submits are rejected with
+        :class:`ServerOverloaded` — the status a load-balancer already
+        handles during rollout, unlike the old hard ``RuntimeError``),
+        then the queued buckets flush, the fetch pipelines drain, and
+        the decode pool joins.  With ``drain_timeout_s`` the whole drain
+        is bounded: past the deadline the remaining in-flight futures
+        fail with an explicit error instead of the caller hanging on a
+        wedged device — every future returned by :meth:`submit` always
+        completes, on time or by deadline.
+        """
         if not self._running:
             return
+        deadline = (None if drain_timeout_s is None
+                    else time.perf_counter() + drain_timeout_s)
+
+        def remaining() -> Optional[float]:
+            if deadline is None:
+                return None
+            return max(0.0, deadline - time.perf_counter())
+
+        # order matters: reject new admissions BEFORE the stop sentinel,
+        # so nothing can enqueue behind it and strand
+        self._draining = True
         self._running = False
         self._queue.put(_STOP)
-        self._dispatcher.join()
+        self._dispatcher.join(remaining())
+        expired = self._dispatcher.is_alive()  # daemon; dies with us
         self._dispatcher = None
         # the dispatcher flushed everything before exiting; now drain the
         # fetch pipelines behind it
         for q in self._fetchqs:
             q.put(_STOP)
         for t in self._fetchers:
-            t.join()
+            t.join(remaining())
+            expired = expired or t.is_alive()
         self._fetchers = []
         # a submit that raced the _running flip may have enqueued behind
         # the sentinel; fail those futures rather than hang their callers
@@ -201,8 +235,37 @@ class DynamicBatcher:
                 break
             if req is not _STOP and req is not _KICK:
                 self._finish(req, error=RuntimeError("batcher stopped"))
-        self._pool.shutdown(wait=True)
-        self._pool = None
+        if deadline is not None:
+            # bounded decode drain: poll the admitted-set down instead
+            # of an unbounded pool.shutdown(wait=True)
+            while remaining() > 0:
+                with self._finish_lock:
+                    if not self._inflight_reqs:
+                        break
+                time.sleep(0.005)
+            with self._finish_lock:
+                stranded = list(self._inflight_reqs)
+            # deadline hit with work still wedged in a stage (a hung
+            # device resolve, a stuck decode): fail every remaining
+            # future explicitly — _finish is exactly-once, so a stage
+            # that later completes one anyway is a harmless no-op
+            for req in stranded:
+                self._finish(req, error=RuntimeError(
+                    f"batcher stopped before completion (drain deadline "
+                    f"{drain_timeout_s}s exceeded)"))
+            wedged = bool(expired or stranded)
+            self._pool.shutdown(wait=not wedged)
+            # a wedged stage thread may still recover later and call
+            # self._pool.submit — keep the SHUT-DOWN executor so that
+            # raises the RuntimeError its inline-decode fallback
+            # handles (None would AttributeError and kill the thread);
+            # start() replaces the pool unconditionally
+            if not wedged:
+                self._pool = None
+        else:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        self._draining = False
 
     def __enter__(self) -> "DynamicBatcher":
         return self.start()
@@ -217,9 +280,17 @@ class DynamicBatcher:
         (coco_keypoints, score) tuples).
 
         :raises ServerOverloaded: ``max_queue`` requests already in
-            flight — fail-fast backpressure, nothing is queued.
+            flight (fail-fast backpressure, nothing is queued) — or the
+            batcher is DRAINING toward shutdown (same retry-with-backoff
+            contract: during a rolling restart the replacement instance
+            takes the retry).
         :raises RuntimeError: the batcher is not running.
         """
+        if self._draining:
+            self.metrics.on_reject()
+            raise ServerOverloaded(
+                "batcher is draining (shutdown in progress); retry "
+                "against a live instance")
         if not self._running:
             raise RuntimeError("DynamicBatcher is not running "
                                "(use `with batcher:` or call start())")
@@ -229,6 +300,8 @@ class DynamicBatcher:
                 f"{self.max_queue} requests in flight (max_queue); "
                 "retry with backoff")
         req = _Request(image_bgr)
+        with self._finish_lock:
+            self._inflight_reqs.add(req)
         trace = get_tracer()
         if trace.enabled:
             # one async span per request (enqueue -> fulfilment) plus a
@@ -422,6 +495,7 @@ class DynamicBatcher:
             if req.finished:
                 return
             req.finished = True
+            self._inflight_reqs.discard(req)
         trace = get_tracer()
         if trace.enabled:
             trace.async_end("request", req.rid, cat="serve",

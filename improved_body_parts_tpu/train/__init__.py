@@ -18,6 +18,14 @@ from .state import (
     update_swa,
 )
 from .step import make_eval_step, make_train_step, normalize_images
+from .supervisor import (
+    RunSupervisor,
+    StopRequested,
+    SupervisorGaveUp,
+    TopologyChanged,
+    milestone_eval,
+    reshard_on_topology_change,
+)
 
 __all__ = [
     "CheckpointManager", "is_committed", "latest_checkpoint",
@@ -28,4 +36,6 @@ __all__ = [
     "TrainState", "create_train_state", "make_optimizer", "start_swa",
     "swap_swa_params", "update_swa",
     "make_eval_step", "make_train_step", "normalize_images",
+    "RunSupervisor", "StopRequested", "SupervisorGaveUp",
+    "TopologyChanged", "milestone_eval", "reshard_on_topology_change",
 ]

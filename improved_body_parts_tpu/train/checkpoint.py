@@ -194,6 +194,8 @@ def save_checkpoint(directory: str, state: TrainState, epoch: int,
     API (tools/synth_ap.py's fresh-baseline checkpoints, tests, and the
     sync arm of tools/ckpt_bench.py).
     """
+    from ..parallel.mesh import mesh_topology
+
     path = os.path.abspath(os.path.join(directory, f"epoch_{epoch}"))
     host = snapshot_to_host(_payload(state, epoch, train_loss, best_loss))
     lead = jax.process_index() == 0
@@ -206,7 +208,8 @@ def save_checkpoint(directory: str, state: TrainState, epoch: int,
     if lead:
         _write_marker(path, _marker_meta(
             epoch, train_loss, best_loss, _tree_bytes(host),
-            time_unix=round(time.time(), 3)))
+            time_unix=round(time.time(), 3),
+            topology=mesh_topology()))
         try:
             os.remove(stamp)
         except OSError:
@@ -268,7 +271,7 @@ def restore_checkpoint(path: str, state: Optional[TrainState] = None
     meta = {k: payload[k] for k in ("epoch", "train_loss", "best_loss")}
     marker = read_commit_meta(path)
     if marker:
-        for k in ("best_loss", "metric", "metric_value"):
+        for k in ("best_loss", "metric", "metric_value", "topology"):
             if k in marker:
                 meta[k] = marker[k]
     return restored, meta
@@ -369,9 +372,15 @@ class CheckpointManager:
     def __init__(self, directory: str, *, async_save: bool = True,
                  keep_last_n: int = 0, keep_best: bool = True,
                  milestone_every: int = 0, is_lead_host: bool = True,
-                 registry=None, _commit_delay_s: float = 0.0):
+                 registry=None, topology: Optional[Dict[str, Any]] = None,
+                 _commit_delay_s: float = 0.0):
         self.directory = os.path.abspath(directory)
         self.async_save = bool(async_save)
+        # device layout stamped into every commit marker (None = stamp
+        # the process-global facts at save time); what restore-time
+        # topology-change detection (parallel.mesh.topology_mismatch /
+        # train.supervisor) compares against
+        self.topology = topology
         self.keep_last_n = int(keep_last_n)
         self.keep_best = bool(keep_best)
         self.milestone_every = int(milestone_every)
@@ -422,7 +431,9 @@ class CheckpointManager:
 
     @classmethod
     def from_config(cls, directory: str, train_cfg,
-                    is_lead_host: bool = True) -> "CheckpointManager":
+                    is_lead_host: bool = True,
+                    topology: Optional[Dict[str, Any]] = None
+                    ) -> "CheckpointManager":
         """Build from ``TrainConfig`` knobs (``async_checkpoint``,
         ``keep_last_n``, ``keep_best``, ``milestone_every``)."""
         return cls(directory,
@@ -430,7 +441,7 @@ class CheckpointManager:
                    keep_last_n=getattr(train_cfg, "keep_last_n", 0),
                    keep_best=getattr(train_cfg, "keep_best", True),
                    milestone_every=getattr(train_cfg, "milestone_every", 0),
-                   is_lead_host=is_lead_host)
+                   is_lead_host=is_lead_host, topology=topology)
 
     # ------------------------------------------------------------- save
     def save(self, state: TrainState, epoch: int, train_loss: float,
@@ -453,10 +464,15 @@ class CheckpointManager:
             host = snapshot_to_host(
                 _payload(state, epoch, train_loss, best_loss))
         snapshot_s = time.perf_counter() - t0
+        from ..parallel.mesh import mesh_topology
+
         nbytes = _tree_bytes(host)
         path = os.path.join(self.directory, f"epoch_{epoch}")
-        base_meta = _marker_meta(epoch, train_loss, best_loss, nbytes,
-                                 **{"async": self.async_save})
+        base_meta = _marker_meta(
+            epoch, train_loss, best_loss, nbytes,
+            topology=(self.topology if self.topology is not None
+                      else mesh_topology()),
+            **{"async": self.async_save})
         timings = {"wait_s": wait_s, "snapshot_s": snapshot_s}
         if self.is_lead_host:
             # in-flight stamp BEFORE the write starts: keeps a killed
@@ -572,6 +588,12 @@ class CheckpointManager:
                     ocp.PyTreeCheckpointer().save(path, host_tree,
                                                   force=True)
             serialize_s = time.perf_counter() - t0
+            # deterministic fault-injection point (tools/chaos_train.py):
+            # a kill HERE leaves a complete-looking but uncommitted
+            # directory — exactly what the commit protocol must survive
+            from .supervisor import chaos_kill_point
+
+            chaos_kill_point("mid_ckpt_write")
             if self._commit_delay_s:
                 time.sleep(self._commit_delay_s)
             t0 = time.perf_counter()

@@ -28,6 +28,7 @@ from ..parallel.prefetch import device_prefetch
 from ..utils import AverageMeter, StepTimer
 from . import checkpoint as ckpt
 from .state import TrainState
+from .supervisor import StopRequested, chaos_kill_point
 
 
 def _log_line(checkpoint_dir: str, text: str) -> None:
@@ -43,9 +44,18 @@ def train_epoch(state: TrainState, train_step: Callable,
                 is_lead_host: bool = True,
                 log_fn: Callable[[str], None] = print,
                 prefetch_depth: int = 2,
-                telemetry=None
+                telemetry=None,
+                should_stop: Optional[Callable[[], bool]] = None
                 ) -> Tuple[TrainState, float]:
     """Run one epoch; returns (state, mean loss).
+
+    ``should_stop`` is the elastic-training stop-point predicate
+    (``train.supervisor.RunSupervisor.should_stop``): it is checked at
+    each window readback — the boundary where the device has already
+    drained — and a True raises :class:`supervisor.StopRequested`, which
+    unwinds through ``fit``'s flush path (the in-flight checkpoint write
+    lands before the process exits).  The partial epoch is discarded;
+    resume restarts it from the last committed checkpoint.
 
     ``batches`` yields (images, mask_miss, labels) host arrays — or
     (images, mask_miss, joints, mask_all) when ``train_step`` was built
@@ -190,6 +200,14 @@ def train_epoch(state: TrainState, train_step: Callable,
                         f"==> Epoch [{epoch}][{step_idx + 1}] "
                         f"loss {losses.val:.6f} ({losses.avg:.6f}) "
                         f"imgs/s {global_batch / max(dt, 1e-9):.1f}")
+                chaos_kill_point("window")
+                if should_stop is not None and should_stop():
+                    # window boundary: the readback above already synced
+                    # the device, so stopping HERE loses only the steps
+                    # since the last committed checkpoint
+                    raise StopRequested(
+                        f"stop requested at epoch {epoch} step "
+                        f"{step_idx + 1} (window boundary)")
 
         n_tail = len(pending)
         tail_vals = [(float(v), bs, g) for v, bs, g in pending]
@@ -203,7 +221,8 @@ def train_epoch(state: TrainState, train_step: Callable,
             close_window(tail_vals, n_tail, step_idx + 1,
                          timer.mark(n_tail), partial=True)
     except Exception as e:
-        if telemetry is not None and not isinstance(e, DivergenceError):
+        if telemetry is not None and not isinstance(
+                e, (DivergenceError, StopRequested)):
             # the step loop died — name the resident device buffers
             # before unwinding (an HBM OOM post-mortem's first question);
             # a sentinel halt carries its own diagnosis and skips this.
@@ -240,6 +259,7 @@ def eval_epoch(state: TrainState, eval_step: Callable, batches: Iterable,
         batches = device_prefetch(batches, mesh, depth=prefetch_depth)
     pending = []
     for batch in batches:
+        chaos_kill_point("mid_eval")
         pending.append((eval_step(state, *batch), batch[0].shape[0]))
         if len(pending) >= readback_freq:
             for loss, bs in pending:
@@ -260,7 +280,8 @@ def fit(state: TrainState, train_step: Callable, config: Config,
         log_fn: Callable[[str], None] = print,
         best_loss: float = float("inf"),
         telemetry=None,
-        checkpoint_manager=None) -> TrainState:
+        checkpoint_manager=None,
+        should_stop: Optional[Callable[[], bool]] = None) -> TrainState:
     """Multi-epoch driver with async per-epoch checkpoint + log
     (reference: train_distributed.py:300-324, 441-444).
 
@@ -299,8 +320,11 @@ def fit(state: TrainState, train_step: Callable, config: Config,
     owns_manager = checkpoint_manager is None
     manager = checkpoint_manager
     if manager is None:
+        from ..parallel.mesh import mesh_topology
+
         manager = ckpt.CheckpointManager.from_config(
-            checkpoint_dir, tr, is_lead_host=is_lead_host)
+            checkpoint_dir, tr, is_lead_host=is_lead_host,
+            topology=mesh_topology(mesh))
     save_freq = max(1, int(getattr(tr, "save_freq", 1) or 1))
     eval_freq = max(1, int(getattr(tr, "eval_freq", 1) or 1))
     last_epoch = start_epoch + epochs - 1
@@ -309,7 +333,7 @@ def fit(state: TrainState, train_step: Callable, config: Config,
             state, train_loss = train_epoch(
                 state, train_step, make_batches(epoch), config, epoch,
                 mesh=mesh, is_lead_host=is_lead_host, log_fn=log_fn,
-                telemetry=telemetry)
+                telemetry=telemetry, should_stop=should_stop)
             if is_lead_host:
                 _log_line(checkpoint_dir,
                           f"\nEpoch {epoch}\ttrain_loss: {train_loss}")
@@ -330,6 +354,7 @@ def fit(state: TrainState, train_step: Callable, config: Config,
                 # the snapshot drain blocks here, the write overlaps the
                 # eval below (and epoch+1's steps)
                 manager.save(state, epoch, train_loss, best_loss)
+                chaos_kill_point("post_save")
             val_loss = None
             if do_eval:
                 with get_tracer().span("eval_epoch", track="eval",
@@ -362,6 +387,13 @@ def fit(state: TrainState, train_step: Callable, config: Config,
                 if do_save:
                     fields["saved"] = True
                 telemetry.emit("epoch", **fields)
+            if should_stop is not None and should_stop() \
+                    and epoch != last_epoch:
+                # epoch boundary: this epoch's save is already kicked
+                # off; the unwind below flushes it before the process
+                # exits, so the stop loses zero completed work
+                raise StopRequested(
+                    f"stop requested at epoch {epoch} boundary")
     except BaseException:
         # a sentinel halt (obs.DivergenceError) or any crash must still
         # flush the in-flight write — the run that just died is exactly

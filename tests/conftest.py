@@ -42,6 +42,8 @@ _HEAVY_MODULES = frozenset({
                                 # several (bucket x batch-size) combos
     "test_checkpoint_async.py", # real donated train-step compile + a
                                 # SIGKILLed subprocess + many orbax writes
+    "test_supervisor.py",       # chaos smoke = several full train.py
+                                # subprocesses; topology subprocess pair
 })
 # Individually heavy tests inside otherwise-quick modules.
 _HEAVY_TESTS = frozenset({

@@ -416,3 +416,76 @@ def test_metrics_endpoint_serves_batcher_under_load(warm_pred):
     assert "serve_completed_total 12.0" in body
     assert 'serve_latency_seconds{quantile="0.99"}' in body
     assert "serve_imgs_per_sec" in body
+
+
+def test_graceful_drain_flushes_queued_and_rejects_new(warm_pred):
+    """stop(drain_timeout_s=...) closes admission FIRST (ServerOverloaded
+    — the status a load balancer already retries on during rollout),
+    then flushes everything admitted: no queued request is stranded."""
+    from improved_body_parts_tpu.serve import (
+        DynamicBatcher, ServerOverloaded)
+
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    ref = _reference(warm_pred, img)
+    gate = threading.Event()
+    gated = GatedPredictor(warm_pred, gate)
+    server = DynamicBatcher(gated, max_batch=1, max_wait_ms=5,
+                            max_queue=8, use_native=False).start()
+    f1 = server.submit(img)
+    f2 = server.submit(img)
+    time.sleep(0.05)  # let the dispatcher park on the gate
+    stopper = threading.Thread(target=lambda: server.stop(
+        drain_timeout_s=120.0))
+    stopper.start()
+    deadline = time.time() + 10
+    while not server.draining and time.time() < deadline:
+        time.sleep(0.005)
+    assert server.draining
+    with pytest.raises(ServerOverloaded, match="draining"):
+        server.submit(img)
+    gate.set()  # device 'recovers': the admitted work drains out
+    stopper.join(timeout=120)
+    assert not stopper.is_alive()
+    # both admitted futures completed with real results — not stranded
+    _assert_same_people(f1.result(timeout=0), ref)
+    _assert_same_people(f2.result(timeout=0), ref)
+
+
+def test_drain_deadline_fails_stranded_futures(warm_pred):
+    """A wedged device must not hang shutdown forever: past
+    drain_timeout_s every still-in-flight future fails with an explicit
+    error — every future submit() ever returned always completes."""
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    gate = threading.Event()  # never set until after: device is wedged
+    gated = GatedPredictor(warm_pred, gate)
+    server = DynamicBatcher(gated, max_batch=1, max_wait_ms=5,
+                            max_queue=8, use_native=False).start()
+    f1 = server.submit(img)
+    time.sleep(0.05)
+    t0 = time.perf_counter()
+    server.stop(drain_timeout_s=1.5)
+    assert time.perf_counter() - t0 < 30.0  # bounded, not wait-forever
+    with pytest.raises(RuntimeError, match="drain deadline"):
+        f1.result(timeout=0)
+    gate.set()  # release the parked daemon thread (exactly-once _finish
+    # makes its late completion a harmless no-op)
+
+
+def test_stop_without_deadline_still_drains_everything(warm_pred):
+    """The historical contract unchanged: a deadline-less stop() waits
+    for every admitted request."""
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    ref = _reference(warm_pred, img)
+    server = DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                            use_native=False).start()
+    server.warmup([SIZE_A], batch_sizes=(1, 2))
+    futs = [server.submit(img) for _ in range(4)]
+    server.stop()
+    for f in futs:
+        _assert_same_people(f.result(timeout=0), ref)
+    snap = server.metrics.snapshot()
+    assert snap["completed"] == 4 and snap["failed"] == 0

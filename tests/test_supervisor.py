@@ -1,0 +1,770 @@
+"""Elastic training (``train.supervisor`` + friends).
+
+Covers the PR-6 contracts end to end:
+
+- failure classification (transient infra patterns vs deterministic
+  crashes), the run ledger in RUN.json, exponential backoff, the bounded
+  crash budget and the absolute restart bound;
+- SIGTERM/SIGINT → clean stop at the next step-window boundary
+  (``StopRequested`` out of ``fit``, in-flight checkpoint flushed,
+  partial epoch discarded);
+- topology stamping + topology-change restore: a checkpoint written
+  under one device layout restores under another by RESHARDING onto the
+  new mesh (``--reshard adjust``) or refusing with an actionable error
+  (``refuse``) — never a silent wrong-sharding step (subprocess pair:
+  1-device writer, 2-device reader, real donated jitted step after);
+- supervised shm-ring rebuild: a killed input worker rebuilds the ring
+  and the stream stays bit-identical to sync; consecutive rebuilds are
+  bounded;
+- ``/healthz`` carries the supervisor state; ``telemetry_report``
+  stitches same-``run_id`` segments into one logical run;
+- the chaos fault-injection harness (``tools/chaos_train.py``): the
+  deterministic 2-kill smoke runs tier-1 (seed 6 = one external SIGTERM
+  drain + one in-process window SIGKILL); the full randomized 8-kill
+  sweep with the bit-match against an uninterrupted control run is
+  ``slow`` (its committed artifact is CHAOS.json).
+"""
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import get_config
+from improved_body_parts_tpu.train.checkpoint import (
+    is_committed,
+    latest_checkpoint,
+    read_commit_meta,
+    save_checkpoint,
+)
+from improved_body_parts_tpu.train.state import TrainState
+from improved_body_parts_tpu.train.supervisor import (
+    RunSupervisor,
+    StopRequested,
+    SupervisorGaveUp,
+    chaos_kill_point,
+    classify_error,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _dummy_state(v=1.0):
+    return TrainState(params={"w": jnp.full((8, 8), v)}, batch_stats={},
+                      opt_state=(), step=jnp.asarray(0, jnp.int32))
+
+
+def _sup(directory, **kw):
+    """Supervisor with recorded (not slept) backoffs and silenced logs."""
+    sleeps = []
+    sup = RunSupervisor(str(directory), sleep=sleeps.append,
+                        log_fn=lambda s: None, **kw)
+    return sup, sleeps
+
+
+# ------------------------------------------------------------------ #
+# failure classification
+# ------------------------------------------------------------------ #
+class TestClassification:
+    @pytest.mark.parametrize("msg", [
+        "XlaRuntimeError: UNAVAILABLE: socket closed",
+        "DEADLINE_EXCEEDED while waiting for coordination service",
+        "RuntimeError: input worker died while the consumer waited",
+        "the TPU VM was preempted by the scheduler",
+        "connection reset by peer",
+        "barrier timed out after 120s",
+    ])
+    def test_infrastructure_patterns_are_transient(self, msg):
+        assert classify_error(msg) == "transient"
+
+    @pytest.mark.parametrize("msg", [
+        "ValueError: shapes (3, 4) and (5,) are incompatible",
+        "KeyError: 'params'",
+        "ZeroDivisionError: division by zero",
+    ])
+    def test_program_bugs_are_deterministic(self, msg):
+        assert classify_error(msg) == "deterministic"
+
+    def test_diagnosed_determinism_beats_quoted_transient_text(self):
+        """The shm ring's rebuild-budget error QUOTES the WorkerDied
+        message ('input worker died...'); the explicit 'looks
+        deterministic' diagnosis must win, or a deterministically
+        crashing worker would be retried as transient forever."""
+        msg = ("RuntimeError: input ring rebuilt 3 consecutive times "
+               "without yielding a batch (max_rebuilds=3); the worker "
+               "failure looks deterministic — last: input worker died "
+               "while the consumer waited (exitcode=-11)")
+        assert classify_error(msg) == "deterministic"
+
+
+# ------------------------------------------------------------------ #
+# ledger: segments, backoff, budgets
+# ------------------------------------------------------------------ #
+class TestLedger:
+    def test_fresh_run_opens_segment_zero(self, tmp_path):
+        sup, sleeps = _sup(tmp_path)
+        rec = sup.open_segment({"argv": ["--epochs", "3"]})
+        assert rec["segment"] == 0 and rec["previous_end"] == "fresh"
+        assert sleeps == []  # no backoff on a fresh start
+        assert sup.state() == "running"
+        with open(tmp_path / "RUN.json") as f:
+            ledger = json.load(f)
+        assert ledger["run_id"] == sup.run_id
+        assert ledger["segments"][0]["argv"] == ["--epochs", "3"]
+
+    def test_run_id_stable_and_segments_increment(self, tmp_path):
+        s0, _ = _sup(tmp_path)
+        s0.open_segment()
+        s0.close_segment("preempted", "stop requested")
+        s1, sleeps = _sup(tmp_path)
+        assert s1.run_id == s0.run_id and s1.segment == 1
+        rec = s1.open_segment()
+        # a clean preemption restarts immediately: capacity came back
+        assert rec["previous_end"] == "preemption" and sleeps == []
+
+    def test_killed_without_progress_backs_off_exponentially(self, tmp_path):
+        # three hard kills (record left "running"), no commit in between
+        s0, _ = _sup(tmp_path)
+        s0.open_segment()  # never closed: the process was SIGKILLed
+        s1, sl1 = _sup(tmp_path)
+        assert s1.open_segment()["previous_end"] == "killed"
+        assert sl1 == [1.0]
+        s2, sl2 = _sup(tmp_path)
+        s2.open_segment()
+        assert sl2 == [2.0]  # doubles per consecutive no-progress failure
+        s3, sl3 = _sup(tmp_path, backoff_max_s=3.0)
+        s3.open_segment()
+        assert sl3 == [3.0]  # capped
+
+    def test_committed_progress_resets_the_failure_streak(self, tmp_path):
+        s0, _ = _sup(tmp_path)
+        s0.open_segment()  # killed
+        s1, sl1 = _sup(tmp_path)
+        s1.open_segment()
+        assert sl1 == [1.0]
+        # an epoch commits before the next death: the failure streak and
+        # the backoff reset — the run IS making progress
+        save_checkpoint(str(tmp_path), _dummy_state(), 0,
+                        train_loss=1.0, best_loss=1.0)
+        s2, sl2 = _sup(tmp_path)
+        rec = s2.open_segment()
+        assert sl2 == [] and rec["epoch_committed"] == 0
+
+    def test_deterministic_crash_loop_exhausts_the_budget(self, tmp_path):
+        s0, _ = _sup(tmp_path, crash_budget=2)
+        s0.open_segment()
+        s0.close_segment("crashed", "ValueError: boom")
+        s1, _ = _sup(tmp_path, crash_budget=2)
+        rec = s1.open_segment()
+        assert rec["previous_end"] == "deterministic"
+        s1.close_segment("crashed", "ValueError: boom")
+        s2, _ = _sup(tmp_path, crash_budget=2)
+        with pytest.raises(SupervisorGaveUp, match="looks deterministic"):
+            s2.open_segment()
+
+    def test_transient_crashes_never_trip_the_crash_budget(self, tmp_path):
+        err = "XlaRuntimeError: UNAVAILABLE: socket closed"
+        for i in range(4):
+            s, _ = _sup(tmp_path, crash_budget=2)
+            rec = s.open_segment()
+            if i:
+                assert rec["previous_end"] == "transient"
+            s.close_segment("crashed", err)
+
+    def test_max_restarts_bounds_any_classification(self, tmp_path):
+        for _ in range(2):
+            s, _ = _sup(tmp_path, max_restarts=2)
+            s.open_segment()
+            s.close_segment("preempted")
+        s, _ = _sup(tmp_path, max_restarts=2)
+        with pytest.raises(SupervisorGaveUp, match="max_restarts"):
+            s.open_segment()
+
+    def test_manifest_merges_without_clobbering_the_ledger(self, tmp_path):
+        sup, _ = _sup(tmp_path)
+        sup.open_segment()
+        sup.update_manifest({"tool": "train", "config": "tiny"})
+        with open(tmp_path / "RUN.json") as f:
+            data = json.load(f)
+        assert data["tool"] == "train"
+        assert data["segments"][0]["status"] == "running"
+
+    def test_close_records_leak_evidence(self, tmp_path):
+        sup, _ = _sup(tmp_path)
+        sup.open_segment()
+        sup.close_segment("completed")
+        rec = sup._segment_record()
+        assert rec["status"] == "completed"
+        assert "end_unix" in rec
+
+
+# ------------------------------------------------------------------ #
+# in-process failure decisions (on_failure)
+# ------------------------------------------------------------------ #
+class TestOnFailure:
+    def test_transient_retries_with_backoff(self, tmp_path):
+        sup, sleeps = _sup(tmp_path, crash_budget=3)
+        sup.open_segment()
+        exc = RuntimeError("UNAVAILABLE: connection reset by peer")
+        assert sup.on_failure(exc) == "retry"
+        assert sleeps == [1.0]
+        assert sup.on_failure(exc) == "retry"
+        assert sleeps == [1.0, 2.0]
+
+    def test_deterministic_raises_and_records_the_crash(self, tmp_path):
+        sup, _ = _sup(tmp_path)
+        sup.open_segment()
+        assert sup.on_failure(ValueError("bad shape")) == "raise"
+        rec = sup._segment_record()
+        assert rec["status"] == "crashed"
+        assert "ValueError" in rec["error"]
+        # the NEXT process classifies from the record
+        nxt, _ = _sup(tmp_path)
+        assert nxt.open_segment()["previous_end"] == "deterministic"
+
+    def test_transient_budget_exhausts_without_progress(self, tmp_path):
+        sup, _ = _sup(tmp_path, crash_budget=2)
+        sup.open_segment()
+        exc = RuntimeError("DEADLINE_EXCEEDED")
+        assert sup.on_failure(exc) == "retry"
+        assert sup.on_failure(exc) == "raise"  # 2nd no-progress attempt
+
+    def test_committed_epoch_resets_the_attempt_streak(self, tmp_path):
+        sup, _ = _sup(tmp_path, crash_budget=2)
+        sup.open_segment()
+        exc = RuntimeError("DEADLINE_EXCEEDED")
+        assert sup.on_failure(exc) == "retry"
+        save_checkpoint(str(tmp_path), _dummy_state(), 0,
+                        train_loss=1.0, best_loss=1.0)
+        assert sup.on_failure(exc) == "retry"  # progress since last try
+
+
+# ------------------------------------------------------------------ #
+# signals and stop-points
+# ------------------------------------------------------------------ #
+class TestStopRequest:
+    def test_sigterm_requests_a_drain(self, tmp_path):
+        sup, _ = _sup(tmp_path)
+        sup.install_signal_handlers()
+        try:
+            assert not sup.should_stop()
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.time() + 5
+            while not sup.should_stop() and time.time() < deadline:
+                time.sleep(0.01)
+            assert sup.should_stop()
+            assert sup.state() == "draining"
+        finally:
+            sup.uninstall_signal_handlers()
+
+    def test_stop_honoured_at_window_boundary_after_flush(self, tmp_path):
+        """A stop requested during epoch 1 raises StopRequested at the
+        first window readback; epoch 0's checkpoint (kicked off at the
+        epoch boundary) is flushed and committed by the unwind, and the
+        partial epoch 1 leaves no debris."""
+        from improved_body_parts_tpu.train.loop import fit
+
+        cfg = get_config("tiny")
+        cfg = cfg.replace(train=dataclasses.replace(
+            cfg.train, checkpoint_dir=str(tmp_path), print_freq=1))
+        current = [0]
+
+        def make_batches(epoch):
+            current[0] = epoch
+
+            def gen():
+                for _ in range(3):
+                    yield (np.ones((1, 8, 8, 3), np.float32),)
+            return gen()
+
+        with pytest.raises(StopRequested, match="window boundary"):
+            fit(_dummy_state(), lambda s, imgs: (s, np.float32(0.5)),
+                cfg, make_batches, epochs=4,
+                should_stop=lambda: current[0] >= 1,
+                log_fn=lambda s: None)
+        e0 = os.path.join(str(tmp_path), "epoch_0")
+        assert latest_checkpoint(str(tmp_path)) == e0
+        assert is_committed(e0)
+        assert not os.path.isdir(os.path.join(str(tmp_path), "epoch_1"))
+
+    def test_stop_at_epoch_boundary_keeps_that_epochs_save(self, tmp_path):
+        from improved_body_parts_tpu.train.loop import fit
+
+        cfg = get_config("tiny")
+        cfg = cfg.replace(train=dataclasses.replace(
+            cfg.train, checkpoint_dir=str(tmp_path)))
+        stop = [False]
+
+        def make_batches(epoch):
+            def gen():
+                yield (np.ones((1, 8, 8, 3), np.float32),)
+                stop[0] = True  # request lands mid-epoch, after the
+                # only window of this tiny epoch has been consumed
+            return gen()
+
+        with pytest.raises(StopRequested, match="epoch 0 boundary"):
+            fit(_dummy_state(), lambda s, imgs: (s, np.float32(0.5)),
+                cfg, make_batches, epochs=3,
+                should_stop=lambda: stop[0], log_fn=lambda s: None)
+        # the stop loses ZERO completed work: epoch 0 saved + committed
+        assert is_committed(os.path.join(str(tmp_path), "epoch_0"))
+
+
+class TestChaosKillPoint:
+    def test_noop_without_the_env_knob(self, monkeypatch):
+        monkeypatch.delenv("IBP_CHAOS_KILL", raising=False)
+        chaos_kill_point("window")  # must simply return
+
+    def test_sigkill_at_the_nth_hit(self, tmp_path):
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from improved_body_parts_tpu.train.supervisor import "
+            "chaos_kill_point\n"
+            "chaos_kill_point('pt'); print('one', flush=True)\n"
+            "chaos_kill_point('other'); print('two', flush=True)\n"
+            "chaos_kill_point('pt'); print('never', flush=True)\n"
+            % REPO)
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=120,
+            env={**os.environ, "IBP_CHAOS_KILL": "pt:2",
+                 "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == -signal.SIGKILL
+        assert "one" in r.stdout and "two" in r.stdout
+        assert "never" not in r.stdout
+
+
+# ------------------------------------------------------------------ #
+# topology stamping + reshard-on-restore
+# ------------------------------------------------------------------ #
+class TestTopology:
+    def test_matching_layout_is_no_mismatch(self):
+        from improved_body_parts_tpu.parallel import (make_mesh,
+                                                      mesh_topology,
+                                                      topology_mismatch)
+
+        mesh = make_mesh()
+        stamped = mesh_topology(mesh)
+        assert topology_mismatch(stamped, mesh, 1) is None
+
+    def test_legacy_checkpoint_without_stamp_is_unchecked(self):
+        from improved_body_parts_tpu.parallel import (make_mesh,
+                                                      topology_mismatch)
+
+        assert topology_mismatch(None, make_mesh()) is None
+        assert topology_mismatch({}, make_mesh()) is None
+
+    def test_changed_fields_are_reported(self):
+        from improved_body_parts_tpu.parallel import (make_mesh,
+                                                      mesh_topology,
+                                                      topology_mismatch)
+
+        mesh = make_mesh()
+        stamped = dict(mesh_topology(mesh))
+        stamped["device_count"] = 256
+        stamped["process_count"] = 32
+        diff = topology_mismatch(stamped, mesh, 1)
+        assert diff["device_count"] == (256, jax.device_count())
+        assert diff["process_count"] == (32, 1)
+        assert "platform" not in diff
+
+    def test_commit_marker_carries_the_topology(self, tmp_path):
+        save_checkpoint(str(tmp_path), _dummy_state(), 0,
+                        train_loss=1.0, best_loss=1.0)
+        meta = read_commit_meta(os.path.join(str(tmp_path), "epoch_0"))
+        topo = meta["topology"]
+        assert topo["device_count"] == jax.device_count()
+        assert topo["process_count"] == 1
+        assert topo["platform"] == "cpu"
+
+
+_TOPO_WRITER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax, jax.numpy as jnp
+from improved_body_parts_tpu.parallel import make_mesh, mesh_topology, \\
+    replicated
+from improved_body_parts_tpu.train.checkpoint import CheckpointManager
+from improved_body_parts_tpu.train.state import TrainState
+
+assert jax.device_count() == 1
+mesh = make_mesh()
+state = TrainState(params={{"w": jnp.full((8, 8), 3.0)}}, batch_stats={{}},
+                   opt_state=(), step=jnp.asarray(5, jnp.int32))
+state = jax.device_put(state, replicated(mesh))
+with CheckpointManager(sys.argv[1], topology=mesh_topology(mesh)) as m:
+    m.save(state, 0, train_loss=1.0, best_loss=1.0)
+print("SAVED", flush=True)
+"""
+
+_TOPO_READER = """
+import functools, os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax, jax.numpy as jnp
+import numpy as np
+from improved_body_parts_tpu.parallel import make_mesh, shard_batch
+from improved_body_parts_tpu.train.state import TrainState
+from improved_body_parts_tpu.train.supervisor import (RunSupervisor,
+                                                      TopologyChanged)
+
+assert jax.device_count() == 2
+d = sys.argv[1]
+mesh = make_mesh()
+template = TrainState(params={{"w": jnp.zeros((8, 8))}}, batch_stats={{}},
+                      opt_state=(), step=jnp.asarray(0, jnp.int32))
+
+# refuse: an actionable error, never a silent wrong-sharding step
+try:
+    RunSupervisor(d, reshard="refuse",
+                  log_fn=lambda s: None).resume(template, mesh)
+    print("REFUSE_MISSED", flush=True)
+except TopologyChanged as e:
+    assert "--reshard adjust" in str(e), str(e)
+    print("REFUSED", flush=True)
+
+# adjust: re-place onto the 2-device mesh, then take a REAL donated
+# jitted step over a batch sharded across both devices
+sup = RunSupervisor(d, reshard="adjust", log_fn=lambda s: None)
+state, meta, change = sup.resume(template, mesh)
+assert meta["epoch"] == 0
+assert change is not None and "device_count" in change, change
+for leaf in jax.tree.leaves(state):
+    assert len(leaf.sharding.device_set) == 2, leaf.sharding
+print("RESHARDED", flush=True)
+
+@functools.partial(jax.jit, donate_argnums=0)
+def step(s, batch):
+    scale = 1.0 - 0.001 * batch.mean()
+    return jax.tree.map(
+        lambda x: x * scale if jnp.issubdtype(x.dtype, jnp.floating)
+        else x, s)
+
+batch = shard_batch(np.ones((4, 8, 8, 3), np.float32), mesh)
+state = step(state, batch)
+jax.block_until_ready(state)
+w = float(np.asarray(state.params["w"])[0, 0])
+assert abs(w - 3.0 * 0.999) < 1e-6, w
+print("STEPPED", flush=True)
+"""
+
+
+class TestTopologyChangeRestore:
+    def test_restore_under_doubled_device_count(self, tmp_path):
+        """Checkpoint written under 1 CPU device restores under 2:
+        refuse errors out actionably; adjust reshards (every leaf on
+        both devices) and a donated jitted step runs on the new mesh."""
+        d = str(tmp_path / "ck")
+        writer = tmp_path / "writer.py"
+        writer.write_text(_TOPO_WRITER.format(repo=REPO))
+        reader = tmp_path / "reader.py"
+        reader.write_text(_TOPO_READER.format(repo=REPO))
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        r = subprocess.run([sys.executable, str(writer), d],
+                           capture_output=True, text=True, timeout=600,
+                           env=env)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "SAVED" in r.stdout
+        meta = read_commit_meta(os.path.join(d, "epoch_0"))
+        assert meta["topology"]["device_count"] == 1
+
+        r = subprocess.run([sys.executable, str(reader), d],
+                           capture_output=True, text=True, timeout=600,
+                           env=env)
+        assert r.returncode == 0, r.stderr[-2000:]
+        for marker in ("REFUSED", "RESHARDED", "STEPPED"):
+            assert marker in r.stdout, r.stdout
+        assert "REFUSE_MISSED" not in r.stdout
+
+
+# ------------------------------------------------------------------ #
+# supervised shm-ring rebuild
+# ------------------------------------------------------------------ #
+class TestSupervisedRing:
+    @pytest.fixture(scope="class")
+    def fixture_path(self, tmp_path_factory):
+        from improved_body_parts_tpu.data import build_fixture
+
+        path = str(tmp_path_factory.mktemp("sup_ring") / "fixture.h5")
+        assert build_fixture(path, num_images=6, people_per_image=2,
+                             seed=2) > 0
+        return path
+
+    def test_worker_kill_rebuilds_and_stream_stays_bit_identical(
+            self, fixture_path):
+        """Killing every worker mid-epoch under supervise=True rebuilds
+        the ring and the stream completes BIT-IDENTICAL to the sync
+        path — lost tasks re-render under the same seq numbers."""
+        from improved_body_parts_tpu.data import (CocoPoseDataset,
+                                                  ShmRingInput, batches)
+
+        cfg = get_config("tiny")
+        ds = CocoPoseDataset(fixture_path, cfg, augment=True, seed=11)
+        sync = list(batches(ds, 2, epoch=0, wire="uint8"))
+        with ShmRingInput(ds, 2, num_workers=2, wire="uint8",
+                          supervise=True) as ring:
+            it = ring.batches(0)
+            got = [tuple(np.copy(x) for x in next(it))]
+            for p in ring._procs:
+                p.kill()
+            got += [tuple(np.copy(x) for x in b) for b in it]
+            assert ring.rebuilds_total >= 1
+        assert len(got) == len(sync) >= 3
+        for a, b in zip(sync, got):
+            for x, y in zip(a, b):
+                assert x.dtype == y.dtype
+                np.testing.assert_array_equal(x, y)
+        ds.close()
+
+    def test_unsupervised_ring_still_fails_loudly(self, fixture_path):
+        from improved_body_parts_tpu.data import (CocoPoseDataset,
+                                                  ShmRingInput)
+        from improved_body_parts_tpu.data.shm_ring import WorkerDied
+
+        cfg = get_config("tiny")
+        ds = CocoPoseDataset(fixture_path, cfg, augment=False)
+        with ShmRingInput(ds, 2, num_workers=1, wire="uint8") as ring:
+            it = ring.batches(0)
+            next(it)
+            ring._procs[0].kill()
+            with pytest.raises(WorkerDied, match="worker died"):
+                list(it)
+        ds.close()
+
+    def test_rebuild_budget_bounds_deterministic_worker_death(
+            self, fixture_path):
+        """max_rebuilds consecutive no-yield rebuilds surface as an
+        error, not an infinite respawn loop."""
+        from improved_body_parts_tpu.data import (CocoPoseDataset,
+                                                  ShmRingInput)
+
+        cfg = get_config("tiny")
+        ds = CocoPoseDataset(fixture_path, cfg, augment=False)
+        with ShmRingInput(ds, 2, num_workers=1, wire="uint8",
+                          supervise=True, max_rebuilds=0) as ring:
+            it = ring.batches(0)
+            next(it)
+            ring._procs[0].kill()
+            with pytest.raises(RuntimeError, match="looks deterministic"):
+                list(it)
+        ds.close()
+
+
+# ------------------------------------------------------------------ #
+# healthz + segment stitching
+# ------------------------------------------------------------------ #
+class TestObservability:
+    def test_healthz_reports_supervisor_state(self, tmp_path):
+        from improved_body_parts_tpu.obs.health import HealthSentinel
+
+        sentinel = HealthSentinel()
+        sup, _ = _sup(tmp_path)
+        sup.open_segment()
+
+        class Tele:
+            health = sentinel
+        sup.bind(Tele())
+        body = sentinel.state()
+        assert body["supervisor"]["state"] == "running"
+        assert body["supervisor"]["run_id"] == sup.run_id
+        sup.request_stop()
+        assert sentinel.state()["supervisor"]["state"] == "draining"
+
+    def test_healthz_extra_errors_never_break_the_probe(self):
+        from improved_body_parts_tpu.obs.health import HealthSentinel
+
+        sentinel = HealthSentinel()
+        sentinel.set_extra("boom", lambda: 1 / 0)
+        assert sentinel.state()["boom"] == "error: ZeroDivisionError"
+
+    def test_telemetry_report_stitches_same_run_segments(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from telemetry_report import summarize
+
+        from improved_body_parts_tpu.obs import SCHEMA_VERSION
+
+        def run_start(seg, rid="run-abc"):
+            return {"event": "run_start", "schema": SCHEMA_VERSION,
+                    "run_id": rid, "segment": seg, "time_unix": seg}
+
+        events = [
+            # an UNRELATED earlier run in the same file: not stitched
+            {"event": "run_start", "schema": SCHEMA_VERSION,
+             "run_id": "run-old", "segment": 0},
+            {"event": "train_step", "step_s": 9.0, "imgs_per_sec": 1.0},
+            # segment 0 of the elastic run: fresh, dies mid-epoch-1
+            run_start(0),
+            {"event": "segment_start", "previous_end": "fresh",
+             "backoff_s": 0},
+            {"event": "train_step", "step_s": 1.0, "imgs_per_sec": 8.0},
+            {"event": "epoch", "epoch": 0, "train_loss": 1.0},
+            # segment 1: killed -> resumed from epoch 0, completes
+            run_start(1),
+            {"event": "segment_start", "previous_end": "killed",
+             "backoff_s": 0.1},
+            {"event": "resume", "found": True, "epoch": 0},
+            {"event": "resume_eval", "epoch": 0, "loss": 0.625},
+            {"event": "train_step", "step_s": 1.0, "imgs_per_sec": 8.0},
+            {"event": "epoch", "epoch": 1, "train_loss": 0.5},
+            {"event": "segment_end", "status": "completed",
+             "epoch_committed": 1},
+        ]
+        s = summarize(events)
+        assert s["run_id"] == "run-abc"
+        assert s["previous_runs_in_file"] == 1  # run-old only
+        assert s["windows"] == 2               # aggregated across segs
+        assert len(s["epochs"]) == 2
+        segs = s["segments"]
+        assert [g["segment"] for g in segs] == [0, 1]
+        assert segs[0]["previous_end"] == "fresh"
+        assert segs[0]["end"] == "died (no segment_end)"
+        assert segs[1]["previous_end"] == "killed"
+        assert segs[1]["resumed_from"] == 0
+        assert segs[1]["resume_eval_loss"] == 0.625
+        assert segs[1]["end"] == "completed"
+
+    def test_telemetry_report_plain_run_unchanged(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from telemetry_report import summarize
+
+        from improved_body_parts_tpu.obs import SCHEMA_VERSION
+
+        events = [
+            {"event": "run_start", "schema": SCHEMA_VERSION},
+            {"event": "train_step", "step_s": 1.0, "imgs_per_sec": 4.0},
+        ]
+        s = summarize(events)
+        assert s["segments"] is None
+        assert s["windows"] == 1
+
+
+# ------------------------------------------------------------------ #
+# end-to-end: SIGTERM on a bare (unsupervised) run + the chaos smoke
+# ------------------------------------------------------------------ #
+def _fixture_pair(tmp_path, n_train=4, n_val=2, seed=0):
+    from improved_body_parts_tpu.data import build_fixture
+
+    train_h5 = str(tmp_path / "train.h5")
+    val_h5 = str(tmp_path / "val.h5")
+    build_fixture(train_h5, num_images=n_train, people_per_image=1,
+                  seed=seed + 3)
+    build_fixture(val_h5, num_images=n_val, people_per_image=1,
+                  seed=seed + 7)
+    return train_h5, val_h5
+
+
+def _train_env(workdir):
+    env = dict(os.environ)
+    env.pop("IBP_CHAOS_KILL", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(str(workdir),
+                                                  "jax_cache"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.5",
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+    })
+    return env
+
+
+def test_bare_sigterm_takes_the_clean_shutdown_path(tmp_path):
+    """Even WITHOUT --supervised, a bare `kill` must run the try/finally
+    teardown (flush the in-flight checkpoint, stop the ring, aligned
+    exit) instead of dying mid-write: the default SIGTERM handler
+    converts the signal to SystemExit(143)."""
+    train_h5, val_h5 = _fixture_pair(tmp_path)
+    ckpt = str(tmp_path / "ck")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "train.py"),
+         "--config", "tiny", "--epochs", "50", "--train-h5", train_h5,
+         "--val-h5", val_h5, "--checkpoint-dir", ckpt, "--workers", "0",
+         "--print-freq", "1", "--telemetry-sink", "auto"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+        env=_train_env(tmp_path))
+    try:
+        events_path = os.path.join(ckpt, "events.jsonl")
+        deadline = time.time() + 420
+        seen = False
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                with open(events_path) as f:
+                    seen = '"train_step"' in f.read()
+            except OSError:
+                pass
+            if seen:
+                break
+            time.sleep(0.2)
+        assert seen, "no train_step event before the deadline"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    err = proc.stderr.read() if proc.stderr else ""
+    # 143 = SystemExit(128+SIGTERM) through the shutdown path; a raw
+    # signal death would be -15 and skip every finally
+    assert proc.returncode == 143, f"rc={proc.returncode}\n{err[-2000:]}"
+    assert "Traceback" not in err, err[-2000:]
+    # nothing uncommitted left visible: resume sees committed epochs only
+    latest = latest_checkpoint(ckpt)
+    if latest is not None:
+        assert is_committed(latest)
+
+
+def test_chaos_smoke_two_deterministic_kills(tmp_path):
+    """Tier-1 fault-injection smoke: seed 6's fixed plan = one external
+    SIGTERM (the clean preemption drain) + one in-process SIGKILL at a
+    step-window boundary, relaunch-until-complete, resumes verified
+    against the post-mortem committed epoch, leak scan on.  The full
+    randomized 8-kill sweep with the control-run bit-match is the slow
+    test below / the committed CHAOS.json."""
+    out = str(tmp_path / "CHAOS_SMOKE.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_train.py"),
+         "--kills", "2", "--epochs", "2", "--records", "4", "--seed", "6",
+         "--no-control", "--strict", "--out", out],
+        capture_output=True, text=True, timeout=1500,
+        env=_train_env(tmp_path))
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    with open(out) as f:
+        report = json.load(f)
+    assert report["ok"] is True
+    assert report["completed"] is True
+    assert report["injections_done"] == 2
+    assert report["all_resumes_on_last_committed"] is True
+    assert report["leaked_pids_total"] == 0
+    assert report["writer_thread_leaked"] is False
+    assert report["injection_kinds"] == ["sigterm", "window"]
+
+
+@pytest.mark.slow
+def test_chaos_full_randomized_sweep(tmp_path):
+    """The acceptance sweep: >= 8 randomized injections across a
+    multi-epoch fit, final state bit-matched against an uninterrupted
+    control run of the same seed."""
+    out = str(tmp_path / "CHAOS.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_train.py"),
+         "--kills", "8", "--strict", "--out", out],
+        capture_output=True, text=True, timeout=3600,
+        env=_train_env(tmp_path))
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    with open(out) as f:
+        report = json.load(f)
+    assert report["ok"] is True
+    assert report["injections_done"] >= 8
+    # bit-equality where the host reproduces; the loss-tolerance gate
+    # is the operative verdict on hosts with XLA:CPU numeric drift
+    # (measured A/A on the bench host — see chaos_train's docstring)
+    assert report["final_matches_control"] is True

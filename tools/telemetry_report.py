@@ -49,23 +49,72 @@ def summarize(events):
     """Machine-readable summary dict of one parsed event stream.
 
     The sink appends, so a re-run over the same ``auto`` path (resume /
-    retry) stacks runs in one file: the summary covers the LAST run —
-    everything from the final ``run_start`` header on — and records how
-    many earlier runs were skipped.
+    retry) stacks runs in one file.  Two cases:
+
+    - plain runs: the summary covers the LAST run — everything from the
+      final ``run_start`` header on — and records how many earlier runs
+      were skipped (the historical behavior);
+    - **elastic runs** (``tools/train.py --supervised``): every
+      ``run_start`` carrying the same ``run_id`` as the last one is a
+      SEGMENT of one logical run (a preemption/crash/restart boundary,
+      not a new run).  Those segments are stitched back together — step
+      stats, attribution and epochs aggregate across all of them — and
+      a per-segment table (how the previous segment ended, what epoch
+      the restore landed on, the resume milestone eval) is added.
     """
     from improved_body_parts_tpu.obs import SCHEMA_VERSION
 
     starts = [i for i, e in enumerate(events)
               if e.get("event") == "run_start"]
-    previous_runs = max(len(starts) - 1, 0)
-    if starts:
-        events = events[starts[-1]:]
-    header = events[0] if starts else {}
-    schema = header.get("schema", 0)
+    # split into (header, slice) runs; synthesize one headerless run for
+    # legacy streams with no run_start at all
+    bounds = starts + [len(events)]
+    runs = ([(events[starts[i]], events[starts[i]:bounds[i + 1]])
+             for i in range(len(starts))]
+            if starts else [({}, events)])
+    run_id = runs[-1][0].get("run_id")
+    if run_id:
+        group = [(h, ev) for h, ev in runs if h.get("run_id") == run_id]
+    else:
+        group = [runs[-1]]
+    previous_runs = len(runs) - len(group)
+    header = group[-1][0]
+    events = [e for _, ev in group for e in ev]
+    schema = max((h.get("schema", 0) for h, _ in group), default=0)
     if schema > SCHEMA_VERSION:
         raise SystemExit(
             f"event stream schema {schema} is newer than this tool's "
             f"{SCHEMA_VERSION}; refusing to misread it — update the repo")
+
+    segments = None
+    if run_id:
+        segments = []
+        for h, ev in group:
+            seg_start = next((e for e in ev
+                              if e.get("event") == "segment_start"), None)
+            seg_end = next((e for e in reversed(ev)
+                            if e.get("event") == "segment_end"), None)
+            resume = next((e for e in ev
+                           if e.get("event") == "resume"), None)
+            epochs_in = [e.get("epoch") for e in ev
+                         if e.get("event") == "epoch"]
+            segments.append({
+                "segment": h.get("segment"),
+                "time_unix": h.get("time_unix"),
+                "previous_end": (seg_start or {}).get("previous_end"),
+                "backoff_s": (seg_start or {}).get("backoff_s"),
+                "resumed_from": (resume or {}).get("epoch"),
+                "resume_eval_loss": next(
+                    (e.get("loss") for e in ev
+                     if e.get("event") == "resume_eval"), None),
+                "windows": sum(1 for e in ev
+                               if e.get("event") == "train_step"),
+                "epochs": len(epochs_in),
+                "epoch_range": ([epochs_in[0], epochs_in[-1]]
+                                if epochs_in else None),
+                "end": ((seg_end or {}).get("status")
+                        or "died (no segment_end)"),
+            })
 
     steps = [e for e in events if e.get("event") == "train_step"]
     epochs = [e for e in events if e.get("event") == "epoch"]
@@ -96,6 +145,8 @@ def summarize(events):
                 ("schema", "time_unix", "pid", "tool", "config")
                 if k in header or k == "schema"},
         "previous_runs_in_file": previous_runs,
+        "run_id": run_id,
+        "segments": segments,
         "windows": len(steps),
         "step_seconds": {
             "mean": sum(step_s) / len(step_s) if step_s else 0.0,
@@ -139,6 +190,25 @@ def render(summary):
     if s.get("previous_runs_in_file"):
         lines.append(f"(file holds {s['previous_runs_in_file']} earlier "
                      "run(s); reporting the last)")
+    segs = s.get("segments")
+    if segs and len(segs) > 1:
+        lines.append(f"elastic run {s.get('run_id')}: {len(segs)} "
+                     "segments stitched (stats below aggregate all of "
+                     "them)")
+        lines.append("  seg  prev-end       resumed  windows  epochs"
+                     "   resume-eval  end")
+        for g in segs:
+            er = g.get("epoch_range")
+            er_txt = f"{er[0]}-{er[1]}" if er else "-"
+            rev = g.get("resume_eval_loss")
+            rev_txt = f"{rev:.4f}" if rev is not None else "-"
+            rf = g.get("resumed_from")
+            lines.append(
+                f"  {g.get('segment', '?'):>3}  "
+                f"{str(g.get('previous_end', '?')):<13}  "
+                f"{str(rf) if rf is not None else '-':>7}  "
+                f"{g.get('windows', 0):>7}  {er_txt:>6}  "
+                f"{rev_txt:>11}  {g.get('end', '?')}")
     st = s["step_seconds"]
     lines.append(
         f"steps: {s['windows']} windows | step "

@@ -117,11 +117,54 @@ def main():
                          "on_divergence): warn = record and continue, "
                          "halt = stop the run, skip_step = drop the "
                          "update inside the jitted step")
+    # elastic training (train.supervisor; TRAINING.md §1c)
+    ap.add_argument("--supervised", action="store_true",
+                    help="run under the elastic run supervisor: "
+                         "SIGTERM/SIGINT drain to the next step-window "
+                         "boundary, failures are classified (preemption/"
+                         "transient vs deterministic) with exponential "
+                         "backoff and a bounded crash budget, resume is "
+                         "automatic from the last committed checkpoint "
+                         "(topology changes reshard), and --epochs "
+                         "becomes the TOTAL epoch target the run "
+                         "converges to across restarts")
+    ap.add_argument("--max-restarts", type=int, default=24,
+                    help="supervised: absolute bound on segments "
+                         "(process lifetimes) of one logical run")
+    ap.add_argument("--crash-budget", type=int, default=3,
+                    help="supervised: consecutive no-progress failures "
+                         "before the supervisor gives up")
+    ap.add_argument("--backoff-base", type=float, default=1.0,
+                    help="supervised: first retry backoff in seconds "
+                         "(doubles per consecutive no-progress failure)")
+    ap.add_argument("--backoff-max", type=float, default=60.0)
+    ap.add_argument("--reshard", default=None,
+                    choices=("adjust", "refuse"),
+                    help="what to do when a checkpoint's stamped device "
+                         "topology differs from the current mesh: "
+                         "'adjust' re-places the state onto the new mesh "
+                         "(global batch + world-size LR scaling follow "
+                         "the new device count, reported loudly), "
+                         "'refuse' errors out. Default: refuse for plain "
+                         "resumes, adjust under --supervised")
     # multi-host (jax.distributed)
     ap.add_argument("--coordinator", default=None)
     ap.add_argument("--num-processes", type=int, default=1)
     ap.add_argument("--process-id", type=int, default=0)
     args = ap.parse_args()
+
+    import signal
+
+    # SIGTERM used to kill the process outright, bypassing the
+    # try/finally teardown below (only exceptions reached it): a bare
+    # `kill` lost the in-flight checkpoint write, leaked ring workers
+    # and dropped the span trace.  Convert it to SystemExit so the
+    # shutdown path always runs; --supervised replaces this with the
+    # supervisor's draining handler (stop at the next window boundary).
+    def _sigterm_exit(signum, frame):
+        raise SystemExit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _sigterm_exit)
 
     import jax
     import jax.numpy as jnp
@@ -133,12 +176,14 @@ def main():
     from improved_body_parts_tpu.data import CocoPoseDataset, batches
     from improved_body_parts_tpu.models import build_model
     from improved_body_parts_tpu.parallel import (
-        barrier, initialize_distributed, make_mesh, replicated)
+        barrier, initialize_distributed, make_mesh, mesh_topology,
+        replicated)
     from improved_body_parts_tpu.train import (
-        CheckpointManager, create_train_state, cyclic_swa_schedule, fit,
-        latest_checkpoint, make_eval_step, make_optimizer, make_train_step,
-        restore_checkpoint, start_swa, step_decay_schedule, swap_swa_params,
-        update_swa)
+        CheckpointManager, RunSupervisor, StopRequested, TopologyChanged,
+        create_train_state, cyclic_swa_schedule, fit, latest_checkpoint,
+        make_eval_step, make_optimizer, make_train_step, milestone_eval,
+        reshard_on_topology_change, restore_checkpoint, start_swa,
+        step_decay_schedule, swap_swa_params, update_swa)
 
     initialize_distributed(args.coordinator, args.num_processes,
                            args.process_id)
@@ -185,6 +230,31 @@ def main():
             overrides["milestone_every"] = args.milestone_every
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **overrides))
 
+    # elastic supervision (train.supervisor): created BEFORE telemetry so
+    # the segment's run_id lands in the run_start header — that id is
+    # what telemetry_report.py stitches the segments back together on
+    reshard_policy = args.reshard or ("adjust" if args.supervised
+                                      else "refuse")
+    supervisor = None
+    if args.supervised:
+        if args.swa:
+            # the SWA stage is a short, cheap fine-tune driven by its own
+            # loop below; re-running it after a preemption is simpler
+            # than supervising it
+            raise SystemExit("--supervised covers the main fit only; run "
+                             "the SWA stage unsupervised (it is short — "
+                             "just relaunch it)")
+        supervisor = RunSupervisor(
+            cfg.train.checkpoint_dir, max_restarts=args.max_restarts,
+            crash_budget=args.crash_budget,
+            backoff_base_s=args.backoff_base,
+            backoff_max_s=args.backoff_max, reshard=reshard_policy,
+            is_lead_host=args.process_id == 0)
+        # classification of the previous segment's end + backoff happen
+        # here, before any device work
+        supervisor.open_segment({"argv": sys.argv[1:]})
+        supervisor.install_signal_handlers()
+
     from improved_body_parts_tpu.obs import RunTelemetry, resolve_sink_path
 
     sink_cfg = (args.telemetry_sink if args.telemetry_sink is not None
@@ -220,30 +290,44 @@ def main():
         telemetry = RunTelemetry(
             sink_path, http_port=(tele_port if tele_port >= 0 else None),
             run_meta={"tool": "train", "config": args.config,
-                      "seed": args.seed, "process_id": args.process_id},
+                      "seed": args.seed, "process_id": args.process_id,
+                      **({"run_id": supervisor.run_id,
+                          "segment": supervisor.segment}
+                         if supervisor is not None else {})},
             step_sample=cfg.train.telemetry_sample,
             trace_path=trace_path,
             on_divergence=cfg.train.on_divergence,
             grad_norm_limit=cfg.train.health_grad_norm_limit)
         if telemetry.server is not None:
             print(f"telemetry: {telemetry.server.url}/metrics")
+    if supervisor is not None:
+        # /healthz now reports running/draining/backing-off next to the
+        # sentinel state, and the segment_start record (with the
+        # previous segment's classification) enters the event stream
+        supervisor.bind(telemetry)
     if args.process_id == 0:
         # run manifest: link the checkpoint dir to its event stream so
         # artifacts and telemetry cross-reference (bench.py does the same)
         os.makedirs(cfg.train.checkpoint_dir, exist_ok=True)
         import json
 
-        with open(os.path.join(cfg.train.checkpoint_dir, "RUN.json"),
-                  "w") as f:
-            json.dump({"tool": "train", "config": args.config,
-                       "argv": sys.argv[1:],
-                       "telemetry_events": sink_path,
-                       "telemetry_trace": trace_path,
-                       "on_divergence": cfg.train.on_divergence,
-                       "telemetry_port": (telemetry.server.port
-                                          if telemetry is not None
-                                          and telemetry.server is not None
-                                          else None)}, f, indent=2)
+        manifest = {"tool": "train", "config": args.config,
+                    "argv": sys.argv[1:],
+                    "telemetry_events": sink_path,
+                    "telemetry_trace": trace_path,
+                    "on_divergence": cfg.train.on_divergence,
+                    "telemetry_port": (telemetry.server.port
+                                       if telemetry is not None
+                                       and telemetry.server is not None
+                                       else None)}
+        if supervisor is not None:
+            # merge, not overwrite: RUN.json also carries the run ledger
+            # (run_id, segments) the supervisor owns across restarts
+            supervisor.update_manifest(manifest)
+        else:
+            with open(os.path.join(cfg.train.checkpoint_dir, "RUN.json"),
+                      "w") as f:
+                json.dump(manifest, f, indent=2)
 
     train_h5 = args.train_h5 or cfg.train.hdf5_train_data
     val_h5 = args.val_h5 or cfg.train.hdf5_val_data
@@ -299,11 +383,32 @@ def main():
     start_epoch = 0
     resumed_swa = False
     best_loss = float("inf")
-    if args.resume:
+    resumed_from_epoch = None
+    if supervisor is not None:
+        # supervised runs ALWAYS auto-resume: restore_latest + topology
+        # check + replicated re-placement onto the current mesh
+        resumed = supervisor.resume(state, mesh, args.num_processes)
+        if resumed is not None:
+            state, meta, _change = resumed
+            start_epoch = meta["epoch"] + 1
+            best_loss = float(meta.get("best_loss", float("inf")))
+            resumed_swa = state.swa_count is not None
+            resumed_from_epoch = meta["epoch"]
+            print(f"resumed from epoch {meta['epoch']} "
+                  f"(run {supervisor.run_id} segment {supervisor.segment})")
+    elif args.resume:
         path = (latest_checkpoint(cfg.train.checkpoint_dir)
                 if args.resume == "auto" else args.resume)
         if path:
             state, meta = restore_checkpoint(path, state)
+            try:
+                # one policy implementation with the supervised path
+                # (detection, refusal text, reshard-only-on-change rule)
+                state, _ = reshard_on_topology_change(
+                    state, meta, mesh, args.num_processes,
+                    reshard_policy, path)
+            except TopologyChanged as e:
+                raise SystemExit(str(e)) from None
             start_epoch = meta["epoch"] + 1
             best_loss = float(meta.get("best_loss", float("inf")))
             resumed_swa = state.swa_count is not None
@@ -354,11 +459,13 @@ def main():
 
         train_ring = ShmRingInput(ds, host_batch, args.workers,
                                   raw_gt=args.device_gt, wire=wire,
-                                  slots=cfg.train.input_ring_slots)
+                                  slots=cfg.train.input_ring_slots,
+                                  supervise=args.supervised)
         if val_ds is not None:
             eval_ring = ShmRingInput(val_ds, host_batch, args.workers,
                                      wire=wire,
-                                     slots=cfg.train.input_ring_slots)
+                                     slots=cfg.train.input_ring_slots,
+                                     supervise=args.supervised)
         if telemetry is not None:
             train_ring.attach_telemetry(telemetry.registry)
             if eval_ring is not None:
@@ -404,9 +511,12 @@ def main():
 
     # ONE checkpoint manager for both stages (fit and SWA): async
     # snapshot + background Orbax write + atomic commit markers +
-    # retention GC, from the config knobs (process-symmetric)
+    # retention GC, from the config knobs (process-symmetric).  The mesh
+    # topology rides every commit marker so a restart on a different
+    # device layout is detected at restore time, not mid-step.
     manager = CheckpointManager.from_config(cfg.train.checkpoint_dir,
-                                            cfg.train, is_lead_host=is_lead)
+                                            cfg.train, is_lead_host=is_lead,
+                                            topology=mesh_topology(mesh))
 
     def shutdown():
         # flush the in-flight checkpoint write FIRST: its commit event
@@ -439,12 +549,85 @@ def main():
     # workers, and keep the multi-host jax.distributed exit aligned
     try:
         if not args.swa:
-            fit(state, train_step, cfg, make_train_batches, epochs,
-                start_epoch=start_epoch, mesh=mesh, eval_step=eval_step,
-                make_eval_batches=make_eval_batches, is_lead_host=is_lead,
-                best_loss=best_loss, telemetry=telemetry,
-                checkpoint_manager=manager)
-            return
+            if supervisor is None:
+                fit(state, train_step, cfg, make_train_batches, epochs,
+                    start_epoch=start_epoch, mesh=mesh, eval_step=eval_step,
+                    make_eval_batches=make_eval_batches, is_lead_host=is_lead,
+                    best_loss=best_loss, telemetry=telemetry,
+                    checkpoint_manager=manager)
+                return
+
+            # ---- supervised elastic fit: --epochs is the TOTAL target
+            # the logical run converges to across restarts
+            def fresh_state():
+                s = create_train_state(model, cfg, optimizer,
+                                       jax.random.PRNGKey(args.seed), sample)
+                return jax.device_put(s, replicated(mesh))
+
+            def resume_milestone(epoch):
+                # lightweight eval right after a restore: recovery
+                # correctness as a number in the stream, not a hope.
+                # Collective and argv-symmetric (every process takes the
+                # same branch)
+                if eval_step is None or make_eval_batches is None:
+                    return
+                loss = milestone_eval(state, eval_step,
+                                      make_eval_batches(epoch), mesh=mesh)
+                if telemetry is not None:
+                    telemetry.emit("resume_eval", epoch=epoch,
+                                   loss=round(float(loss), 6))
+                if is_lead:
+                    print(f"resume milestone eval (after epoch {epoch}): "
+                          f"loss {loss:.6f}")
+
+            if resumed_from_epoch is not None:
+                resume_milestone(resumed_from_epoch)
+            target = epochs
+            while True:
+                to_run = target - start_epoch
+                if to_run <= 0:
+                    if is_lead:
+                        print(f"supervisor: epoch target {target} already "
+                              "reached — nothing to train")
+                    supervisor.mark_completed()
+                    return
+                try:
+                    fit(state, train_step, cfg, make_train_batches, to_run,
+                        start_epoch=start_epoch, mesh=mesh,
+                        eval_step=eval_step,
+                        make_eval_batches=make_eval_batches,
+                        is_lead_host=is_lead, best_loss=best_loss,
+                        telemetry=telemetry, checkpoint_manager=manager,
+                        should_stop=supervisor.should_stop)
+                except StopRequested as e:
+                    # fit already flushed the in-flight write; the
+                    # finally below exports the trace and stops the ring
+                    supervisor.close_segment("preempted", str(e))
+                    if is_lead:
+                        print(f"supervisor: clean stop — {e}")
+                    return
+                except Exception as e:
+                    # transient -> backoff happened inside on_failure;
+                    # deterministic (or budget exhausted) -> recorded as
+                    # crashed and re-raised
+                    if supervisor.on_failure(e) != "retry":
+                        raise
+                    resumed = supervisor.resume(state, mesh,
+                                                args.num_processes)
+                    if resumed is None:
+                        # failed before the first commit: restart the
+                        # segment from the deterministic initial state
+                        state = fresh_state()
+                        start_epoch, best_loss = 0, float("inf")
+                    else:
+                        state, meta, _change = resumed
+                        start_epoch = meta["epoch"] + 1
+                        best_loss = float(meta.get("best_loss",
+                                                   float("inf")))
+                        resume_milestone(meta["epoch"])
+                    continue
+                supervisor.mark_completed()
+                return
 
         # SWA fine-tune: average params every swa_freq epochs, swap
         # averaged params in for the checkpoint (reference:
