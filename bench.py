@@ -35,12 +35,14 @@ TOTAL_TIMEOUT_S = 1800
 
 def _watchdog(seconds, message):
     def fire():
+        # allow_nan=False: every field is a finite literal, and the
+        # watchdog cannot rely on package imports mid-teardown (JGL004)
         print(json.dumps({
             "metric": "network_inference_fps_512x512_batch8",
             "value": 0.0,
             "unit": f"imgs/sec ({message})",
             "vs_baseline": 0.0,
-        }), flush=True)
+        }, allow_nan=False), flush=True)
         os._exit(2)
 
     t = threading.Timer(seconds, fire)
@@ -84,6 +86,19 @@ def _provenance():
         backend = jax.default_backend()
     except Exception:  # noqa: BLE001 — provenance must never kill the line
         jax_version = backend = None
+    try:
+        from improved_body_parts_tpu.analysis import (
+            GRAFTLINT_VERSION,
+            ruleset_hash,
+        )
+
+        # version + rule-set hash make lint counts comparable across
+        # PRs: a count change means the TREE changed only when the
+        # ruleset stamp is identical
+        graftlint = {"version": GRAFTLINT_VERSION,
+                     "ruleset": ruleset_hash()}
+    except Exception:  # noqa: BLE001 — provenance must never kill the line
+        graftlint = None
     return {
         "git_sha": sha,
         "jax_version": jax_version,
@@ -91,6 +106,7 @@ def _provenance():
         "platform": _platform.platform(),
         "python": _platform.python_version(),
         "cpu_count": os.cpu_count(),
+        "graftlint": graftlint,
     }
 
 
@@ -350,6 +366,41 @@ def _chaos_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _lint_summary(budget_s):
+    """Run tools/lint.py (the graftlint static-analysis gate) and return
+    finding counts by severity, or an {"error"/"skipped"} marker — the
+    "serve"/"feed"/... key contract.  Subprocess so a linter crash can
+    never take down the primary metric; the scan is pure-host AST work
+    (seconds), so the budget floor is small.  ``IBP_BENCH_LINT=0`` skips
+    it unconditionally."""
+    import subprocess
+
+    if os.environ.get("IBP_BENCH_LINT") == "0":
+        return {"skipped": "IBP_BENCH_LINT=0"}
+    if budget_s < 60:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (run tools/lint.py directly)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "lint.py"),
+             "--format", "json", "--fail-on", "never"],
+            capture_output=True, text=True, timeout=min(300, budget_s),
+            check=True, env=dict(os.environ))
+        r = json.loads(proc.stdout)
+        return {
+            "files": r["files"],
+            "errors": r["counts"]["error"],
+            "warnings": r["counts"]["warning"],
+            "info": r["counts"]["info"],
+            "suppressed": r["suppressed"],
+            "version": r["version"],
+            "ruleset": r["ruleset"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def main():
     import time
 
@@ -423,7 +474,12 @@ def main():
     # discipline
     chaos = _chaos_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
-    print(json.dumps({
+    # static-analysis gate (graftlint), same discipline
+    lint = _lint_summary(
+        TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    from improved_body_parts_tpu.obs.events import strict_dumps
+
+    print(strict_dumps({
         # metric name carries the ACTUAL batch (the fallback runs batch 2)
         "metric": f"network_inference_fps_512x512_batch{batch}",
         "value": round(fps, 2),
@@ -434,6 +490,7 @@ def main():
         "telemetry": telemetry,
         "ckpt": ckpt,
         "chaos": chaos,
+        "lint": lint,
         "provenance": _provenance(),
     }))
 

@@ -1,0 +1,50 @@
+"""graftlint — a JAX-aware static-analysis suite encoding this repo's
+shipped bug classes as enforced rules.
+
+The three worst bugs in this repo's history were statically detectable:
+the donated-buffer read that corrupted in-flight checkpoints (PR 5),
+the persistent-cache donated-executable corruption on resume (PR 6),
+and the per-batch ``float(loss)`` sync that defeated
+``device_prefetch`` (PR 3).  Each rule here turns one such postmortem
+into a machine-checked invariant; ``tools/lint.py`` is the runner and
+``tests/test_graftlint.py::test_self_scan_clean`` keeps the tree clean
+in tier-1.
+
+Stdlib-only by construction: linting parses source with ``ast`` and
+never imports the linted code, so it runs in seconds with no jax
+bring-up and cannot execute repo side effects.
+
+Rules (severity in parentheses; suppression:
+``# graftlint: disable=JGL00N -- reason``, reason required):
+
+- JGL001 donation-safety (error)  — reads after ``donate_argnums``
+  donation; escaping zero-copy ``np.asarray`` views of state leaves
+- JGL002 hidden-host-sync (error) — per-batch ``float()``/``.item()``/
+  ``device_get``/... on device values in train/serve/infer loops
+- JGL003 recompile-hazard (warning) — jit-in-loop over fresh function
+  objects, mutable static args, jitted closures over mutated names
+- JGL004 strict-json (error)      — ``json.dumps`` not routed through
+  ``obs.events`` strict emission (bare-NaN-token class)
+- JGL005 resource-lifecycle (warning) — threads/pools/shm/processes
+  without cleanup on any path
+- JGL006 metric-names (error)     — Prometheus naming contract at
+  ``Registry`` call sites
+- JGL007 bare-print (warning)     — stdout prints in library code
+- JGL000 (error)                  — suppressions without a reason,
+  unknown rule ids, unparseable files
+
+Config: ``[tool.graftlint]`` in ``pyproject.toml`` (see
+``analysis/config.py``).
+"""
+from .config import ConfigError, LintConfig, load_config  # noqa: F401
+from .core import (  # noqa: F401
+    GRAFTLINT_VERSION,
+    Finding,
+    LintResult,
+    Rule,
+    all_rules,
+    iter_lint_files,
+    lint_paths,
+    lint_source,
+    ruleset_hash,
+)

@@ -1,0 +1,175 @@
+"""graftlint configuration: ``[tool.graftlint]`` in ``pyproject.toml``.
+
+Python 3.10 has no ``tomllib``, and the repo bakes in no third-party
+TOML parser, so this module reads the *subset* of TOML the graftlint
+sections actually use: ``[tool.graftlint]`` / ``[tool.graftlint.*]``
+tables with string / bool / int values and (possibly multi-line) arrays
+of strings.  Everything outside those sections is skipped unparsed —
+the rest of ``pyproject.toml`` is setuptools' problem, not ours.
+"""
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+#: severities in increasing order of badness
+SEVERITIES = ("info", "warning", "error")
+
+_SECTION_RE = re.compile(r"^\s*\[([^\]]+)\]\s*(?:#.*)?$")
+_KEY_RE = re.compile(r"^\s*([A-Za-z0-9_\-\.]+)\s*=\s*(.*)$")
+_STR_RE = re.compile(r'"((?:[^"\\]|\\.)*)"|\'([^\']*)\'')
+
+
+@dataclass(frozen=True)
+class LintConfig:
+    """Resolved graftlint configuration (defaults mirror the committed
+    ``[tool.graftlint]`` section so ``LintConfig()`` behaves like the
+    repo checkout)."""
+
+    #: lint roots, relative to the repo root (files or directories)
+    paths: Tuple[str, ...] = ("improved_body_parts_tpu", "tools",
+                              "tests", "bench.py")
+    #: fnmatch patterns (against the repo-relative posix path) to skip
+    exclude: Tuple[str, ...] = ()
+    #: rule ids disabled globally
+    disable: Tuple[str, ...] = ()
+    #: per-rule severity overrides, e.g. {"JGL005": "info"}
+    severity: Dict[str, str] = field(default_factory=dict)
+    #: callables whose RESULT is a donating jitted step: "name:pos[,pos]"
+    donating_factories: Tuple[str, ...] = ("make_train_step:0",)
+    #: extra regexes over dotted callee names that produce device values
+    extra_device_producers: Tuple[str, ...] = ()
+    #: error-severity findings in tests/ are reported as warnings — test
+    #: code exercises bad patterns on purpose; JGL000 stays an error
+    tests_downgrade: bool = True
+
+    def donated_positions(self, callee: str) -> Optional[Tuple[int, ...]]:
+        """Donated positional-arg indices for a configured factory name,
+        or None when ``callee`` is not a donating factory."""
+        for spec in self.donating_factories:
+            name, _, positions = spec.partition(":")
+            if name == callee:
+                if not positions:
+                    return (0,)
+                return tuple(int(p) for p in positions.split(",") if p)
+        return None
+
+
+class ConfigError(ValueError):
+    """Malformed ``[tool.graftlint]`` content (bad severity, bad value
+    shape) — loud, so a typo'd config cannot silently lint nothing."""
+
+
+def _parse_value(raw: str, path: str, key: str):
+    raw = raw.strip()
+    if raw.startswith("["):
+        body = raw[1:raw.rindex("]")]
+        items = []
+        for m in _STR_RE.finditer(body):
+            items.append(m.group(1) if m.group(1) is not None
+                         else m.group(2))
+        return items
+    if raw.startswith(("\"", "'")):
+        m = _STR_RE.match(raw)
+        if not m:
+            raise ConfigError(f"{path}: unterminated string for {key!r}")
+        return m.group(1) if m.group(1) is not None else m.group(2)
+    bare = raw.split("#", 1)[0].strip()
+    if bare in ("true", "false"):
+        return bare == "true"
+    try:
+        return int(bare)
+    except ValueError:
+        raise ConfigError(
+            f"{path}: unsupported value {bare!r} for {key!r} (graftlint "
+            "accepts strings, bools, ints and arrays of strings)") from None
+
+
+def parse_graftlint_tables(text: str, path: str = "pyproject.toml"
+                           ) -> Dict[str, Dict[str, object]]:
+    """``{section_suffix: {key: value}}`` for every ``[tool.graftlint*]``
+    table in ``text`` (suffix "" for the root table, "severity" for
+    ``[tool.graftlint.severity]``, ...)."""
+    tables: Dict[str, Dict[str, object]] = {}
+    current: Optional[Dict[str, object]] = None
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        i += 1
+        sect = _SECTION_RE.match(line)
+        if sect:
+            name = sect.group(1).strip()
+            if name == "tool.graftlint":
+                current = tables.setdefault("", {})
+            elif name.startswith("tool.graftlint."):
+                current = tables.setdefault(
+                    name[len("tool.graftlint."):], {})
+            else:
+                current = None
+            continue
+        if current is None:
+            continue
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        kv = _KEY_RE.match(line)
+        if not kv:
+            raise ConfigError(f"{path}: cannot parse line {i}: {line!r}")
+        key, raw = kv.group(1), kv.group(2)
+        # multi-line array: keep consuming lines until brackets balance
+        # (string contents never contain brackets in our config keys)
+        while raw.count("[") > raw.count("]"):
+            if i >= len(lines):
+                raise ConfigError(
+                    f"{path}: unterminated array for {key!r}")
+            raw += " " + lines[i].strip()
+            i += 1
+        current[key.replace("-", "_")] = _parse_value(raw, path, key)
+    return tables
+
+
+def config_from_tables(tables: Dict[str, Dict[str, object]],
+                       path: str = "pyproject.toml") -> LintConfig:
+    root = dict(tables.get("", {}))
+    severity = {str(k).upper(): str(v)
+                for k, v in tables.get("severity", {}).items()}
+    for rid, sev in severity.items():
+        if sev not in SEVERITIES:
+            raise ConfigError(
+                f"{path}: [tool.graftlint.severity] {rid} = {sev!r} "
+                f"(must be one of {SEVERITIES})")
+    kwargs = {}
+    for key, default in (("paths", None), ("exclude", None),
+                         ("disable", None),
+                         ("donating_factories", None),
+                         ("extra_device_producers", None)):
+        if key in root:
+            val = root.pop(key)
+            if not isinstance(val, list):
+                raise ConfigError(f"{path}: {key} must be an array")
+            kwargs[key] = tuple(str(v) for v in val)
+    if "tests_downgrade" in root:
+        val = root.pop("tests_downgrade")
+        if not isinstance(val, bool):
+            raise ConfigError(f"{path}: tests_downgrade must be a bool")
+        kwargs["tests_downgrade"] = val
+    if root:
+        raise ConfigError(
+            f"{path}: unknown [tool.graftlint] keys {sorted(root)}")
+    if "disable" in kwargs:
+        kwargs["disable"] = tuple(r.upper() for r in kwargs["disable"])
+    return LintConfig(severity=severity, **kwargs)
+
+
+def load_config(root: str) -> LintConfig:
+    """Read ``<root>/pyproject.toml``'s graftlint tables; defaults when
+    the file or the section is absent."""
+    pp = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(pp):
+        return LintConfig()
+    with open(pp, encoding="utf-8") as f:
+        text = f.read()
+    return config_from_tables(parse_graftlint_tables(text, pp), pp)

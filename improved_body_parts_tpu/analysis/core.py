@@ -1,0 +1,340 @@
+"""graftlint core: findings, the rule registry, inline suppressions and
+the per-file / per-tree orchestration.
+
+The linter encodes this repo's shipped bug classes as machine-checked
+invariants (see ``analysis/rules/``); this module is the plumbing those
+rules share.  Design points:
+
+- **Suppressions require a reason.**  ``# graftlint: disable=JGL002 --
+  warmup precompile syncs on purpose`` silences a finding on that line;
+  a pragma with no ``-- reason`` suppresses *nothing* and is itself an
+  error (JGL000) — the whole point is that every silenced postmortem
+  pattern carries its justification in the diff.
+- **tests/ findings are downgraded** to warnings by default (config
+  ``tests_downgrade``): test code reproduces bad patterns on purpose,
+  and the acceptance gate ("zero error-severity findings") is about
+  product code.  JGL000 keeps its severity everywhere — a reasonless
+  suppression is a process bug wherever it sits.
+- Rules are pure functions of a parsed module; no imports of the
+  linted code ever happen, so linting cannot execute repo code and the
+  linter itself needs nothing beyond the stdlib.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import hashlib
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import dataflow
+from .config import SEVERITIES, LintConfig
+
+GRAFTLINT_VERSION = "1.0.0"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+?)"
+    r"(?:\s*--\s*(.*\S))?\s*$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.severity.upper()} {self.rule} {self.message}")
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message}
+
+
+@dataclass
+class Suppression:
+    line: int
+    ids: Set[str]          # upper-cased rule ids, may contain "ALL"
+    reason: Optional[str]  # None when the pragma carries no reason
+    used: int = 0
+
+    def covers(self, rule_id: str) -> bool:
+        return "ALL" in self.ids or rule_id in self.ids
+
+
+class ModuleContext:
+    """Everything a rule sees for one file: the parented AST, raw lines,
+    the repo-relative posix path and the resolved config."""
+
+    def __init__(self, source: str, rel_path: str, config: LintConfig):
+        self.source = source
+        self.rel_path = rel_path.replace(os.sep, "/")
+        self.config = config
+        self.lines = source.splitlines()
+        self.tree = dataflow.add_parents(ast.parse(source))
+        self._findings: List[Finding] = []
+
+    # -- path scoping ------------------------------------------------------
+    def under(self, *prefixes: str) -> bool:
+        return any(self.rel_path == p or self.rel_path.startswith(p + "/")
+                   for p in prefixes)
+
+    @property
+    def in_tests(self) -> bool:
+        return self.under("tests")
+
+    # -- emission ----------------------------------------------------------
+    def finding(self, rule: "Rule", node: ast.AST, message: str,
+                severity: Optional[str] = None) -> None:
+        self._findings.append((Finding(
+            rule=rule.id,
+            severity=severity or rule.severity,
+            path=self.rel_path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message), node))
+
+
+class Rule:
+    """One bug class.  Subclasses set the class attributes and implement
+    ``check``; registration happens via the ``@register`` decorator."""
+
+    id: str = ""
+    name: str = ""
+    severity: str = "error"
+    #: one-line pointer at the postmortem this rule encodes
+    postmortem: str = ""
+
+    def check(self, ctx: ModuleContext) -> None:
+        raise NotImplementedError
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    inst = cls()
+    assert inst.id and inst.id not in _RULES, inst.id
+    assert inst.severity in SEVERITIES, inst.severity
+    _RULES[inst.id] = inst
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    from . import rules  # noqa: F401 — importing registers them
+
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+def known_rule_ids() -> Set[str]:
+    return {r.id for r in all_rules()} | {"JGL000"}
+
+
+def ruleset_hash() -> str:
+    """12 hex chars over the analysis package's own source — any rule
+    change (new rule, tuned heuristic, severity default) changes the
+    stamp, so lint counts in bench provenance are only compared between
+    identical rule sets."""
+    h = hashlib.sha256()
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for dirpath, dirnames, filenames in sorted(os.walk(pkg)):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(f for f in filenames if f.endswith(".py")):
+            p = os.path.join(dirpath, fn)
+            h.update(os.path.relpath(p, pkg).encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()[:12]
+
+
+# ------------------------------------------------------------- suppressions
+
+
+def parse_suppressions(source: str) -> Dict[int, Suppression]:
+    """Pragmas from actual COMMENT tokens only — a docstring *describing*
+    the suppression syntax (this repo documents it in several places)
+    must not register as one."""
+    import io
+    import tokenize
+
+    out: Dict[int, Suppression] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if not m:
+                continue
+            ids = {s.strip().upper() for s in m.group(1).split(",")
+                   if s.strip()}
+            line = tok.start[0]
+            out[line] = Suppression(line=line, ids=ids, reason=m.group(2))
+    except (tokenize.TokenError, SyntaxError):
+        pass  # the ast.parse in ModuleContext reports the syntax error
+    return out
+
+
+def _suppression_for(finding: Finding, span: Tuple[int, int],
+                     sups: Dict[int, Suppression]) -> Optional[Suppression]:
+    first, last = span
+    for ln in range(first, last + 1):
+        s = sups.get(ln)
+        if s is not None and s.covers(finding.rule):
+            return s
+    return None
+
+
+# ------------------------------------------------------------ orchestration
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    files: int = 0
+    suppressed: int = 0
+    #: files that failed to parse are reported as JGL000 errors AND
+    #: counted here so a syntax error can never read as "clean"
+    parse_errors: int = 0
+
+    def count(self, severity: str) -> int:
+        return sum(1 for f in self.findings if f.severity == severity)
+
+    def counts(self) -> Dict[str, int]:
+        return {s: self.count(s) for s in reversed(SEVERITIES)}
+
+
+def _effective_severity(finding: Finding, ctx: ModuleContext) -> str:
+    sev = ctx.config.severity.get(finding.rule, finding.severity)
+    if (ctx.config.tests_downgrade and ctx.in_tests and sev == "error"
+            and finding.rule != "JGL000"):
+        sev = "warning"
+    return sev
+
+
+def lint_source(source: str, rel_path: str,
+                config: Optional[LintConfig] = None
+                ) -> Tuple[List[Finding], int]:
+    """Lint one source string as if it lived at ``rel_path``.
+
+    Returns ``(findings, suppressed_count)``.  ``rel_path`` drives the
+    path-scoped rules (JGL002 only looks at train/serve/infer, JGL007
+    only at library code), which is also what lets the fixture tests
+    exercise every scope without touching the real tree.
+    """
+    config = config or LintConfig()
+    rel_path = rel_path.replace(os.sep, "/")
+    sups = parse_suppressions(source)
+    try:
+        ctx = ModuleContext(source, rel_path, config)
+    except SyntaxError as e:
+        return [Finding("JGL000", "error", rel_path, e.lineno or 1,
+                        (e.offset or 0) + 1,
+                        f"file does not parse: {e.msg}")], 0
+
+    disabled = set(config.disable)
+    for rule in all_rules():
+        if rule.id in disabled:
+            continue
+        rule.check(ctx)
+
+    # a pragma anywhere on the lines of the flagged node's enclosing
+    # STATEMENT suppresses the finding — multi-line calls put the
+    # comment wherever it reads best
+    findings: List[Finding] = []
+    suppressed = 0
+    for f, node in ctx._findings:
+        stmt = dataflow.stmt_ancestor(node)
+        first = getattr(stmt, "lineno", f.line)
+        last = getattr(stmt, "end_lineno", None) or f.line
+        sup = _suppression_for(f, (min(first, f.line), max(last, f.line)),
+                               sups)
+        if sup is not None:
+            if sup.reason:
+                sup.used += 1
+                suppressed += 1
+                continue
+            # reasonless pragma: it suppresses nothing (JGL000 below
+            # fires on the pragma line); fall through and keep f
+        findings.append(Finding(f.rule, _effective_severity(f, ctx),
+                                f.path, f.line, f.col, f.message))
+
+    known = known_rule_ids()
+    for sup in sups.values():
+        if not sup.reason:
+            findings.append(Finding(
+                "JGL000", "error", rel_path, sup.line, 1,
+                "graftlint suppression requires a reason: "
+                "`# graftlint: disable=JGL00N -- why`"))
+        unknown = sorted(i for i in sup.ids if i != "ALL" and i not in known)
+        if unknown:
+            findings.append(Finding(
+                "JGL000", "error", rel_path, sup.line, 1,
+                f"unknown rule id(s) in suppression: {', '.join(unknown)}"))
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings, suppressed
+
+
+def iter_lint_files(paths: Sequence[str], root: str,
+                    config: LintConfig) -> List[str]:
+    """Expand configured roots into a sorted list of repo-relative .py
+    paths, honoring ``exclude`` patterns (``__pycache__`` always)."""
+    rels: Set[str] = set()
+    for p in paths:
+        ap = os.path.join(root, p)
+        if os.path.isfile(ap):
+            rels.add(os.path.relpath(ap, root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    rels.add(os.path.relpath(os.path.join(dirpath, fn),
+                                             root))
+    out = []
+    for rel in sorted(rels):
+        posix = rel.replace(os.sep, "/")
+        if any(fnmatch.fnmatch(posix, pat) for pat in config.exclude):
+            continue
+        out.append(rel)
+    return out
+
+
+def lint_paths(paths: Sequence[str], root: str,
+               config: Optional[LintConfig] = None) -> LintResult:
+    config = config or LintConfig()
+    result = LintResult()
+    for p in paths:
+        if not os.path.exists(os.path.join(root, p)):
+            # a typo'd/renamed root must not read as a clean scan of
+            # zero files — the exact silent failure the gate exists to
+            # prevent
+            result.findings.append(Finding(
+                "JGL000", "error", str(p).replace(os.sep, "/"), 1, 1,
+                "lint root does not exist (typo'd path in "
+                "[tool.graftlint] paths or on the command line?)"))
+    for rel in iter_lint_files(paths, root, config):
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            result.findings.append(Finding(
+                "JGL000", "error", rel.replace(os.sep, "/"), 1, 1,
+                f"unreadable file: {e}"))
+            result.parse_errors += 1
+            continue
+        result.files += 1
+        findings, suppressed = lint_source(source, rel, config)
+        result.parse_errors += sum(
+            1 for f in findings if "does not parse" in f.message)
+        result.findings.extend(findings)
+        result.suppressed += suppressed
+    result.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return result

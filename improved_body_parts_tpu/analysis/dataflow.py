@@ -1,0 +1,380 @@
+"""AST utilities + intra-procedural dataflow for the graftlint rules.
+
+Everything here is deliberately approximate: the rules encode *bug
+classes this repo has actually shipped*, so the analyses are tuned to
+catch the shipped shape of each bug (and the fixture tests pin exactly
+that) while passing the repaired idioms that replaced them.  Names, not
+objects, are tracked; flow through containers is modeled only where a
+historical bug needed it (``pending.append(loss)`` → windowed
+readback).  Where the analysis cannot tell, it stays silent — a lint
+that cries wolf gets disabled, and then catches nothing.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------- AST helpers
+
+
+def add_parents(tree: ast.AST) -> ast.AST:
+    """Annotate every node with ``.graftlint_parent`` (None on the root)."""
+    tree.graftlint_parent = None  # type: ignore[attr-defined]
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.graftlint_parent = node  # type: ignore[attr-defined]
+    return tree
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "graftlint_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    p = parent(node)
+    while p is not None:
+        yield p
+        p = parent(p)
+
+
+def stmt_ancestor(node: ast.AST) -> ast.AST:
+    """The nearest enclosing statement (the node itself when it is one)."""
+    n: Optional[ast.AST] = node
+    while n is not None and not isinstance(n, ast.stmt):
+        n = parent(n)
+    return n if n is not None else node
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_callee(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def call_kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def assigned_names(target: ast.expr) -> List[str]:
+    """Simple names bound by an assignment target (tuple/list unpacking
+    flattened; starred, attribute and subscript targets skipped)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in target.elts:
+            if isinstance(elt, ast.Starred):
+                elt = elt.value
+            out.extend(assigned_names(elt))
+        return out
+    return []
+
+
+def stmt_bound_names(stmt: ast.stmt) -> List[str]:
+    """Names (re)bound by a statement — assignment targets, ``for``
+    targets, ``with ... as`` names, aug-assign targets."""
+    out: List[str] = []
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            out.extend(assigned_names(t))
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        out.extend(assigned_names(stmt.target))
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        out.extend(assigned_names(stmt.target))
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                out.extend(assigned_names(item.optional_vars))
+    return out
+
+
+def functions(tree: ast.AST) -> List[ast.AST]:
+    """Every function/lambda-free analysis scope: the module itself plus
+    each (async) function definition.  Cached on the tree — every rule
+    asks for the same scope list."""
+    cached = getattr(tree, "_graftlint_scopes", None)
+    if cached is not None:
+        return cached
+    scopes: List[ast.AST] = [tree]
+    scopes.extend(node for node in ast.walk(tree)
+                  if isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)))
+    tree._graftlint_scopes = scopes  # type: ignore[attr-defined]
+    return scopes
+
+
+def own_statements(scope: ast.AST) -> List[ast.stmt]:
+    """Statements belonging to ``scope`` itself — nested function bodies
+    excluded (they are their own analysis scopes).  Cached on the scope
+    node (several rules re-walk the same scopes)."""
+    cached = getattr(scope, "_graftlint_own_stmts", None)
+    if cached is not None:
+        return cached
+    out: List[ast.stmt] = []
+
+    def visit(stmts: Sequence[ast.stmt]) -> None:
+        for s in stmts:
+            out.append(s)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            for name in ("body", "orelse", "finalbody"):
+                visit(getattr(s, name, []) or [])
+            for handler in getattr(s, "handlers", []) or []:
+                visit(handler.body)
+
+    body = scope.body if hasattr(scope, "body") else []
+    visit(body)
+    scope._graftlint_own_stmts = out  # type: ignore[attr-defined]
+    return out
+
+
+def walk_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` pruned at scope boundaries: nested function / class
+    / lambda bodies are not descended into (each is its own analysis
+    scope — walking through them is how per-scope state leaks across
+    functions).  The def/class node itself is not yielded either: a
+    statement that *is* one contributes nothing to its enclosing
+    scope's dataflow."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)) and n is not node:
+            continue
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)) and n is node:
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return
+
+
+def loops_in(scope: ast.AST) -> List[ast.AST]:
+    """For/While statements owned by ``scope`` (nested defs excluded)."""
+    return [s for s in own_statements(scope)
+            if isinstance(s, (ast.For, ast.AsyncFor, ast.While))]
+
+
+def is_within(node: ast.AST, ancestor: ast.AST) -> bool:
+    return any(a is ancestor for a in ancestors(node))
+
+
+def in_nested_function(node: ast.AST, scope: ast.AST) -> bool:
+    """True when ``node`` sits inside a def nested under ``scope`` —
+    i.e. it does not execute on scope's own control flow."""
+    for a in ancestors(node):
+        if a is scope:
+            return False
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return True
+    return False
+
+
+def guarded_within(node: ast.AST, loop: ast.AST) -> bool:
+    """True when an ``if`` sits between ``loop``'s body and ``node`` —
+    the windowed-readback idiom (``if step % freq == 0: float(...)``)
+    that repaired the PR 3 per-batch sync runs the sync conditionally,
+    not once per iteration."""
+    for a in ancestors(node):
+        if a is loop:
+            return False
+        if isinstance(a, ast.If):
+            return True
+        if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
+            # an inner loop is the one whose per-iteration cost matters;
+            # the caller iterates innermost-first so just stop here
+            return False
+    return False
+
+
+def name_loads(scope_node: ast.AST, name: str) -> List[ast.Name]:
+    return [n for n in ast.walk(scope_node)
+            if isinstance(n, ast.Name) and n.id == name
+            and isinstance(n.ctx, ast.Load)]
+
+
+# ------------------------------------------------------------- device taint
+
+#: dotted-callee prefixes whose call results live on device
+DEVICE_NAMESPACES = (
+    "jnp.", "jax.numpy.", "jax.lax.", "jax.nn.", "jax.random.",
+    "jax.image.", "jax.scipy.",
+)
+
+#: callee patterns that return device values in this codebase: jitted
+#: step functions and flax ``.apply``
+_STEP_NAME_RE = re.compile(r"(^|_)step(_fn)?$")
+
+#: sync sinks: (callee dotted name, arg index) — ``float(x)`` etc.
+SYNC_CALLEES = {"float": 0, "int": 0, "jax.device_get": 0,
+                "jax.block_until_ready": 0, "np.asarray": 0,
+                "numpy.asarray": 0}
+#: sync methods on the value itself
+SYNC_METHODS = ("item", "tolist", "block_until_ready")
+#: of the sinks above, the ones that read back regardless of taint
+#: heuristics — jax.* syncs are unambiguous
+ALWAYS_SYNC_CALLEES = ("jax.device_get", "jax.block_until_ready")
+
+
+class DeviceTaint:
+    """Forward, flow-insensitive-ish name taint for one analysis scope.
+
+    Two passes over the scope's own statements approximate loop
+    back-edges; the result is the set of names that *may* hold device
+    values anywhere in the scope.  Sinks then pair that set with
+    position (inside an unguarded loop body) to decide.
+    """
+
+    def __init__(self, scope: ast.AST, jit_bound: Set[str],
+                 extra_producers: Sequence[str] = ()):
+        self.scope = scope
+        self.jit_bound = jit_bound
+        self.extra = [re.compile(p) for p in extra_producers]
+        self.tainted: Set[str] = set()
+        for _ in range(2):
+            self._pass()
+
+    # -- producers ---------------------------------------------------------
+    def _producer_call(self, call: ast.Call) -> bool:
+        callee = call_callee(call)
+        if callee:
+            if callee in ("jax.device_get", "np.asarray", "numpy.asarray"):
+                return False  # these RETURN host values
+            if any(callee.startswith(ns) for ns in DEVICE_NAMESPACES):
+                return True
+            if callee == "jax.device_put":
+                return True
+            base = callee.split(".")[-1]
+            if _STEP_NAME_RE.search(base):
+                return True
+            if base == "apply" or callee.endswith(".apply"):
+                return True
+            if callee.split(".")[0] in self.jit_bound and "." not in callee:
+                return True
+            if any(p.search(callee) for p in self.extra):
+                return True
+        # jax.jit(f)(x) / pjit(f)(x): callee is itself a call expression
+        if isinstance(call.func, ast.Call):
+            inner = call_callee(call.func)
+            if inner in ("jax.jit", "jax.pmap", "pjit", "jax.pjit",
+                         "jax.experimental.pjit.pjit"):
+                return True
+        return False
+
+    def is_tainted(self, expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, ast.Attribute):
+            return self.is_tainted(expr.value)
+        if isinstance(expr, ast.Subscript):
+            return self.is_tainted(expr.value)
+        if isinstance(expr, ast.BinOp):
+            return self.is_tainted(expr.left) or self.is_tainted(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.is_tainted(expr.operand)
+        if isinstance(expr, ast.IfExp):
+            return self.is_tainted(expr.body) or self.is_tainted(expr.orelse)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in expr.elts)
+        if isinstance(expr, ast.Starred):
+            return self.is_tainted(expr.value)
+        if isinstance(expr, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            # taint flows out of a comprehension iff it flows in: either
+            # the element expression or an iterated source is tainted
+            # (the comprehension targets are bound from the iterables)
+            if any(self.is_tainted(g.iter) for g in expr.generators):
+                return True
+            return self.is_tainted(expr.elt)
+        if isinstance(expr, ast.Call):
+            callee = call_callee(expr)
+            if callee in SYNC_CALLEES or (
+                    isinstance(expr.func, ast.Attribute)
+                    and expr.func.attr in SYNC_METHODS):
+                return False  # the sync RESULT is a host value
+            if self._producer_call(expr):
+                return True
+            # a method on a tainted receiver keeps the value on device
+            # (loss.mean(), state.replace(...))
+            if isinstance(expr.func, ast.Attribute):
+                return self.is_tainted(expr.func.value)
+            return False
+        return False
+
+    # -- one forward pass --------------------------------------------------
+    def _pass(self) -> None:
+        for stmt in own_statements(self.scope):
+            if isinstance(stmt, ast.Assign):
+                t = self.is_tainted(stmt.value)
+                for target in stmt.targets:
+                    for name in assigned_names(target):
+                        (self.tainted.add if t
+                         else self.tainted.discard)(name)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                t = self.is_tainted(stmt.value)
+                for name in assigned_names(stmt.target):
+                    (self.tainted.add if t else self.tainted.discard)(name)
+            elif isinstance(stmt, ast.AugAssign):
+                if self.is_tainted(stmt.value):
+                    self.tainted.update(assigned_names(stmt.target))
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                if self.is_tainted(stmt.iter):
+                    self.tainted.update(assigned_names(stmt.target))
+            elif isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                           ast.Call):
+                # container.append(tainted) taints the container — the
+                # buffered-readback idiom iterates it later
+                call = stmt.value
+                if (isinstance(call.func, ast.Attribute)
+                        and call.func.attr in ("append", "extend", "add",
+                                               "insert")
+                        and isinstance(call.func.value, ast.Name)
+                        and any(self.is_tainted(a) for a in call.args)):
+                    self.tainted.add(call.func.value.id)
+
+
+def collect_jit_bound(tree: ast.AST) -> Set[str]:
+    """Names anywhere in the module assigned from ``jax.jit`` /
+    ``jax.pmap`` / ``pjit`` calls — calling them yields device values."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = call_callee(node.value)
+            if callee in ("jax.jit", "jax.pmap", "pjit", "jax.pjit"):
+                for t in node.targets:
+                    out.update(assigned_names(t))
+    return out
+
+
+def sync_call_argument(call: ast.Call) -> Optional[ast.expr]:
+    """The device-value operand of a host-sync call, or None when the
+    call is not a sync sink."""
+    callee = call_callee(call)
+    if callee in SYNC_CALLEES:
+        idx = SYNC_CALLEES[callee]
+        if len(call.args) == 1 + idx and not call.keywords:
+            return call.args[idx]
+        # np.asarray(x, dtype) converts — a copy, not a zero-cost view
+        # readback; float(x)/int(x) never take extra args for arrays
+        if callee in ALWAYS_SYNC_CALLEES and call.args:
+            return call.args[0]
+        return None
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in SYNC_METHODS and not call.args:
+        return call.func.value
+    return None

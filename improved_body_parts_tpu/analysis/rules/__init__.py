@@ -1,0 +1,18 @@
+"""graftlint rules — importing this package registers every rule.
+
+Each module encodes one bug class this repo has actually shipped; the
+rule docstrings carry the postmortem.  Add a rule by dropping a module
+here with a ``@register``-decorated :class:`~..core.Rule` subclass and
+importing it below — the fixture-test contract in
+``tests/test_graftlint.py`` (bad snippet flags / fixed idiom passes /
+suppressed site is silent) applies to new rules too.
+"""
+from . import (  # noqa: F401 — imported for registration side effect
+    bare_print,
+    donation,
+    host_sync,
+    lifecycle,
+    metric_names,
+    recompile,
+    strict_json,
+)

@@ -1,0 +1,49 @@
+"""JGL007 — bare print in library code.
+
+Postmortem encoded (PR 3): every signal the reference printed died in
+stdout; the obs stack exists so library-layer reports reach the run's
+structured event stream.  ``utils.profiling.timed`` is the pattern:
+emit through ``obs.events.get_sink()`` when a run installed one, fall
+back to print otherwise — call sites keep working with telemetry off,
+and stop polluting stdout the moment a run turns it on.
+
+Scope: ``improved_body_parts_tpu/`` library modules only.  CLI tools
+(``tools/``), tests and the package's ``demo``/CLI entry points print
+by design.
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import dataflow as df
+from ..core import ModuleContext, Rule, register
+
+#: library files whose job is interactive stdout (CLI entry points)
+_EXEMPT_SUFFIXES = ("/demo.py",)
+
+
+@register
+class BarePrint(Rule):
+    id = "JGL007"
+    name = "bare-print"
+    severity = "warning"
+    postmortem = ("PR 3: signals printed to stdout are invisible to the "
+                  "run's event stream; route via obs.events.get_sink() "
+                  "with a print fallback (utils.profiling.timed)")
+
+    def check(self, ctx: ModuleContext) -> None:
+        if not ctx.under("improved_body_parts_tpu"):
+            return
+        if ctx.rel_path.endswith(_EXEMPT_SUFFIXES):
+            return
+        if "print(" not in ctx.source:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    df.call_callee(node) == "print":
+                ctx.finding(
+                    self, node,
+                    "bare print() in library code never reaches the "
+                    "run's event stream; emit through "
+                    "obs.events.get_sink() when enabled and fall back "
+                    "to print (the utils.profiling.timed pattern)")

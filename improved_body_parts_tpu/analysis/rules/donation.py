@@ -1,0 +1,269 @@
+"""JGL001 — donation safety.
+
+Postmortems encoded (PR 5, PR 6): a ``jit(..., donate_argnums=...)``
+step writes its outputs *in place* through its donated input buffers;
+with a donated executable served from the persistent compilation cache
+(jax 0.4.37, host platform) it does so WITHOUT marking the donated
+array deleted — so a value read after it flowed into a donated call, or
+a zero-copy ``np.asarray`` view of a state leaf that escapes without
+``.copy()``, silently corrupts whatever still references it (the PR 5
+in-flight-checkpoint corruption, the PR 6 resume corruption).
+
+Two checks, both intra-procedural:
+
+1. **read-after-donation** — a name passed in a donated position of a
+   call to a known donating callable is *consumed*; any later read of
+   that name in the same scope (before rebinding) is an error.  Inside
+   a loop, a donating call whose donated name is never rebound in the
+   loop body is flagged at the call itself: the next iteration reads a
+   donated buffer.
+2. **escaping asarray view** — in a module that manipulates donated
+   buffers (mentions ``donate_argnums`` / ``copy_to_host_async``), an
+   ``np.asarray(x)`` result that escapes the function (returned,
+   yielded, stored, appended) without a ``.copy()`` is an error: on the
+   CPU backend ``np.asarray`` of a device array is a zero-copy view of
+   a donatable buffer.
+
+Donating callables: names assigned from ``jax.jit(..., donate_argnums=
+...)`` in the same module, plus the configured factories
+(``donating-factories`` in ``[tool.graftlint]``, default
+``make_train_step:0``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import dataflow as df
+from ..core import ModuleContext, Rule, register
+
+_JIT_CALLEES = ("jax.jit", "jax.pmap", "pjit", "jax.pjit")
+
+
+def _donated_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Donated positions of a ``jax.jit(...)`` call, or None when the
+    call does not donate.  Non-literal ``donate_argnums`` expressions
+    (``(0,) if donate else ()``) conservatively donate position 0."""
+    kw = df.call_kwarg(call, "donate_argnums")
+    if kw is None:
+        if df.call_kwarg(call, "donate_argnames") is not None:
+            return (0,)
+        return None
+    try:
+        val = ast.literal_eval(kw)
+    except ValueError:
+        return (0,)
+    if val is None:
+        return None
+    if isinstance(val, int):
+        return (val,)
+    positions = tuple(int(v) for v in val)
+    return positions or None
+
+
+def _collect_donating(tree: ast.AST, ctx: ModuleContext
+                      ) -> Dict[str, Tuple[int, ...]]:
+    """name -> donated positions, for names assigned from donating
+    ``jax.jit`` calls or configured donating factories."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        callee = df.call_callee(node.value)
+        positions: Optional[Tuple[int, ...]] = None
+        if callee in _JIT_CALLEES:
+            positions = _donated_positions(node.value)
+        elif callee:
+            positions = ctx.config.donated_positions(callee.split(".")[-1])
+            if positions:
+                # an explicit donate=False at the factory call site
+                # opts out (make_train_step(..., donate=False))
+                donate = df.call_kwarg(node.value, "donate")
+                if isinstance(donate, ast.Constant) and \
+                        donate.value is False:
+                    positions = None
+        if positions:
+            for t in node.targets:
+                for name in df.assigned_names(t):
+                    out[name] = positions
+    return out
+
+
+@register
+class DonationSafety(Rule):
+    id = "JGL001"
+    name = "donation-safety"
+    severity = "error"
+    postmortem = ("PR 5: snapshot views of donated state corrupted "
+                  "in-flight checkpoints; PR 6: cache-served donated "
+                  "executable corrupted resumed runs")
+
+    def check(self, ctx: ModuleContext) -> None:
+        # cheap source precheck: donation requires a jit call or a
+        # configured donating factory by name
+        factory_names = tuple(spec.partition(":")[0] for spec
+                              in ctx.config.donating_factories)
+        if any(tok in ctx.source
+               for tok in ("jit(", "pmap(") + factory_names):
+            donating = _collect_donating(ctx.tree, ctx)
+            if donating:
+                for scope in df.functions(ctx.tree):
+                    self._check_read_after_donation(ctx, scope, donating)
+        if ("donate_argnums" in ctx.source
+                or "copy_to_host_async" in ctx.source):
+            for scope in df.functions(ctx.tree):
+                if isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    self._check_asarray_escape(ctx, scope)
+
+    # ------------------------------------------------- read after donation
+    def _check_read_after_donation(self, ctx: ModuleContext,
+                                   scope: ast.AST,
+                                   donating: Dict[str, Tuple[int, ...]]
+                                   ) -> None:
+        stmts = df.own_statements(scope)
+        # (donated name, consuming call, rebound-by-same-stmt?)
+        consumed: Dict[str, ast.Call] = {}
+        for stmt in stmts:
+            rebound = set(df.stmt_bound_names(stmt))
+            donated_here: List[Tuple[str, ast.Call]] = []
+            for node in df.walk_scope(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = df.call_callee(node)
+                if callee is None or callee not in donating:
+                    continue
+                for pos in donating[callee]:
+                    if pos < len(node.args) and isinstance(node.args[pos],
+                                                           ast.Name):
+                        donated_here.append((node.args[pos].id, node))
+            # reads in this statement of PREVIOUSLY consumed names
+            for name_node in df.walk_scope(stmt):
+                if (isinstance(name_node, ast.Name)
+                        and isinstance(name_node.ctx, ast.Load)
+                        and name_node.id in consumed):
+                    call = consumed[name_node.id]
+                    # the donating call's own argument is the consumption
+                    # site, not a read-after
+                    if any(name_node is a for a in call.args):
+                        continue
+                    ctx.finding(self, name_node,
+                                f"`{name_node.id}` is read after being "
+                                f"donated to the jitted call on line "
+                                f"{call.lineno}; a donated buffer may "
+                                "already hold the step's outputs "
+                                "(rebind the result, or snapshot with "
+                                "an owned copy first)")
+                    del consumed[name_node.id]  # one finding per donation
+            for name in rebound:
+                consumed.pop(name, None)
+            for name, call in donated_here:
+                if name not in rebound:
+                    consumed[name] = call
+        # loop bodies: a donated name never rebound anywhere in the loop
+        # body is handed to the donating call again on the next
+        # iteration — flag the call itself (`out = step(state, b)` in a
+        # loop without `state = ...` is the classic)
+        for loop in df.loops_in(scope):
+            loop_stmts = df.own_statements(loop)
+            bound_in_loop: Set[str] = set()
+            for stmt in loop_stmts:
+                bound_in_loop.update(df.stmt_bound_names(stmt))
+            seen: Set[Tuple[str, int]] = set()
+            for stmt in loop_stmts:
+                for node in df.walk_scope(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = df.call_callee(node)
+                    if callee is None or callee not in donating:
+                        continue
+                    for pos in donating[callee]:
+                        if pos < len(node.args) and \
+                                isinstance(node.args[pos], ast.Name):
+                            name = node.args[pos].id
+                            key = (name, node.lineno)
+                            if name not in bound_in_loop and \
+                                    key not in seen:
+                                seen.add(key)
+                                ctx.finding(
+                                    self, node,
+                                    f"`{name}` is donated to this call "
+                                    "every loop iteration but never "
+                                    "rebound in the loop body; the next "
+                                    "iteration reads a donated buffer "
+                                    "(rebind: `"
+                                    f"{name}, ... = {callee}(...)`)")
+
+    # --------------------------------------------------- asarray view escape
+    def _check_asarray_escape(self, ctx: ModuleContext,
+                              fn: ast.AST) -> None:
+        stmts = df.own_statements(fn)
+        views: Dict[str, ast.Call] = {}
+        copied: Set[str] = set()
+        for stmt in stmts:
+            for node in df.walk_scope(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = df.call_callee(node)
+                if callee in ("np.asarray", "numpy.asarray") and \
+                        len(node.args) == 1 and not node.keywords and \
+                        isinstance(node.args[0], ast.Name):
+                    parent_stmt = df.stmt_ancestor(node)
+                    if isinstance(parent_stmt, ast.Assign) and \
+                            parent_stmt.value is node:
+                        for t in parent_stmt.targets:
+                            for name in df.assigned_names(t):
+                                views[name] = node
+                    elif isinstance(parent_stmt, ast.Return):
+                        # `return np.asarray(x)` — escapes uncopied
+                        ctx.finding(self, node, self._escape_msg(
+                            node.args[0].id))
+                # name.copy() sanitizes the view wherever it appears —
+                # including the conditional-copy repair idiom
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "copy" and \
+                        isinstance(node.func.value, ast.Name):
+                    copied.add(node.func.value.id)
+        for name, call in views.items():
+            if name in copied:
+                continue
+            if self._escapes(fn, name):
+                ctx.finding(self, call, self._escape_msg(
+                    call.args[0].id, via=name))
+
+    @staticmethod
+    def _escape_msg(src: str, via: str = "") -> str:
+        head = (f"`np.asarray({src})`"
+                + (f" (as `{via}`)" if via and via != src else ""))
+        return (f"{head} may be a zero-copy view of a donatable device "
+                "buffer and escapes this function without `.copy()`; a "
+                "later donated step writes through it (PR 5/6 in-flight "
+                "checkpoint corruption) — copy when "
+                "`not arr.flags.owndata`")
+
+    def _escapes(self, fn: ast.AST, name: str) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and node.value is not None:
+                if any(n.id == name for n in ast.walk(node.value)
+                       if isinstance(n, ast.Name)
+                       and isinstance(n.ctx, ast.Load)):
+                    return True
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("append", "extend", "add", "put",
+                                       "update", "insert"):
+                if any(isinstance(a, ast.Name) and a.id == name
+                       for a in node.args):
+                    return True
+            if isinstance(node, ast.Assign):
+                stores_out = any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in node.targets)
+                if stores_out and any(
+                        isinstance(n, ast.Name) and n.id == name
+                        and isinstance(n.ctx, ast.Load)
+                        for n in ast.walk(node.value)):
+                    return True
+        return False

@@ -1,0 +1,118 @@
+"""JGL002 — hidden host sync in per-batch loops.
+
+Postmortem encoded (PR 3): ``eval_epoch`` called ``float(loss)`` on
+every batch — each call blocks the host on that step's device result,
+serializing host placement against dispatch and defeating
+``device_prefetch`` for the whole pass.  The repair buffers the device
+scalars and reads them back in *windows* (``if len(pending) >=
+readback_freq: float(...)``), which is exactly the shape this rule
+passes: a sync guarded by an ``if`` inside the loop runs once per
+window, not once per iteration.
+
+Scope: files under ``improved_body_parts_tpu/{train,serve,infer}`` —
+the per-batch hot paths.  A sync is flagged when all of:
+
+- it is a host-sync operation (``float()``, ``int()``, ``.item()``,
+  ``.tolist()``, ``np.asarray()``, ``jax.device_get()``,
+  ``block_until_ready``);
+- its operand may hold a device value (taint from ``jnp.*`` /
+  ``jax.lax.*`` calls, jitted-name calls, ``*_step`` calls, ``.apply``,
+  with propagation through assignment, arithmetic, buffering
+  ``.append`` and iteration);
+- it executes on *every* iteration of a loop (not nested under an
+  ``if``, not in a nested function).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from .. import dataflow as df
+from ..core import ModuleContext, Rule, register
+
+
+@register
+class HiddenHostSync(Rule):
+    id = "JGL002"
+    name = "hidden-host-sync"
+    severity = "error"
+    postmortem = ("PR 3: per-batch float(loss) in eval_epoch defeated "
+                  "device_prefetch; fixed by windowed readback")
+
+    SCOPE = ("improved_body_parts_tpu/train",
+             "improved_body_parts_tpu/serve",
+             "improved_body_parts_tpu/infer")
+
+    def check(self, ctx: ModuleContext) -> None:
+        if not ctx.under(*self.SCOPE):
+            return
+        jit_bound = df.collect_jit_bound(ctx.tree)
+        for scope in df.functions(ctx.tree):
+            taint = df.DeviceTaint(scope, jit_bound,
+                                   ctx.config.extra_device_producers)
+            if not taint.tainted:
+                continue
+            reported: Set[int] = set()
+            for stmt in df.own_statements(scope):
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call) or \
+                            id(node) in reported:
+                        continue
+                    reported.add(id(node))
+                    operand = df.sync_call_argument(node)
+                    if operand is None or not taint.is_tainted(operand):
+                        continue
+                    if df.in_nested_function(node, scope):
+                        continue
+                    # walk enclosing loops innermost-first, skipping
+                    # loops that dispatch no device work themselves —
+                    # the windowed-readback repair DRAINS a buffer in an
+                    # inner producer-free loop (`for v in pending:
+                    # float(v)`), and draining N already-computed
+                    # scalars is the amortized idiom, not the stall.
+                    # The first producer loop decides: guarded by an if
+                    # on the way up -> windowed -> pass.
+                    if not self._unguarded_in_producer_loop(node, scope,
+                                                            taint):
+                        continue
+                    op = df.call_callee(node) or \
+                        f".{node.func.attr}()"  # type: ignore[union-attr]
+                    ctx.finding(
+                        self, node,
+                        f"`{op}` on a device value inside a per-batch "
+                        "loop syncs the host every iteration and defeats "
+                        "device_prefetch (the PR 3 eval stall); buffer "
+                        "the device scalars and read back in windows "
+                        "(`if len(pending) >= N: ...`)")
+
+    @staticmethod
+    def _loop_dispatches(loop: ast.AST, taint: df.DeviceTaint) -> bool:
+        """True when the loop body itself produces device values (calls
+        a jitted step / jnp op / .apply) — the loops where a
+        per-iteration sync serializes host against dispatch."""
+        for stmt in df.own_statements(loop):
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and \
+                        taint._producer_call(node):
+                    return True
+        return False
+
+    def _unguarded_in_producer_loop(self, node: ast.AST, scope: ast.AST,
+                                    taint: df.DeviceTaint) -> bool:
+        guarded = False
+        for a in df.ancestors(node):
+            if a is scope:
+                return False
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return False
+            if isinstance(a, ast.If):
+                guarded = True
+            if isinstance(a, (ast.For, ast.AsyncFor, ast.While)):
+                if self._loop_dispatches(a, taint):
+                    return not guarded
+                # producer-free drain loop: one windowed readback costs
+                # one iteration of the NEXT enclosing loop — keep
+                # walking out (an If above this drain loop still
+                # guards the outer producer loop)
+        return False

@@ -1,0 +1,191 @@
+"""JGL005 — resource lifecycle.
+
+Postmortems encoded (PRs 2/4/6): the prefetch producer thread leaked
+and pinned an in-flight device buffer (PR 2); the orbax
+``AsyncCheckpointer`` leaked its commit thread per manager (PR 5); ring
+workers outlived SIGKILLed consumers (PR 6).  Every one was a
+concurrency primitive created without a join/close on the exit path.
+
+Flagged: a thread / pool / executor / shared-memory segment /
+subprocess bound to a *local* name with **no** cleanup call
+(``join``/``close``/``shutdown``/``terminate``/``kill``/``wait``/
+``unlink``/``stop``/``release``) anywhere in the function.
+
+Exempt (ownership is elsewhere or lifetime is the process):
+
+- created with ``daemon=True`` (dies with the process by design);
+- stored on ``self``/an attribute/a subscript (object lifecycle);
+- returned or yielded (caller owns it);
+- used as a context manager (``with``);
+- appended to a container that is itself cleaned up in a loop
+  (``for t in threads: t.join()``).
+
+The rule checks *existence* of cleanup, not full path coverage — the
+all-exit-paths discipline (try/finally) is reviewed where the cleanup
+sits; a missing cleanup is the shipped bug class.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .. import dataflow as df
+from ..core import ModuleContext, Rule, register
+
+_CONSTRUCTOR_SUFFIXES = (
+    "threading.Thread", "Thread",
+    "ThreadPoolExecutor", "ProcessPoolExecutor",
+    "multiprocessing.Pool", "mp.Pool",
+    "shared_memory.SharedMemory", "SharedMemory",
+    "subprocess.Popen", "Popen",
+)
+_CLEANUPS = ("join", "close", "shutdown", "terminate", "kill", "wait",
+             "unlink", "stop", "release")
+
+
+def _is_constructor(callee: Optional[str]) -> bool:
+    if callee is None:
+        return False
+    return any(callee == s or callee.endswith("." + s)
+               for s in _CONSTRUCTOR_SUFFIXES)
+
+
+@register
+class ResourceLifecycle(Rule):
+    id = "JGL005"
+    name = "resource-lifecycle"
+    severity = "warning"
+    postmortem = ("PR 2: leaked prefetch thread pinned a device buffer; "
+                  "PR 5: leaked orbax commit threads; PR 6: orphaned "
+                  "ring workers")
+
+    #: cheap source precheck — most files construct none of these, and
+    #: the dataflow walk below is the scan's hottest rule without it
+    _TOKENS = ("Thread", "Pool", "Executor", "SharedMemory", "Popen")
+
+    def check(self, ctx: ModuleContext) -> None:
+        if not any(tok in ctx.source for tok in self._TOKENS):
+            return
+        for scope in df.functions(ctx.tree):
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            self._check_scope(ctx, scope)
+
+    def _check_scope(self, ctx: ModuleContext, fn: ast.AST) -> None:
+        stmts = df.own_statements(fn)
+        created: Dict[str, ast.Call] = {}
+        appended_to: Dict[str, str] = {}  # resource container -> example
+        cleaned: Set[str] = set()
+        escaped: Set[str] = set()
+        containers_cleaned: Set[str] = set()
+
+        for stmt in stmts:
+            for node in df.walk_scope(stmt):
+                if isinstance(node, ast.Call):
+                    callee = df.call_callee(node)
+                    if _is_constructor(callee):
+                        daemon = df.call_kwarg(node, "daemon")
+                        if isinstance(daemon, ast.Constant) and \
+                                daemon.value is True:
+                            continue
+                        parent_stmt = df.stmt_ancestor(node)
+                        if isinstance(parent_stmt, (ast.With,
+                                                    ast.AsyncWith)):
+                            continue
+                        if isinstance(parent_stmt, ast.Return):
+                            continue  # `return Thread(...)`: caller owns
+                        if isinstance(parent_stmt, ast.Assign) and \
+                                parent_stmt.value is node:
+                            names = []
+                            attr_store = False
+                            for t in parent_stmt.targets:
+                                if isinstance(t, (ast.Attribute,
+                                                  ast.Subscript)):
+                                    attr_store = True
+                                names.extend(df.assigned_names(t))
+                            if attr_store:
+                                continue
+                            for name in names:
+                                created[name] = node
+                        elif isinstance(node.graftlint_parent, ast.Call):
+                            # SomeContainer.append(Thread(...)) — track
+                            # the container
+                            outer = node.graftlint_parent
+                            if isinstance(outer.func, ast.Attribute) and \
+                                    outer.func.attr in ("append",
+                                                        "add") and \
+                                    isinstance(outer.func.value,
+                                               ast.Name):
+                                appended_to[outer.func.value.id] = \
+                                    callee or "resource"
+                                created.setdefault(
+                                    "@" + outer.func.value.id, node)
+                    # cleanup calls on names
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in _CLEANUPS and \
+                            isinstance(node.func.value, ast.Name):
+                        cleaned.add(node.func.value.id)
+            # escape routes
+            if isinstance(stmt, (ast.Return,)) and stmt.value is not None:
+                for n in ast.walk(stmt.value):
+                    if isinstance(n, ast.Name):
+                        escaped.add(n.id)
+            for n in ast.walk(stmt):
+                if isinstance(n, (ast.Yield, ast.YieldFrom)) and \
+                        n.value is not None:
+                    for nn in ast.walk(n.value):
+                        if isinstance(nn, ast.Name):
+                            escaped.add(nn.id)
+            if isinstance(stmt, ast.Assign):
+                # self.x = t  /  d[k] = t: ownership transferred
+                if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in stmt.targets):
+                    for n in ast.walk(stmt.value):
+                        if isinstance(n, ast.Name):
+                            escaped.add(n.id)
+            # resource appended to a container cleaned in a loop:
+            # `for t in threads: t.join()`
+            if isinstance(stmt, (ast.For, ast.AsyncFor)) and \
+                    isinstance(stmt.iter, ast.Name) and \
+                    stmt.iter.id in appended_to:
+                targets = df.assigned_names(stmt.target)
+                for node in df.walk_scope(stmt):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in _CLEANUPS and \
+                            isinstance(node.func.value, ast.Name) and \
+                            node.func.value.id in targets:
+                        containers_cleaned.add(stmt.iter.id)
+
+        for name, call in created.items():
+            if name.startswith("@"):
+                container = name[1:]
+                if container in containers_cleaned or \
+                        container in escaped or container in cleaned:
+                    continue
+            elif name in cleaned or name in escaped:
+                continue
+            # `t` passed whole to another call (handoff: supervisor,
+            # registry) — treat as ownership transfer
+            if not name.startswith("@") and self._passed_on(stmts, name):
+                continue
+            what = df.call_callee(call) or "resource"
+            ctx.finding(
+                self, call,
+                f"`{what}` created here has no "
+                f"join/close/shutdown on any path in this function and "
+                "never escapes it — a leaked worker pins its resources "
+                "past the run (PR 2/5/6 leak class); clean up in a "
+                "finally block or hand ownership somewhere that does")
+
+    @staticmethod
+    def _passed_on(stmts: List[ast.stmt], name: str) -> bool:
+        for stmt in stmts:
+            for node in df.walk_scope(stmt):
+                if isinstance(node, ast.Call):
+                    for a in list(node.args) + [k.value
+                                                for k in node.keywords]:
+                        if isinstance(a, ast.Name) and a.id == name:
+                            return True
+        return False

@@ -1,0 +1,96 @@
+"""JGL006 — metric naming at Registry call sites.
+
+Postmortem encoded (PR 4): the obs exposition lint
+(``tests/test_obs.py::TestMetricNameLint``) runs at *runtime* over
+whatever one instrumented dry-run happened to register — a bad name on
+a path the dry-run misses ships to the production scrape.  This rule
+promotes the same contract to a static check over every
+``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` call site
+with a literal name (or a ``prefix + "literal"`` suffix):
+
+- names match the Prometheus charset ``[a-zA-Z_:][a-zA-Z0-9_:]*``;
+- counters end in ``_total`` (the convention the scrape-side rules
+  assume; ``Registry.span`` appends ``_seconds`` itself and is exempt);
+- literal label keys match ``[a-zA-Z_][a-zA-Z0-9_]*``.
+
+Non-literal names are skipped — the runtime lint still covers those.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional, Tuple
+
+from .. import dataflow as df
+from ..core import ModuleContext, Rule, register
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SUFFIX_RE = re.compile(r"^[a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+_METHODS = ("counter", "gauge", "histogram")
+
+
+def _literal_name(expr: ast.expr) -> Optional[Tuple[str, bool]]:
+    """(text, is_full_name) for a literal or prefix+literal name."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value, True
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add) and \
+            isinstance(expr.right, ast.Constant) and \
+            isinstance(expr.right.value, str):
+        return expr.right.value, False
+    return None
+
+
+@register
+class MetricNames(Rule):
+    id = "JGL006"
+    name = "metric-names"
+    severity = "error"
+    postmortem = ("PR 4: exposition naming enforced only at runtime "
+                  "over one dry-run's registrations")
+
+    def check(self, ctx: ModuleContext) -> None:
+        if not any(f".{m}(" in ctx.source for m in _METHODS):
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METHODS
+                    and node.args):
+                continue
+            lit = _literal_name(node.args[0])
+            if lit is None:
+                continue
+            text, full = lit
+            if full and not _NAME_RE.match(text):
+                ctx.finding(self, node.args[0],
+                            f"metric name {text!r} is not Prometheus-"
+                            "legal ([a-zA-Z_:][a-zA-Z0-9_:]*)")
+                continue
+            if not full and not _SUFFIX_RE.match(text):
+                ctx.finding(self, node.args[0],
+                            f"metric name suffix {text!r} contains "
+                            "characters outside [a-zA-Z0-9_:]")
+                continue
+            if node.func.attr == "counter" and \
+                    not text.endswith("_total"):
+                ctx.finding(self, node.args[0],
+                            f"counter {text!r} must end in `_total` "
+                            "(the scrape-side convention "
+                            "tests/test_obs.py enforces at runtime)")
+            self._check_labels(ctx, node)
+
+    def _check_labels(self, ctx: ModuleContext, node: ast.Call) -> None:
+        labels = df.call_kwarg(node, "labels")
+        if labels is None and len(node.args) >= 3:
+            labels = node.args[2]
+        if not isinstance(labels, ast.Dict):
+            return
+        for key in labels.keys:
+            if isinstance(key, ast.Constant) and \
+                    isinstance(key.value, str) and \
+                    not _LABEL_RE.match(key.value):
+                ctx.finding(self, key,
+                            f"label key {key.value!r} is not "
+                            "Prometheus-legal ([a-zA-Z_][a-zA-Z0-9_]*)")

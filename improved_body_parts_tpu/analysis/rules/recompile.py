@@ -1,0 +1,235 @@
+"""JGL003 — recompile hazards.
+
+Postmortem encoded (PR 3/4): the obs ``CompileWatch`` exists because
+post-warmup XLA recompiles silently multiply step time; the recompile
+patterns it catches *at runtime* are statically visible at the call
+site.  Three shapes:
+
+1. **jit-in-loop** — ``jax.jit(...)`` invoked inside a ``for``/``while``
+   body over a lambda or locally-defined function creates a *fresh*
+   wrapped callable each iteration: every call retraces (the jit cache
+   keys on function identity).  Hoist the jit, or cache the wrapper
+   behind a dict-miss guard (a jit call under an ``if`` inside the loop
+   is the caching idiom and passes).
+2. **mutable static arg** — a list/dict/set display (or ``list()`` /
+   ``dict()`` / ``set()`` call) passed in a ``static_argnums`` position
+   compares unequal (or unhashably) call-to-call → recompile every
+   call.
+3. **closure over a mutated name** — a function passed to ``jax.jit``
+   that reads an enclosing-scope name which the enclosing scope
+   *mutates* (``.append``/``.update``/subscript-store/augassign): the
+   traced value is baked at first call, so the mutation silently never
+   reaches the compiled program (or forces a retrace via shape change).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import dataflow as df
+from ..core import ModuleContext, Rule, register
+
+_JIT_CALLEES = ("jax.jit", "jax.pmap", "pjit", "jax.pjit")
+_MUTATORS = ("append", "extend", "add", "insert", "update", "setdefault",
+             "pop", "remove", "clear")
+
+
+def _static_positions(call: ast.Call) -> Tuple[int, ...]:
+    kw = df.call_kwarg(call, "static_argnums")
+    if kw is None:
+        return ()
+    try:
+        val = ast.literal_eval(kw)
+    except ValueError:
+        return ()
+    if isinstance(val, int):
+        return (val,)
+    try:
+        return tuple(int(v) for v in val)
+    except TypeError:
+        return ()
+
+
+def _is_fresh_mutable(node: ast.expr) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return df.call_callee(node) in ("list", "dict", "set")
+    return False
+
+
+@register
+class RecompileHazard(Rule):
+    id = "JGL003"
+    name = "recompile-hazard"
+    severity = "warning"
+    postmortem = ("PR 3/4: CompileWatch exists because post-warmup "
+                  "recompiles silently multiply step time")
+
+    def check(self, ctx: ModuleContext) -> None:
+        # cheap source precheck: every pattern needs a jit/pmap call
+        if not any(tok in ctx.source for tok in ("jit(", "pmap(")):
+            return
+        self._check_jit_in_loop(ctx)
+        self._check_static_mutables(ctx)
+        self._check_closure_mutables(ctx)
+
+    # ----------------------------------------------------------- jit-in-loop
+    def _check_jit_in_loop(self, ctx: ModuleContext) -> None:
+        for scope in df.functions(ctx.tree):
+            local_defs = {s.name: s for s in df.own_statements(scope)
+                          if isinstance(s, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))}
+            for loop in df.loops_in(scope):
+                defs_in_loop = {s.name for s in df.own_statements(loop)
+                                if isinstance(s, (ast.FunctionDef,
+                                                  ast.AsyncFunctionDef))}
+                for node in ast.walk(loop):
+                    if not (isinstance(node, ast.Call)
+                            and df.call_callee(node) in _JIT_CALLEES
+                            and node.args):
+                        continue
+                    if df.in_nested_function(node, scope) or \
+                            not df.is_within(node, loop):
+                        continue
+                    if df.guarded_within(node, loop):
+                        # `if key not in cache: cache[key] = jax.jit(...)`
+                        # — the caching idiom jits once per key
+                        continue
+                    target = node.args[0]
+                    fresh = isinstance(target, ast.Lambda) or (
+                        isinstance(target, ast.Name)
+                        and target.id in defs_in_loop)
+                    if fresh:
+                        ctx.finding(
+                            self, node,
+                            "jax.jit over a function object created "
+                            "inside this loop retraces every iteration "
+                            "(the jit cache keys on function identity); "
+                            "hoist the jit out of the loop or cache the "
+                            "wrapper behind a dict-miss guard")
+
+    # ------------------------------------------------------ static mutables
+    def _check_static_mutables(self, ctx: ModuleContext) -> None:
+        # name -> static positions, from module-wide jit assignments
+        static_bound: Dict[str, Tuple[int, ...]] = {}
+        static_names: Dict[str, Tuple[str, ...]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    df.call_callee(node.value) in _JIT_CALLEES:
+                pos = _static_positions(node.value)
+                kw = df.call_kwarg(node.value, "static_argnames")
+                names: Tuple[str, ...] = ()
+                if kw is not None:
+                    try:
+                        v = ast.literal_eval(kw)
+                        names = (v,) if isinstance(v, str) else tuple(v)
+                    except ValueError:
+                        names = ()
+                if not pos and not names:
+                    continue
+                for t in node.targets:
+                    for name in df.assigned_names(t):
+                        if pos:
+                            static_bound[name] = pos
+                        if names:
+                            static_names[name] = names
+        if not static_bound and not static_names:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = df.call_callee(node)
+            if callee is None:
+                continue
+            base = callee.split(".")[0] if "." not in callee else None
+            if base is None:
+                continue
+            for pos in static_bound.get(base, ()):
+                if pos < len(node.args) and \
+                        _is_fresh_mutable(node.args[pos]):
+                    ctx.finding(
+                        self, node.args[pos],
+                        f"freshly-constructed mutable passed in static "
+                        f"position {pos} of jitted `{base}`: unhashable "
+                        "or unequal across calls, so every call "
+                        "recompiles; pass a tuple / frozen value")
+            for kw in node.keywords:
+                if kw.arg in static_names.get(base, ()) and \
+                        _is_fresh_mutable(kw.value):
+                    ctx.finding(
+                        self, kw.value,
+                        f"freshly-constructed mutable passed as static "
+                        f"arg `{kw.arg}` of jitted `{base}`: every call "
+                        "recompiles; pass a tuple / frozen value")
+
+    # ------------------------------------------------------ closure mutables
+    def _check_closure_mutables(self, ctx: ModuleContext) -> None:
+        for scope in df.functions(ctx.tree):
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            nested = {s.name: s for s in df.own_statements(scope)
+                      if isinstance(s, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+            if not nested:
+                continue
+            mutated = self._mutated_names(scope)
+            if not mutated:
+                continue
+            for node in ast.walk(scope):
+                if not (isinstance(node, ast.Call)
+                        and df.call_callee(node) in _JIT_CALLEES
+                        and node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in nested):
+                    continue
+                fn = nested[node.args[0].id]
+                for free in sorted(self._free_reads(fn) & mutated):
+                    ctx.finding(
+                        self, node,
+                        f"jitted `{fn.name}` closes over `{free}`, which "
+                        "this scope mutates: the traced value is baked "
+                        "at first call, so later mutations never reach "
+                        "the compiled program (or force a retrace); "
+                        "pass it as an argument instead")
+
+    @staticmethod
+    def _mutated_names(scope: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for stmt in df.own_statements(scope):
+            if isinstance(stmt, ast.AugAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                out.add(stmt.target.id)
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name):
+                        out.add(t.value.id)
+            if isinstance(stmt, ast.Expr) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    isinstance(stmt.value.func, ast.Attribute) and \
+                    stmt.value.func.attr in _MUTATORS and \
+                    isinstance(stmt.value.func.value, ast.Name):
+                out.add(stmt.value.func.value.id)
+        return out
+
+    @staticmethod
+    def _free_reads(fn: ast.AST) -> Set[str]:
+        bound: Set[str] = set()
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            bound.add(a.arg)
+        for stmt in df.own_statements(fn):
+            bound.update(df.stmt_bound_names(stmt))
+        reads: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id not in bound:
+                reads.add(node.id)
+        return reads

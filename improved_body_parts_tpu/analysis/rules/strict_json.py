@@ -1,0 +1,67 @@
+"""JGL004 — strict JSON emission.
+
+Postmortem encoded (PRs 4–5): ``json.dumps`` on a record carrying a
+non-finite float emits bare ``NaN`` / ``Infinity`` tokens — which are
+not JSON — and the records most likely to carry them (a diverged loss,
+an empty histogram's quantiles) are exactly the ones a strict consumer
+(jq, Go, JS, the telemetry report) must parse.  Both the event sink and
+the checkpoint COMMIT markers shipped this bug before being routed
+through ``obs.events._definan``.
+
+A ``json.dumps`` / ``json.dump`` call passes when any of:
+
+- ``allow_nan=False`` is passed (the failure is loud at the emit site,
+  the ``EventSink._write`` first-try idiom);
+- the payload is wrapped in ``_definan(...)`` / ``definan(...)``;
+- the call goes through ``obs.events.strict_dumps`` /
+  ``strict_dump`` (they are the two idioms above packaged).
+
+``obs/events.py`` itself (the implementation site) is exempt.
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import dataflow as df
+from ..core import ModuleContext, Rule, register
+
+_SANITIZERS = ("_definan", "definan", "strict_dumps", "strict_dump")
+
+
+@register
+class StrictJson(Rule):
+    id = "JGL004"
+    name = "strict-json"
+    severity = "error"
+    postmortem = ("PR 4/5: bare-NaN tokens in sink records and COMMIT "
+                  "markers broke strict consumers; fixed via "
+                  "obs.events._definan")
+
+    def check(self, ctx: ModuleContext) -> None:
+        if ctx.rel_path.endswith("obs/events.py"):
+            return
+        if "json.dump" not in ctx.source:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = df.call_callee(node)
+            if callee not in ("json.dumps", "json.dump"):
+                continue
+            allow_nan = df.call_kwarg(node, "allow_nan")
+            if isinstance(allow_nan, ast.Constant) and \
+                    allow_nan.value is False:
+                continue
+            if node.args:
+                payload = node.args[0]
+                if isinstance(payload, ast.Call):
+                    inner = df.call_callee(payload)
+                    if inner and inner.split(".")[-1] in _SANITIZERS:
+                        continue
+            ctx.finding(
+                self, node,
+                f"`{callee}` emits bare NaN/Infinity tokens (not JSON) "
+                "for non-finite floats — and diverged-loss records are "
+                "exactly what strict consumers must parse; route "
+                "through obs.events.strict_dumps/_definan or pass "
+                "allow_nan=False")

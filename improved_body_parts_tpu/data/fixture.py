@@ -8,11 +8,11 @@ non-trivial heatmaps.
 """
 from __future__ import annotations
 
-import json
 from typing import Tuple
 
 import numpy as np
 
+from ..obs.events import strict_dump
 from .hdf5_corpus import NUM_COCO_PARTS, write_record
 
 # rough upright stick figure in a unit box: (x, y) per COCO part
@@ -359,8 +359,8 @@ def _write_coco_set(images_dir: str, anno_path: str, num_images: int,
                     "size": [h, w], "counts": rle_to_string(rle_encode(cm))}
             annotations.append(ann)
     with open(anno_path, "w") as f:
-        json.dump({"images": images, "annotations": annotations,
-                   "categories": [{"id": 1, "name": "person"}]}, f)
+        strict_dump({"images": images, "annotations": annotations,
+                     "categories": [{"id": 1, "name": "person"}]}, f)
     return n_scored
 
 

@@ -34,6 +34,8 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import cv2
 import numpy as np
 
+from ..obs.events import strict_dumps
+
 MIN_KEYPOINTS = 5
 MIN_AREA = 32 * 32
 MAIN_PERSON_MIN_DIST_RATIO = 0.3
@@ -165,8 +167,12 @@ def write_record(dataset_grp, images_grp, masks_grp, record: Dict, count: int,
         "objpos": record["objpos"],
         "scale_provided": record["scale_provided"],
     }
-    ds = dataset_grp.create_dataset("%07d" % count, data=json.dumps(required))
-    ds.attrs["meta"] = json.dumps(record)
+    # strict emission (graftlint JGL004): COCO floats are finite today,
+    # but a bare-NaN token in a stored record would surface as a parse
+    # error at TRAINING time, arbitrarily far from the corpus build
+    ds = dataset_grp.create_dataset("%07d" % count,
+                                    data=strict_dumps(required))
+    ds.attrs["meta"] = strict_dumps(record)
 
 
 def load_coco_annotations(anno_path: str) -> Tuple[Dict, Dict]:

@@ -20,9 +20,21 @@ from ..config import (
     InferenceParams,
     default_inference_params,
 )
+from ..obs.events import get_sink, strict_dump
 from ..utils import AverageMeter
 from .decode import decode
 from .predict import Predictor
+
+
+def _report(event: str, text: str, **fields) -> None:
+    """Progress reports reach the run's telemetry stream when a sink is
+    installed (structured record an eval run can be audited from),
+    stdout otherwise — the ``utils.profiling.timed`` pattern."""
+    sink = get_sink()
+    if sink.enabled:
+        sink.emit(event, **fields)
+    else:
+        print(text)  # graftlint: disable=JGL007 -- stdout fallback when no run installed a sink
 
 
 def process_image(predictor: Predictor, image_bgr: np.ndarray,
@@ -94,7 +106,9 @@ def format_results(keypoints: Dict[int, list], res_file: str) -> None:
                         "keypoints": flat, "score": score})
     os.makedirs(os.path.dirname(os.path.abspath(res_file)), exist_ok=True)
     with open(res_file, "w") as f:
-        json.dump(out, f)
+        # strict emission (graftlint JGL004): decode scores are floats;
+        # a bare-NaN token here would break COCO.loadRes downstream
+        strict_dump(out, f)
 
 
 def validation(predictor: Predictor, anno_file: str, images_dir: str,
@@ -133,8 +147,12 @@ def validation(predictor: Predictor, anno_file: str, images_dir: str,
     coco_eval.accumulate()
     coco_eval.summarize()
     if decode_timer.count:
-        print(f"keypoint assignment: {1.0 / max(decode_timer.avg, 1e-9):.1f} "
-              f"FPS (avg {decode_timer.avg * 1000:.1f} ms)")
+        fps = 1.0 / max(decode_timer.avg, 1e-9)
+        _report("decode_fps",
+                f"keypoint assignment: {fps:.1f} FPS "
+                f"(avg {decode_timer.avg * 1000:.1f} ms)",
+                fps=round(fps, 2),
+                avg_ms=round(decode_timer.avg * 1000, 3))
     return coco_eval
 
 
@@ -170,7 +188,10 @@ def _collect_detections(predictor: Predictor, id_to_name: Dict[int, str],
         for image_id, results in zip(ids, results_iter):
             keypoints[image_id] = results
         dt = time.perf_counter() - t0
-        print(f"end-to-end (pipelined): {len(ids) / max(dt, 1e-9):.1f} FPS")
+        fps = len(ids) / max(dt, 1e-9)
+        _report("pipeline_fps",
+                f"end-to-end (pipelined): {fps:.1f} FPS",
+                fps=round(fps, 2), images=len(ids))
     else:
         for image_id in ids:
             keypoints[image_id] = process_image(predictor, load(image_id),
@@ -240,5 +261,7 @@ def validation_oks(predictor: Predictor, anno_file: str, images_dir: str,
     format_results(detections, res_file)
 
     metrics = evaluate_oks({i: gts.get(i, []) for i in ids}, detections)
-    print("  ".join(f"{k}={v:.4f}" for k, v in metrics.items()))
+    _report("oks_summary",
+            "  ".join(f"{k}={v:.4f}" for k, v in metrics.items()),
+            **{k: round(v, 6) for k, v in metrics.items()})
     return metrics

@@ -64,6 +64,32 @@ def _jsonable(o):
         return str(o)
 
 
+def strict_dumps(obj, *, default=None, **kw) -> str:
+    """``json.dumps`` that can never emit bare ``NaN``/``Infinity``
+    tokens: first try strict (``allow_nan=False`` — the common all-finite
+    record pays no scan), and on rejection re-serialize through
+    :func:`_definan` so non-finite floats become their string names.
+
+    This is the process-wide emission idiom (graftlint JGL004): the sink,
+    the COMMIT markers and every tool/artifact writer route through it,
+    because the records most likely to carry a NaN — a diverged loss, an
+    empty histogram's quantiles — are exactly the ones strict consumers
+    (jq, Go, JS, the report tools) must be able to parse.
+    """
+    d = default if default is not None else _jsonable
+    try:
+        return json.dumps(obj, default=d, allow_nan=False, **kw)
+    except ValueError:  # non-finite float somewhere in the payload
+        return json.dumps(_definan(obj), default=lambda o: _definan(d(o)),
+                          allow_nan=True, **kw)
+
+
+def strict_dump(obj, fp, *, default=None, **kw) -> None:
+    """:func:`strict_dumps` for file targets (``json.dump`` call sites:
+    the bench/report artifacts, COMMIT markers, run ledgers)."""
+    fp.write(strict_dumps(obj, default=default, **kw))
+
+
 class NullSink:
     """Telemetry disabled: every emit is a no-op (the default sink)."""
 
@@ -106,13 +132,7 @@ class EventSink:
         self._write(header)
 
     def _write(self, rec: dict) -> None:
-        try:
-            line = json.dumps(rec, separators=(",", ":"),
-                              default=_jsonable, allow_nan=False)
-        except ValueError:  # non-finite float somewhere in the record
-            line = json.dumps(_definan(rec), separators=(",", ":"),
-                              default=lambda o: _definan(_jsonable(o)),
-                              allow_nan=True)
+        line = strict_dumps(rec, separators=(",", ":"))
         with self._lock:
             if not self._closed:
                 self._f.write(line + "\n")

@@ -29,6 +29,7 @@ import json
 import threading
 from typing import Callable, Optional
 
+from .events import _definan
 from .registry import Registry
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -58,7 +59,9 @@ class MetricsServer:
                         if extra_fn is not None:
                             snap.update(extra_fn())
                         code = 200
-                        body = json.dumps(snap, indent=2,
+                        # an empty histogram's quantiles are real NaNs;
+                        # _definan keeps the body strict JSON (JGL004)
+                        body = json.dumps(_definan(snap), indent=2,
                                           default=str).encode()
                         ctype = "application/json"
                     elif path == "/healthz":
@@ -66,7 +69,8 @@ class MetricsServer:
                                  else {"status": "ok"})
                         code = 200 if state.get("status", "ok") == "ok" \
                             else 503
-                        body = json.dumps(state, indent=2,
+                        # the diverged body carries the NaN loss itself
+                        body = json.dumps(_definan(state), indent=2,
                                           default=str).encode()
                         ctype = "application/json"
                     else:

@@ -273,8 +273,12 @@ class TraceRecorder:
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
+        from .events import strict_dump
+
         with open(path, "w") as f:
-            json.dump(self.export(), f)
+            # span args carry run floats (losses) — strict emission so a
+            # diverged run's trace stays loadable (graftlint JGL004)
+            strict_dump(self.export(), f)
         return path
 
 

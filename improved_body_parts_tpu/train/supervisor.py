@@ -57,6 +57,8 @@ import time
 import uuid
 from typing import Any, Callable, Dict, Optional
 
+from ..obs.events import strict_dump
+
 RUN_FILE = "RUN.json"
 
 # substrings marking an infrastructure/transient failure — safe to retry.
@@ -296,7 +298,10 @@ class RunSupervisor:
         self._ledger = on_disk
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(self._ledger, f, indent=2, default=str)
+            # the ledger records crash evidence (classified errors, loss
+            # fields from resume evals) — strict emission keeps it
+            # parseable exactly when a run diverged (graftlint JGL004)
+            strict_dump(self._ledger, f, indent=2, default=str)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)

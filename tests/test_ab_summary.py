@@ -9,7 +9,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _write(d, name, **kw):
     with open(os.path.join(d, name), "w") as f:
-        json.dump(kw, f)
+        json.dump(kw, f, allow_nan=False)
 
 
 def _run(tmp_path):

@@ -73,7 +73,8 @@ def test_cli_journey_train_resume_evaluate(tmp_path):
     anno = tmp_path / "person_keypoints_val.json"
     anno.write_text(json.dumps({
         "images": images, "annotations": annotations,
-        "categories": [{"id": 1, "name": "person"}]}))
+        "categories": [{"id": 1, "name": "person"}]},
+        allow_nan=False))
 
     from improved_body_parts_tpu.train.checkpoint import latest_checkpoint
 

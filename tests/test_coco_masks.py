@@ -154,7 +154,7 @@ class TestLoadCocoAnnotations:
                            {"id": 2, "name": "bicycle"}],
         }
         p = tmp_path / "ann.json"
-        p.write_text(json.dumps(data))
+        p.write_text(json.dumps(data, allow_nan=False))
         imgs, anns = load_coco_annotations(str(p))
         assert list(imgs) == [7, 3]  # file order preserved
         assert [a["id"] for a in anns[7]] == [3]  # non-person filtered

@@ -94,7 +94,8 @@ def test_validation_oks_end_to_end(tmp_path):
     anno_file = tmp_path / "person_keypoints.json"
     anno_file.write_text(json.dumps({
         "images": image_entries, "annotations": annotations,
-        "categories": [{"id": 1, "name": "person"}]}))
+        "categories": [{"id": 1, "name": "person"}]},
+        allow_nan=False))
 
     from improved_body_parts_tpu.infer import Predictor
 

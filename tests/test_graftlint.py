@@ -1,0 +1,867 @@
+"""graftlint: the static-analysis suite that encodes this repo's shipped
+bug classes as enforced rules (``improved_body_parts_tpu/analysis/``).
+
+Contract per rule (the fixture triplet):
+
+- a *bad* snippet reproducing the bug class must flag;
+- the *fixed* idiom that repaired it must pass (false-positive guard);
+- a *suppressed* site (``# graftlint: disable=... -- reason``) must
+  stay silent, and a reasonless pragma must both NOT suppress and be an
+  error itself (JGL000).
+
+Plus the historical regressions verbatim-shaped: PR 5's snapshot-view
+read and PR 3's per-batch ``float(loss)`` loop — the two postmortems
+the suite exists for — and the tier-1 self-scan gate
+(:func:`test_self_scan_clean`) that keeps the real tree at zero
+error-severity findings.
+
+No jax import anywhere in the linter path: these tests run on a bare
+interpreter.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from improved_body_parts_tpu.analysis import (  # noqa: E402
+    GRAFTLINT_VERSION,
+    LintConfig,
+    all_rules,
+    lint_paths,
+    lint_source,
+    load_config,
+    ruleset_hash,
+)
+from improved_body_parts_tpu.analysis.config import (  # noqa: E402
+    ConfigError,
+    config_from_tables,
+    parse_graftlint_tables,
+)
+
+TRAIN_PATH = "improved_body_parts_tpu/train/snippet.py"
+
+
+def lint(src, path=TRAIN_PATH, config=None):
+    findings, _ = lint_source(textwrap.dedent(src), path, config)
+    return findings
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# --------------------------------------------------------------- framework
+
+
+class TestFramework:
+    def test_rule_table_is_complete(self):
+        ids = {r.id for r in all_rules()}
+        assert ids == {"JGL001", "JGL002", "JGL003", "JGL004",
+                       "JGL005", "JGL006", "JGL007"}
+        for r in all_rules():
+            assert r.postmortem, f"{r.id} lacks its postmortem pointer"
+
+    def test_ruleset_hash_is_stable_and_version_present(self):
+        h = ruleset_hash()
+        assert h == ruleset_hash()
+        assert len(h) == 12 and int(h, 16) >= 0
+        assert GRAFTLINT_VERSION.count(".") == 2
+
+    def test_syntax_error_reports_not_silently_clean(self):
+        findings = lint("def broken(:\n    pass\n")
+        assert rules_of(findings) == ["JGL000"]
+        assert "does not parse" in findings[0].message
+
+    def test_findings_carry_position_and_serialize(self):
+        findings = lint("""
+            import json
+            json.dumps({"a": 1.0})
+        """)
+        (f,) = findings
+        assert f.rule == "JGL004" and f.line == 3 and f.col > 0
+        assert json.loads(json.dumps(f.as_dict(),
+                                     allow_nan=False))["path"] == TRAIN_PATH
+
+    def test_disable_via_config(self):
+        cfg = LintConfig(disable=("JGL004",))
+        assert lint("import json\njson.dumps({})\n", config=cfg) == []
+
+    def test_severity_override_via_config(self):
+        cfg = LintConfig(severity={"JGL004": "info"})
+        (f,) = lint("import json\njson.dumps({})\n", config=cfg)
+        assert f.severity == "info"
+
+    def test_tests_downgrade_errors_to_warnings(self):
+        src = "import json\njson.dumps({})\n"
+        (f,) = lint(src, path="tests/test_x.py")
+        assert f.severity == "warning"
+        (f,) = lint(src, path="tools/x.py")
+        assert f.severity == "error"
+        cfg = LintConfig(tests_downgrade=False)
+        (f,) = lint(src, path="tests/test_x.py", config=cfg)
+        assert f.severity == "error"
+
+
+class TestSuppressions:
+    BAD = "import json\njson.dumps({})  # graftlint: disable=JGL004%s\n"
+
+    def test_suppression_with_reason_is_silent_and_counted(self):
+        findings, suppressed = lint_source(
+            self.BAD % " -- fixture data is finite by construction",
+            TRAIN_PATH)
+        assert findings == [] and suppressed == 1
+
+    def test_reasonless_pragma_does_not_suppress_and_is_an_error(self):
+        findings, suppressed = lint_source(self.BAD % "", TRAIN_PATH)
+        assert suppressed == 0
+        assert sorted(rules_of(findings)) == ["JGL000", "JGL004"]
+        jgl0 = next(f for f in findings if f.rule == "JGL000")
+        assert "requires a reason" in jgl0.message
+
+    def test_unknown_rule_id_in_pragma_is_an_error(self):
+        findings, _ = lint_source(
+            "x = 1  # graftlint: disable=JGL999 -- whatever\n", TRAIN_PATH)
+        assert rules_of(findings) == ["JGL000"]
+        assert "JGL999" in findings[0].message
+
+    def test_pragma_anywhere_on_multiline_statement_suppresses(self):
+        src = ("import json\n"
+               "json.dumps(\n"
+               "    {'a': 1},\n"
+               ")  # graftlint: disable=JGL004 -- demo payload, finite\n")
+        findings, suppressed = lint_source(src, TRAIN_PATH)
+        assert findings == [] and suppressed == 1
+
+    def test_pragma_in_docstring_is_not_a_suppression(self):
+        src = ('"""docs: use # graftlint: disable=JGL004 like this"""\n'
+               "import json\n"
+               "json.dumps({})\n")
+        findings, _ = lint_source(src, TRAIN_PATH)
+        assert rules_of(findings) == ["JGL004"]
+
+    def test_disable_all_with_reason(self):
+        findings, suppressed = lint_source(
+            "import json\n"
+            "json.dumps({})  # graftlint: disable=all -- generated code\n",
+            TRAIN_PATH)
+        assert findings == [] and suppressed == 1
+
+
+class TestConfigParsing:
+    SECTION = """
+        [project]
+        name = "x"
+
+        [tool.graftlint]
+        paths = [
+            "pkg",
+            "tools",
+        ]
+        disable = ["jgl007"]
+        donating-factories = ["make_train_step:0", "make_other:1,2"]
+        tests-downgrade = false
+
+        [tool.graftlint.severity]
+        JGL005 = "info"
+
+        [tool.other]
+        irrelevant = { not = "parsed" }
+    """
+
+    def test_parse_subset(self):
+        cfg = config_from_tables(parse_graftlint_tables(
+            textwrap.dedent(self.SECTION)))
+        assert cfg.paths == ("pkg", "tools")
+        assert cfg.disable == ("JGL007",)
+        assert cfg.tests_downgrade is False
+        assert cfg.severity == {"JGL005": "info"}
+        assert cfg.donated_positions("make_other") == (1, 2)
+        assert cfg.donated_positions("make_train_step") == (0,)
+        assert cfg.donated_positions("unknown") is None
+
+    def test_bad_severity_is_loud(self):
+        with pytest.raises(ConfigError):
+            config_from_tables({"severity": {"JGL001": "fatal"}})
+
+    def test_unknown_key_is_loud(self):
+        with pytest.raises(ConfigError):
+            config_from_tables({"": {"typo_key": ["x"]}})
+
+    def test_repo_config_loads(self):
+        cfg = load_config(REPO)
+        assert "improved_body_parts_tpu" in cfg.paths
+        assert "tests" in cfg.paths
+        assert cfg.donated_positions("make_train_step") == (0,)
+
+
+# ------------------------------------------------------- JGL001 donation
+
+
+class TestDonationSafety:
+    def test_read_after_donation_flags(self):
+        findings = lint("""
+            import jax
+
+            step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+            def bad(state, batch):
+                new_state = step(state, batch)
+                return float(state.mean()), new_state
+        """)
+        assert "JGL001" in rules_of(findings)
+
+    def test_rebinding_passes(self):
+        findings = lint("""
+            import jax
+
+            step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+            def good(state, batch):
+                state = step(state, batch)
+                return float(state.mean()), state
+        """)
+        assert [f for f in findings if f.rule == "JGL001"] == []
+
+    def test_unrebound_donation_in_loop_flags_the_call(self):
+        findings = lint("""
+            import jax
+
+            step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+            def bad(state, batches):
+                for batch in batches:
+                    out = step(state, batch)
+        """)
+        assert "JGL001" in rules_of(findings)
+        assert "next" in next(f.message for f in findings
+                              if f.rule == "JGL001")
+
+    def test_rebound_donation_in_loop_passes(self):
+        findings = lint("""
+            import jax
+
+            step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+            def good(state, batches):
+                for batch in batches:
+                    state = step(state, batch)
+                return state
+        """)
+        assert [f for f in findings if f.rule == "JGL001"] == []
+
+    def test_configured_factory_donates(self):
+        findings = lint("""
+            from improved_body_parts_tpu.train.step import make_train_step
+
+            def bad(model, cfg, opt, state, batch):
+                step = make_train_step(model, cfg, opt)
+                new_state, loss = step(state, batch)
+                return state.params
+        """)
+        assert "JGL001" in rules_of(findings)
+
+    def test_factory_with_donate_false_passes(self):
+        findings = lint("""
+            from improved_body_parts_tpu.train.step import make_train_step
+
+            def good(model, cfg, opt, state, batch):
+                step = make_train_step(model, cfg, opt, donate=False)
+                new_state, loss = step(state, batch)
+                return state.params
+        """)
+        assert [f for f in findings if f.rule == "JGL001"] == []
+
+    def test_pr5_snapshot_view_regression(self):
+        """The PR 5 bug, verbatim shape: a zero-copy ``np.asarray`` view
+        of donatable state escaping the snapshot uncopied."""
+        findings = lint("""
+            import jax
+            import numpy as np
+
+            step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+            def snapshot_to_host(tree):
+                def drain(x):
+                    return np.asarray(x)
+                return jax.tree.map(drain, tree)
+        """)
+        assert "JGL001" in rules_of(findings)
+        assert "zero-copy" in next(f.message for f in findings
+                                   if f.rule == "JGL001")
+
+    def test_pr5_snapshot_fix_passes(self):
+        """The shipped repair: conditional ``.copy()`` when the view
+        does not own its memory (train/checkpoint.py)."""
+        findings = lint("""
+            import jax
+            import numpy as np
+
+            step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+            def snapshot_to_host(tree):
+                def drain(x):
+                    arr = np.asarray(x)
+                    if isinstance(x, jax.Array) and not arr.flags.owndata:
+                        arr = arr.copy()
+                    return arr
+                return jax.tree.map(drain, tree)
+        """)
+        assert [f for f in findings if f.rule == "JGL001"] == []
+
+    def test_suppressed_site_is_silent(self):
+        findings, suppressed = lint_source(textwrap.dedent("""
+            import jax
+
+            step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+            def probe(state, batch):
+                new = step(state, batch)
+                return state.x  # graftlint: disable=JGL001 -- the read IS the test: donated leaves must report deleted
+        """), TRAIN_PATH)
+        assert [f for f in findings if f.rule == "JGL001"] == []
+        assert suppressed == 1
+
+
+# ------------------------------------------------------ JGL002 host sync
+
+
+class TestHiddenHostSync:
+    def test_pr3_float_loss_per_batch_regression(self):
+        """The PR 3 bug, verbatim shape (train/loop.py eval_epoch before
+        the fix): float(loss) on every batch."""
+        findings = lint("""
+            def eval_epoch(state, eval_step, batches, losses):
+                for batch in batches:
+                    loss = eval_step(state, *batch)
+                    losses.update(float(loss), batch[0].shape[0])
+                return losses.avg
+        """)
+        assert "JGL002" in rules_of(findings)
+
+    def test_pr3_windowed_readback_fix_passes(self):
+        """The shipped repair: buffer device scalars, drain in windows."""
+        findings = lint("""
+            def eval_epoch(state, eval_step, batches, losses):
+                pending = []
+                for batch in batches:
+                    pending.append((eval_step(state, *batch),
+                                    batch[0].shape[0]))
+                    if len(pending) >= 32:
+                        for loss, bs in pending:
+                            losses.update(float(loss), bs)
+                        pending.clear()
+                for loss, bs in pending:
+                    losses.update(float(loss), bs)
+                return losses.avg
+        """)
+        assert [f for f in findings if f.rule == "JGL002"] == []
+
+    def test_item_and_device_get_flag_too(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            def serve_loop(requests):
+                for r in requests:
+                    out = jnp.sum(r)
+                    yield {}.get(out.item())
+        """
+        findings = lint(src, path="improved_body_parts_tpu/serve/x.py")
+        assert "JGL002" in rules_of(findings)
+
+    def test_scope_is_train_serve_infer_only(self):
+        src = """
+            import jax.numpy as jnp
+
+            def host_tool(batches):
+                for b in batches:
+                    v = jnp.sum(b)
+                    print(float(v))
+        """
+        assert "JGL002" not in rules_of(
+            lint(src, path="improved_body_parts_tpu/data/x.py"))
+        assert "JGL002" not in rules_of(lint(src, path="tools/x.py"))
+        assert "JGL002" in rules_of(
+            lint(src, path="improved_body_parts_tpu/infer/x.py"))
+
+    def test_untainted_host_values_pass(self):
+        findings = lint("""
+            import numpy as np
+
+            def stats(rows):
+                out = []
+                for r in rows:
+                    out.append(float(np.mean(r)))
+                return out
+        """)
+        assert [f for f in findings if f.rule == "JGL002"] == []
+
+    def test_suppressed_warmup_sync_is_silent(self):
+        findings, suppressed = lint_source(textwrap.dedent("""
+            import jax
+
+            def warmup(shapes, compiled, x):
+                for s in shapes:
+                    out = compiled.apply(x, s)
+                    jax.block_until_ready(out)  # graftlint: disable=JGL002 -- warmup precompile: one sync per shape is the point
+        """), TRAIN_PATH)
+        assert [f for f in findings if f.rule == "JGL002"] == []
+        assert suppressed == 1
+
+
+# ------------------------------------------------------ JGL003 recompile
+
+
+class TestRecompileHazard:
+    def test_jit_of_loop_local_lambda_flags(self):
+        findings = lint("""
+            import jax
+
+            def sweep(xs):
+                outs = []
+                for x in xs:
+                    f = jax.jit(lambda v: v + x)
+                    outs.append(f(x))
+                return outs
+        """)
+        assert "JGL003" in rules_of(findings)
+
+    def test_cached_jit_behind_dict_miss_guard_passes(self):
+        findings = lint("""
+            import jax
+
+            def precompile(shapes, fn, cache):
+                for s in shapes:
+                    if s not in cache:
+                        cache[s] = jax.jit(lambda v: fn(v, s))
+                return cache
+        """)
+        assert [f for f in findings if f.rule == "JGL003"] == []
+
+    def test_hoisted_jit_passes(self):
+        findings = lint("""
+            import jax
+
+            def run(xs, fn):
+                f = jax.jit(fn)
+                return [f(x) for x in xs]
+        """)
+        assert [f for f in findings if f.rule == "JGL003"] == []
+
+    def test_mutable_static_arg_flags(self):
+        findings = lint("""
+            import jax
+
+            def kernel(x, opts):
+                return x
+
+            f = jax.jit(kernel, static_argnums=(1,))
+
+            def call(x):
+                return f(x, {"mode": "fast"})
+        """)
+        assert "JGL003" in rules_of(findings)
+
+    def test_hashable_static_arg_passes(self):
+        findings = lint("""
+            import jax
+
+            def kernel(x, opts):
+                return x
+
+            f = jax.jit(kernel, static_argnums=(1,))
+
+            def call(x):
+                return f(x, ("fast",))
+        """)
+        assert [f for f in findings if f.rule == "JGL003"] == []
+
+    def test_closure_over_mutated_name_flags(self):
+        findings = lint("""
+            import jax
+
+            def build(x):
+                scales = [1.0]
+
+                def fwd(v):
+                    return v * scales[0]
+
+                f = jax.jit(fwd)
+                scales.append(2.0)
+                return f
+        """)
+        assert "JGL003" in rules_of(findings)
+
+    def test_closure_over_constant_passes(self):
+        findings = lint("""
+            import jax
+
+            def build(x, scale):
+                def fwd(v):
+                    return v * scale
+
+                return jax.jit(fwd)
+        """)
+        assert [f for f in findings if f.rule == "JGL003"] == []
+
+    def test_suppressed_site_is_silent(self):
+        findings, suppressed = lint_source(textwrap.dedent("""
+            import jax
+
+            def sweep(xs):
+                for x in xs:
+                    f = jax.jit(lambda v: v + x)  # graftlint: disable=JGL003 -- one compile per grid point is the benchmark protocol
+                    f(x)
+        """), TRAIN_PATH)
+        assert [f for f in findings if f.rule == "JGL003"] == []
+        assert suppressed == 1
+
+
+# ----------------------------------------------------- JGL004 strict json
+
+
+class TestStrictJson:
+    def test_bare_dumps_flags(self):
+        assert "JGL004" in rules_of(lint(
+            "import json\njson.dumps({'loss': 1.0})\n"))
+
+    def test_strict_idioms_pass(self):
+        findings = lint("""
+            import json
+            from improved_body_parts_tpu.obs.events import (
+                _definan,
+                strict_dumps,
+            )
+
+            def emit(rec, f):
+                a = json.dumps(rec, allow_nan=False)
+                b = json.dumps(_definan(rec))
+                c = strict_dumps(rec)
+                f.write(a + b + c)
+        """)
+        assert [f for f in findings if f.rule == "JGL004"] == []
+
+    def test_events_py_implementation_site_exempt(self):
+        src = "import json\njson.dumps({'x': 1.0})\n"
+        assert "JGL004" not in rules_of(lint(
+            src, path="improved_body_parts_tpu/obs/events.py"))
+
+
+# ------------------------------------------------------ JGL005 lifecycle
+
+
+class TestResourceLifecycle:
+    def test_unjoined_thread_flags(self):
+        findings = lint("""
+            import threading
+
+            def fire_and_forget(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+        """)
+        assert "JGL005" in rules_of(findings)
+
+    def test_joined_thread_passes(self):
+        findings = lint("""
+            import threading
+
+            def run(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                try:
+                    fn()
+                finally:
+                    t.join()
+        """)
+        assert [f for f in findings if f.rule == "JGL005"] == []
+
+    def test_daemon_thread_exempt(self):
+        findings = lint("""
+            import threading
+
+            def background(fn):
+                t = threading.Thread(target=fn, daemon=True)
+                t.start()
+        """)
+        assert [f for f in findings if f.rule == "JGL005"] == []
+
+    def test_self_stored_and_returned_exempt(self):
+        findings = lint("""
+            import threading
+
+            class Owner:
+                def start(self, fn):
+                    self._t = threading.Thread(target=fn)
+                    self._t.start()
+
+            def make(fn):
+                t = threading.Thread(target=fn)
+                return t
+        """)
+        assert [f for f in findings if f.rule == "JGL005"] == []
+
+    def test_pool_and_shared_memory_flag(self):
+        findings = lint("""
+            from concurrent.futures import ThreadPoolExecutor
+            from multiprocessing import shared_memory
+
+            def leaky(n):
+                pool = ThreadPoolExecutor(4)
+                shm = shared_memory.SharedMemory(create=True, size=n)
+                pool.submit(print, shm.name)
+        """)
+        assert rules_of([f for f in findings
+                         if f.rule == "JGL005"]) == ["JGL005", "JGL005"]
+
+    def test_container_cleanup_loop_passes(self):
+        findings = lint("""
+            import threading
+
+            def fan_out(fns):
+                threads = []
+                for fn in fns:
+                    threads.append(threading.Thread(target=fn))
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+        """)
+        assert [f for f in findings if f.rule == "JGL005"] == []
+
+    def test_suppressed_site_is_silent(self):
+        findings, suppressed = lint_source(textwrap.dedent("""
+            import threading
+
+            def detach(fn):
+                t = threading.Thread(target=fn)  # graftlint: disable=JGL005 -- intentionally outlives the caller; reaped by the supervisor
+                t.start()
+        """), TRAIN_PATH)
+        assert [f for f in findings if f.rule == "JGL005"] == []
+        assert suppressed == 1
+
+
+# ---------------------------------------------------- JGL006 metric names
+
+
+class TestMetricNames:
+    def test_counter_without_total_flags(self):
+        findings = lint("""
+            def instrument(registry):
+                registry.counter("requests")
+        """)
+        assert "JGL006" in rules_of(findings)
+        assert "_total" in findings[0].message
+
+    def test_bad_charset_flags(self):
+        findings = lint("""
+            def instrument(registry):
+                registry.gauge("ring.free-slots")
+        """)
+        assert "JGL006" in rules_of(findings)
+
+    def test_suffix_literal_checked(self):
+        findings = lint("""
+            def instrument(registry, prefix):
+                registry.counter(prefix + "_stalls")
+        """)
+        assert "JGL006" in rules_of(findings)
+
+    def test_good_names_pass(self):
+        findings = lint("""
+            def instrument(registry, prefix):
+                registry.counter("requests_total")
+                registry.counter(prefix + "_stalls_total")
+                registry.gauge("ring_free_slots")
+                registry.histogram("step_seconds",
+                                   labels={"worker": "0"})
+        """)
+        assert [f for f in findings if f.rule == "JGL006"] == []
+
+    def test_bad_label_key_flags(self):
+        findings = lint("""
+            def instrument(registry):
+                registry.gauge("ring_free_slots",
+                               labels={"worker-id": "0"})
+        """)
+        assert "JGL006" in rules_of(findings)
+
+    def test_suppressed_site_is_silent(self):
+        findings, suppressed = lint_source(textwrap.dedent("""
+            def instrument(registry):
+                registry.counter("legacy.requests")  # graftlint: disable=JGL006 -- legacy dashboard name; Registry sanitizes at exposition
+        """), TRAIN_PATH)
+        assert [f for f in findings if f.rule == "JGL006"] == []
+        assert suppressed == 1
+
+
+# ------------------------------------------------------ JGL007 bare print
+
+
+class TestBarePrint:
+    def test_library_print_flags(self):
+        assert "JGL007" in rules_of(lint(
+            "print('hello')\n",
+            path="improved_body_parts_tpu/infer/x.py"))
+
+    def test_tools_and_tests_exempt(self):
+        assert "JGL007" not in rules_of(lint("print('x')\n",
+                                             path="tools/x.py"))
+        assert "JGL007" not in rules_of(lint("print('x')\n",
+                                             path="tests/test_x.py"))
+
+    def test_sink_fallback_pattern_passes_with_reason(self):
+        findings, suppressed = lint_source(textwrap.dedent("""
+            from ..obs.events import get_sink
+
+            def report(event, text, **fields):
+                sink = get_sink()
+                if sink.enabled:
+                    sink.emit(event, **fields)
+                else:
+                    print(text)  # graftlint: disable=JGL007 -- stdout fallback when no sink installed
+        """), "improved_body_parts_tpu/infer/x.py")
+        assert findings == [] and suppressed == 1
+
+
+# ------------------------------------------------------------- self scan
+
+
+@pytest.fixture(scope="module")
+def self_scan():
+    config = load_config(REPO)
+    return lint_paths(list(config.paths), REPO, config)
+
+
+def test_self_scan_clean(self_scan):
+    """The tier-1 gate: the real tree has zero error-severity findings.
+    New code that reintroduces a postmortem pattern fails HERE, with the
+    rule's message naming the original incident."""
+    errors = [f for f in self_scan.findings if f.severity == "error"]
+    assert errors == [], "\n".join(f.format() for f in errors)
+    assert self_scan.parse_errors == 0
+
+
+def test_missing_lint_root_is_an_error_not_a_clean_scan(tmp_path):
+    """A typo'd root in [tool.graftlint] paths (or on the CLI) must not
+    read as a clean scan of zero files."""
+    result = lint_paths(["no_such_dir"], str(tmp_path))
+    assert result.files == 0
+    (f,) = result.findings
+    assert f.rule == "JGL000" and f.severity == "error"
+    assert "does not exist" in f.message
+
+
+def test_self_scan_covers_the_tree(self_scan):
+    # the scan actually walked the repo (a path typo in pyproject would
+    # otherwise read as "clean")
+    assert self_scan.files > 100
+    # every committed suppression carries a reason — lint_paths counts a
+    # suppression only when the reasoned pragma matched a finding
+    assert self_scan.suppressed >= 3
+
+
+def test_self_scan_warnings_stay_bounded(self_scan):
+    """Warnings are allowed to exist but not to silently pile up: this
+    count is a ratchet — if your PR adds warnings, either fix them or
+    suppress with a reason and bump consciously."""
+    warnings = [f for f in self_scan.findings if f.severity == "warning"]
+    assert len(warnings) <= 5, "\n".join(f.format() for f in warnings)
+
+
+# ------------------------------------------------------------------- CLI
+
+
+class TestRunnerCli:
+    def run(self, *argv, cwd=None):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+             *argv],
+            capture_output=True, text=True, timeout=300, cwd=cwd or REPO)
+
+    def test_json_output_and_exit_zero_on_clean_tree(self, tmp_path):
+        # a small clean tree keeps this a plumbing test — the full-repo
+        # scan already runs in-process via the self_scan fixture
+        good = tmp_path / "improved_body_parts_tpu" / "ok.py"
+        good.parent.mkdir()
+        good.write_text("import json\njson.dumps({}, allow_nan=False)\n")
+        proc = self.run("--root", str(tmp_path), "--format", "json",
+                        "improved_body_parts_tpu")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["files"] == 1
+        assert out["counts"]["error"] == 0
+        assert out["version"] == GRAFTLINT_VERSION
+        assert out["ruleset"] == ruleset_hash()
+
+    def test_exit_one_on_error_findings(self, tmp_path):
+        bad = tmp_path / "improved_body_parts_tpu" / "bad.py"
+        bad.parent.mkdir()
+        bad.write_text("import json\njson.dumps({})\n")
+        proc = self.run("--root", str(tmp_path),
+                        "improved_body_parts_tpu")
+        assert proc.returncode == 1
+        assert "JGL004" in proc.stdout
+
+    def test_rules_listing(self):
+        proc = self.run("--rules")
+        assert proc.returncode == 0
+        for rid in ("JGL001", "JGL007"):
+            assert rid in proc.stdout
+
+    def test_changed_mode_bad_ref_exits_two(self, tmp_path):
+        # an empty repo: any ref is unresolvable, and the run must say
+        # so loudly (2), never read as a clean pass (0)
+        repo = tmp_path / "r"
+        repo.mkdir()
+        subprocess.run(["git", "init", "-q"], cwd=repo, check=True,
+                       capture_output=True)
+        proc = self.run("--root", str(repo), "--changed",
+                        "not-a-ref-xyz")
+        assert proc.returncode == 2
+
+    def test_changed_mode_lints_only_the_diff(self, tmp_path):
+        repo = tmp_path / "r"
+        (repo / "improved_body_parts_tpu").mkdir(parents=True)
+        (repo / "tools").mkdir()
+        env = dict(os.environ,
+                   GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+                   GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+
+        def git(*argv):
+            subprocess.run(["git", *argv], cwd=repo, check=True,
+                           capture_output=True, env=env)
+
+        git("init", "-q")
+        clean = repo / "improved_body_parts_tpu" / "clean.py"
+        clean.write_text("import json\njson.dumps({})\n")  # pre-existing
+        git("add", "-A")
+        git("commit", "-qm", "seed")
+        # new bad file + an untracked one; the committed bad file must
+        # NOT be linted in --changed mode
+        (repo / "improved_body_parts_tpu" / "new.py").write_text(
+            "x = 1\n")
+        proc = self.run("--root", str(repo), "--changed", "HEAD",
+                        "--format", "json")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["files"] == 1  # only new.py; clean.py untouched
+        assert out["counts"]["error"] == 0
+
+
+
+def test_bench_provenance_carries_linter_stamp():
+    """bench.py's provenance block stamps linter version + rule-set
+    hash so lint counts are only compared between identical rule
+    sets."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    prov = bench._provenance()
+    assert prov["graftlint"]["version"] == GRAFTLINT_VERSION
+    assert prov["graftlint"]["ruleset"] == ruleset_hash()

@@ -128,7 +128,7 @@ class TestRegistry:
         r = Registry()
         r.counter("a_total").inc()
         r.histogram("h_seconds").observe(0.5)
-        snap = json.loads(json.dumps(r.snapshot()))
+        snap = json.loads(json.dumps(r.snapshot(), allow_nan=False))
         assert snap["a_total"] == 1.0
         assert snap["h_seconds"]["count"] == 1
 
@@ -555,7 +555,7 @@ class TestTelemetryReport:
         with open(p, "w") as f:
             f.write(json.dumps({"event": "run_start",
                                 "schema": SCHEMA_VERSION + 1,
-                                "t": 0.0}) + "\n")
+                                "t": 0.0}, allow_nan=False) + "\n")
         proc = subprocess.run(
             [sys.executable,
              os.path.join(REPO, "tools", "telemetry_report.py"), p],
@@ -826,7 +826,8 @@ class TestTraceIntegration:
     def test_trace_report_refuses_garbage(self, tmp_path):
         p = str(tmp_path / "bad.json")
         with open(p, "w") as f:
-            json.dump({"traceEvents": [{"nonsense": 1}, 7]}, f)
+            json.dump({"traceEvents": [{"nonsense": 1}, 7]}, f,
+                      allow_nan=False)
         proc = subprocess.run(
             [sys.executable,
              os.path.join(REPO, "tools", "trace_report.py"), p],
@@ -1205,4 +1206,4 @@ class TestBenchProvenance:
         # inside the repo checkout the SHA must resolve
         assert prov["git_sha"] and re.match(r"^[0-9a-f]{40}$",
                                             prov["git_sha"])
-        assert json.dumps(prov)  # JSON-ready, always
+        assert json.dumps(prov, allow_nan=False)  # JSON-ready, always

@@ -65,7 +65,7 @@ def test_fixture_param_pin_refuses_mismatched_rerun(tmp_path, monkeypatch):
            "people": 2, "canvas": [384, 512], "seed": 0, "val_seed": 777,
            "crowd": False, "hard": False, "mask_extras": True}
     (work / "fixture_params.json").write_text(json.dumps(
-        dict(pin, train_images=48)))
+        dict(pin, train_images=48), allow_nan=False))
 
     ns = argparse.Namespace(smoke=False, force=False,
                             work_root=str(tmp_path),

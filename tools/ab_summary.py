@@ -19,6 +19,13 @@ import os
 import re
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 
 def _stats(vals):
     n = len(vals)
@@ -96,8 +103,8 @@ def main():
                                          "mask_ablated"),
     }
     with open(args.out, "w") as f:
-        json.dump(summary, f, indent=2)
-    print(json.dumps(summary, indent=2))
+        strict_dump(summary, f, indent=2)
+    print(strict_dumps(summary, indent=2))
 
 
 if __name__ == "__main__":

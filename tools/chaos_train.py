@@ -48,6 +48,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -491,8 +496,8 @@ def main():
           and (final_ok is not False))
     report["ok"] = bool(ok)
     with open(args.out, "w") as f:
-        json.dump(report, f, indent=2)
-    print(json.dumps({k: report[k] for k in (
+        strict_dump(report, f, indent=2)
+    print(strict_dumps({k: report[k] for k in (
         "ok", "completed", "injections_done", "segments_total",
         "all_resumes_on_last_committed", "leaked_pids_total",
         "writer_thread_leaked", "final_bit_identical",

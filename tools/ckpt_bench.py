@@ -37,6 +37,11 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 STALL_REDUCTION_TARGET = 5.0
 
 
@@ -245,8 +250,8 @@ def main():
         "commit_meta_sample": read_commit_meta(async_path),
     }
     with open(args.out, "w") as f:
-        json.dump(report, f, indent=2)
-    print(json.dumps(report))
+        strict_dump(report, f, indent=2)
+    print(strict_dumps(report))
     if args.strict and not (report["meets_target"]
                             and report["bit_identical_restore"]):
         sys.exit(1)

@@ -45,6 +45,11 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 
 def run_train(h5, val_h5, ckpt_dir, epochs, env_extra, extra_args=(),
               timeout=3600, log_path=None, config="synth_deep"):
@@ -146,7 +151,7 @@ def main():
                       people_per_image=2, img_size=(384, 512),
                       image_size=256, seed=99, drawn=True)
         with open(params_path, "w") as f:
-            json.dump(fixture_params, f)
+            strict_dump(fixture_params, f)
     print(f"corpus: {n_rec} records", flush=True)
 
     # --- arm A: single process, 2-device mesh (topology-parity arm) -----
@@ -310,8 +315,8 @@ def main():
         "workdir": work,
     }
     with open(args.out, "w") as f:
-        json.dump(result, f, indent=2)
-    print(json.dumps(result))
+        strict_dump(result, f, indent=2)
+    print(strict_dumps(result))
     if not parity_ok:
         raise SystemExit(
             f"parity failed: resume_rel={resume_rel} "

@@ -35,6 +35,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 
 def synth_images(n, size, rng):
     """BGR uint8 images with mild structure (blobs + gradient)."""
@@ -199,7 +204,7 @@ def main():
 
     def flush():
         with open(args.out, "w") as f:
-            json.dump(report, f, indent=2)
+            strict_dump(report, f, indent=2)
 
     # --- 1. full ensemble (single scale + flip) + host decode -----------
     if "full" in modes:
@@ -211,7 +216,7 @@ def main():
     if modes & {"compact", "compact-pipelined", "compact-batch"}:
         run_compact_modes(pred, imgs, decode, cfg, args, report, flush,
                           modes, pipelined_inference)
-    print(json.dumps(report))
+    print(strict_dumps(report))
 
 
 def run_full(pred, imgs, decode, cfg, report, flush):

@@ -37,6 +37,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 
 def measure(make_iter, batch_size, mesh, min_seconds, device_sink=True,
             abandonable=True):
@@ -259,8 +264,8 @@ def main():
             "projection": projection,
         }
         with open(args.out, "w") as f:
-            json.dump(result, f, indent=2)
-        print(json.dumps(result))
+            strict_dump(result, f, indent=2)
+        print(strict_dumps(result))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""graftlint runner — the repo's static-analysis gate.
+
+    python tools/lint.py                      # lint the configured roots
+    python tools/lint.py improved_body_parts_tpu/train
+    python tools/lint.py --changed origin/main   # only files that differ
+    python tools/lint.py --format json        # machine-readable output
+
+Exit codes: 0 = no findings at/above ``--fail-on`` (default: error);
+1 = findings at/above the threshold; 2 = usage / internal error (a
+crash must not read as "clean").
+
+``--changed REF`` lints only tracked files differing from ``REF`` plus
+untracked .py files (both intersected with the configured roots) — the
+fast pre-PR check on a 150+-file tree.  Rules, severities and roots
+come from ``[tool.graftlint]`` in ``pyproject.toml``; suppression is
+inline per finding: ``# graftlint: disable=JGL00N -- reason`` (the
+reason is mandatory, enforced as JGL000).
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from improved_body_parts_tpu.analysis import (  # noqa: E402
+    GRAFTLINT_VERSION,
+    ConfigError,
+    all_rules,
+    lint_paths,
+    load_config,
+    ruleset_hash,
+)
+from improved_body_parts_tpu.analysis.config import SEVERITIES  # noqa: E402
+
+
+def changed_files(ref, root):
+    """Repo-relative .py paths differing from ``ref`` (tracked, minus
+    deletions) plus untracked ones."""
+    def run(*argv):
+        out = subprocess.run(["git", *argv], cwd=root, check=True,
+                             capture_output=True, text=True).stdout
+        return [p for p in out.split("\0") if p]
+
+    files = run("diff", "--name-only", "-z", "--diff-filter=d", ref, "--")
+    files += run("ls-files", "--others", "--exclude-standard", "-z")
+    return sorted({f for f in files if f.endswith(".py")})
+
+
+def scope_to_config(files, config):
+    """Keep only files under the configured lint roots."""
+    keep = []
+    for f in files:
+        posix = f.replace(os.sep, "/")
+        for p in config.paths:
+            if posix == p or posix.startswith(p.rstrip("/") + "/"):
+                keep.append(f)
+                break
+    return keep
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="graftlint: this repo's bug classes as lint rules")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: [tool.graftlint] "
+                         "paths)")
+    ap.add_argument("--root", default=REPO,
+                    help="repo root (pyproject.toml location)")
+    ap.add_argument("--changed", metavar="REF",
+                    help="lint only files differing from this git ref "
+                         "(plus untracked .py files)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--fail-on", choices=SEVERITIES + ("never",),
+                    default="error",
+                    help="exit 1 when findings at/above this severity "
+                         "exist (default: error)")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name:20s} [{rule.severity}]  "
+                  f"{rule.postmortem}")
+        return 0
+
+    try:
+        config = load_config(args.root)
+    except ConfigError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.changed:
+        try:
+            files = changed_files(args.changed, args.root)
+        except (subprocess.CalledProcessError, OSError) as e:
+            detail = e.stderr.strip() if getattr(e, "stderr", None) else e
+            print(f"graftlint: --changed {args.changed}: {detail}",
+                  file=sys.stderr)
+            return 2
+        paths = scope_to_config(files, config)
+        if args.paths:
+            paths = [p for p in paths
+                     if any(p == q or p.startswith(q.rstrip("/") + "/")
+                            for q in args.paths)]
+    else:
+        paths = args.paths or list(config.paths)
+
+    result = lint_paths(paths, args.root, config)
+    counts = result.counts()
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": GRAFTLINT_VERSION,
+            "ruleset": ruleset_hash(),
+            "files": result.files,
+            "counts": counts,
+            "suppressed": result.suppressed,
+            "parse_errors": result.parse_errors,
+            "findings": [f.as_dict() for f in result.findings],
+        }, indent=2, allow_nan=False))
+    else:
+        for f in result.findings:
+            print(f.format())
+        print(f"graftlint {GRAFTLINT_VERSION} (rules {ruleset_hash()}): "
+              f"{result.files} files, "
+              f"{counts['error']} errors, {counts['warning']} warnings, "
+              f"{counts['info']} info, {result.suppressed} suppressed")
+
+    if args.fail_on == "never":
+        return 0
+    threshold = SEVERITIES.index(args.fail_on)
+    bad = sum(n for sev, n in counts.items()
+              if SEVERITIES.index(sev) >= threshold)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

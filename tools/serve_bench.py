@@ -34,6 +34,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 
 def run_clients(n_clients, requests, work_fn):
     """Spawn ``n_clients`` closed-loop clients, each issuing ``requests``
@@ -324,7 +329,7 @@ def main():
 
     def flush():
         with open(args.out, "w") as f:
-            json.dump(report, f, indent=2)
+            strict_dump(report, f, indent=2)
 
     decode_one = compact_decode_fn(pred, params, use_native=use_native)
 
@@ -415,9 +420,9 @@ def main():
                        "batched_beats_sequential"])
     telemetry.close()
     flush()
-    print(json.dumps({"batched_beats_sequential":
-                      report["batched_beats_sequential"],
-                      "speedup": report["speedup_at_peak_load"]}))
+    print(strict_dumps({"batched_beats_sequential":
+                        report["batched_beats_sequential"],
+                        "speedup": report["speedup_at_peak_load"]}))
 
 
 if __name__ == "__main__":

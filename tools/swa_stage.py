@@ -23,6 +23,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tools"))
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 # one parser/runner for the train/evaluate CLI output format, shared with
 # the base-run orchestrator
 from synth_ap import parse_ap, run_cli  # noqa: E402
@@ -105,8 +110,8 @@ def main():
         result["base_artifact"] = os.path.basename(args.base)
         result["swa_delta"] = round(ap_swa - base["ap_trained"], 6)
     with open(args.out, "w") as f:
-        json.dump(result, f, indent=2)
-    print(json.dumps(result))
+        strict_dump(result, f, indent=2)
+    print(strict_dumps(result))
 
 
 if __name__ == "__main__":

@@ -27,6 +27,11 @@ import tempfile
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 
 def run_cli(args, env_extra=None, timeout=7200, cwd=None):
     env = dict(os.environ)
@@ -268,8 +273,8 @@ def main():
                     "CLIs as subprocesses",
     }
     with open(args.out, "w") as f:
-        json.dump(result, f, indent=2)
-    print(json.dumps(result))
+        strict_dump(result, f, indent=2)
+    print(strict_dumps(result))
     if not args.keep_workdir and args.workdir is None:
         import shutil
         shutil.rmtree(work, ignore_errors=True)

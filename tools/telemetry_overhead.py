@@ -40,6 +40,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 OVERHEAD_BUDGET_PCT = 2.0
 
 
@@ -237,8 +242,8 @@ def main():
             1 for e in events if e.get("event") == "recompile"),
     }
     with open(args.out, "w") as f:
-        json.dump(report, f, indent=2)
-    print(json.dumps(report))
+        strict_dump(report, f, indent=2)
+    print(strict_dumps(report))
     if args.strict and not report["within_budget"]:
         sys.exit(1)
 

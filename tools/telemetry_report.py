@@ -26,6 +26,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from improved_body_parts_tpu.obs.events import strict_dump  # noqa: E402
+
 # above this fraction of attributed wall time spent waiting on data the
 # run is input-bound; below half of it, compute-bound; between, mixed.
 # The threshold lives in obs.registry so tools/trace_report.py's verdict
@@ -266,7 +268,7 @@ def main():
     print(render(summary))
     if args.json:
         with open(args.json, "w") as f:
-            json.dump(summary, f, indent=2)
+            strict_dump(summary, f, indent=2)
         print(f"\nwrote {args.json}")
 
 

@@ -28,6 +28,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 BACKEND_TIMEOUT_S = 900
 
 
@@ -74,7 +79,7 @@ def main():
         # the chip session is scarce: persist after EVERY section so a late
         # failure never discards earlier measurements
         with open(args.out, "w") as f:
-            json.dump(summary, f, indent=2)
+            strict_dump(summary, f, indent=2)
     size = 128 if args.quick else 512
     iters = 3 if args.quick else args.iters
     cfg = get_config("tiny" if args.quick else "canonical")
@@ -250,7 +255,7 @@ def main():
                   flush=True)
 
     flush_summary()
-    print(json.dumps(summary), flush=True)
+    print(strict_dumps(summary), flush=True)
 
 
 if __name__ == "__main__":

@@ -50,6 +50,11 @@ TOOLS = os.path.join(REPO, "tools")
 sys.path.insert(0, REPO)
 sys.path.insert(0, TOOLS)
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 BIND_TIMEOUT_S = 420
 
 
@@ -97,7 +102,7 @@ class Session:
 
     def flush(self):
         with open(self.args.session_out, "w") as f:
-            json.dump(self.summary, f, indent=2)
+            strict_dump(self.summary, f, indent=2)
 
     def art(self, name):
         """Artifact filename; --smoke runs get a SMOKE_ prefix so a later
@@ -162,7 +167,7 @@ class Session:
                                   crowd=crowd, hard=hard,
                                   mask_extras=mask_extras)
             with open(pin_path, "w") as f:
-                json.dump(pin, f)
+                strict_dump(pin, f)
         else:
             assert os.path.exists(pin_path) and json.load(
                 open(pin_path)) == pin, (
@@ -274,7 +279,7 @@ class Session:
                       f"{max(additional, 0)} to go", flush=True)
             else:
                 with open(tpin_path, "w") as f:
-                    json.dump(tpin, f)
+                    strict_dump(tpin, f)
             if additional > 0:
                 argv = (["--config", config, "--epochs", additional,
                          "--train-h5", corpus, "--checkpoint-dir", ckpt_dir,
@@ -362,7 +367,7 @@ class Session:
             result.update({"epochs": epochs, "ap_trained": ap_trained,
                            "ap_untrained": ap_fresh})
         with open(out, "w") as f:
-            json.dump(result, f, indent=2)
+            strict_dump(result, f, indent=2)
         print(f"[done] {out}: AP {ap_trained} (train {train_s}s)", flush=True)
         return result
 
@@ -538,7 +543,7 @@ def main():
                             "hard": sess.run_hard,
                             "ab": sess.run_ab}[name])
     sess.flush()
-    print(json.dumps(sess.summary))
+    print(strict_dumps(sess.summary))
 
 
 if __name__ == "__main__":

@@ -34,6 +34,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from improved_body_parts_tpu.obs.events import strict_dump  # noqa: E402
+
 # ONE threshold for both reporting tools (imported, not copied — see
 # obs.registry): the fraction of the attributed split spent waiting on
 # data above which the run is input-bound
@@ -212,14 +214,14 @@ def main():
     stem = args.trace[:-5] if args.trace.endswith(".json") else args.trace
     out_path = args.out or stem + ".perfetto.json"
     with open(out_path, "w") as f:
-        json.dump({"traceEvents": meta + body, "displayTimeUnit": "ms",
-                   "otherData": other}, f)
+        strict_dump({"traceEvents": meta + body, "displayTimeUnit": "ms",
+                     "otherData": other}, f)
 
     summary = summarize(events, other)
     summary["perfetto"] = out_path
     if args.json:
         with open(args.json, "w") as f:
-            json.dump(summary, f, indent=2)
+            strict_dump(summary, f, indent=2)
     print(render_text(summary))
     print(f"perfetto export: {out_path} (open at https://ui.perfetto.dev)")
 

@@ -20,6 +20,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from improved_body_parts_tpu.obs.events import strict_dump  # noqa: E402
+
 
 def main():
     ap = argparse.ArgumentParser(description="IMHN pose training (SPMD)")
@@ -327,7 +329,7 @@ def main():
         else:
             with open(os.path.join(cfg.train.checkpoint_dir, "RUN.json"),
                       "w") as f:
-                json.dump(manifest, f, indent=2)
+                strict_dump(manifest, f, indent=2)
 
     train_h5 = args.train_h5 or cfg.train.hdf5_train_data
     val_h5 = args.val_h5 or cfg.train.hdf5_val_data

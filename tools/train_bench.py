@@ -22,6 +22,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -64,7 +69,7 @@ def main():
 
     def flush():
         with open(args.out, "w") as f:
-            json.dump(report, f, indent=2)
+            strict_dump(report, f, indent=2)
 
     opt = make_optimizer(cfg, step_decay_schedule(cfg.train,
                                                   steps_per_epoch=100))
@@ -154,7 +159,7 @@ def main():
         flush()
         print(f"device-gt batch {b}: {b / dt:.2f} imgs/s", flush=True)
 
-    print(json.dumps(report))
+    print(strict_dumps(report))
 
 
 if __name__ == "__main__":

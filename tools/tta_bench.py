@@ -30,6 +30,11 @@ _TOOLS = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_TOOLS))
 sys.path.insert(0, _TOOLS)  # for `from evaluate import load_predictor`
 
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
 
 GRIDS = {
     "single_scale": {},
@@ -93,8 +98,8 @@ def main():
            "decode_path": "compact (device-resident grid)",
            "grids": results}
     with open(args.out, "w") as f:
-        json.dump(out, f, indent=2)
-    print(json.dumps({k: v["AP"] for k, v in results.items()}))
+        strict_dump(out, f, indent=2)
+    print(strict_dumps({k: v["AP"] for k, v in results.items()}))
 
 
 if __name__ == "__main__":
