@@ -99,6 +99,18 @@ def _provenance():
                      "ruleset": ruleset_hash()}
     except Exception:  # noqa: BLE001 — provenance must never kill the line
         graftlint = None
+    try:
+        from improved_body_parts_tpu.analysis.program import (
+            GRAFTAUDIT_VERSION,
+            audit_ruleset_hash,
+        )
+
+        # same contract as lint: audit verdicts/fingerprints are only
+        # compared between identical check sets
+        graftaudit = {"version": GRAFTAUDIT_VERSION,
+                      "ruleset": audit_ruleset_hash()}
+    except Exception:  # noqa: BLE001 — provenance must never kill the line
+        graftaudit = None
     return {
         "git_sha": sha,
         "jax_version": jax_version,
@@ -107,6 +119,7 @@ def _provenance():
         "python": _platform.python_version(),
         "cpu_count": os.cpu_count(),
         "graftlint": graftlint,
+        "graftaudit": graftaudit,
     }
 
 
@@ -366,6 +379,47 @@ def _chaos_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _audit_summary(budget_s):
+    """Run tools/program_audit.py (the graftaudit compiled-program tier:
+    jaxpr checks + fingerprint gating over the program registry, at
+    trace level for speed — the committed PROGRAM_AUDIT.json carries
+    the full AOT sweep) and return verdict counts, or an
+    {"error"/"skipped"} marker — the "lint" key contract.  Subprocess
+    so an auditor crash can never take down the primary metric.
+    ``IBP_BENCH_AUDIT=0`` skips it unconditionally."""
+    import subprocess
+
+    if os.environ.get("IBP_BENCH_AUDIT") == "0":
+        return {"skipped": "IBP_BENCH_AUDIT=0"}
+    if budget_s < 180:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (run tools/program_audit.py directly)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "program_audit.py"),
+             "--level", "trace", "--format", "json"],
+            capture_output=True, text=True, timeout=min(600, budget_s),
+            env=dict(os.environ))
+        if proc.returncode not in (0, 1):
+            return {"error": f"exit {proc.returncode}"}
+        r = json.loads(proc.stdout)
+        drifted = sum(1 for p in r["programs"].values() if p["drift"])
+        return {
+            "ok": r["ok"],
+            "programs": len(r["programs"]),
+            "errors": r["counts"]["error"],
+            "warnings": r["counts"]["warning"],
+            "drifted": drifted,
+            "level": r["level"],
+            "version": r["graftaudit"]["version"],
+            "ruleset": r["graftaudit"]["ruleset"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def _lint_summary(budget_s):
     """Run tools/lint.py (the graftlint static-analysis gate) and return
     finding counts by severity, or an {"error"/"skipped"} marker — the
@@ -477,6 +531,10 @@ def main():
     # static-analysis gate (graftlint), same discipline
     lint = _lint_summary(
         TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # compiled-program audit (graftaudit registry sweep), same
+    # discipline
+    audit = _audit_summary(
+        TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     from improved_body_parts_tpu.obs.events import strict_dumps
 
     print(strict_dumps({
@@ -491,6 +549,7 @@ def main():
         "ckpt": ckpt,
         "chaos": chaos,
         "lint": lint,
+        "audit": audit,
         "provenance": _provenance(),
     }))
 

@@ -30,11 +30,21 @@ Rules (severity in parentheses; suppression:
 - JGL006 metric-names (error)     — Prometheus naming contract at
   ``Registry`` call sites
 - JGL007 bare-print (warning)     — stdout prints in library code
+- JGL008 dtype-hygiene (warning)  — f64 literals flowing into jnp
+  constructors in library code (PRG002's source-tier mirror)
 - JGL000 (error)                  — suppressions without a reason,
   unknown rule ids, unparseable files
 
 Config: ``[tool.graftlint]`` in ``pyproject.toml`` (see
 ``analysis/config.py``).
+
+The sibling subpackage ``analysis.program`` (graftaudit) is the
+SECOND tier: it audits what XLA actually compiled for every registered
+entry-point program — host-interop primitives, dtype drift, donation
+aliasing, constant bloat, sharding coverage, and an HLO cost
+fingerprint gated against the committed ``PROGRAM_AUDIT.json``.
+Unlike this tier it imports jax (abstract tracing + AOT compiles, zero
+data); importing ``analysis`` itself stays stdlib-only.
 """
 from .config import ConfigError, LintConfig, load_config  # noqa: F401
 from .core import (  # noqa: F401

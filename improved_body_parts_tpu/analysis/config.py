@@ -87,11 +87,14 @@ def _parse_value(raw: str, path: str, key: str):
             "accepts strings, bools, ints and arrays of strings)") from None
 
 
-def parse_graftlint_tables(text: str, path: str = "pyproject.toml"
+def parse_graftlint_tables(text: str, path: str = "pyproject.toml",
+                           section: str = "tool.graftlint"
                            ) -> Dict[str, Dict[str, object]]:
-    """``{section_suffix: {key: value}}`` for every ``[tool.graftlint*]``
+    """``{section_suffix: {key: value}}`` for every ``[<section>*]``
     table in ``text`` (suffix "" for the root table, "severity" for
-    ``[tool.graftlint.severity]``, ...)."""
+    ``[tool.graftlint.severity]``, ...).  ``section`` defaults to
+    graftlint's table; the program auditor reuses the same TOML-subset
+    parser for ``[tool.graftaudit]``."""
     tables: Dict[str, Dict[str, object]] = {}
     current: Optional[Dict[str, object]] = None
     lines = text.splitlines()
@@ -102,11 +105,11 @@ def parse_graftlint_tables(text: str, path: str = "pyproject.toml"
         sect = _SECTION_RE.match(line)
         if sect:
             name = sect.group(1).strip()
-            if name == "tool.graftlint":
+            if name == section:
                 current = tables.setdefault("", {})
-            elif name.startswith("tool.graftlint."):
+            elif name.startswith(section + "."):
                 current = tables.setdefault(
-                    name[len("tool.graftlint."):], {})
+                    name[len(section) + 1:], {})
             else:
                 current = None
             continue

@@ -1,0 +1,48 @@
+"""graftaudit — compiled-program auditing: the second static-analysis
+tier, checking what XLA *actually compiled* for every program the repo
+ships.
+
+graftlint (the sibling ``analysis/rules`` tier) reasons about source
+text; this tier traces every registered entry-point program
+**abstractly** — ``jax.eval_shape`` / ``jax.make_jaxpr`` over
+``ShapeDtypeStruct``s, plus AOT ``.lower().compile()`` on the CPU
+backend — with zero real data and zero FLOPs of model execution, and
+audits the jaxpr and the compiled artifact:
+
+- PRG001 host-interop   — ``pure_callback`` / ``io_callback`` /
+  ``debug_callback`` / infeed / outfeed inside hot programs
+- PRG002 dtype-drift    — any f64 anywhere; a program declared
+  bf16-compute that compiled with no bf16 left in it
+- PRG003 donation-aliasing — every ``donate_argnums`` declaration must
+  be REALIZED as ``input_output_alias`` entries in the compiled
+  executable (the PR 5/6 corruption class, checked per program)
+- PRG004 constant-bloat — giant literals baked into the jaxpr
+- PRG005 dynamic-while  — unbounded ``while`` in programs that did not
+  declare one
+- PRG006 sharding-coverage — under a mesh: inputs left unconstrained by
+  the partition rules; donated leaves whose in/out shardings diverge
+  (an alias cannot be established across a sharding change)
+- PRG007 fingerprint-drift — HLO cost-analysis fingerprint (flops,
+  bytes accessed, peak temp memory, instruction count) and jaxpr
+  structure vs the committed golden registry (``PROGRAM_AUDIT.json``)
+
+``registry.program_registry()`` enumerates the real entry points;
+``tools/program_audit.py`` is the runner and
+``tests/test_program_audit.py`` wires the sweep into tier-1.
+
+Unlike the lint tier this package imports jax and repo code by
+construction — but only ever traces/compiles abstract values, so no
+model arithmetic executes and no accelerator is touched (the audit
+pins the CPU backend).
+"""
+from .audit import (  # noqa: F401
+    GRAFTAUDIT_VERSION,
+    AuditReport,
+    ProgramVerdict,
+    audit_registry,
+    audit_ruleset_hash,
+)
+from .checks import PROGRAM_RULES, AuditFinding  # noqa: F401
+from .config import AuditConfig, load_audit_config  # noqa: F401
+from .fingerprint import compare_fingerprints  # noqa: F401
+from .registry import BuiltProgram, ProgramSpec, program_registry  # noqa: F401

@@ -1,0 +1,255 @@
+"""Audit orchestration: sweep the program registry, run the checks,
+fingerprint, and gate against the committed golden registry.
+
+The committed ``PROGRAM_AUDIT.json`` at the repo root IS the golden
+registry: ``tools/program_audit.py`` audits the current tree, compares
+against it, and only ``--bless`` rewrites it — so any drift (a new
+transfer, a new dtype, a lost alias, a >tolerance cost jump) is a loud
+diff against a reviewed artifact, never a silent change.
+
+A program that fails to build/trace/compile is a PRG000 error — a
+crashed audit must never read as a clean one (the graftlint exit-code
+contract, applied here).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .checks import (
+    AuditFinding,
+    run_compiled_checks,
+    run_trace_checks,
+)
+from .compiled import compile_program
+from .config import AuditConfig
+from .fingerprint import (
+    compare_compiled,
+    compare_trace,
+    compiled_fingerprint,
+    trace_fingerprint,
+)
+from .registry import ProgramSpec, program_registry
+from .trace import trace_program
+
+GRAFTAUDIT_VERSION = "1.0.0"
+
+#: audit levels, cheap to expensive: ``trace`` = jaxpr only (tier-1's
+#: sweep), ``compile`` = + AOT lower/compile on the CPU backend
+LEVELS = ("trace", "compile")
+
+
+def audit_ruleset_hash() -> str:
+    """12 hex chars over the program subpackage's own source — the
+    graftaudit twin of graftlint's ``ruleset_hash()`` (which covers the
+    whole analysis package, this subtree included).  Fingerprints and
+    verdicts are only comparable between identical check sets."""
+    h = hashlib.sha256()
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(f for f in os.listdir(pkg) if f.endswith(".py")):
+        h.update(fn.encode())
+        with open(os.path.join(pkg, fn), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:12]
+
+
+@dataclass
+class ProgramVerdict:
+    name: str
+    description: str
+    #: "ok" | "findings" | "skipped" | "crashed"
+    status: str
+    findings: List[AuditFinding] = field(default_factory=list)
+    #: fingerprint-drift diff records (field/golden/current/drift_pct)
+    drift: List[Dict] = field(default_factory=list)
+    fingerprint: Dict = field(default_factory=dict)
+    note: Optional[str] = None
+    tags: List[str] = field(default_factory=list)
+    declarations: Dict = field(default_factory=dict)
+
+    def as_dict(self) -> Dict:
+        return {
+            "description": self.description,
+            "status": self.status,
+            "tags": list(self.tags),
+            "declarations": self.declarations,
+            "findings": [f.as_dict() for f in self.findings],
+            "drift": list(self.drift),
+            "fingerprint": self.fingerprint,
+            "note": self.note,
+        }
+
+
+@dataclass
+class AuditReport:
+    level: str
+    verdicts: List[ProgramVerdict] = field(default_factory=list)
+    jax_version: str = ""
+    backend: str = ""
+    golden_jax_version: Optional[str] = None
+
+    def counts(self) -> Dict[str, int]:
+        from ..config import SEVERITIES
+
+        out = {s: 0 for s in reversed(SEVERITIES)}
+        for v in self.verdicts:
+            for f in v.findings:
+                out[f.severity] += 1
+        return out
+
+    @property
+    def ok(self) -> bool:
+        return self.counts()["error"] == 0
+
+    def findings(self) -> List[AuditFinding]:
+        return [f for v in self.verdicts for f in v.findings]
+
+    def as_dict(self) -> Dict:
+        return {
+            "graftaudit": {"version": GRAFTAUDIT_VERSION,
+                           "ruleset": audit_ruleset_hash()},
+            "jax_version": self.jax_version,
+            "backend": self.backend,
+            "level": self.level,
+            "programs": {v.name: v.as_dict() for v in self.verdicts},
+            "counts": self.counts(),
+            "ok": self.ok,
+        }
+
+
+def _declarations(spec: ProgramSpec) -> Dict:
+    return {
+        "hot": spec.hot,
+        "donate_argnums": list(spec.donate_argnums),
+        "expect_bf16": spec.expect_bf16,
+        "allow_f64": spec.allow_f64,
+        "allow_while": spec.allow_while,
+        "meshed": spec.meshed,
+        "requires_devices": spec.requires_devices,
+    }
+
+
+def _crash_finding(spec: ProgramSpec, stage: str, exc: BaseException
+                   ) -> AuditFinding:
+    return AuditFinding(
+        program=spec.name, rule="PRG000", severity="error",
+        message=f"audit {stage} crashed: {type(exc).__name__}: {exc} — "
+                "a program that cannot be audited must not read as clean")
+
+
+def audit_program(spec: ProgramSpec, level: str = "compile",
+                  config: Optional[AuditConfig] = None,
+                  golden: Optional[Dict] = None,
+                  drift_severity: str = "error") -> ProgramVerdict:
+    """Audit one registry program.  ``golden`` is this program's entry
+    from the committed registry (``{"fingerprint": {...}}``) or None;
+    ``drift_severity`` lets callers downgrade PRG007 when the golden
+    was recorded under a different jax version."""
+    import jax
+
+    config = config or AuditConfig()
+    verdict = ProgramVerdict(name=spec.name, description=spec.description,
+                             status="ok", tags=list(spec.tags),
+                             declarations=_declarations(spec))
+
+    if spec.requires_devices > len(jax.devices()):
+        verdict.status = "skipped"
+        verdict.note = (f"needs {spec.requires_devices} devices, host has "
+                        f"{len(jax.devices())} (run under XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=8)")
+        return verdict
+
+    try:
+        built = spec.build()
+    except Exception as e:  # noqa: BLE001 — crash must surface as finding
+        verdict.status = "crashed"
+        verdict.findings.append(_crash_finding(spec, "build", e))
+        return verdict
+
+    try:
+        trace = trace_program(built)
+    except Exception as e:  # noqa: BLE001 — crash must surface as finding
+        verdict.status = "crashed"
+        verdict.findings.append(_crash_finding(spec, "trace", e))
+        return verdict
+
+    verdict.findings.extend(run_trace_checks(spec, trace, config))
+    verdict.fingerprint = {"trace": trace_fingerprint(trace)}
+
+    if level == "compile":
+        try:
+            compiled, _ = compile_program(built)
+        except Exception as e:  # noqa: BLE001 — crash must surface
+            verdict.status = "crashed"
+            verdict.findings.append(_crash_finding(spec, "compile", e))
+            return verdict
+        verdict.findings.extend(
+            run_compiled_checks(spec, built, compiled, config))
+        verdict.fingerprint["compiled"] = compiled_fingerprint(compiled)
+
+    if golden:
+        gfp = golden.get("fingerprint", {})
+        drift = compare_trace(gfp.get("trace"),
+                              verdict.fingerprint["trace"],
+                              config.cost_tolerance_pct)
+        if level == "compile" and "compiled" in verdict.fingerprint:
+            drift += compare_compiled(gfp.get("compiled"),
+                                      verdict.fingerprint["compiled"],
+                                      config.cost_tolerance_pct)
+        verdict.drift = drift
+        if drift:
+            fields = ", ".join(
+                f"{d['field']} {d['golden']!r}->{d['current']!r}"
+                + (f" ({d['drift_pct']}%)" if d.get("drift_pct") else "")
+                for d in drift)
+            verdict.findings.append(AuditFinding(
+                program=spec.name, rule="PRG007",
+                severity=config.severity.get("PRG007", drift_severity),
+                message="fingerprint drifted from the committed golden "
+                        f"registry: {fields} — if intentional, bless "
+                        "with tools/program_audit.py --bless"))
+
+    if verdict.findings:
+        verdict.status = "findings"
+    return verdict
+
+
+def audit_registry(level: str = "compile",
+                   config: Optional[AuditConfig] = None,
+                   golden: Optional[Dict] = None,
+                   names: Optional[List[str]] = None) -> AuditReport:
+    """Sweep the program registry.  ``golden`` is the parsed committed
+    ``PROGRAM_AUDIT.json`` (or None to skip drift gating); ``names``
+    restricts the sweep."""
+    import jax
+
+    assert level in LEVELS, level
+    config = config or AuditConfig()
+    golden_programs = (golden or {}).get("programs", {})
+    golden_jax = (golden or {}).get("jax_version")
+    # structural fingerprints are only exact within one jax version: a
+    # golden recorded elsewhere still gates, but as warnings
+    drift_severity = ("error" if not golden or golden_jax == jax.__version__
+                      else "warning")
+
+    report = AuditReport(level=level, jax_version=jax.__version__,
+                         backend=jax.default_backend(),
+                         golden_jax_version=golden_jax)
+    for spec in program_registry():
+        if names is not None and spec.name not in names:
+            continue
+        if spec.name in config.exclude:
+            verdict = ProgramVerdict(
+                name=spec.name, description=spec.description,
+                status="skipped", tags=list(spec.tags),
+                declarations=_declarations(spec),
+                note="excluded via [tool.graftaudit] exclude")
+            report.verdicts.append(verdict)
+            continue
+        report.verdicts.append(audit_program(
+            spec, level=level, config=config,
+            golden=golden_programs.get(spec.name),
+            drift_severity=drift_severity))
+    return report

@@ -1,0 +1,250 @@
+"""The program-audit checks (PRG001–PRG007).
+
+Each check is a pure function over the structural summaries
+(``TraceInfo`` / ``CompiledInfo``) plus the program's declarations
+(``ProgramSpec``); findings carry the program name instead of a source
+location — the "line number" of a compiled-program defect is the
+program itself.
+
+Severity defaults can be overridden per check via
+``[tool.graftaudit.severity]`` (same mechanism as graftlint).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..config import SEVERITIES
+from .compiled import CompiledInfo
+from .config import AuditConfig
+from .registry import ProgramSpec
+from .trace import TraceInfo, donated_leaves
+
+
+@dataclass(frozen=True)
+class AuditFinding:
+    program: str
+    rule: str
+    severity: str
+    message: str
+
+    def format(self) -> str:
+        return (f"{self.program}: {self.severity.upper()} {self.rule} "
+                f"{self.message}")
+
+    def as_dict(self) -> dict:
+        return {"program": self.program, "rule": self.rule,
+                "severity": self.severity, "message": self.message}
+
+
+@dataclass(frozen=True)
+class ProgramRule:
+    id: str
+    name: str
+    severity: str
+    doc: str
+
+
+#: the rule table — ``tools/program_audit.py --rules`` prints it and
+#: TRAINING.md §8a mirrors it
+PROGRAM_RULES = (
+    ProgramRule(
+        "PRG001", "host-interop", "error",
+        "host round-trip primitives (pure_callback/io_callback/"
+        "debug_callback/infeed/outfeed) inside a hot program — every "
+        "dispatch would stall the device on the host"),
+    ProgramRule(
+        "PRG002", "dtype-drift", "error",
+        "float64 anywhere in the program (silent upcasts double memory "
+        "and are 10-100x slower on TPU), or a program declared "
+        "bf16-compute that compiled with no bf16 left in it"),
+    ProgramRule(
+        "PRG003", "donation-aliasing", "error",
+        "a donate_argnums declaration the compiled executable did not "
+        "realize as input_output_alias entries — jax drops donation "
+        "silently, and an unaliased donated buffer is exactly the "
+        "PR 5/6 corruption-or-2x-memory class"),
+    ProgramRule(
+        "PRG004", "constant-bloat", "warning",
+        "a giant literal baked into the jaxpr (closure-captured array) "
+        "— it is re-uploaded with every executable and bloats the "
+        "compile cache"),
+    ProgramRule(
+        "PRG005", "dynamic-while", "warning",
+        "a `while` primitive in a program that did not declare one — "
+        "unbounded trip counts defeat static scheduling and can hide "
+        "data-dependent host syncs"),
+    ProgramRule(
+        "PRG006", "sharding-coverage", "error",
+        "a meshed program whose inputs are all left unconstrained by "
+        "the partition rules, or a donated leaf whose input/output "
+        "shardings diverge (the alias cannot be established)"),
+    ProgramRule(
+        "PRG007", "fingerprint-drift", "error",
+        "the program's fingerprint (cost analysis, structure) drifted "
+        "beyond tolerance from the committed golden registry — bless "
+        "intentional changes with tools/program_audit.py --bless"),
+)
+
+_RULES_BY_ID = {r.id: r for r in PROGRAM_RULES}
+
+
+def _make(config: AuditConfig, spec: ProgramSpec, rule_id: str,
+          message: str) -> AuditFinding:
+    rule = _RULES_BY_ID[rule_id]
+    severity = config.severity.get(rule_id, rule.severity)
+    assert severity in SEVERITIES, severity
+    return AuditFinding(program=spec.name, rule=rule_id,
+                        severity=severity, message=message)
+
+
+# ------------------------------------------------------- trace-level checks
+
+
+def check_host_interop(spec: ProgramSpec, trace: TraceInfo,
+                       config: AuditConfig) -> List[AuditFinding]:
+    if not spec.hot or not trace.callbacks:
+        return []
+    detail = ", ".join(f"{name} x{n}"
+                       for name, n in sorted(trace.callbacks.items()))
+    return [_make(config, spec, "PRG001",
+                  f"host-interop primitives in a hot program: {detail}")]
+
+
+def check_dtype_drift(spec: ProgramSpec, trace: TraceInfo,
+                      config: AuditConfig) -> List[AuditFinding]:
+    out = []
+    # int64 is legal (counters, indices); 64-bit floats are the drift
+    f64 = sorted(d for d in trace.dtypes
+                 if d in ("float64", "complex128"))
+    if f64 and not spec.allow_f64:
+        out.append(_make(
+            config, spec, "PRG002",
+            f"64-bit float dtypes in the program: {', '.join(f64)} — "
+            "a silent upcast (np.float64 literal, python float chain) "
+            "doubles memory and dies on TPU"))
+    if spec.expect_bf16 and "bfloat16" not in trace.dtypes:
+        out.append(_make(
+            config, spec, "PRG002",
+            "program is declared bf16-compute but no bfloat16 appears "
+            "in its jaxpr — the mixed-precision path silently upcast "
+            f"to {{{', '.join(sorted(trace.dtypes))}}}"))
+    return out
+
+
+def check_constant_bloat(spec: ProgramSpec, trace: TraceInfo,
+                         config: AuditConfig) -> List[AuditFinding]:
+    out = []
+    if trace.const_max >= config.const_bloat_bytes:
+        out.append(_make(
+            config, spec, "PRG004",
+            f"largest jaxpr constant is {trace.const_max} bytes "
+            f"(threshold {config.const_bloat_bytes}) — a closure "
+            "captured an array that should be an argument"))
+    elif trace.const_total >= config.const_total_bytes:
+        out.append(_make(
+            config, spec, "PRG004",
+            f"{len(trace.const_bytes)} jaxpr constants total "
+            f"{trace.const_total} bytes (threshold "
+            f"{config.const_total_bytes})"))
+    return out
+
+
+def check_dynamic_while(spec: ProgramSpec, trace: TraceInfo,
+                        config: AuditConfig) -> List[AuditFinding]:
+    if trace.while_count and not spec.allow_while:
+        return [_make(
+            config, spec, "PRG005",
+            f"{trace.while_count} `while` primitive(s) in a program "
+            "that declared none (scan/fori with static trip counts "
+            "lower as `scan`; declare allow_while for an intentional "
+            "bounded-iteration kernel)")]
+    return []
+
+
+# ---------------------------------------------------- compiled-level checks
+
+
+def check_donation(spec: ProgramSpec, built, compiled: CompiledInfo,
+                   config: AuditConfig) -> List[AuditFinding]:
+    """Every declared donation must be REALIZED by the executable."""
+    if not spec.donate_argnums:
+        return []
+    leaf_count, leaf_bytes = donated_leaves(built, spec.donate_argnums)
+    if leaf_count == 0:
+        return []
+    out = []
+    if not compiled.aliases and compiled.alias_bytes == 0:
+        out.append(_make(
+            config, spec, "PRG003",
+            f"donate_argnums={spec.donate_argnums} declared "
+            f"({leaf_count} leaves, {leaf_bytes} bytes) but the "
+            "compiled executable established ZERO input/output aliases "
+            "— donation was silently dropped; the step runs at 2x "
+            "state memory (or worse: PR 5/6's corruption window)"))
+    elif compiled.alias_bytes < leaf_bytes:
+        out.append(_make(
+            config, spec, "PRG003",
+            f"donation only partially realized: {compiled.alias_bytes} "
+            f"of {leaf_bytes} donated bytes aliased "
+            f"({compiled.aliased_param_count} of {leaf_count} leaves) "
+            "— some state leaves changed shape/dtype/sharding between "
+            "input and output"))
+    return out
+
+
+def check_sharding_coverage(spec: ProgramSpec, compiled: CompiledInfo,
+                            config: AuditConfig) -> List[AuditFinding]:
+    if not spec.meshed:
+        return []
+    out = []
+    specs = compiled.input_specs
+    if not specs:
+        out.append(_make(
+            config, spec, "PRG006",
+            "meshed program but the compiled executable exposes no "
+            "sharding metadata — the mesh never reached the program"))
+        return out
+    nontrivial = [s for s in specs if s not in ("PartitionSpec()", "None")]
+    if not nontrivial:
+        out.append(_make(
+            config, spec, "PRG006",
+            f"all {len(specs)} input leaves are fully replicated — "
+            "nothing is sharded over the mesh; the partition rules "
+            "cover no input"))
+    for out_idx, param_idx in sorted(compiled.aliases.items()):
+        if (param_idx < len(compiled.input_specs)
+                and out_idx < len(compiled.output_specs)
+                and compiled.input_specs[param_idx]
+                != compiled.output_specs[out_idx]):
+            out.append(_make(
+                config, spec, "PRG006",
+                f"donated leaf sharding diverges across the step: "
+                f"input {param_idx} {compiled.input_specs[param_idx]} "
+                f"vs output {out_idx} "
+                f"{compiled.output_specs[out_idx]} — the alias cannot "
+                "hold and the update silently materializes a resharded "
+                "copy"))
+    return out
+
+
+def run_trace_checks(spec: ProgramSpec, trace: TraceInfo,
+                     config: Optional[AuditConfig] = None
+                     ) -> List[AuditFinding]:
+    config = config or AuditConfig()
+    out: List[AuditFinding] = []
+    out += check_host_interop(spec, trace, config)
+    out += check_dtype_drift(spec, trace, config)
+    out += check_constant_bloat(spec, trace, config)
+    out += check_dynamic_while(spec, trace, config)
+    return out
+
+
+def run_compiled_checks(spec: ProgramSpec, built, compiled: CompiledInfo,
+                        config: Optional[AuditConfig] = None
+                        ) -> List[AuditFinding]:
+    config = config or AuditConfig()
+    out: List[AuditFinding] = []
+    out += check_donation(spec, built, compiled, config)
+    out += check_sharding_coverage(spec, compiled, config)
+    return out

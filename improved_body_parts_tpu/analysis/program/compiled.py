@@ -1,0 +1,127 @@
+"""AOT lowering/compilation and compiled-artifact extraction.
+
+``jitted.lower(*ShapeDtypeStructs).compile()`` builds the real XLA
+executable on the CPU backend without running it — zero data, zero
+model FLOPs, but the artifact is exactly what a run would execute
+(modulo backend codegen).  From it we extract:
+
+- the realized ``input_output_alias`` map (HLO module header) — the
+  ground truth for donation verification (PRG003): jax drops a
+  donation silently when shapes/dtypes/shardings prevent aliasing,
+  and the PR 5/6 corruption class lived precisely in that gap;
+- cost analysis (flops / bytes accessed) and memory analysis
+  (argument / output / alias / peak-temp bytes) — the fingerprint;
+- input/output shardings for the mesh-coverage check (PRG006).
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+_INSTR_RE = re.compile(r"^\s+(?:ROOT\s+)?%?[\w.\-]+\s+=\s+", re.M)
+_ALIAS_ENTRY_RE = re.compile(r"\{([\d,\s]*)\}:\s*\((\d+)")
+
+
+@dataclass
+class CompiledInfo:
+    """Summary of one compiled executable."""
+
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    alias_bytes: int = 0
+    temp_bytes: int = 0
+    generated_code_bytes: int = 0
+    hlo_instruction_count: int = 0
+    #: flat output index -> flat parameter index, parsed from the HLO
+    #: module header's ``input_output_alias`` map
+    aliases: Dict[int, int] = field(default_factory=dict)
+    #: str(PartitionSpec) per flattened input / output (empty when the
+    #: program was not built with explicit shardings)
+    input_specs: List[str] = field(default_factory=list)
+    output_specs: List[str] = field(default_factory=list)
+
+    @property
+    def aliased_param_count(self) -> int:
+        return len(set(self.aliases.values()))
+
+
+def parse_input_output_aliases(hlo_text: str) -> Dict[int, int]:
+    """Parse the ``input_output_alias={ ... }`` map out of an HLO module
+    header.  Entries look like ``{3}: (3, {}, may-alias)`` — output
+    tuple index -> (parameter number, param subindex, kind); the output
+    tuple of a jax program is the flattened result, so the top-level
+    index IS the flat output leaf index."""
+    start = hlo_text.find("input_output_alias=")
+    if start < 0:
+        return {}
+    i = hlo_text.index("{", start)
+    depth, j = 0, i
+    while j < len(hlo_text):
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        j += 1
+    body = hlo_text[i + 1:j]
+    aliases: Dict[int, int] = {}
+    for m in _ALIAS_ENTRY_RE.finditer(body):
+        out_path = [p for p in m.group(1).replace(" ", "").split(",") if p]
+        if not out_path:
+            continue
+        aliases[int(out_path[0])] = int(m.group(2))
+    return aliases
+
+
+def _sharding_specs(shardings) -> List[str]:
+    """Flatten a compiled executable's input/output shardings into
+    ``str(PartitionSpec)`` per leaf (best-effort: backends without
+    sharding metadata yield an empty list)."""
+    import jax
+
+    def is_leaf(x):
+        return hasattr(x, "spec") or hasattr(x, "device_set")
+
+    out = []
+    for s in jax.tree.leaves(shardings, is_leaf=is_leaf):
+        spec = getattr(s, "spec", None)
+        out.append(str(spec) if spec is not None else str(s))
+    return out
+
+
+def compile_program(built) -> Tuple[CompiledInfo, object]:
+    """AOT-compile a :class:`~.registry.BuiltProgram` and extract its
+    :class:`CompiledInfo`.  Returns ``(info, compiled)`` — the compiled
+    object itself for callers that need more (never executed here)."""
+    compiled = built.fn.lower(*built.args).compile()
+    info = CompiledInfo()
+
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if cost:
+        info.flops = float(cost.get("flops", 0.0))
+        info.bytes_accessed = float(cost.get("bytes accessed", 0.0))
+
+    mem = compiled.memory_analysis()
+    if mem is not None:
+        info.argument_bytes = int(mem.argument_size_in_bytes)
+        info.output_bytes = int(mem.output_size_in_bytes)
+        info.alias_bytes = int(mem.alias_size_in_bytes)
+        info.temp_bytes = int(mem.temp_size_in_bytes)
+        info.generated_code_bytes = int(mem.generated_code_size_in_bytes)
+
+    text = compiled.as_text()
+    info.hlo_instruction_count = len(_INSTR_RE.findall(text))
+    info.aliases = parse_input_output_aliases(text)
+
+    try:
+        info.input_specs = _sharding_specs(compiled.input_shardings)
+        info.output_specs = _sharding_specs(compiled.output_shardings)
+    except Exception:  # noqa: BLE001 — sharding metadata is best-effort
+        pass
+    return info, compiled

@@ -1,0 +1,81 @@
+"""graftaudit configuration: ``[tool.graftaudit]`` in ``pyproject.toml``.
+
+Reuses graftlint's TOML-subset parser (``analysis.config``) — same
+file, same value shapes, same loud failure on unknown keys.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+from ..config import ConfigError, parse_graftlint_tables
+
+
+@dataclass(frozen=True)
+class AuditConfig:
+    """Resolved graftaudit configuration (defaults mirror the committed
+    ``[tool.graftaudit]`` section so ``AuditConfig()`` behaves like the
+    repo checkout)."""
+
+    #: max relative drift (percent) tolerated per numeric fingerprint
+    #: field before PRG007 fires — cost-analysis numbers move a little
+    #: with XLA minor versions, a real regression moves a lot
+    cost_tolerance_pct: float = 25.0
+    #: a single jaxpr constant at/above this many bytes is PRG004
+    const_bloat_bytes: int = 1 << 20
+    #: total baked-in constants at/above this many bytes is PRG004
+    const_total_bytes: int = 8 << 20
+    #: program names excluded from the sweep (escape hatch for a
+    #: program under active rework; the audit reports the exclusion)
+    exclude: Tuple[str, ...] = ()
+    #: per-check severity overrides, e.g. {"PRG005": "info"}
+    severity: Dict[str, str] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.severity is None:
+            object.__setattr__(self, "severity", {})
+
+
+def audit_config_from_tables(tables: Dict[str, Dict[str, object]],
+                             path: str = "pyproject.toml") -> AuditConfig:
+    from ..config import SEVERITIES
+
+    root = dict(tables.get("", {}))
+    severity = {str(k).upper(): str(v)
+                for k, v in tables.get("severity", {}).items()}
+    for rid, sev in severity.items():
+        if sev not in SEVERITIES:
+            raise ConfigError(
+                f"{path}: [tool.graftaudit.severity] {rid} = {sev!r} "
+                f"(must be one of {SEVERITIES})")
+    kwargs: Dict[str, object] = {}
+    for key, typ in (("cost_tolerance_pct", (int, float)),
+                     ("const_bloat_bytes", int),
+                     ("const_total_bytes", int)):
+        if key in root:
+            val = root.pop(key)
+            if not isinstance(val, typ) or isinstance(val, bool):
+                raise ConfigError(f"{path}: {key} must be a number")
+            kwargs[key] = float(val) if key == "cost_tolerance_pct" else val
+    if "exclude" in root:
+        val = root.pop("exclude")
+        if not isinstance(val, list):
+            raise ConfigError(f"{path}: exclude must be an array")
+        kwargs["exclude"] = tuple(str(v) for v in val)
+    if root:
+        raise ConfigError(
+            f"{path}: unknown [tool.graftaudit] keys {sorted(root)}")
+    return AuditConfig(severity=severity, **kwargs)
+
+
+def load_audit_config(root: str) -> AuditConfig:
+    """Read ``<root>/pyproject.toml``'s graftaudit tables; defaults when
+    the file or the section is absent."""
+    pp = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(pp):
+        return AuditConfig()
+    with open(pp, encoding="utf-8") as f:
+        text = f.read()
+    return audit_config_from_tables(
+        parse_graftlint_tables(text, pp, section="tool.graftaudit"), pp)

@@ -1,0 +1,133 @@
+"""Program fingerprints and golden-registry comparison (PRG007).
+
+Two tiers, matching the audit's two cost tiers:
+
+- the **trace fingerprint** (jaxpr structure: equation counts by
+  primitive, the dtype lattice, constant bytes, control-flow shape,
+  input/output signatures) is deterministic for a given jax version
+  and costs ~1 s — tier-1 gates on it for every program;
+- the **compiled fingerprint** (XLA cost analysis: flops, bytes
+  accessed, peak temp memory, instruction count, realized aliases)
+  needs the AOT compile — ``tools/program_audit.py`` computes it for
+  the committed artifact and the bench key.
+
+Comparison semantics: STRUCTURAL fields must match exactly (a new
+dtype, a new host callback, a changed signature, a lost alias is a
+regression, full stop); NUMERIC fields tolerate
+``cost_tolerance_pct`` relative drift (XLA minor versions jiggle
+instruction counts and fusion decisions; real regressions move far
+more).  Every diff names the field, both values, and the relative
+change — the "diff, not a 2-day debugging session" contract.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .compiled import CompiledInfo
+from .trace import TraceInfo
+
+#: trace-fingerprint fields compared exactly
+TRACE_EXACT = ("dtypes", "callbacks", "while_count", "scan_count",
+               "in_signature", "out_signature")
+#: trace-fingerprint fields compared under tolerance (fusion-adjacent
+#: rewrites move equation counts slightly across jax versions)
+TRACE_NUMERIC = ("eqn_count", "const_total", "const_max")
+#: compiled-fingerprint fields compared exactly
+COMPILED_EXACT = ("argument_bytes", "output_bytes", "alias_bytes",
+                  "aliased_params")
+#: compiled-fingerprint fields compared under tolerance
+COMPILED_NUMERIC = ("flops", "bytes_accessed", "temp_bytes",
+                    "hlo_instruction_count")
+
+
+def _signature_summary(sig) -> Dict:
+    """A flattened-leaves signature as {count, 12-hex hash}: exact
+    equality still detects ANY leaf shape/dtype/order change, while the
+    committed artifact stays small (the train state alone is 762
+    leaves — the full list per program tripled PROGRAM_AUDIT.json)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for s in sig:
+        h.update(s.encode())
+        h.update(b"\0")
+    return {"count": len(sig), "hash": h.hexdigest()[:12]}
+
+
+def trace_fingerprint(trace: TraceInfo) -> Dict:
+    return {
+        "eqn_count": trace.eqn_count,
+        "primitives": dict(sorted(trace.primitives.items())),
+        "dtypes": sorted(trace.dtypes),
+        "callbacks": dict(sorted(trace.callbacks.items())),
+        "while_count": trace.while_count,
+        "scan_count": trace.scan_count,
+        "const_count": len(trace.const_bytes),
+        "const_total": trace.const_total,
+        "const_max": trace.const_max,
+        "in_signature": _signature_summary(trace.in_signature),
+        "out_signature": _signature_summary(trace.out_signature),
+    }
+
+
+def compiled_fingerprint(compiled: CompiledInfo) -> Dict:
+    return {
+        "flops": int(compiled.flops),
+        "bytes_accessed": int(compiled.bytes_accessed),
+        "argument_bytes": compiled.argument_bytes,
+        "output_bytes": compiled.output_bytes,
+        "alias_bytes": compiled.alias_bytes,
+        "temp_bytes": compiled.temp_bytes,
+        "hlo_instruction_count": compiled.hlo_instruction_count,
+        "aliased_params": compiled.aliased_param_count,
+        "input_spec_kinds": sorted(set(compiled.input_specs)),
+        "output_spec_kinds": sorted(set(compiled.output_specs)),
+    }
+
+
+def _rel_pct(old: float, new: float) -> float:
+    if old == new:
+        return 0.0
+    base = max(abs(old), 1e-12)
+    return 100.0 * abs(new - old) / base
+
+
+def compare_fingerprints(golden: Dict, current: Dict,
+                         tolerance_pct: float,
+                         exact_keys, numeric_keys) -> List[Dict]:
+    """Diff two fingerprint dicts.  Returns one record per drifted
+    field: ``{"field", "golden", "current", "drift_pct"|None}`` —
+    empty list means no drift beyond tolerance."""
+    diffs: List[Dict] = []
+    for key in exact_keys:
+        if golden.get(key) != current.get(key):
+            diffs.append({"field": key, "golden": golden.get(key),
+                          "current": current.get(key), "drift_pct": None})
+    for key in numeric_keys:
+        old, new = golden.get(key), current.get(key)
+        if old is None or new is None:
+            if old != new:
+                diffs.append({"field": key, "golden": old, "current": new,
+                              "drift_pct": None})
+            continue
+        pct = _rel_pct(float(old), float(new))
+        if pct > tolerance_pct:
+            diffs.append({"field": key, "golden": old, "current": new,
+                          "drift_pct": round(pct, 2)})
+    return diffs
+
+
+def compare_trace(golden: Optional[Dict], current: Dict,
+                  tolerance_pct: float) -> List[Dict]:
+    if not golden:
+        return []
+    return compare_fingerprints(golden, current, tolerance_pct,
+                                TRACE_EXACT, TRACE_NUMERIC)
+
+
+def compare_compiled(golden: Optional[Dict], current: Dict,
+                     tolerance_pct: float) -> List[Dict]:
+    if not golden:
+        return []
+    return compare_fingerprints(golden, current, tolerance_pct,
+                                COMPILED_EXACT, COMPILED_NUMERIC)

@@ -1,0 +1,320 @@
+"""The program registry: every compiled entry point the repo ships,
+buildable ABSTRACTLY.
+
+Each :class:`ProgramSpec` names one real program — the donated train
+step (health sentinel on and off, device-GT variant), the eval step,
+the compact serve program per bucket shape, the flip-TTA peaks program,
+the SWA running average, and the meshed GSPMD train step — together
+with the declarations the checks verify (donated argnums, bf16-compute,
+hot-path status, mesh expectations).
+
+``build()`` returns the jitted callable plus ``ShapeDtypeStruct``
+example arguments: tracing/lowering/compiling them runs ZERO model
+arithmetic and moves zero real data (``jax.eval_shape`` builds even the
+parameter/optimizer trees abstractly).  Programs are registered on the
+``tiny`` config: the audit checks *program structure* — transfers,
+dtypes, aliasing, sharding — which the depth/width of the flagship
+model does not change, and the tiny IMHN keeps the AOT sweep minutes,
+not hours, on a CPU host.  Structural deviations the flagship could
+introduce (a new primitive, a new dtype) would come from code changes
+this registry compiles too.
+
+The registry is append-only by convention: removing a program (or
+renaming one) shows up as a loud diff against the committed
+``PROGRAM_AUDIT.json``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+#: batch size used by the batched registry programs — small (abstract
+#: tracing cost is shape-independent, but compile time is not) yet >1 so
+#: batch semantics (vmapped extraction, batch-dim sharding) are real
+_B = 2
+
+
+@dataclass(frozen=True)
+class BuiltProgram:
+    """What ``ProgramSpec.build`` returns: a jitted callable plus the
+    abstract arguments to trace/lower it with."""
+
+    fn: Callable
+    args: Tuple
+
+
+@dataclass(frozen=True)
+class ProgramSpec:
+    name: str
+    description: str
+    #: lazily builds the program — jax and model imports happen inside
+    build: Callable[[], BuiltProgram]
+    #: hot programs forbid host-interop primitives (PRG001)
+    hot: bool = True
+    #: positional argnums DECLARED donated — PRG003 verifies the
+    #: compiled executable realized every one as an input/output alias
+    donate_argnums: Tuple[int, ...] = ()
+    #: program is declared bf16-compute: PRG002 requires bf16 to appear
+    expect_bf16: bool = False
+    #: f64 anywhere is an error unless explicitly allowed
+    allow_f64: bool = False
+    #: a `while` primitive is a hazard unless declared intentional
+    allow_while: bool = False
+    #: sharding-coverage checks (PRG006) apply
+    meshed: bool = False
+    #: minimum device count the program needs (the meshed step needs the
+    #: virtual 8-device CPU mesh); short hosts record a skip, not a crash
+    requires_devices: int = 1
+    #: extra tags recorded into the report (e.g. the serve bucket shape)
+    tags: Tuple[str, ...] = field(default_factory=tuple)
+
+
+# --------------------------------------------------------- shared builders
+
+
+def _tiny_setup():
+    """(config, model, optimizer) for the registry's programs — one
+    construction path shared by every spec so the audited programs are
+    built exactly like ``tools/train.py`` builds them."""
+    from ...config import get_config
+    from ...models import build_model
+    from ...train.schedule import step_decay_schedule
+    from ...train.state import make_optimizer
+
+    cfg = get_config("tiny")
+    model = build_model(cfg)
+    optimizer = make_optimizer(cfg, step_decay_schedule(cfg.train, 10))
+    return cfg, model, optimizer
+
+
+def _abstract_state(cfg, model, optimizer):
+    """The TrainState as a ShapeDtypeStruct pytree: parameter shapes,
+    optimizer slots and the step counter, built with zero FLOPs."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...train.state import create_train_state
+
+    h, w = cfg.skeleton.height, cfg.skeleton.width
+    return jax.eval_shape(lambda: create_train_state(
+        model, cfg, optimizer, jax.random.PRNGKey(0),
+        jnp.zeros((1, h, w, 3), jnp.float32)))
+
+
+def _train_batch(cfg, batch: int):
+    """(images, mask_miss, gt) ShapeDtypeStructs on the uint8 wire —
+    the shm-ring pipeline's actual feed format."""
+    import jax
+    import jax.numpy as jnp
+
+    h, w = cfg.skeleton.height, cfg.skeleton.width
+    gh, gw = cfg.skeleton.grid_shape
+    return (jax.ShapeDtypeStruct((batch, h, w, 3), jnp.uint8),
+            jax.ShapeDtypeStruct((batch, gh, gw, 1), jnp.float32),
+            jax.ShapeDtypeStruct((batch, gh, gw, cfg.skeleton.num_layers),
+                                 jnp.float32))
+
+
+def _build_train_step(health: bool = False) -> BuiltProgram:
+    from ...train.step import make_train_step
+
+    cfg, model, optimizer = _tiny_setup()
+    state = _abstract_state(cfg, model, optimizer)
+    images, mask, gt = _train_batch(cfg, _B)
+    fn = make_train_step(model, cfg, optimizer, health=health)
+    return BuiltProgram(fn=fn, args=(state, images, mask, gt))
+
+
+def _train_donate_argnums():
+    from ...train.step import TRAIN_STEP_DONATE_ARGNUMS
+
+    return TRAIN_STEP_DONATE_ARGNUMS
+
+
+def _build_train_step_device_gt() -> BuiltProgram:
+    import jax
+    import jax.numpy as jnp
+
+    from ...train.step import make_train_step
+
+    cfg, model, optimizer = _tiny_setup()
+    state = _abstract_state(cfg, model, optimizer)
+    images, mask, _ = _train_batch(cfg, _B)
+    gh, gw = cfg.skeleton.grid_shape
+    joints = jax.ShapeDtypeStruct((_B, 4, cfg.skeleton.num_parts, 3),
+                                  jnp.float32)
+    mask_all = jax.ShapeDtypeStruct((_B, gh, gw, 1), jnp.float32)
+    fn = make_train_step(model, cfg, optimizer, device_gt=True)
+    return BuiltProgram(fn=fn, args=(state, images, mask, joints, mask_all))
+
+
+def _build_eval_step() -> BuiltProgram:
+    from ...train.step import make_eval_step
+
+    cfg, model, optimizer = _tiny_setup()
+    state = _abstract_state(cfg, model, optimizer)
+    images, mask, gt = _train_batch(cfg, _B)
+    fn = make_eval_step(model, cfg)
+    return BuiltProgram(fn=fn, args=(state, images, mask, gt))
+
+
+def _build_swa_update() -> BuiltProgram:
+    import jax
+
+    from ...train.state import start_swa, update_swa
+
+    cfg, model, optimizer = _tiny_setup()
+    state = _abstract_state(cfg, model, optimizer)
+    swa_state = jax.eval_shape(start_swa, state)
+    return BuiltProgram(fn=jax.jit(update_swa), args=(swa_state,))
+
+
+def _abstract_predictor():
+    """A Predictor over abstract variables: ``_ensemble_fn`` only ever
+    threads the variables through to the jitted program, so the
+    ShapeDtypeStruct tree traces/lowers exactly like real weights."""
+    import jax
+
+    from ...infer.predict import Predictor
+
+    cfg, model, _ = _tiny_setup()
+    h, w = cfg.skeleton.height, cfg.skeleton.width
+
+    def init():
+        import jax.numpy as jnp
+
+        return model.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, h, w, 3), jnp.float32), train=False)
+
+    variables = jax.eval_shape(init)
+    return cfg, Predictor(model, variables, cfg.skeleton)
+
+
+def _build_serve_compact() -> BuiltProgram:
+    import jax
+    import jax.numpy as jnp
+
+    _, p = _abstract_predictor()
+    b = p.bucket
+    fn = p.compact_program((b, b))
+    img = jax.ShapeDtypeStruct((b, b, 3), jnp.float32)
+    valid = jax.ShapeDtypeStruct((), jnp.int32)
+    return BuiltProgram(fn=fn, args=(p.variables, img, valid, valid))
+
+
+def _build_serve_compact_batch() -> BuiltProgram:
+    import jax
+    import jax.numpy as jnp
+
+    _, p = _abstract_predictor()
+    b = p.bucket
+    fn = p.compact_program((b, b), batch=_B)
+    imgs = jax.ShapeDtypeStruct((_B, b, b, 3), jnp.float32)
+    valid = jax.ShapeDtypeStruct((_B,), jnp.int32)
+    return BuiltProgram(fn=fn, args=(p.variables, imgs, valid, valid))
+
+
+def _build_flip_tta_peaks() -> BuiltProgram:
+    import jax
+    import jax.numpy as jnp
+
+    _, p = _abstract_predictor()
+    b = p.bucket
+    fn = p.peaks_program((b, b))
+    img = jax.ShapeDtypeStruct((b, b, 3), jnp.float32)
+    valid = jax.ShapeDtypeStruct((), jnp.int32)
+    return BuiltProgram(fn=fn, args=(p.variables, img, valid, valid))
+
+
+def _build_train_step_mesh() -> BuiltProgram:
+    """The GSPMD train step: state replicated, batch sharded over
+    'data' on a ('data', 'model') mesh — the program ROADMAP item 2
+    promotes to pod scale, audited for sharding coverage (PRG006)."""
+    from ...parallel.mesh import (
+        abstract_with_sharding,
+        batch_sharding,
+        make_mesh,
+        replicated,
+    )
+    from ...train.step import make_train_step
+
+    cfg, model, optimizer = _tiny_setup()
+    state = _abstract_state(cfg, model, optimizer)
+    mesh = make_mesh(data=4, model=2)
+    state = abstract_with_sharding(state, replicated(mesh))
+    images, mask, gt = (abstract_with_sharding(a, batch_sharding(mesh))
+                        for a in _train_batch(cfg, 4))
+    fn = make_train_step(model, cfg, optimizer)
+    return BuiltProgram(fn=fn, args=(state, images, mask, gt))
+
+
+# ---------------------------------------------------------------- registry
+
+
+def program_registry() -> List[ProgramSpec]:
+    """Every program the audit sweeps, in stable (committed-artifact)
+    order.  ≥ 6 real entry points by construction — the acceptance
+    floor of the audit tier."""
+    # the declaration the audit verifies is the step's OWN constant —
+    # if train.step ever changes what it donates, the registry follows
+    donate = _train_donate_argnums()
+    return [
+        ProgramSpec(
+            name="train_step",
+            description="donated jitted train step (uint8 wire, focal "
+                        "loss, abnormal-batch select), health off",
+            build=_build_train_step,
+            donate_argnums=donate, expect_bf16=True),
+        ProgramSpec(
+            name="train_step_health",
+            description="donated train step with the health sentinel's "
+                        "grad-norm extra output",
+            build=lambda: _build_train_step(health=True),
+            donate_argnums=donate, expect_bf16=True),
+        ProgramSpec(
+            name="train_step_device_gt",
+            description="donated train step with on-device GT synthesis "
+                        "(joints wire instead of label maps)",
+            build=_build_train_step_device_gt,
+            donate_argnums=donate, expect_bf16=True),
+        ProgramSpec(
+            name="eval_step",
+            description="jitted validation step (loss only, running BN "
+                        "averages)",
+            build=_build_eval_step, expect_bf16=True),
+        ProgramSpec(
+            name="swa_update",
+            description="SWA running-average parameter update",
+            build=_build_swa_update),
+        ProgramSpec(
+            name="serve_compact_b1",
+            description="compact serve program, bucket 128, batch 1 "
+                        "(deadline-straggler singleton flush)",
+            build=_build_serve_compact,
+            expect_bf16=True, tags=("bucket=128x128", "batch=1")),
+        ProgramSpec(
+            name="serve_compact_batch_b2",
+            description="compact-batch serve program, bucket 128, "
+                        "batch 2 (the DynamicBatcher's pow2-chunk unit)",
+            build=_build_serve_compact_batch,
+            expect_bf16=True, tags=("bucket=128x128", f"batch={_B}")),
+        ProgramSpec(
+            name="flip_tta_peaks",
+            description="flip-TTA ensemble + on-device NMS peaks "
+                        "program (the fast single-scale path)",
+            build=_build_flip_tta_peaks, expect_bf16=True),
+        ProgramSpec(
+            name="train_step_mesh",
+            description="GSPMD train step on a ('data': 4, 'model': 2) "
+                        "mesh — state replicated, batch sharded",
+            build=_build_train_step_mesh,
+            donate_argnums=donate, expect_bf16=True, meshed=True,
+            requires_devices=8),
+    ]
+
+
+def get_program(name: str) -> Optional[ProgramSpec]:
+    for spec in program_registry():
+        if spec.name == name:
+            return spec
+    return None

@@ -1,0 +1,151 @@
+"""Abstract jaxpr tracing and structural extraction.
+
+``jax.make_jaxpr`` over ``ShapeDtypeStruct``s runs the Python of a
+program once under tracing — no device math, no data — and yields the
+full jaxpr.  This module walks it (recursing into every sub-jaxpr:
+pjit calls, scan/while/cond bodies, custom-derivative wrappers) and
+reduces it to the structural facts the checks and the trace-level
+fingerprint consume: primitive counts, the dtype lattice, baked-in
+constant sizes, host-callback sites, and control-flow shape.
+
+Trace-level work is CHEAP (~1 s/program for the registry) — it is what
+the tier-1 sweep runs on every program; the expensive AOT compile tier
+lives in ``compiled.py``.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+#: primitives that round-trip through the host — forbidden in hot
+#: programs (PRG001).  ``debug_callback`` is what ``jax.debug.print``
+#: lowers to; infeed/outfeed are the raw host-transfer ops.
+HOST_INTEROP_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed",
+})
+
+#: control-flow primitives tracked for the dynamic-shape/while hazard
+_WHILE_PRIMITIVES = frozenset({"while"})
+_BOUNDED_LOOP_PRIMITIVES = frozenset({"scan"})
+
+
+@dataclass
+class TraceInfo:
+    """Structural summary of one program's jaxpr."""
+
+    eqn_count: int = 0
+    primitives: Counter = field(default_factory=Counter)
+    dtypes: set = field(default_factory=set)
+    #: host-interop primitive name -> occurrence count
+    callbacks: Counter = field(default_factory=Counter)
+    while_count: int = 0
+    scan_count: int = 0
+    #: byte size of every jaxpr constant (closure-captured arrays baked
+    #: into the program)
+    const_bytes: List[int] = field(default_factory=list)
+    #: "shape/dtype" signature per flattened input / output
+    in_signature: List[str] = field(default_factory=list)
+    out_signature: List[str] = field(default_factory=list)
+
+    @property
+    def const_total(self) -> int:
+        return sum(self.const_bytes)
+
+    @property
+    def const_max(self) -> int:
+        return max(self.const_bytes, default=0)
+
+
+def _aval_sig(aval) -> str:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return str(aval)
+    return f"{'x'.join(map(str, shape))}/{dtype}"
+
+
+def _nbytes(value) -> int:
+    size = getattr(value, "size", None)
+    itemsize = getattr(value, "itemsize", None)
+    if itemsize is None:
+        itemsize = getattr(getattr(value, "dtype", None), "itemsize", 0)
+    if size is None or not itemsize:
+        return 0
+    return int(size) * int(itemsize)
+
+
+def _record_aval(info: TraceInfo, aval) -> None:
+    dtype = getattr(aval, "dtype", None)
+    if dtype is not None:
+        info.dtypes.add(str(dtype))
+
+
+def _walk_jaxpr(jaxpr, info: TraceInfo, seen: set) -> None:
+    """Accumulate one (inner) jaxpr into ``info``, recursing into every
+    sub-jaxpr found in equation params."""
+    import jax
+
+    if id(jaxpr) in seen:  # a shared sub-jaxpr counts once
+        return
+    seen.add(id(jaxpr))
+
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        _record_aval(info, getattr(v, "aval", None))
+    for eqn in jaxpr.eqns:
+        info.eqn_count += 1
+        name = eqn.primitive.name
+        info.primitives[name] += 1
+        if name in HOST_INTEROP_PRIMITIVES:
+            info.callbacks[name] += 1
+        if name in _WHILE_PRIMITIVES:
+            info.while_count += 1
+        if name in _BOUNDED_LOOP_PRIMITIVES:
+            info.scan_count += 1
+        for ov in eqn.outvars:
+            _record_aval(info, getattr(ov, "aval", None))
+        for value in eqn.params.values():
+            items = value if isinstance(value, (list, tuple)) else (value,)
+            for item in items:
+                if isinstance(item, jax.core.ClosedJaxpr):
+                    # consts dedup with the same `seen` discipline as
+                    # equations: a sub-jaxpr shared by two call sites
+                    # bakes its constants into the program ONCE
+                    if id(item) not in seen:
+                        seen.add(id(item))
+                        for const in item.consts:
+                            info.const_bytes.append(_nbytes(const))
+                    _walk_jaxpr(item.jaxpr, info, seen)
+                elif isinstance(item, jax.core.Jaxpr):
+                    _walk_jaxpr(item, info, seen)
+
+
+def trace_program(built) -> TraceInfo:
+    """Trace a :class:`~.registry.BuiltProgram` abstractly and return
+    its :class:`TraceInfo`.  Zero model FLOPs execute."""
+    import jax
+
+    closed = jax.make_jaxpr(built.fn)(*built.args)
+    info = TraceInfo()
+    for const in closed.consts:
+        info.const_bytes.append(_nbytes(const))
+    _walk_jaxpr(closed.jaxpr, info, seen=set())
+    info.in_signature = [_aval_sig(v.aval) for v in closed.jaxpr.invars]
+    info.out_signature = [_aval_sig(v.aval) for v in closed.jaxpr.outvars]
+    return info
+
+
+def donated_leaves(built, donate_argnums: Tuple[int, ...]
+                   ) -> Tuple[int, int]:
+    """(leaf count, total bytes) of the flattened donated arguments —
+    what PRG003 expects the compiled executable to alias."""
+    import jax
+
+    count = 0
+    total = 0
+    for i in donate_argnums:
+        for leaf in jax.tree.leaves(built.args[i]):
+            count += 1
+            total += _nbytes(leaf)
+    return count, total

@@ -10,6 +10,7 @@ suppressed site is silent) applies to new rules too.
 from . import (  # noqa: F401 — imported for registration side effect
     bare_print,
     donation,
+    dtype_hygiene,
     host_sync,
     lifecycle,
     metric_names,

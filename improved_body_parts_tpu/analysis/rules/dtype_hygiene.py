@@ -1,0 +1,102 @@
+"""JGL008 — dtype hygiene at the source tier.
+
+The source-level mirror of graftaudit's PRG002 dtype-drift check
+(``analysis/program``): a ``float64`` literal flowing into a jnp
+constructor compiles into an f64 program — silently doubled memory on
+CPU, an outright error on TPU (or a silent demotion, depending on
+``jax_enable_x64``) — and by the time the auditor sees it in the jaxpr
+the source site takes real digging to find.  This rule flags the
+source sites:
+
+- ``dtype=np.float64`` / ``dtype="float64"`` / ``dtype=float`` (the
+  bare builtin IS float64 in numpy) passed to a ``jnp.*`` /
+  ``jax.numpy.*`` constructor;
+- ``jnp.float64`` used anywhere;
+- an ``.astype(np.float64)`` / ``.astype("float64")`` result passed
+  directly into a jnp call.
+
+Scope: ``improved_body_parts_tpu/`` library modules only.  HOST-side
+``np.float64`` is untouched — the decode/OKS path uses f64 on purpose
+for reference parity, and it never crosses into a compiled program.
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import dataflow as df
+from ..core import ModuleContext, Rule, register
+
+#: spellings of the f64 dtype as a call argument
+_F64_NAMES = ("np.float64", "numpy.float64", "jnp.float64",
+              "jax.numpy.float64")
+#: jnp members that build/convert device arrays and accept dtype=
+_JNP_PREFIXES = ("jnp.", "jax.numpy.")
+
+
+def _is_f64_literal(node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant) and node.value in ("float64",
+                                                         "double"):
+        return True
+    if isinstance(node, ast.Name) and node.id == "float":
+        return True  # bare builtin float == numpy float64
+    dotted = df.dotted(node)
+    return dotted in _F64_NAMES
+
+
+def _is_jnp_call(call: ast.Call) -> bool:
+    callee = df.call_callee(call)
+    return bool(callee) and callee.startswith(_JNP_PREFIXES)
+
+
+def _is_f64_astype(node: ast.expr) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args and _is_f64_literal(node.args[0]))
+
+
+@register
+class DtypeHygiene(Rule):
+    id = "JGL008"
+    name = "dtype-hygiene"
+    severity = "warning"
+    postmortem = ("graftaudit PRG002's source-tier mirror: f64 literals "
+                  "reaching jnp constructors compile f64 programs — "
+                  "2x memory, dead on TPU")
+
+    def check(self, ctx: ModuleContext) -> None:
+        if not ctx.under("improved_body_parts_tpu"):
+            return
+        src = ctx.source
+        if ("float64" not in src and "double" not in src
+                and "dtype=float" not in src
+                and "dtype = float" not in src):
+            return
+        for node in ast.walk(ctx.tree):
+            if df.dotted(node) == "jnp.float64":
+                ctx.finding(
+                    self, node,
+                    "jnp.float64 in library code: f64 compiles to a "
+                    "double-memory program (and dies on TPU); use "
+                    "jnp.float32 — or keep the value on the host as "
+                    "np.float64 if reference parity needs it")
+                continue
+            if not isinstance(node, ast.Call) or not _is_jnp_call(node):
+                continue
+            dtype = df.call_kwarg(node, "dtype")
+            if dtype is not None and _is_f64_literal(dtype):
+                spelled = (ast.unparse(dtype) if hasattr(ast, "unparse")
+                           else "float64")
+                ctx.finding(
+                    self, node,
+                    f"dtype={spelled} flowing into a jnp constructor "
+                    "builds an f64 device array (bare `float` IS "
+                    "float64); pass jnp.float32, or construct on the "
+                    "host with np.* if f64 is intentional")
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if _is_f64_astype(arg):
+                    ctx.finding(
+                        self, node,
+                        "an .astype(float64) result passed straight "
+                        "into a jnp call uploads an f64 array; cast to "
+                        "float32 at the device boundary")

@@ -391,8 +391,7 @@ class Predictor:
             for img, (rh, rw) in prepared
             for angle in prm.rotation_search]
 
-        spec = (prm.thre2, prm.mid_num, prm.offset_radius, self.compact_topk,
-                prm.connect_ration)
+        spec = self._compact_spec(prm)
         packed_d = self._compact_avg_fn(len(maps_d), (rh0, rw0), thre1,
                                         spec)(maps_d)
         return packed_d, rh0, (ow / rw0, oh / rh0)
@@ -557,8 +556,7 @@ class Predictor:
                 "protocol; scale/rotation grids compile per image")
         if thre1 is None:
             thre1 = prm.thre1
-        spec = (prm.thre2, prm.mid_num, prm.offset_radius, self.compact_topk,
-                prm.connect_ration)
+        spec = self._compact_spec(prm)
         # the row-concat/stack helpers are part of the serving hot path
         # (multi-chunk flushes); touching the properties pre-creates them
         self._concat_rows_fn, self._stack_rows_fn  # noqa: B018
@@ -568,8 +566,7 @@ class Predictor:
             # singleton flush (deadline straggler) through it instead of
             # the batch path's stack/group/concat machinery
             compiled += ((h, w), "compact", thre1, spec) not in self._fns
-            one = self._ensemble_fn((int(h), int(w)), mode="compact",
-                                    thre1=thre1, compact_spec=spec)
+            one = self.compact_program((h, w), thre1=thre1, params=prm)
             jax.block_until_ready(one(
                 self.variables, np.zeros((h, w, 3), np.float32),
                 int(h), int(w)))
@@ -577,14 +574,62 @@ class Predictor:
                 shape = (int(n), int(h), int(w), 3)
                 compiled += (shape, "compact_batch", thre1,
                              spec) not in self._fns
-                fn = self._ensemble_fn(shape, mode="compact_batch",
-                                       thre1=thre1, compact_spec=spec)
+                fn = self.compact_program((h, w), batch=n, thre1=thre1,
+                                          params=prm)
                 out = fn(self.variables,
                          np.zeros(shape, np.float32),
                          np.full((shape[0],), h, np.int32),
                          np.full((shape[0],), w, np.int32))
                 jax.block_until_ready(out)
         return compiled
+
+    def _compact_spec(self, prm: InferenceParams
+                      ) -> Tuple[float, int, int, int, float]:
+        """The (thre2, mid_num, offset_radius, top-K, connect_ration)
+        tuple every compact program bakes in — ONE construction site so
+        the program-cache keys, the dispatch paths and the AOT
+        accessors below can never disagree on the layout."""
+        return (prm.thre2, prm.mid_num, prm.offset_radius,
+                self.compact_topk, prm.connect_ration)
+
+    # ------------------------------------------------------------------ #
+    # Public program accessors: the jitted executables behind the serve /
+    # fast paths, WITHOUT dispatching anything — what AOT tooling traces,
+    # lowers and audits (analysis.program registry, precompile paths).
+    # Call signature of the returned programs:
+    #   compact (batch=None):  (variables, img (H,W,3) f32, valid_h, valid_w)
+    #   compact (batch=N):     (variables, imgs (N,H,W,3) f32, valid_h (N,), valid_w (N,))
+    #   peaks:                 (variables, img (H,W,3) f32, valid_h, valid_w)
+
+    def compact_program(self, shape: Tuple[int, int],
+                        batch: Optional[int] = None,
+                        thre1: Optional[float] = None,
+                        params: Optional[InferenceParams] = None):
+        """The compact(-batch) serve program for one padded bucket
+        shape — ``batch=None`` is the singleton-flush program,
+        ``batch=N`` the N-lane pow2-chunk program."""
+        prm = params or self.params
+        if thre1 is None:
+            thre1 = prm.thre1
+        spec = self._compact_spec(prm)
+        h, w = int(shape[0]), int(shape[1])
+        if batch is None:
+            return self._ensemble_fn((h, w), mode="compact", thre1=thre1,
+                                     compact_spec=spec)
+        return self._ensemble_fn((int(batch), h, w, 3),
+                                 mode="compact_batch", thre1=thre1,
+                                 compact_spec=spec)
+
+    def peaks_program(self, shape: Tuple[int, int],
+                      thre1: Optional[float] = None,
+                      params: Optional[InferenceParams] = None):
+        """The flip-TTA ensemble + on-device NMS program (the fast
+        single-scale path) for one padded input shape."""
+        prm = params or self.params
+        if thre1 is None:
+            thre1 = prm.thre1
+        return self._ensemble_fn((int(shape[0]), int(shape[1])),
+                                 mode="peaks", thre1=thre1)
 
     def _merge_flip(self, straight, mirrored):
         """The flip-ensemble merge shared by the single (2-lane) and
@@ -747,8 +792,7 @@ class Predictor:
         oh, ow = image_bgr.shape[:2]
         scale = prm.scale_search[0] * mp.boxsize / oh
         img, (rh, rw) = self._prepare_input(image_bgr, scale)
-        spec = (prm.thre2, prm.mid_num, prm.offset_radius, self.compact_topk,
-                prm.connect_ration)
+        spec = self._compact_spec(prm)
         packed_d = self._ensemble_fn(
             img.shape[:2], mode="compact", thre1=thre1, compact_spec=spec)(
             self.variables, img, rh, rw)
@@ -823,8 +867,7 @@ class Predictor:
             sizes.append((oh, ow, rh, rw))
 
         n = len(prepared)
-        spec = (prm.thre2, prm.mid_num, prm.offset_radius, self.compact_topk,
-                prm.connect_ration)
+        spec = self._compact_spec(prm)
         groups: Dict[Tuple[int, ...], list] = {}
         for i, p in enumerate(prepared):
             groups.setdefault(p.shape, []).append(i)

@@ -158,6 +158,18 @@ def reshard_replicated(tree, mesh: Mesh):
         lambda x: jax.device_put(x, sharding, may_alias=False), tree)
 
 
+def abstract_with_sharding(tree, sharding):
+    """The ``ShapeDtypeStruct`` twin of ``device_put(tree, sharding)``:
+    stamp a sharding onto every leaf of an abstract pytree WITHOUT
+    materializing anything.  This is how AOT tooling (``jit.lower`` on
+    shape trees — the program auditor in ``analysis.program``, export
+    paths) expresses "the state is replicated, the batch is sharded"
+    for a compile that never sees real data."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=sharding), tree)
+
+
 def batch_spec(spatial_shard: bool = False) -> P:
     """PartitionSpec for an NHWC batch: batch over 'data'; optionally the
     height axis over 'model' (spatial partitioning for huge inputs)."""

@@ -26,6 +26,16 @@ from ..ops import multi_task_loss
 from .state import TrainState
 
 
+# The train step donates the STATE argument (and only it): position 0 of
+# (state, images, mask_miss, *gt).  One constant shared by
+# ``make_train_step`` and the program auditor's registry
+# (``analysis.program``), so the declaration the audit verifies against
+# the compiled executable's input_output_aliases can never drift from
+# what the step actually donates.  graftlint's JGL001 factory config
+# (``donating-factories = ["make_train_step:0"]``) mirrors it.
+TRAIN_STEP_DONATE_ARGNUMS = (0,)
+
+
 def normalize_images(images: jnp.ndarray) -> jnp.ndarray:
     """uint8 wire → float32 in [0, 1] on device; f32 passes through.
 
@@ -141,7 +151,9 @@ def make_train_step(model, config: Config,
             return state, loss, gnorm
         return state, loss
 
-    return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+    return jax.jit(train_step,
+                   donate_argnums=TRAIN_STEP_DONATE_ARGNUMS if donate
+                   else ())
 
 
 def make_eval_step(model, config: Config, use_focal: bool = True) -> Callable:

@@ -44,6 +44,8 @@ _HEAVY_MODULES = frozenset({
                                 # SIGKILLed subprocess + many orbax writes
     "test_supervisor.py",       # chaos smoke = several full train.py
                                 # subprocesses; topology subprocess pair
+    "test_program_audit.py",    # registry sweep traces every shipped
+                                # program (eval_shape of the full state)
 })
 # Individually heavy tests inside otherwise-quick modules.
 _HEAVY_TESTS = frozenset({

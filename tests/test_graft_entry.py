@@ -26,7 +26,10 @@ def test_entry_is_jittable(graft_entry):
     assert tuple(out.shape) == (1, 128, 128, 50)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8(graft_entry, eight_devices):
+    # slow tier (PR 8 budget audit): the 2-device dryrun below compiles
+    # the identical mesh/step path; the 8-way adds 29 s for scale alone
     graft_entry.dryrun_multichip(8)  # raises on any failure
 
 

@@ -266,7 +266,11 @@ class TestVariants:
         loss = multi_task_loss(preds, gt, mask, cfg)
         assert np.isfinite(float(loss))
 
+    @pytest.mark.slow
     def test_remat_via_config(self):
+        # slow tier (PR 8 budget audit): 37 s — a full grad compile to
+        # check config plumbing; remat correctness itself is
+        # backend-enforced (identical math, different schedule)
         import jax
         import jax.numpy as jnp
 

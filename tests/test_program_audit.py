@@ -1,0 +1,474 @@
+"""graftaudit (analysis/program): seeded-regression fixtures + the
+tier-1 registry sweep.
+
+The contract mirrors test_graftlint.py's per-rule triplets, at the
+compiled-program tier: for each check, a toy program SEEDED with the
+defect (a ``pure_callback``, an f64 upcast, a dropped
+``donate_argnums``, a perturbed fingerprint) must flag with the right
+rule id, and the fixed twin must pass clean.  The sweep fixture then
+audits the REAL registry at trace level and gates it against the
+committed ``PROGRAM_AUDIT.json`` golden — the tier-1 guardrail every
+subsequent perf/sharding PR runs under.
+
+Toy programs compile in well under a second on the CPU backend; the
+expensive full AOT sweep of real programs lives in
+``tools/program_audit.py`` (bench "audit" key), not here.
+"""
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from improved_body_parts_tpu.analysis.program import (  # noqa: E402
+    AuditConfig,
+    BuiltProgram,
+    ProgramSpec,
+    audit_registry,
+    compare_fingerprints,
+    program_registry,
+)
+from improved_body_parts_tpu.analysis.program.audit import (  # noqa: E402
+    audit_program,
+)
+from improved_body_parts_tpu.analysis.program.compiled import (  # noqa: E402
+    compile_program,
+    parse_input_output_aliases,
+)
+from improved_body_parts_tpu.analysis.program.fingerprint import (  # noqa: E402
+    TRACE_EXACT,
+    TRACE_NUMERIC,
+    trace_fingerprint,
+)
+from improved_body_parts_tpu.analysis.program.trace import (  # noqa: E402
+    trace_program,
+)
+
+F32 = jnp.float32
+SDS = jax.ShapeDtypeStruct
+
+
+def toy_spec(fn, args, name="toy", **kw):
+    """A ProgramSpec over an already-built toy program."""
+    return ProgramSpec(name=name, description="toy fixture",
+                       build=lambda: BuiltProgram(fn=fn, args=args), **kw)
+
+
+def rules_of(verdict):
+    return sorted({f.rule for f in verdict.findings})
+
+
+# ----------------------------------------------------- PRG001 host interop
+
+
+class TestHostInterop:
+    def test_seeded_pure_callback_flags(self):
+        def host_double(x):
+            return np.asarray(x) * 2  # graftlint: disable=JGL001 -- toy callback fixture: x is the callback's host copy, not a donatable leaf
+
+        def f(x):
+            y = x + 1.0
+            return jax.pure_callback(host_double, SDS(x.shape, x.dtype), y)
+
+        spec = toy_spec(jax.jit(f), (SDS((4, 4), F32),))
+        verdict = audit_program(spec, level="trace")
+        assert rules_of(verdict) == ["PRG001"]
+        assert "pure_callback" in verdict.findings[0].message
+
+    def test_seeded_debug_print_flags(self):
+        def f(x):
+            jax.debug.print("loss {}", x.sum())
+            return x * 2
+
+        spec = toy_spec(jax.jit(f), (SDS((4,), F32),))
+        verdict = audit_program(spec, level="trace")
+        assert "PRG001" in rules_of(verdict)
+
+    def test_clean_program_passes(self):
+        spec = toy_spec(jax.jit(lambda x: x * 2), (SDS((4, 4), F32),))
+        verdict = audit_program(spec, level="trace")
+        assert verdict.status == "ok" and verdict.findings == []
+
+    def test_cold_program_exempt(self):
+        def f(x):
+            return jax.pure_callback(
+                lambda a: np.asarray(a), SDS(x.shape, x.dtype), x)
+
+        spec = toy_spec(jax.jit(f), (SDS((4,), F32),), hot=False)
+        assert audit_program(spec, level="trace").findings == []
+
+
+# ------------------------------------------------------- PRG002 dtype drift
+
+
+class TestDtypeDrift:
+    def test_seeded_f64_upcast_flags(self):
+        from jax.experimental import enable_x64
+
+        def f(x):
+            return (x.astype(jnp.float64) * 2.0).astype(jnp.float32)
+
+        with enable_x64():
+            spec = toy_spec(jax.jit(f), (SDS((4, 4), F32),))
+            verdict = audit_program(spec, level="trace")
+        assert rules_of(verdict) == ["PRG002"]
+        assert "float64" in verdict.findings[0].message
+
+    def test_f64_allowed_when_declared(self):
+        from jax.experimental import enable_x64
+
+        def f(x):
+            return (x.astype(jnp.float64) * 2.0).astype(jnp.float32)
+
+        with enable_x64():
+            spec = toy_spec(jax.jit(f), (SDS((4, 4), F32),),
+                            allow_f64=True)
+            assert audit_program(spec, level="trace").findings == []
+
+    def test_declared_bf16_with_no_bf16_flags(self):
+        # the silent-upcast drift: a "bf16-compute" program where the
+        # mixed-precision cast chain was lost compiles all-f32
+        spec = toy_spec(jax.jit(lambda x: x * 2), (SDS((4, 4), F32),),
+                        expect_bf16=True)
+        verdict = audit_program(spec, level="trace")
+        assert rules_of(verdict) == ["PRG002"]
+        assert "bf16" in verdict.findings[0].message
+
+    def test_declared_bf16_with_bf16_passes(self):
+        def f(x):
+            return x.astype(jnp.bfloat16).sum().astype(jnp.float32)
+
+        spec = toy_spec(jax.jit(f), (SDS((4, 4), F32),), expect_bf16=True)
+        assert audit_program(spec, level="trace").findings == []
+
+
+# ------------------------------------------------- PRG003 donation aliasing
+
+
+def _state_update(x, y):
+    return x * 0.9 + y, (x * y).sum()
+
+
+class TestDonationAliasing:
+    ARGS = (SDS((64, 64), F32), SDS((64, 64), F32))
+
+    def test_seeded_dropped_donation_flags(self):
+        # the declaration says donated, the jit call DOESN'T donate —
+        # exactly what a refactor that rebuilds the jit wrapper and
+        # loses donate_argnums produces
+        spec = toy_spec(jax.jit(_state_update), self.ARGS,
+                        donate_argnums=(0,))
+        verdict = audit_program(spec, level="compile")
+        assert rules_of(verdict) == ["PRG003"]
+        assert "ZERO" in verdict.findings[0].message
+
+    def test_realized_donation_passes(self):
+        spec = toy_spec(jax.jit(_state_update, donate_argnums=(0,)),
+                        self.ARGS, donate_argnums=(0,))
+        verdict = audit_program(spec, level="compile")
+        assert verdict.findings == []
+        fp = verdict.fingerprint["compiled"]
+        assert fp["alias_bytes"] == 64 * 64 * 4
+        assert fp["aliased_params"] == 1
+
+    def test_partially_droppable_donation_flags(self):
+        # donating two buffers when only one output can alias: jax
+        # warns and silently drops the second — the audit makes it loud
+        def f(x, y):
+            return x + y  # ONE output; two donated inputs
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # jax's donation warning
+            spec = toy_spec(jax.jit(f, donate_argnums=(0, 1)),
+                            self.ARGS, donate_argnums=(0, 1))
+            verdict = audit_program(spec, level="compile")
+        assert rules_of(verdict) == ["PRG003"]
+        assert "partially realized" in verdict.findings[0].message
+
+    def test_alias_parser_reads_hlo_header(self):
+        hlo = ("HloModule jit_f, is_scheduled=true, input_output_alias="
+               "{ {0}: (1, {}, may-alias), {2}: (0, {}, must-alias) }, "
+               "entry_computation_layout={()->()}")
+        assert parse_input_output_aliases(hlo) == {0: 1, 2: 0}
+        assert parse_input_output_aliases("HloModule x") == {}
+
+
+# ------------------------------------------- PRG004/PRG005 consts and while
+
+
+class TestConstantsAndWhile:
+    def test_seeded_baked_constant_flags(self):
+        big = jnp.asarray(np.zeros((512, 1024), np.float32))  # 2 MiB
+
+        def f(x):
+            return x + big.sum()
+
+        spec = toy_spec(jax.jit(f), (SDS((4,), F32),))
+        verdict = audit_program(spec, level="trace")
+        assert "PRG004" in rules_of(verdict)
+
+    def test_small_constants_pass(self):
+        small = jnp.ones((8, 8), F32)
+        spec = toy_spec(jax.jit(lambda x: x + small.sum()),
+                        (SDS((4,), F32),))
+        assert audit_program(spec, level="trace").findings == []
+
+    def test_shared_subjaxpr_constants_count_once(self):
+        # two call sites of the same jitted closure share one
+        # ClosedJaxpr — its baked-in constant exists once in the
+        # program and must not double in the fingerprint
+        big = jnp.ones((1000,), F32)  # 4000 bytes
+        inner = jax.jit(lambda x: x + big)
+
+        def f(x):
+            return inner(x) + inner(x * 2)
+
+        trace = trace_program(
+            BuiltProgram(fn=jax.jit(f), args=(SDS((1000,), F32),)))
+        assert trace.primitives.get("pjit", 0) >= 2
+        assert trace.const_total <= 4000
+
+    def test_seeded_while_flags_and_declaration_clears(self):
+        def f(x):
+            return jax.lax.while_loop(
+                lambda v: v.sum() < 100.0, lambda v: v + 1.0, x)
+
+        spec = toy_spec(jax.jit(f), (SDS((4,), F32),))
+        assert rules_of(audit_program(spec, level="trace")) == ["PRG005"]
+        ok = toy_spec(jax.jit(f), (SDS((4,), F32),), allow_while=True)
+        assert audit_program(ok, level="trace").findings == []
+
+    def test_bounded_scan_is_not_a_while_hazard(self):
+        def f(x):
+            return jax.lax.scan(lambda c, _: (c + 1.0, None), x,
+                                None, length=8)[0]
+
+        spec = toy_spec(jax.jit(f), (SDS((4,), F32),))
+        assert audit_program(spec, level="trace").findings == []
+
+
+# ------------------------------------------------ PRG006 sharding coverage
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+class TestShardingCoverage:
+    def _mesh_args(self, sharded_batch):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from improved_body_parts_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(data=4, model=2)
+        rep = NamedSharding(mesh, P())
+        bsh = NamedSharding(mesh, P("data")) if sharded_batch else rep
+        return (SDS((64, 64), F32, sharding=rep),
+                SDS((8, 64), F32, sharding=bsh))
+
+    def test_all_replicated_meshed_program_flags(self):
+        spec = toy_spec(jax.jit(lambda p, b: (p, (p[:1] * b).sum())),
+                        self._mesh_args(sharded_batch=False), meshed=True,
+                        requires_devices=8)
+        verdict = audit_program(spec, level="compile")
+        assert "PRG006" in rules_of(verdict)
+        assert "replicated" in verdict.findings[0].message
+
+    def test_sharded_batch_passes(self):
+        spec = toy_spec(jax.jit(lambda p, b: (p, (p[:1] * b).sum())),
+                        self._mesh_args(sharded_batch=True), meshed=True,
+                        requires_devices=8)
+        assert audit_program(spec, level="compile").findings == []
+
+    def test_short_host_records_skip_not_crash(self):
+        spec = toy_spec(jax.jit(lambda x: x), (SDS((4,), F32),),
+                        requires_devices=4096)
+        verdict = audit_program(spec, level="compile")
+        assert verdict.status == "skipped"
+        assert "4096" in verdict.note
+
+
+# ------------------------------------------------ PRG007 fingerprint drift
+
+
+class TestFingerprintDrift:
+    def _golden_for(self, fn, args):
+        spec = toy_spec(jax.jit(fn), args)
+        return {"fingerprint":
+                audit_program(spec, level="trace").fingerprint}
+
+    def test_perturbed_program_drifts_and_diff_names_the_field(self):
+        args = (SDS((16, 16), F32),)
+        golden = self._golden_for(lambda x: x * 2 + 1.0, args)
+        # the "perturbation": an extra dtype enters the program
+        drifted = toy_spec(
+            jax.jit(lambda x: x * 2 + x.astype(jnp.bfloat16)
+                    .astype(jnp.float32)), args)
+        verdict = audit_program(drifted, level="trace", golden=golden)
+        assert rules_of(verdict) == ["PRG007"]
+        fields = {d["field"] for d in verdict.drift}
+        assert "dtypes" in fields
+        assert "dtypes" in verdict.findings[0].message
+
+    def test_unchanged_program_does_not_drift(self):
+        args = (SDS((16, 16), F32),)
+        golden = self._golden_for(lambda x: x * 2 + 1.0, args)
+        same = toy_spec(jax.jit(lambda x: x * 2 + 1.0), args)
+        verdict = audit_program(same, level="trace", golden=golden)
+        assert verdict.findings == [] and verdict.drift == []
+
+    def test_numeric_tolerance_and_exact_fields(self):
+        golden = {"eqn_count": 100, "dtypes": ["float32"],
+                  "while_count": 0}
+        within = {"eqn_count": 110, "dtypes": ["float32"],
+                  "while_count": 0}
+        assert compare_fingerprints(golden, within, 25.0, TRACE_EXACT,
+                                    TRACE_NUMERIC) == []
+        beyond = dict(within, eqn_count=200)
+        (d,) = compare_fingerprints(golden, beyond, 25.0, TRACE_EXACT,
+                                    TRACE_NUMERIC)
+        assert d["field"] == "eqn_count" and d["drift_pct"] == 100.0
+        structural = dict(within, dtypes=["float32", "float64"])
+        diffs = compare_fingerprints(golden, structural, 25.0,
+                                     TRACE_EXACT, TRACE_NUMERIC)
+        assert {x["field"] for x in diffs} == {"dtypes"}
+
+    def test_crashed_build_is_a_prg000_error_not_clean(self):
+        def boom():
+            raise RuntimeError("cannot build")
+
+        spec = ProgramSpec(name="broken", description="x", build=boom)
+        verdict = audit_program(spec, level="trace")
+        assert verdict.status == "crashed"
+        assert rules_of(verdict) == ["PRG000"]
+        assert verdict.findings[0].severity == "error"
+
+
+# ------------------------------------------------------ the real registry
+
+
+@pytest.fixture(scope="module")
+def registry_sweep():
+    """Trace-level audit of every real registry program, gated against
+    the committed golden (PROGRAM_AUDIT.json).  One sweep, shared by
+    every assertion below — this is the tier-1 guardrail."""
+    golden_path = os.path.join(REPO, "PROGRAM_AUDIT.json")
+    golden = None
+    if os.path.exists(golden_path):
+        with open(golden_path, encoding="utf-8") as f:
+            golden = json.load(f)
+    return golden, audit_registry(level="trace", golden=golden)
+
+
+def test_registry_has_the_shipped_entry_points(registry_sweep):
+    names = {s.name for s in program_registry()}
+    # the acceptance floor: >= 6 real programs, including the donated
+    # train step both ways, eval, serve-compact, flip-TTA and SWA
+    assert len(names) >= 6
+    for required in ("train_step", "train_step_health", "eval_step",
+                     "serve_compact_b1", "flip_tta_peaks", "swa_update"):
+        assert required in names
+
+
+def test_registry_sweep_is_clean(registry_sweep):
+    """Zero error findings over every real program the repo ships —
+    a new host callback, an f64 leak, a lost donation or an
+    undeclared while in ANY entry point fails tier-1 here."""
+    _, report = registry_sweep
+    errors = [f for f in report.findings() if f.severity == "error"]
+    assert errors == [], "\n".join(f.format() for f in errors)
+    for v in report.verdicts:
+        assert v.status in ("ok", "skipped", "findings"), \
+            f"{v.name}: {v.status} ({v.note})"
+        assert v.status != "crashed"
+
+
+def test_registry_sweep_matches_committed_golden(registry_sweep):
+    """Fingerprint regression gate: the tree's programs match the
+    blessed PROGRAM_AUDIT.json.  An intentional change reruns
+    `python tools/program_audit.py --bless` and commits the diff."""
+    golden, report = registry_sweep
+    assert golden is not None, \
+        "PROGRAM_AUDIT.json missing — run tools/program_audit.py --bless"
+    if golden.get("jax_version") != jax.__version__:
+        pytest.skip("golden recorded under a different jax version")
+    drifted = {v.name: v.drift for v in report.verdicts if v.drift}
+    assert drifted == {}, json.dumps(drifted, indent=2, allow_nan=False)
+    # and the golden covers every non-skipped program (registry grew
+    # without re-blessing -> loud)
+    audited = {v.name for v in report.verdicts if v.status != "skipped"}
+    missing = audited - set(golden.get("programs", {}))
+    assert missing == set(), f"programs not in golden: {missing}"
+
+
+def test_trace_fingerprint_is_deterministic():
+    """Same program, two traces, identical fingerprints — the property
+    the whole gating scheme rests on."""
+    fn, args = jax.jit(lambda x: x * 2 + 1.0), (SDS((16, 16), F32),)
+    a = trace_fingerprint(trace_program(BuiltProgram(fn=fn, args=args)))
+    b = trace_fingerprint(trace_program(BuiltProgram(fn=fn, args=args)))
+    assert a == b
+
+
+def test_compiled_info_extracts_cost_and_memory():
+    built = BuiltProgram(fn=jax.jit(_state_update),
+                         args=(SDS((64, 64), F32), SDS((64, 64), F32)))
+    info, _ = compile_program(built)
+    assert info.flops > 0
+    assert info.argument_bytes == 2 * 64 * 64 * 4
+    assert info.hlo_instruction_count > 0
+
+
+# --------------------------------------------------------------------- CLI
+
+
+class TestRunnerCli:
+    def run(self, *argv):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "program_audit.py"), *argv],
+            capture_output=True, text=True, timeout=1200, cwd=REPO)
+
+    def test_rules_table(self):
+        proc = self.run("--rules")
+        assert proc.returncode == 0, proc.stderr
+        for rid in ("PRG001", "PRG003", "PRG007"):
+            assert rid in proc.stdout
+
+    def test_unknown_program_is_usage_error(self):
+        proc = self.run("--programs", "no_such_program")
+        assert proc.returncode == 2
+        assert "unknown program" in proc.stderr
+
+    def test_empty_programs_list_is_usage_error_not_clean(self):
+        # `--programs` with zero names must not sweep nothing and exit
+        # 0 — and `--bless --programs` must not write an empty golden
+        proc = self.run("--programs")
+        assert proc.returncode == 2
+        assert "at least one name" in proc.stderr
+        proc = self.run("--bless", "--programs")
+        assert proc.returncode == 2
+
+    def test_bless_refuses_partial_sweep(self):
+        proc = self.run("--bless", "--programs", "train_step")
+        assert proc.returncode == 2
+        assert "FULL sweep" in proc.stderr
+
+    def test_bless_refuses_trace_level(self):
+        proc = self.run("--bless", "--level", "trace")
+        assert proc.returncode == 2
+        assert "--level compile" in proc.stderr
+
+    @pytest.mark.slow
+    def test_trace_sweep_exits_clean_against_committed_golden(self):
+        proc = self.run("--level", "trace", "--format", "json")
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["ok"] is True
+        assert out["counts"]["error"] == 0
+        assert len(out["programs"]) >= 6

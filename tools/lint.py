@@ -5,6 +5,7 @@
     python tools/lint.py improved_body_parts_tpu/train
     python tools/lint.py --changed origin/main   # only files that differ
     python tools/lint.py --format json        # machine-readable output
+    python tools/lint.py install-hook         # pre-push: both tiers
 
 Exit codes: 0 = no findings at/above ``--fail-on`` (default: error);
 1 = findings at/above the threshold; 2 = usage / internal error (a
@@ -62,12 +63,66 @@ def scope_to_config(files, config):
     return keep
 
 
+#: the pre-push hook `install-hook` writes: both static-analysis tiers
+#: run before any PR leaves the machine, with no CI infrastructure —
+#: graftlint over the diff (fast), then the program-audit registry
+#: sweep at trace level (jaxpr checks + structural fingerprints,
+#: ~1 min).  Either tier failing aborts the push.
+_PRE_PUSH_HOOK = """\
+#!/bin/sh
+# installed by `python tools/lint.py install-hook` — both
+# static-analysis tiers gate every push (re-run it after pulling a
+# newer hook version).
+set -e
+repo="$(git rev-parse --show-toplevel)"
+echo "pre-push: graftlint (changed files vs origin/main)"
+"{python}" "$repo/tools/lint.py" --changed origin/main
+echo "pre-push: graftaudit registry sweep (trace level)"
+"{python}" "$repo/tools/program_audit.py" --level trace
+"""
+
+
+def install_hook(root):
+    """Write the repo's ``pre-push`` hook running both analysis tiers.
+    Refuses to clobber a hook it did not write.
+
+    The installing interpreter's path is baked into the hook — the
+    non-interactive hook shell has no venv activated and stock
+    Debian/macOS ship no bare ``python``; ``sys.executable`` is the one
+    interpreter known to import this repo's dependencies.  The hooks
+    directory comes from ``git rev-parse --git-path hooks`` — the
+    directory git actually consults (``--git-dir`` points inside
+    ``.git/worktrees/<name>`` in a linked worktree, where hooks never
+    run)."""
+    hooks_dir = subprocess.run(
+        ["git", "rev-parse", "--git-path", "hooks"], cwd=root, check=True,
+        capture_output=True, text=True).stdout.strip()
+    if not os.path.isabs(hooks_dir):
+        hooks_dir = os.path.join(root, hooks_dir)
+    hook = os.path.join(hooks_dir, "pre-push")
+    if os.path.exists(hook):
+        with open(hook, encoding="utf-8") as f:
+            existing = f.read()
+        if "tools/lint.py" not in existing:
+            print(f"graftlint: {hook} exists and was not written by "
+                  "install-hook; refusing to overwrite", file=sys.stderr)
+            return 2
+    os.makedirs(os.path.dirname(hook), exist_ok=True)
+    with open(hook, "w", encoding="utf-8") as f:
+        f.write(_PRE_PUSH_HOOK.format(python=sys.executable))
+    os.chmod(hook, 0o755)
+    print(f"installed {hook} (graftlint --changed + graftaudit trace "
+          "sweep run before every push)")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="graftlint: this repo's bug classes as lint rules")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: [tool.graftlint] "
-                         "paths)")
+                         "paths); the single word `install-hook` "
+                         "installs the pre-push hook instead")
     ap.add_argument("--root", default=REPO,
                     help="repo root (pyproject.toml location)")
     ap.add_argument("--changed", metavar="REF",
@@ -87,6 +142,13 @@ def main(argv=None):
             print(f"{rule.id}  {rule.name:20s} [{rule.severity}]  "
                   f"{rule.postmortem}")
         return 0
+
+    if args.paths == ["install-hook"]:
+        try:
+            return install_hook(args.root)
+        except (subprocess.CalledProcessError, OSError) as e:
+            print(f"graftlint: install-hook: {e}", file=sys.stderr)
+            return 2
 
     try:
         config = load_config(args.root)

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""graftaudit runner — compiled-program auditing over the registry.
+
+    python tools/program_audit.py                  # full AOT sweep, gated
+    python tools/program_audit.py --level trace    # jaxpr-only (fast)
+    python tools/program_audit.py --bless          # record a new golden
+    python tools/program_audit.py --programs train_step eval_step
+    python tools/program_audit.py --format json    # machine-readable
+    python tools/program_audit.py --rules          # the check table
+
+Sweeps every program in ``analysis.program.registry`` abstractly
+(``ShapeDtypeStruct``s + AOT ``.lower().compile()`` on the CPU backend
+— zero real data, zero model FLOPs), runs the PRG checks, and compares
+fingerprints against the committed ``PROGRAM_AUDIT.json`` golden
+registry.  ``--bless`` rewrites the golden after an INTENTIONAL change
+(a reviewed diff of the artifact is the blessing).
+
+Exit codes: 0 = clean (no error findings, no drift); 1 = findings at
+error severity or fingerprint drift; 2 = usage / internal error (a
+crash must not read as "clean") — the graftlint contract.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the audit is SPECIFIED to run on the CPU backend (never claiming the
+# exclusive TPU) with the virtual 8-device mesh the meshed programs
+# need; both must land before the first jax import
+os.environ["JAX_PLATFORMS"] = "cpu"
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+
+GOLDEN_BASENAME = "PROGRAM_AUDIT.json"
+
+
+def load_golden(path):
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="graftaudit: jaxpr/HLO-level checks + fingerprint "
+                    "regression gating for every program the repo ships")
+    ap.add_argument("--level", choices=("trace", "compile"),
+                    default="compile",
+                    help="trace = jaxpr checks only (~1 min); compile = "
+                         "+ AOT compile per program (minutes, the full "
+                         "donation/sharding/cost audit; default)")
+    ap.add_argument("--programs", nargs="*", metavar="NAME",
+                    help="restrict the sweep to these registry programs")
+    ap.add_argument("--golden", default=os.path.join(REPO, GOLDEN_BASENAME),
+                    help="golden registry path (default: committed "
+                         f"{GOLDEN_BASENAME})")
+    ap.add_argument("--bless", action="store_true",
+                    help="write the audit result as the new golden "
+                         "registry (full sweep only — a partial sweep "
+                         "must not shrink the golden)")
+    ap.add_argument("--out", default=None,
+                    help="also write the full report JSON here")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the check table and exit")
+    args = ap.parse_args(argv)
+
+    from improved_body_parts_tpu.analysis.program import (
+        GRAFTAUDIT_VERSION,
+        PROGRAM_RULES,
+        audit_registry,
+        audit_ruleset_hash,
+        load_audit_config,
+        program_registry,
+    )
+
+    if args.rules:
+        for rule in PROGRAM_RULES:
+            print(f"{rule.id}  {rule.name:20s} [{rule.severity}]  "
+                  f"{rule.doc}")
+        return 0
+
+    known = {s.name for s in program_registry()}
+    if args.programs is not None and not args.programs:
+        # `--programs` with zero names must not read as "sweep nothing,
+        # exit clean" — and `--bless --programs` would have replaced
+        # the golden with an EMPTY registry
+        print("program_audit: --programs requires at least one name; "
+              f"registry has {sorted(known)}", file=sys.stderr)
+        return 2
+    if args.programs:
+        unknown = sorted(set(args.programs) - known)
+        if unknown:
+            print(f"program_audit: unknown program(s) {unknown}; "
+                  f"registry has {sorted(known)}", file=sys.stderr)
+            return 2
+        if args.bless:
+            print("program_audit: --bless requires the FULL sweep (a "
+                  "partial sweep must not shrink the golden registry)",
+                  file=sys.stderr)
+            return 2
+    if args.bless and args.level != "compile":
+        print("program_audit: --bless requires --level compile (a "
+              "trace-only golden would silently drop the compiled "
+              "fingerprints — donation aliases, cost analysis — from "
+              "the gate)", file=sys.stderr)
+        return 2
+
+    config = load_audit_config(REPO)
+    golden = None if args.bless else load_golden(args.golden)
+    report = audit_registry(level=args.level, config=config, golden=golden,
+                            names=args.programs)
+    payload = report.as_dict()
+
+    from improved_body_parts_tpu.obs.events import strict_dump, strict_dumps
+
+    if args.bless:
+        tmp = args.golden + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            strict_dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, args.golden)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            strict_dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if args.format == "json":
+        print(strict_dumps(payload, indent=2, sort_keys=True))
+    else:
+        for v in report.verdicts:
+            cfp = v.fingerprint.get("compiled") or {}
+            tfp = v.fingerprint.get("trace") or {}
+            cost = (f" flops={cfp.get('flops'):,}"
+                    f" temp={cfp.get('temp_bytes'):,}"
+                    f" alias={cfp.get('alias_bytes'):,}"
+                    if cfp else f" eqns={tfp.get('eqn_count')}")
+            print(f"{v.name:26s} {v.status:8s}{cost}"
+                  + (f"  [{v.note}]" if v.note else ""))
+            for f_ in v.findings:
+                print(f"    {f_.format()}")
+        counts = report.counts()
+        drifted = sum(1 for v in report.verdicts if v.drift)
+        gate = ("no golden registry — run with --bless to record one"
+                if golden is None and not args.bless else
+                f"golden jax {report.golden_jax_version or 'n/a'}, "
+                f"{drifted} program(s) drifted")
+        print(f"graftaudit {GRAFTAUDIT_VERSION} "
+              f"(checks {audit_ruleset_hash()}): "
+              f"{len(report.verdicts)} programs at level={args.level}, "
+              f"{counts['error']} errors, {counts['warning']} warnings; "
+              f"{gate}")
+        if args.bless:
+            print(f"blessed -> {args.golden}")
+
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except KeyboardInterrupt:
+        sys.exit(2)
