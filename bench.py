@@ -159,6 +159,8 @@ def _serve_bench_summary(fallback, budget_s):
     here = os.path.dirname(os.path.abspath(__file__))
     out = os.path.join(tempfile.mkdtemp(prefix="serve_bench_"),
                        "SERVE_BENCH.json")
+    # --no-decode-ab: the decode-lane A/B is the separate budget-gated
+    # "decode" key (--decode-only), never paid twice per bench run
     if fallback:
         # CPU: small model at the 512 protocol size (where batch lanes
         # measurably pay even on the host backend), one verdict round —
@@ -166,12 +168,12 @@ def _serve_bench_summary(fallback, budget_s):
         argv = ["--config", "tiny", "--sizes", "512", "--boxsize", "512",
                 "--requests", "3", "--clients", "8", "--max-batch", "4",
                 "--max-wait-ms", "400", "--occupancy-first",
-                "--rounds", "1", "--planted", "2"]
+                "--rounds", "1", "--planted", "2", "--no-decode-ab"]
         timeout = min(600, budget_s)
     else:
         argv = ["--config", "canonical", "--sizes", "512",
                 "--requests", "6", "--clients", "8", "--max-batch", "8",
-                "--rounds", "2", "--planted", "2"]
+                "--rounds", "2", "--planted", "2", "--no-decode-ab"]
         timeout = min(900, budget_s)
     try:
         subprocess.run(
@@ -188,6 +190,58 @@ def _serve_bench_summary(fallback, budget_s):
             "mean_batch_occupancy":
                 r["serve_at_peak_load"]["mean_batch_occupancy"],
             "batched_beats_sequential": r["batched_beats_sequential"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
+def _decode_summary(fallback, budget_s):
+    """Run tools/serve_bench.py --decode-only (the fused device-decode
+    lane vs the host decode-pool lane, interleaved A/B rounds) and
+    return a compact summary, or an {"error"/"skipped"} marker — the
+    "serve" key contract.  Subprocess so a decode-bench failure can
+    never take down the primary metric; bounded by the REMAINING driver
+    budget.  ``IBP_BENCH_DECODE=0`` skips it unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_DECODE") == "0":
+        return {"skipped": "IBP_BENCH_DECODE=0"}
+    if budget_s < 180:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (SERVE_BENCH.json has the full A/B)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="decode_ab_"),
+                       "SERVE_BENCH.json")
+    if fallback:
+        # CPU: small model at the 512 protocol size, fewer rounds —
+        # the committed SERVE_BENCH.json carries the full-protocol A/B
+        argv = ["--config", "tiny", "--sizes", "512", "--boxsize", "512",
+                "--requests", "3", "--clients", "8", "--max-batch", "4",
+                "--max-wait-ms", "400", "--occupancy-first",
+                "--decode-rounds", "3", "--planted", "2"]
+        timeout = min(600, budget_s)
+    else:
+        argv = ["--config", "canonical", "--sizes", "512",
+                "--requests", "6", "--clients", "8", "--max-batch", "8",
+                "--decode-rounds", "3", "--planted", "2"]
+        timeout = min(900, budget_s)
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "serve_bench.py"),
+             "--decode-only", "--out", out] + argv,
+            capture_output=True, timeout=timeout, check=True,
+            env=dict(os.environ))
+        with open(out) as f:
+            ab = json.load(f)["decode_ab"]
+        return {
+            "median_round_ratio": ab["median_round_ratio"],
+            "device_decode_beats_host_pool":
+                ab["device_decode_beats_host_pool"],
+            "device_imgs_per_sec": ab["device_imgs_per_sec"],
+            "host_pool_imgs_per_sec": ab["host_pool_imgs_per_sec"],
+            "decode_fused": ab["decode_fused"],
+            "decode_host_fallback": ab["decode_host_fallback"],
         }
     except Exception as e:  # noqa: BLE001 — the primary metric must land
         return {"error": f"{type(e).__name__}"}
@@ -515,6 +569,9 @@ def main():
     # computed, so a serve failure can only cost this one extra field
     serve = _serve_bench_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # fused device decode vs host decode pool, same budget discipline
+    decode = _decode_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     # input feed rate (sync vs shm workers), same budget discipline
     feed = _feed_rate_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
@@ -544,6 +601,7 @@ def main():
         "unit": unit,
         "vs_baseline": round(fps / BASELINE_FPS, 3),
         "serve": serve,
+        "decode": decode,
         "feed": feed,
         "telemetry": telemetry,
         "ckpt": ckpt,

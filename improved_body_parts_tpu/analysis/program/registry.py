@@ -3,10 +3,12 @@ buildable ABSTRACTLY.
 
 Each :class:`ProgramSpec` names one real program — the donated train
 step (health sentinel on and off, device-GT variant), the eval step,
-the compact serve program per bucket shape, the flip-TTA peaks program,
-the SWA running average, and the meshed GSPMD train step — together
-with the declarations the checks verify (donated argnums, bf16-compute,
-hot-path status, mesh expectations).
+the compact and FUSED-decode serve programs per bucket shape (the
+latter with a declared bounded `while`: the assembly kernel's
+candidate walk), the flip-TTA peaks program, the SWA running average,
+and the meshed GSPMD train step — together with the declarations the
+checks verify (donated argnums, bf16-compute, hot-path status, mesh
+expectations).
 
 ``build()`` returns the jitted callable plus ``ShapeDtypeStruct``
 example arguments: tracing/lowering/compiling them runs ZERO model
@@ -214,6 +216,30 @@ def _build_serve_compact_batch() -> BuiltProgram:
     return BuiltProgram(fn=fn, args=(p.variables, imgs, valid, valid))
 
 
+def _build_serve_decode() -> BuiltProgram:
+    import jax
+    import jax.numpy as jnp
+
+    _, p = _abstract_predictor()
+    b = p.bucket
+    fn = p.decode_program((b, b))
+    img = jax.ShapeDtypeStruct((b, b, 3), jnp.float32)
+    valid = jax.ShapeDtypeStruct((), jnp.int32)
+    return BuiltProgram(fn=fn, args=(p.variables, img, valid, valid))
+
+
+def _build_serve_decode_batch() -> BuiltProgram:
+    import jax
+    import jax.numpy as jnp
+
+    _, p = _abstract_predictor()
+    b = p.bucket
+    fn = p.decode_program((b, b), batch=_B)
+    imgs = jax.ShapeDtypeStruct((_B, b, b, 3), jnp.float32)
+    valid = jax.ShapeDtypeStruct((_B,), jnp.int32)
+    return BuiltProgram(fn=fn, args=(p.variables, imgs, valid, valid))
+
+
 def _build_flip_tta_peaks() -> BuiltProgram:
     import jax
     import jax.numpy as jnp
@@ -298,6 +324,27 @@ def program_registry() -> List[ProgramSpec]:
                         "batch 2 (the DynamicBatcher's pow2-chunk unit)",
             build=_build_serve_compact_batch,
             expect_bf16=True, tags=("bucket=128x128", f"batch={_B}")),
+        ProgramSpec(
+            name="serve_decode_b1",
+            description="FUSED end-to-end decode serve program, bucket "
+                        "128, batch 1: forward + compact extraction + "
+                        "greedy assembly (the device-decode lane's "
+                        "singleton flush).  allow_while: the assembly's "
+                        "candidate walk is a DECLARED bounded "
+                        "lax.while_loop (trip count <= the candidate "
+                        "cap; ops/assembly.py)",
+            build=_build_serve_decode,
+            expect_bf16=True, allow_while=True,
+            tags=("bucket=128x128", "batch=1")),
+        ProgramSpec(
+            name="serve_decode_batch_b2",
+            description="FUSED end-to-end decode serve program, bucket "
+                        "128, batch 2 (the device-decode lane's "
+                        "pow2-chunk unit); declared bounded while, as "
+                        "serve_decode_b1",
+            build=_build_serve_decode_batch,
+            expect_bf16=True, allow_while=True,
+            tags=("bucket=128x128", f"batch={_B}")),
         ProgramSpec(
             name="flip_tta_peaks",
             description="flip-TTA ensemble + on-device NMS peaks "

@@ -1,9 +1,11 @@
 from .decode import (
     CompactOverflow,
     CompactResult,
+    DeviceDecoded,
     assemble,
     decode,
     decode_compact,
+    decode_device,
     find_connections,
     find_peaks,
     find_people,
@@ -19,15 +21,16 @@ from .evaluate import (
 )
 from .native import native_available
 from .oks import evaluate_oks, oks
-from .pipeline import pipelined_inference
+from .pipeline import device_decode_fn, pipelined_inference
 from .predict import Predictor, center_pad, pad_right_down
 
 __all__ = [
-    "CompactOverflow", "CompactResult", "assemble", "decode",
-    "decode_compact", "find_connections", "find_peaks", "find_people",
-    "subsets_to_keypoints", "draw_skeletons", "limb_flow_bgr", "run_demo",
-    "format_results", "load_coco_ground_truth", "process_image",
-    "validation", "validation_oks", "native_available",
-    "evaluate_oks", "oks", "pipelined_inference", "Predictor", "center_pad",
+    "CompactOverflow", "CompactResult", "DeviceDecoded", "assemble",
+    "decode", "decode_compact", "decode_device", "find_connections",
+    "find_peaks", "find_people", "subsets_to_keypoints", "draw_skeletons",
+    "limb_flow_bgr", "run_demo", "format_results",
+    "load_coco_ground_truth", "process_image", "validation",
+    "validation_oks", "native_available", "evaluate_oks", "oks",
+    "device_decode_fn", "pipelined_inference", "Predictor", "center_pad",
     "pad_right_down",
 ]

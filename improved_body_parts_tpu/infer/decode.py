@@ -372,6 +372,59 @@ class CompactOverflow(RuntimeError):
     caller should fall back to the full-map path."""
 
 
+class DeviceDecoded(NamedTuple):
+    """Host-side payload of the fused device-decode path
+    (``Predictor.predict_decoded``): the assembled person table from
+    ``ops.assembly.greedy_assemble`` plus the compact records it was
+    built from (the fallback input when an overflow flag is set).
+
+    ``subset`` uses flat slot ids (``channel * top_k + slot``) — feed it
+    to :func:`decode_device`, never to the host ``subsets_to_keypoints``
+    with a row-major candidate array.
+    """
+    subset: np.ndarray          # (P_max, num_parts + 2, 2) float32
+    mask: np.ndarray            # (P_max,) bool — pruned-in people
+    n_people: int
+    peak_overflow: bool         # host path would raise CompactOverflow
+    cand_overflow: bool         # host path would raise CompactOverflow
+    person_overflow: bool       # device person table hit capacity
+    compact: CompactResult
+
+    @property
+    def ok(self) -> bool:
+        """True when the device assembly is authoritative (no capacity
+        overflowed); False routes the caller to the host fallback."""
+        return not (self.peak_overflow or self.cand_overflow
+                    or self.person_overflow)
+
+
+def decode_device(dev: "DeviceDecoded", skeleton: SkeletonConfig
+                  ) -> List[Tuple[List[Optional[Tuple[float, float]]],
+                                  float]]:
+    """Finish a fused device decode on the host: O(people) work only.
+
+    The device already ran peak extraction, candidate scoring AND greedy
+    assembly (``ops.assembly``); all that remains is the id→coordinate
+    lookup + COCO reordering of ``subsets_to_keypoints``, fed with a
+    candidate array in the kernel's flat slot-id indexing
+    (``channel * K + slot``) with coordinates scaled back to
+    original-image space.
+
+    Callers must check ``dev.ok`` first (``infer.pipeline
+    .device_decode_fn`` wraps this with the documented overflow
+    fallback); decoding an overflowed result would silently drop people.
+    """
+    pk = dev.compact.peaks
+    sx, sy = dev.compact.coord_scale
+    candidate = np.stack(
+        [pk.x_ref.ravel().astype(np.float64) * sx,
+         pk.y_ref.ravel().astype(np.float64) * sy,
+         pk.score.ravel().astype(np.float64),
+         np.arange(pk.score.size, dtype=np.float64)], axis=1)
+    return subsets_to_keypoints(dev.subset[dev.mask].astype(np.float64),
+                                candidate, skeleton)
+
+
 def decode_compact(compact: CompactResult, params: InferenceParams,
                    skeleton: SkeletonConfig, use_native: bool = True):
     """Decode from on-device peak records + accepted limb candidates.

@@ -117,7 +117,7 @@ def validation(predictor: Predictor, anno_file: str, images_dir: str,
                params: Optional[InferenceParams] = None,
                use_native: bool = True, results_dir: str = "results",
                fast: bool = False, compact: bool = False,
-               compact_batch: int = 0):
+               compact_batch: int = 0, device_decode: bool = False):
     """Run COCOeval on ``validation_ids`` (default: first ``max_images`` val
     ids — the reference's first-500 protocol, evaluate.py:597-598).
 
@@ -136,7 +136,8 @@ def validation(predictor: Predictor, anno_file: str, images_dir: str,
     keypoints = _collect_detections(
         predictor, {i: coco_gt.imgs[i]["file_name"] for i in validation_ids},
         images_dir, list(validation_ids), params, use_native, fast,
-        decode_timer, compact=compact, compact_batch=compact_batch)
+        decode_timer, compact=compact, compact_batch=compact_batch,
+        device_decode=device_decode)
 
     res_file = os.path.join(results_dir, f"person_keypoints_{dump_name}.json")
     format_results(keypoints, res_file)
@@ -162,13 +163,15 @@ def _collect_detections(predictor: Predictor, id_to_name: Dict[int, str],
                         fast: bool,
                         decode_timer: Optional[AverageMeter] = None,
                         compact: bool = False,
-                        compact_batch: int = 0) -> Dict[int, list]:
+                        compact_batch: int = 0,
+                        device_decode: bool = False) -> Dict[int, list]:
     """Run inference over ``ids`` — the one detection-collection loop shared
     by the COCOeval and OKS-proxy protocols.  ``fast`` uses the pipelined
     single-scale path (forward N+1 overlaps threaded decode N);
     ``compact`` additionally keeps peak extraction + pair scoring on the
     device (minimal device→host transfer); ``compact_batch`` > 1 runs the
-    shape-bucketed batched throughput mode."""
+    shape-bucketed batched throughput mode; ``device_decode`` runs the
+    greedy assembly on-device too (the fused decode program)."""
 
     def load(image_id):
         image = cv2.imread(os.path.join(images_dir, id_to_name[image_id]))
@@ -177,14 +180,14 @@ def _collect_detections(predictor: Predictor, id_to_name: Dict[int, str],
         return image
 
     keypoints: Dict[int, list] = {}
-    if fast or compact or compact_batch >= 1:
+    if fast or compact or compact_batch >= 1 or device_decode:
         from .pipeline import pipelined_inference
 
         t0 = time.perf_counter()
         results_iter = pipelined_inference(
             predictor, (load(i) for i in ids), params,
             use_native=use_native, compact=compact,
-            compact_batch=compact_batch)
+            compact_batch=compact_batch, device_decode=device_decode)
         for image_id, results in zip(ids, results_iter):
             keypoints[image_id] = results
         dt = time.perf_counter() - t0
@@ -232,6 +235,7 @@ def validation_oks(predictor: Predictor, anno_file: str, images_dir: str,
                    params: Optional[InferenceParams] = None,
                    use_native: bool = True, fast: bool = False,
                    compact: bool = False, compact_batch: int = 0,
+                   device_decode: bool = False,
                    dump_name: str = "tpu", results_dir: str = "results"):
     """The first-500 protocol evaluated with the dependency-free OKS
     evaluator (COCOeval ignore/crowd/maxDets semantics, see APCHECK.md) —
@@ -256,7 +260,8 @@ def validation_oks(predictor: Predictor, anno_file: str, images_dir: str,
     detections = _collect_detections(predictor, images, images_dir, ids,
                                      params, use_native, fast,
                                      compact=compact,
-                                     compact_batch=compact_batch)
+                                     compact_batch=compact_batch,
+                                     device_decode=device_decode)
     res_file = os.path.join(results_dir, f"person_keypoints_{dump_name}.json")
     format_results(detections, res_file)
 

@@ -19,7 +19,7 @@ from typing import Callable, Iterable, Iterator, Optional, Tuple
 import numpy as np
 
 from ..config import InferenceParams, SkeletonConfig
-from .decode import CompactOverflow, decode, decode_compact
+from .decode import CompactOverflow, decode, decode_compact, decode_device
 
 
 def compact_decode_fn(predictor, params: Optional[InferenceParams] = None,
@@ -64,13 +64,43 @@ def compact_decode_fn(predictor, params: Optional[InferenceParams] = None,
     return decode_one
 
 
+def device_decode_fn(predictor, params: Optional[InferenceParams] = None,
+                     skeleton: Optional[SkeletonConfig] = None,
+                     use_native: bool = True
+                     ) -> Callable[[object, np.ndarray], list]:
+    """Build the one-``DeviceDecoded`` finisher with the documented
+    overflow fallback — the default-lane plumbing shared by
+    ``pipelined_inference(device_decode=True)`` and
+    ``serve.DynamicBatcher``.
+
+    The returned ``decode_one(device_res, image)`` finishes one image's
+    fused device decode: when no capacity overflowed (``.ok``) the host
+    work is the O(people) id→coordinate lookup of
+    ``decode.decode_device``; otherwise the image re-decodes from the
+    compact records shipped in the same buffer through
+    :func:`compact_decode_fn`'s host path — which itself falls back to
+    the full-map path when the compact records overflowed too.  So every
+    overflow class degrades one level, never fails.
+    """
+    skeleton = skeleton or predictor.skeleton
+    fallback = compact_decode_fn(predictor, params, skeleton, use_native)
+
+    def decode_one(device_res, image: np.ndarray) -> list:
+        if device_res.ok:
+            return decode_device(device_res, skeleton)
+        return fallback(device_res.compact, image)
+
+    return decode_one
+
+
 def pipelined_inference(predictor, images: Iterable[np.ndarray],
                         params: Optional[InferenceParams] = None,
                         skeleton: Optional[SkeletonConfig] = None,
                         use_native: bool = True,
                         decode_workers: int = 2,
                         compact: bool = False,
-                        compact_batch: int = 0) -> Iterator[list]:
+                        compact_batch: int = 0,
+                        device_decode: bool = False) -> Iterator[list]:
     """Run the fast path over a stream of BGR images, overlapping stages.
 
     Yields ``decode`` results (list of (coco_keypoints, score) per image) in
@@ -89,11 +119,19 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
     compact path, one fetch per chunk); the 2N-lane sharing only applies
     to the trivial grid.  ``compact_batch == 1`` degrades to the plain
     compact path rather than being silently ignored.
+
+    ``device_decode`` (implies ``compact``) runs the greedy person
+    assembly on the device too (``Predictor.predict_decoded*`` — the
+    whole decode is one XLA program per dispatch); the thread pool then
+    only finishes the O(people) coordinate lookup, or handles the
+    documented overflow fallbacks.
     """
     from .predict import trivial_grid
 
     params = params or predictor.params
     skeleton = skeleton or predictor.skeleton
+    if device_decode:
+        compact = True
     if compact_batch == 1:
         compact, compact_batch = True, 0
     single_dispatch_grid = trivial_grid(params)
@@ -104,9 +142,18 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
                       coord_scale=scale, use_native=use_native)
 
     # the shared compact decode plumbing (overflow fallback included) —
-    # same callable the serving engine's decode pool runs
-    decode_one_compact = compact_decode_fn(predictor, params, skeleton,
-                                           use_native)
+    # same callable the serving engine's decode pool runs; the device
+    # lane swaps in the DeviceDecoded finisher and the fused dispatchers
+    if device_decode:
+        decode_one_compact = device_decode_fn(predictor, params, skeleton,
+                                              use_native)
+        dispatch_one = predictor.predict_decoded_async
+        dispatch_batch = predictor.predict_decoded_batch_async
+    else:
+        decode_one_compact = compact_decode_fn(predictor, params, skeleton,
+                                               use_native)
+        dispatch_one = predictor.predict_compact_async
+        dispatch_batch = predictor.predict_compact_batch_async
 
     def run_decode_compact(resolve: Callable, image: np.ndarray):
         return decode_one_compact(resolve(), image)
@@ -138,7 +185,7 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
             n_in = 0
 
             def dispatch(idxs, chunk):
-                resolve = predictor.predict_compact_batch_async(
+                resolve = dispatch_batch(
                     chunk, thre1=params.thre1, params=params)
                 futures.append((idxs, pool.submit(
                     run_decode_compact_batch, resolve, chunk)))
@@ -182,10 +229,11 @@ def pipelined_inference(predictor, images: Iterable[np.ndarray],
             # dispatch forward; thre1 from the caller's params must reach
             # the on-device NMS, same as the sequential fast path
             if compact:
-                # predict_compact_async itself routes non-trivial
-                # scale/rotation grids to the device-resident ms path —
-                # ONE routing point, no predicate copy to drift here
-                resolve = predictor.predict_compact_async(
+                # predict_compact_async / predict_decoded_async route
+                # non-trivial scale/rotation grids to the device-resident
+                # ms path themselves — ONE routing point, no predicate
+                # copy to drift here
+                resolve = dispatch_one(
                     image, thre1=params.thre1, params=params)
                 futures.append(
                     (pool.submit(run_decode_compact, resolve, image), False))

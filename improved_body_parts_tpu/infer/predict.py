@@ -140,7 +140,8 @@ class Predictor:
     def __init__(self, model, variables, skeleton: SkeletonConfig,
                  params: Optional[InferenceParams] = None,
                  model_params: Optional[InferenceModelParams] = None,
-                 bucket: int = 128, mesh=None, compact_topk: int = 64):
+                 bucket: int = 128, mesh=None, compact_topk: int = 64,
+                 assembly_pmax: int = 32):
         from ..config import default_inference_params
 
         d_params, d_model_params = default_inference_params()
@@ -165,6 +166,11 @@ class Predictor:
         # channels with more NMS peaks than this trigger the documented
         # fallback to the full-map path (decode.CompactOverflow)
         self.compact_topk = compact_topk
+        # person-table capacity of the fused on-device assembly
+        # (ops.assembly.greedy_assemble): crowds that allocate more
+        # in-progress skeletons than this set the person_overflow flag
+        # and the caller falls back to the host decoder
+        self.assembly_pmax = assembly_pmax
         # jitted program cache keyed by (padded shape, mode, thre1)
         self._fns: Dict[Tuple[Tuple[int, int], str, Optional[float]],
                         object] = {}
@@ -227,16 +233,22 @@ class Predictor:
                 kp = jnp.where(valid, kp, -1e9)
                 peaks = keypoint_nms(kp, kernel=3, thre=thre1) > 0
                 return maps, peaks
-        elif mode in ("compact", "compact_batch"):
+        elif mode in ("compact", "compact_batch", "decode", "decode_batch"):
             # the compact payload: on-device NMS + top-K peaks + limb pair
             # acceptance/ranking; only accepted candidates ship, packed
             # into ONE fp32 buffer — a remote-attached chip pays a round
             # trip PER fetched array and ~bytes for the rest, so both the
             # array count (1) and the payload (~100 KB/img) are minimized
-            # (ints ≤2^24 are exact in fp32)
-            one_image = self._compact_extract_fn(thre1, compact_spec)
+            # (ints ≤2^24 are exact in fp32).  The decode modes run the
+            # greedy person assembly on device as well (ops.assembly) and
+            # append the person table to the same single buffer — the
+            # whole serve hot path becomes one XLA program per batch.
+            if mode.startswith("decode"):
+                one_image = self._decode_extract_fn(thre1, compact_spec)
+            else:
+                one_image = self._compact_extract_fn(thre1, compact_spec)
 
-            if mode == "compact":
+            if mode in ("compact", "decode"):
                 def fn(variables, img, valid_h, valid_w):
                     maps = ensemble(variables, img)
                     return one_image(maps, valid_h, valid_w)
@@ -270,14 +282,11 @@ class Predictor:
         return jax.image.resize(maps, (h, w, maps.shape[-1]),
                                 method="cubic")
 
-    def _compact_extract_fn(self, thre1: float, spec):
-        """The compact extraction (traced inside a jitted program):
-        (maps, valid_h, valid_w) → ONE packed fp32 buffer of top-K peaks +
-        accepted limb candidates.  The single source for the compact,
-        compact-batch and multi-scale programs (payload layout twin of
-        ``_unpack_compact``)."""
-        import jax.numpy as jnp
-
+    def _compact_records_fn(self, thre1: float, spec):
+        """The compact record extraction (traced inside a jitted
+        program): (maps, valid_h, valid_w) → (TopKPeaks,
+        LimbCandidates).  The shared front half of the compact and fused
+        decode extractors."""
         from ..ops.peaks import limb_topk_candidates, topk_peaks
 
         sk = self.skeleton
@@ -285,7 +294,7 @@ class Predictor:
         limbs_from = tuple(a for a, _ in sk.limbs_conn)
         limbs_to = tuple(b for _, b in sk.limbs_conn)
 
-        def one_image(maps, valid_h, valid_w):
+        def records(maps, valid_h, valid_w):
             kp = maps[..., sk.paf_layers:sk.paf_layers + sk.num_parts]
             peaks = topk_peaks(kp, valid_h, valid_w, thre=thre1,
                                k=topk, radius=radius)
@@ -295,9 +304,65 @@ class Predictor:
                 num_samples=mid_num, thre2=thre2,
                 connect_ration=connect_ration,
                 m_cap=COMPACT_M_FACTOR * topk)
+            return peaks, cands
+
+        return records
+
+    def _compact_extract_fn(self, thre1: float, spec):
+        """The compact extraction (traced inside a jitted program):
+        (maps, valid_h, valid_w) → ONE packed fp32 buffer of top-K peaks +
+        accepted limb candidates.  The single source for the compact,
+        compact-batch and multi-scale programs (payload layout twin of
+        ``_unpack_compact``)."""
+        import jax.numpy as jnp
+
+        records = self._compact_records_fn(thre1, spec)
+
+        def one_image(maps, valid_h, valid_w):
+            peaks, cands = records(maps, valid_h, valid_w)
             return jnp.concatenate(
                 [a.astype(jnp.float32).ravel()
                  for a in tuple(peaks) + tuple(cands)])
+
+        return one_image
+
+    def _decode_extract_fn(self, thre1: float, spec):
+        """The FUSED decode extraction (traced inside a jitted program):
+        (maps, valid_h, valid_w) → ONE packed fp32 buffer of the compact
+        records PLUS the greedy-assembled person table + prune mask +
+        overflow flags (``ops.assembly.greedy_assemble``).  Shipping the
+        compact records alongside keeps the overflow fallback a pure
+        host-side re-decode — no second device dispatch.  Payload layout
+        twin of ``_unpack_decoded``."""
+        import jax.numpy as jnp
+
+        from ..ops.assembly import greedy_assemble
+
+        sk = self.skeleton
+        compact_spec, (p_max, len_rate, connection_tole, remove_recon,
+                       min_parts, min_mean_score) = spec
+        records = self._compact_records_fn(thre1, compact_spec)
+        limbs_from = tuple(a for a, _ in sk.limbs_conn)
+        limbs_to = tuple(b for _, b in sk.limbs_conn)
+
+        def one_image(maps, valid_h, valid_w):
+            peaks, cands = records(maps, valid_h, valid_w)
+            asm = greedy_assemble(
+                peaks, cands, limbs_from=limbs_from, limbs_to=limbs_to,
+                num_parts=sk.num_parts, p_max=p_max, len_rate=len_rate,
+                connection_tole=connection_tole,
+                remove_recon=remove_recon, min_parts=min_parts,
+                min_mean_score=min_mean_score)
+            flags = jnp.stack([
+                asm.n_people.astype(jnp.float32),
+                asm.peak_overflow.astype(jnp.float32),
+                asm.cand_overflow.astype(jnp.float32),
+                asm.person_overflow.astype(jnp.float32)])
+            return jnp.concatenate(
+                [a.astype(jnp.float32).ravel()
+                 for a in tuple(peaks) + tuple(cands)]
+                + [asm.subset.ravel(),
+                   asm.mask.astype(jnp.float32), flags])
 
         return one_image
 
@@ -364,12 +429,16 @@ class Predictor:
         return resolve
 
     def _compact_ms_dispatch(self, image_bgr: np.ndarray,
-                             thre1: Optional[float], prm: InferenceParams):
+                             thre1: Optional[float], prm: InferenceParams,
+                             mode: str = "compact"):
         """Dispatch the (scale × rotation) grid ensemble for one image;
         returns the DEVICE-resident packed buffer plus the decode-grid
         metadata, so callers choose between a per-image fetch
         (:meth:`predict_compact_ms_async`) and a batched single fetch
-        (the grid branch of :meth:`predict_compact_batch_async`)."""
+        (the grid branch of :meth:`predict_compact_batch_async`).
+        ``mode="decode"`` runs the fused on-device assembly on the
+        averaged grid maps (the :meth:`predict_decoded_async` grid
+        route)."""
         mp = self.model_params
         if self.mesh is not None:
             raise ValueError(
@@ -391,9 +460,10 @@ class Predictor:
             for img, (rh, rw) in prepared
             for angle in prm.rotation_search]
 
-        spec = self._compact_spec(prm)
+        spec = (self._decode_spec(prm) if mode == "decode"
+                else self._compact_spec(prm))
         packed_d = self._compact_avg_fn(len(maps_d), (rh0, rw0), thre1,
-                                        spec)(maps_d)
+                                        spec, mode)(maps_d)
         return packed_d, rh0, (ow / rw0, oh / rh0)
 
     def _scale_to_grid_fn(self, shape: Tuple[int, int],
@@ -448,18 +518,21 @@ class Predictor:
         return jitted
 
     def _compact_avg_fn(self, n_entries: int, grid: Tuple[int, int],
-                        thre1: float, spec):
+                        thre1: float, spec, mode: str = "compact"):
         """Jitted: average ``n_entries`` grid-aligned map stacks — one per
         (scale, rotation) grid entry, device arrays from
         *_scale_to_grid_fn* — and run the compact peak + candidate
-        extraction on the mean."""
-        key = (n_entries, grid, thre1, spec, "compact_avg")
+        extraction (or, ``mode="decode"``, the fused extraction +
+        assembly) on the mean."""
+        key = (n_entries, grid, thre1, spec, mode + "_avg")
         if key in self._fns:
             return self._fns[key]
 
         import jax
 
-        one_image = self._compact_extract_fn(thre1, spec)
+        one_image = (self._decode_extract_fn(thre1, spec)
+                     if mode == "decode"
+                     else self._compact_extract_fn(thre1, spec))
 
         def fn(maps_list):
             maps = sum(maps_list) / len(maps_list)
@@ -531,7 +604,8 @@ class Predictor:
     def precompile_compact(self, lane_shapes: Sequence[Tuple[int, int]],
                            batch_sizes: Sequence[int] = (1,),
                            thre1: Optional[float] = None,
-                           params: Optional[InferenceParams] = None) -> int:
+                           params: Optional[InferenceParams] = None,
+                           decode: bool = False) -> int:
         """Compile (and warm) the compact-batch program for every
         (lane shape × batch size) combination by running it once on
         zeros, blocking until each executable is built.
@@ -543,6 +617,10 @@ class Predictor:
         the first unlucky request in each bucket.  Pass every power of
         two ≤ ``max_batch`` as ``batch_sizes`` to cover the exact-size
         pow2 chunks ``predict_compact_batch_async`` dispatches.
+
+        ``decode=True`` warms the FUSED decode programs instead (the
+        serving engine's default device-decode lane dispatches those,
+        never the compact ones).
 
         Returns the number of programs that were NOT already in this
         predictor's program cache (0 on a fully warm predictor).
@@ -556,26 +634,28 @@ class Predictor:
                 "protocol; scale/rotation grids compile per image")
         if thre1 is None:
             thre1 = prm.thre1
-        spec = self._compact_spec(prm)
+        mode = "decode" if decode else "compact"
+        spec = (self._decode_spec(prm) if decode
+                else self._compact_spec(prm))
+        program = self.decode_program if decode else self.compact_program
         # the row-concat/stack helpers are part of the serving hot path
         # (multi-chunk flushes); touching the properties pre-creates them
         self._concat_rows_fn, self._stack_rows_fn  # noqa: B018
         compiled = 0
         for h, w in lane_shapes:
-            # the single-image compact program too: serving dispatches a
+            # the single-image program too: serving dispatches a
             # singleton flush (deadline straggler) through it instead of
             # the batch path's stack/group/concat machinery
-            compiled += ((h, w), "compact", thre1, spec) not in self._fns
-            one = self.compact_program((h, w), thre1=thre1, params=prm)
+            compiled += ((h, w), mode, thre1, spec) not in self._fns
+            one = program((h, w), thre1=thre1, params=prm)
             jax.block_until_ready(one(
                 self.variables, np.zeros((h, w, 3), np.float32),
                 int(h), int(w)))
             for n in batch_sizes:
                 shape = (int(n), int(h), int(w), 3)
-                compiled += (shape, "compact_batch", thre1,
+                compiled += (shape, mode + "_batch", thre1,
                              spec) not in self._fns
-                fn = self.compact_program((h, w), batch=n, thre1=thre1,
-                                          params=prm)
+                fn = program((h, w), batch=n, thre1=thre1, params=prm)
                 out = fn(self.variables,
                          np.zeros(shape, np.float32),
                          np.full((shape[0],), h, np.int32),
@@ -591,6 +671,17 @@ class Predictor:
         accessors below can never disagree on the layout."""
         return (prm.thre2, prm.mid_num, prm.offset_radius,
                 self.compact_topk, prm.connect_ration)
+
+    def _decode_spec(self, prm: InferenceParams):
+        """The fused-decode program spec: the compact spec plus every
+        assembly knob ``ops.assembly.greedy_assemble`` bakes in.  One
+        construction site, same rationale as :meth:`_compact_spec` —
+        and part of the program-cache key, so changing a capacity knob
+        (``assembly_pmax``) or an assembly parameter compiles a fresh
+        program instead of silently reusing a stale one."""
+        return (self._compact_spec(prm),
+                (self.assembly_pmax, prm.len_rate, prm.connection_tole,
+                 prm.remove_recon, prm.min_parts, prm.min_mean_score))
 
     # ------------------------------------------------------------------ #
     # Public program accessors: the jitted executables behind the serve /
@@ -618,6 +709,27 @@ class Predictor:
                                      compact_spec=spec)
         return self._ensemble_fn((int(batch), h, w, 3),
                                  mode="compact_batch", thre1=thre1,
+                                 compact_spec=spec)
+
+    def decode_program(self, shape: Tuple[int, int],
+                       batch: Optional[int] = None,
+                       thre1: Optional[float] = None,
+                       params: Optional[InferenceParams] = None):
+        """The FUSED decode serve program (forward + compact extraction
+        + greedy assembly in one XLA program) for one padded bucket
+        shape — ``batch=None`` is the singleton-flush program,
+        ``batch=N`` the N-lane pow2-chunk program.  Same call signature
+        as :meth:`compact_program`."""
+        prm = params or self.params
+        if thre1 is None:
+            thre1 = prm.thre1
+        spec = self._decode_spec(prm)
+        h, w = int(shape[0]), int(shape[1])
+        if batch is None:
+            return self._ensemble_fn((h, w), mode="decode", thre1=thre1,
+                                     compact_spec=spec)
+        return self._ensemble_fn((int(batch), h, w, 3),
+                                 mode="decode_batch", thre1=thre1,
                                  compact_spec=spec)
 
     def peaks_program(self, shape: Tuple[int, int],
@@ -829,11 +941,100 @@ class Predictor:
         DEVICE into one buffer so a relay-attached chip still pays a
         single fetch round trip.  Results come back in input order.
         """
+        return self._packed_batch_async(images_bgr, thre1, params,
+                                        mode="compact")
+
+    def predict_decoded(self, image_bgr: np.ndarray,
+                        thre1: Optional[float] = None,
+                        params: Optional[InferenceParams] = None):
+        """Fused end-to-end decode on device: forward + compact
+        extraction + greedy person assembly in ONE program; returns an
+        ``infer.decode.DeviceDecoded`` (feed it to
+        ``infer.decode.decode_device`` when ``.ok``, or to the host
+        fallback via ``infer.pipeline.device_decode_fn`` otherwise)."""
+        return self.predict_decoded_async(image_bgr, thre1, params)()
+
+    def predict_decoded_async(self, image_bgr: np.ndarray,
+                              thre1: Optional[float] = None,
+                              params: Optional[InferenceParams] = None):
+        """Dispatch the fused decode program; returns a ``resolve()``
+        closure (the :meth:`predict_fast_async` overlap contract).
+
+        Same protocol and routing as :meth:`predict_compact_async`
+        (non-trivial grids go through the device-resident ms path, with
+        the assembly running on the averaged maps); the payload adds the
+        assembled person table + overflow flags to the single fp32
+        buffer, so a no-overflow request needs only an O(people)
+        id→coordinate lookup on the host (``decode.decode_device``) —
+        no decode thread pool in the hot path.
+        """
+        prm = params or self.params
+        mp = self.model_params
+        if thre1 is None:
+            thre1 = prm.thre1
+        spec = self._decode_spec(prm)
+        if not trivial_grid(prm):
+            packed_d, rh0, coord_scale = self._compact_ms_dispatch(
+                image_bgr, thre1, prm, mode="decode")
+
+            def resolve_grid():
+                return self._unpack_decoded(np.asarray(packed_d), spec,
+                                            rh0, coord_scale)
+
+            return resolve_grid
+        oh, ow = image_bgr.shape[:2]
+        scale = prm.scale_search[0] * mp.boxsize / oh
+        img, (rh, rw) = self._prepare_input(image_bgr, scale)
+        packed_d = self._ensemble_fn(
+            img.shape[:2], mode="decode", thre1=thre1, compact_spec=spec)(
+            self.variables, img, rh, rw)
+
+        def resolve():
+            return self._unpack_decoded(np.asarray(packed_d), spec,
+                                        rh, (ow / rw, oh / rh))
+
+        return resolve
+
+    def predict_decoded_batch(self, images_bgr: Sequence[np.ndarray],
+                              thre1: Optional[float] = None,
+                              params: Optional[InferenceParams] = None):
+        """Batched fused decode; list of ``DeviceDecoded`` per image."""
+        return self.predict_decoded_batch_async(images_bgr, thre1,
+                                                params)()
+
+    def predict_decoded_batch_async(self, images_bgr: Sequence[np.ndarray],
+                                    thre1: Optional[float] = None,
+                                    params: Optional[InferenceParams] = None):
+        """Batched twin of :meth:`predict_decoded_async` — the serving
+        engine's default lane: one device program per pow2 chunk runs
+        forward, extraction AND assembly; the decode pool only sees
+        overflow fallbacks.  Same grouping/chunking/single-fetch
+        contract as :meth:`predict_compact_batch_async`."""
+        return self._packed_batch_async(images_bgr, thre1, params,
+                                        mode="decode")
+
+    def _packed_batch_async(self, images_bgr: Sequence[np.ndarray],
+                            thre1: Optional[float],
+                            params: Optional[InferenceParams], mode: str):
+        """Shared batched dispatch for the compact and fused-decode
+        payloads (see :meth:`predict_compact_batch_async` for the
+        grouping/chunking/single-fetch contract; ``mode`` picks the
+        per-image extraction and the row unpacking)."""
         prm = params or self.params
         mp = self.model_params
         if self.mesh is not None:
-            raise ValueError("compact_batch does not support the spatial "
+            raise ValueError(f"{mode}_batch does not support the spatial "
                              "sharding mesh (meant for single giant inputs)")
+        spec = (self._decode_spec(prm) if mode == "decode"
+                else self._compact_spec(prm))
+
+        def unpack(buf, image_size, coord_scale):
+            if mode == "decode":
+                return self._unpack_decoded(buf, spec, image_size,
+                                            coord_scale)
+            return self._unpack_compact(buf, spec[3], image_size,
+                                        coord_scale)
+
         if not trivial_grid(prm):
             # grid ensembles can't share one batched forward; dispatch
             # each image through the multi-scale/rotation compact path
@@ -842,14 +1043,14 @@ class Predictor:
             # fetch round trip
             if not len(images_bgr):
                 return lambda: []
-            dispatches = [self._compact_ms_dispatch(im, thre1, prm)
+            dispatches = [self._compact_ms_dispatch(im, thre1, prm,
+                                                    mode=mode)
                           for im in images_bgr]
             stacked_d = self._stack_rows_fn([d[0] for d in dispatches])
 
             def resolve_grid():
                 buf = np.asarray(stacked_d)  # (n, P) — ONE fetch
-                return [self._unpack_compact(buf[i], self.compact_topk,
-                                             rh0, cs)
+                return [unpack(buf[i], rh0, cs)
                         for i, (_, rh0, cs) in enumerate(dispatches)]
 
             return resolve_grid
@@ -867,7 +1068,6 @@ class Predictor:
             sizes.append((oh, ow, rh, rw))
 
         n = len(prepared)
-        spec = self._compact_spec(prm)
         groups: Dict[Tuple[int, ...], list] = {}
         for i, p in enumerate(prepared):
             groups.setdefault(p.shape, []).append(i)
@@ -879,7 +1079,7 @@ class Predictor:
                 valid_h = np.asarray([sizes[i][2] for i in chunk], np.int32)
                 valid_w = np.asarray([sizes[i][3] for i in chunk], np.int32)
                 packed_d = self._ensemble_fn(
-                    batch.shape, mode="compact_batch", thre1=thre1,
+                    batch.shape, mode=mode + "_batch", thre1=thre1,
                     compact_spec=spec)(self.variables, batch,
                                        valid_h, valid_w)
                 dispatched.append((chunk, packed_d))
@@ -899,8 +1099,7 @@ class Predictor:
             results = [None] * n
             for row, i in enumerate(order):
                 oh, ow, rh, rw = sizes[i]
-                results[i] = self._unpack_compact(
-                    buf[row], spec[3], rh, (ow / rw, oh / rh))
+                results[i] = unpack(buf[row], rh, (ow / rw, oh / rh))
             return results
 
         return resolve
@@ -960,6 +1159,50 @@ class Predictor:
         return CompactResult(peaks=TopKPeaks(*fields[:7]),
                              stats=LimbCandidates(*fields[7:]),
                              image_size=image_size, coord_scale=coord_scale)
+
+    def _compact_payload_floats(self, k: int) -> int:
+        """Length of the packed compact payload for top-K capacity
+        ``k`` — the split point of the fused decode buffer."""
+        c = self.skeleton.num_parts
+        n_limbs = len(self.skeleton.limbs_conn)
+        m = COMPACT_M_FACTOR * k
+        # TopKPeaks: six (C, K) arrays + (C,) count;
+        # LimbCandidates: five (L, M) arrays + (L,) count
+        return 6 * c * k + c + 5 * n_limbs * m + n_limbs
+
+    def _unpack_decoded(self, buf: np.ndarray, spec, image_size: int,
+                        coord_scale: Tuple[float, float]):
+        """Split one packed fp32 fused-decode buffer back into a
+        ``DeviceDecoded`` (layout twin of ``_decode_extract_fn``)."""
+        from .decode import DeviceDecoded
+
+        compact_spec, asm_spec = spec
+        k, p_max = compact_spec[3], asm_spec[0]
+        n_compact = self._compact_payload_floats(k)
+        compact = self._unpack_compact(buf[:n_compact], k, image_size,
+                                       coord_scale)
+        rows = self.skeleton.num_parts + 2
+        pos = n_compact
+        subset = buf[pos:pos + p_max * rows * 2].reshape(p_max, rows, 2)
+        pos += p_max * rows * 2
+        mask = buf[pos:pos + p_max] > 0.5
+        pos += p_max
+        n_people, peak_of, cand_of, person_of = buf[pos:pos + 4]
+        if pos + 4 != buf.size:
+            # hard error even under `python -O`: a pack/unpack layout
+            # drift would otherwise read the overflow FLAGS from wrong
+            # offsets and decode a should-fallback crowd as
+            # authoritative (silently dropped people)
+            raise RuntimeError(
+                f"fused decode payload size mismatch: parsed {pos + 4} "
+                f"of {buf.size} floats — _decode_extract_fn and "
+                "_unpack_decoded layouts drifted")
+        return DeviceDecoded(
+            subset=subset, mask=mask, n_people=int(n_people),
+            peak_overflow=bool(peak_of > 0.5),
+            cand_overflow=bool(cand_of > 0.5),
+            person_overflow=bool(person_of > 0.5),
+            compact=compact)
 
     def _clamp_scale(self, scale: float, oh: int, ow: int) -> float:
         mp = self.model_params

@@ -1,0 +1,323 @@
+"""On-device greedy skeleton assembly — the third and last decode stage.
+
+``ops.peaks`` already runs peak top-K and limb candidate scoring on the
+device; person assembly (the reference's evaluate.py:279-498 greedy
+merge/spawn walk) still ran as host NumPy/C++ on serve's decode thread
+pool — ROADMAP open item 1's serving throughput ceiling.  This module is
+that walk expressed as a fixed-shape, bounded-iteration device kernel:
+
+- one ``lax.fori_loop`` over the (static) limb list;
+- per limb, a **declared bounded** ``lax.while_loop`` over the
+  rank-ordered accepted candidates (``ops.peaks.limb_topk_candidates``
+  ships them rank-sorted with validity a prefix, so the walk stops at
+  the first invalid slot and can never exceed M iterations) applying the
+  one-to-one used-peak filter (reference: evaluate.py:260-271);
+- per selected connection, the exact found∈{0,1,2} spawn / assign /
+  replace / rescore / merge / compete rules of ``infer.decode
+  .find_people`` over a fixed-capacity person table.
+
+Peaks are identified by the flat slot id ``channel * K + slot`` (exact
+in fp32 up to 2^24); the host side rebuilds a candidate array in the
+same indexing, so ``infer.decode.subsets_to_keypoints`` consumes the
+device subset unchanged.
+
+Overflow is a FLAG, never an exception: a program output cannot
+data-depend on host control flow, so the three capacity conditions the
+host path raises ``CompactOverflow`` for (peak top-K, candidate cap) or
+cannot hit (the host person table is unbounded; ``p_max`` here) are
+returned as booleans and the caller falls back to the host decoder.
+
+Documented deviations from the host walk (tests/test_assembly.py):
+
+- arithmetic is fp32 (the host accumulates in float64) — raw scores and
+  lengths are identical, only running sums round differently, which can
+  flip a comparison exactly at a tie;
+- the found==2 "compete" case where NEITHER endpoint of the new limb is
+  in the second matched row is total here (it reads a -1 confidence);
+  the host reference crashes on that input (an empty ``np.where``), so
+  no parity case exists.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .peaks import LimbCandidates, TopKPeaks
+
+
+class AssemblyResult(NamedTuple):
+    """Fixed-capacity assembled-person table, host-layout compatible.
+
+    ``subset`` is (P_max, num_parts+2, 2) float32 in ``find_people``'s
+    row layout: per part [flat peak id ``c*K+slot`` or -1, confidence];
+    row -2 = [total score, -1]; row -1 = [part count, longest limb].
+    Only rows with ``mask`` are people (post-prune); the rest are
+    scratch.  The three overflow flags mirror the host path's
+    ``CompactOverflow`` conditions plus the table-capacity one.
+    """
+    subset: jnp.ndarray          # (P, num_parts + 2, 2) float32
+    mask: jnp.ndarray            # (P,) bool — pruned-in people
+    n_people: jnp.ndarray        # int32 — mask.sum()
+    peak_overflow: jnp.ndarray   # bool — a channel's NMS count > top-K
+    cand_overflow: jnp.ndarray   # bool — a limb's accepted pairs > M
+    person_overflow: jnp.ndarray  # bool — person table hit p_max
+
+
+def _first_two(match: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                            jnp.ndarray]:
+    """(j1, j2, found) — indices of the first two True rows in table
+    order (creation order; rows are allocated append-only so slot order
+    is the host's post-np.delete row order) and how many were found,
+    capped at 2 like the host's ``found_idx`` scan."""
+    n = match.shape[0]
+    rows = jnp.arange(n)
+    j1 = jnp.argmax(match)
+    has1 = match.any()
+    later = match & (rows > j1)
+    j2 = jnp.argmax(later)
+    has2 = later.any()
+    found = has1.astype(jnp.int32) + has2.astype(jnp.int32)
+    return j1, j2, found
+
+
+@partial(jax.jit, static_argnames=(
+    "limbs_from", "limbs_to", "num_parts", "p_max", "len_rate",
+    "connection_tole", "remove_recon", "min_parts", "min_mean_score"))
+def greedy_assemble(peaks: TopKPeaks, cands: LimbCandidates, *,
+                    limbs_from: Tuple[int, ...], limbs_to: Tuple[int, ...],
+                    num_parts: int, p_max: int, len_rate: float,
+                    connection_tole: float, remove_recon: int,
+                    min_parts: int, min_mean_score: float) -> AssemblyResult:
+    """Greedy person assembly on device (see module docstring).
+
+    Statics mirror ``InferenceParams``' assembly knobs so one compiled
+    kernel serves a fixed protocol; ``p_max`` is the person-table
+    capacity knob (``Predictor(assembly_pmax=...)``).
+    """
+    f32 = jnp.float32
+    c, k = peaks.valid.shape
+    n_limbs, m_cap = cands.valid.shape
+    p = p_max
+    rows = jnp.arange(p)
+    parts = jnp.arange(num_parts)
+
+    la = jnp.asarray(limbs_from, jnp.int32)
+    lb = jnp.asarray(limbs_to, jnp.int32)
+    n_peaks = jnp.minimum(peaks.count, k)              # true counts, capped
+    limit = jnp.minimum(n_peaks[la], n_peaks[lb])      # (L,) per-limb cap
+    pscore = peaks.score.astype(f32).reshape(-1)       # flat-id score lookup
+
+    state0 = dict(
+        ids=jnp.full((p, num_parts), -1, jnp.int32),
+        conf=jnp.full((p, num_parts), -1.0, f32),
+        tot=jnp.zeros((p,), f32),
+        npart=jnp.zeros((p,), f32),
+        maxlen=jnp.full((p,), -1.0, f32),
+        active=jnp.zeros((p,), bool),
+        count=jnp.int32(0),
+        overflow=jnp.zeros((), bool),
+    )
+
+    def process(st, ia, ib, sa, sb, score, limb_len):
+        """One selected connection through the found∈{0,1,2} rules."""
+        aid = ia * k + sa
+        bid = ib * k + sb
+        psa = pscore[aid]
+        psb = pscore[bid]
+        match = st["active"] & ((jnp.take(st["ids"], ia, axis=1) == aid)
+                                | (jnp.take(st["ids"], ib, axis=1) == bid))
+        j1, j2, found = _first_two(match)
+
+        def spawn(st):
+            # no owner: new person at the next slot (evaluate.py:473-488);
+            # a full table sets the overflow flag instead of growing
+            cnt = st["count"]
+            can = cnt < p
+            rmask = (rows == cnt) & can
+            col_a = parts == ia
+            col_b = parts == ib
+            cell = rmask[:, None] & (col_a | col_b)[None, :]
+            ids = jnp.where(cell, jnp.where(col_a[None, :], aid, bid),
+                            st["ids"])
+            conf = jnp.where(cell, score, st["conf"])
+            return dict(
+                ids=ids, conf=conf,
+                tot=jnp.where(rmask, psa + psb + score, st["tot"]),
+                npart=jnp.where(rmask, 2.0, st["npart"]),
+                maxlen=jnp.where(rmask, limb_len, st["maxlen"]),
+                active=st["active"] | rmask,
+                count=cnt + can.astype(jnp.int32),
+                overflow=st["overflow"] | ~can)
+
+        def one(st):
+            # one owner: assign / replace / rescore part B on row j1
+            # (evaluate.py:320-380); the elif chain reduces to three
+            # disjoint predicates over (slot state, confidence, length)
+            j = j1
+            old_b = st["ids"][j, ib]
+            conf_b = st["conf"][j, ib]
+            grow_ok = len_rate * st["maxlen"][j] > limb_len
+            same = old_b == bid
+            do_assign = (old_b == -1) & grow_ok
+            do_update = (~do_assign) & jnp.where(
+                same, conf_b <= score, (conf_b < score) & grow_ok)
+            write = do_assign | do_update
+            old_p = pscore[jnp.clip(old_b, 0, c * k - 1)]
+            delta = jnp.where(
+                do_assign, psb + score,
+                jnp.where(do_update, psb + score - old_p - conf_b, 0.0))
+            cell = (rows == j)[:, None] & (parts == ib)[None, :] & write
+            rmask = (rows == j) & write
+            return dict(
+                ids=jnp.where(cell, bid, st["ids"]),
+                conf=jnp.where(cell, score, st["conf"]),
+                tot=st["tot"] + jnp.where(rows == j, delta, 0.0),
+                npart=st["npart"] + jnp.where(
+                    (rows == j) & do_assign, 1.0, 0.0),
+                maxlen=jnp.where(rmask,
+                                 jnp.maximum(st["maxlen"], limb_len),
+                                 st["maxlen"]),
+                active=st["active"], count=st["count"],
+                overflow=st["overflow"])
+
+        def two(st):
+            memb1 = st["ids"][j1] >= 0
+            memb2 = st["ids"][j2] >= 0
+            overlap = (memb1 & memb2).any()
+
+            def merge(st):
+                # disjoint people sharing this limb: merge j2 into j1,
+                # gated by confidence + length priors (evaluate.py:403-424)
+                conf1 = st["conf"][j1]
+                conf2 = st["conf"][j2]
+                min_tol = jnp.minimum(
+                    jnp.min(jnp.where(memb1, conf1, jnp.inf)),
+                    jnp.min(jnp.where(memb2, conf2, jnp.inf)))
+                refuse = ((score < connection_tole * min_tol)
+                          | (len_rate * st["maxlen"][j1] <= limb_len))
+
+                def do(st):
+                    r1 = rows == j1
+                    r2 = rows == j2
+                    ids1 = st["ids"][j1] + st["ids"][j2] + 1
+                    conf1n = conf1 + conf2 + 1.0
+                    ids = jnp.where(r1[:, None], ids1[None, :], st["ids"])
+                    conf = jnp.where(r1[:, None], conf1n[None, :],
+                                     st["conf"])
+                    return dict(
+                        ids=jnp.where(r2[:, None], -1, ids),
+                        conf=jnp.where(r2[:, None], -1.0, conf),
+                        tot=jnp.where(
+                            r1, st["tot"][j1] + st["tot"][j2] + score,
+                            jnp.where(r2, 0.0, st["tot"])),
+                        npart=jnp.where(
+                            r1, st["npart"][j1] + st["npart"][j2],
+                            jnp.where(r2, 0.0, st["npart"])),
+                        # the host takes max(limb_len, j1's) — j2's
+                        # longest limb is deliberately NOT folded in
+                        maxlen=jnp.where(
+                            r1, jnp.maximum(st["maxlen"], limb_len),
+                            jnp.where(r2, -1.0, st["maxlen"])),
+                        active=st["active"] & ~r2,
+                        count=st["count"], overflow=st["overflow"])
+
+                return jax.lax.cond(refuse, lambda s: s, do, st)
+
+            def compete(st):
+                # two people own one endpoint each (evaluate.py:426-460);
+                # with remove_recon == 0 (the protocol default) the host
+                # resolves this to a no-op, so the kernel compiles it out
+                if remove_recon <= 0:
+                    return st
+                a_in_1 = st["ids"][j1, ia] == aid
+                c1 = jnp.where(a_in_1, ia, ib)
+                c2 = jnp.where(a_in_1, ib, ia)
+                conf_11 = st["conf"][j1, c1]
+                conf_22 = st["conf"][j2, c2]
+                skip = (score < conf_11) & (score < conf_22)
+                small_is_2 = conf_11 > conf_22
+                sj = jnp.where(small_is_2, j2, j1)
+                rc = jnp.where(small_is_2, c2, c1)
+
+                def do(st):
+                    old_id = st["ids"][sj, rc]
+                    old_conf = st["conf"][sj, rc]
+                    old_p = pscore[jnp.clip(old_id, 0, c * k - 1)]
+                    cell = (rows == sj)[:, None] & (parts == rc)[None, :]
+                    return dict(
+                        ids=jnp.where(cell, -1, st["ids"]),
+                        conf=jnp.where(cell, -1.0, st["conf"]),
+                        tot=st["tot"] - jnp.where(
+                            rows == sj, old_p + old_conf, 0.0),
+                        npart=st["npart"] - jnp.where(
+                            rows == sj, 1.0, 0.0),
+                        maxlen=st["maxlen"], active=st["active"],
+                        count=st["count"], overflow=st["overflow"])
+
+                return jax.lax.cond(skip, lambda s: s, do, st)
+
+            return jax.lax.cond(overlap, compete, merge, st)
+
+        return jax.lax.switch(found, [spawn, one, two], st)
+
+    def limb_body(li, st):
+        ia = la[li]
+        ib = lb[li]
+        lim = limit[li]
+        slot_a = cands.slot_a[li]
+        slot_b = cands.slot_b[li]
+        prior = cands.prior[li].astype(f32)
+        norm = cands.norm[li].astype(f32)
+        valid = cands.valid[li]
+
+        def cond(carry):
+            mi, nrows, _used_a, _used_b, _st = carry
+            # candidates are rank-ordered with validity a prefix: the
+            # first invalid slot ends the limb — the walk is bounded by
+            # M but usually far shorter (the declared-while rationale)
+            return ((mi < m_cap) & valid[jnp.minimum(mi, m_cap - 1)]
+                    & (nrows < lim))
+
+        def body(carry):
+            mi, nrows, used_a, used_b, st = carry
+            sa = jnp.clip(slot_a[mi], 0, k - 1)
+            sb = jnp.clip(slot_b[mi], 0, k - 1)
+            free = ~(used_a[sa] | used_b[sb])
+
+            def take(args):
+                nrows, used_a, used_b, st = args
+                return (nrows + 1,
+                        used_a.at[sa].set(True),
+                        used_b.at[sb].set(True),
+                        process(st, ia, ib, sa, sb, prior[mi], norm[mi]))
+
+            nrows, used_a, used_b, st = jax.lax.cond(
+                free, take, lambda a: a, (nrows, used_a, used_b, st))
+            return mi + 1, nrows, used_a, used_b, st
+
+        carry = (jnp.int32(0), jnp.int32(0),
+                 jnp.zeros((k,), bool), jnp.zeros((k,), bool), st)
+        return jax.lax.while_loop(cond, body, carry)[4]
+
+    st = jax.lax.fori_loop(0, n_limbs, limb_body, state0)
+
+    # prune sparse / low-confidence people (evaluate.py:491-496)
+    npart_safe = jnp.maximum(st["npart"], 1.0)
+    mask = (st["active"] & (st["npart"] >= min_parts)
+            & (st["tot"] / npart_safe >= min_mean_score))
+
+    part_rows = jnp.stack([st["ids"].astype(f32), st["conf"]], axis=-1)
+    row_m2 = jnp.stack([st["tot"], jnp.full((p,), -1.0, f32)],
+                       axis=-1)[:, None, :]
+    row_m1 = jnp.stack([st["npart"], st["maxlen"]], axis=-1)[:, None, :]
+    subset = jnp.concatenate([part_rows, row_m2, row_m1], axis=1)
+
+    return AssemblyResult(
+        subset=subset, mask=mask,
+        n_people=mask.sum(dtype=jnp.int32),
+        peak_overflow=(peaks.count > k).any(),
+        cand_overflow=(cands.count > m_cap).any(),
+        person_overflow=st["overflow"])

@@ -1,0 +1,153 @@
+"""Pallas sketch of the assembly kernel's inner candidate walk.
+
+``ops.assembly.greedy_assemble`` expresses the per-limb one-to-one
+used-peak filter (reference: evaluate.py:260-271) as a
+``lax.while_loop`` inside the fused decode program; XLA schedules that
+walk serially against the rest of the program.  This module is the
+hand-scheduled Mosaic variant of exactly that inner loop — the hot
+sequential part — as a Pallas kernel: one grid step per limb, the
+used-A/used-B occupancy masks and the candidate slots living in SMEM
+(scalar-indexed loads/stores are natural there; the walk is pure
+scalar control flow, no vector work).
+
+Status: a SKETCH, gated behind ``tools/pallas_check.py --assembly``
+like the focal kernel before it — parity-tested in interpreter mode on
+CPU (tests/test_assembly.py), to be timed under the real Mosaic
+lowering the moment a chip is available.  Wire it into
+``greedy_assemble`` only if it wins on hardware; the XLA while_loop
+path stays the shipped default either way.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _walk_kernel(slot_a_ref, slot_b_ref, valid_ref, limit_ref, sel_ref,
+                 used_a, used_b):
+    """One limb's walk: mark the rank-ordered candidates that survive
+    the one-to-one used filter, up to ``limit`` selections."""
+    k = used_a.shape[0]
+    m_cap = sel_ref.shape[-1]
+
+    def clear(i, carry):
+        used_a[i] = 0
+        used_b[i] = 0
+        return carry
+
+    jax.lax.fori_loop(0, k, clear, 0)
+    lim = limit_ref[0]
+
+    def body(m, nrows):
+        sa = slot_a_ref[0, m]
+        sb = slot_b_ref[0, m]
+        ok = ((valid_ref[0, m] > 0) & (nrows < lim)
+              & (used_a[sa] == 0) & (used_b[sb] == 0))
+        sel_ref[0, m] = jnp.where(ok, 1, 0)
+
+        @pl.when(ok)
+        def _take():
+            used_a[sa] = 1
+            used_b[sb] = 1
+
+        return nrows + jnp.where(ok, 1, 0)
+
+    jax.lax.fori_loop(0, m_cap, body, jnp.int32(0))
+
+
+def candidate_walk_pallas(slot_a, slot_b, valid, limit, k: int,
+                          interpret: bool = False):
+    """Selection flags (L, M) int32 for the per-limb one-to-one walk.
+
+    :param slot_a, slot_b: (L, M) int32 candidate endpoint slots in
+        [0, k) — ``ops.peaks.LimbCandidates`` order (rank-sorted,
+        validity a prefix)
+    :param valid: (L, M) bool/int32 acceptance flags
+    :param limit: (L,) int32 per-limb selection cap (min of the two
+        endpoint channels' true peak counts)
+    :param k: top-K slot capacity (the used-mask width)
+    """
+    n_limbs, m_cap = slot_a.shape
+    spec_row = pl.BlockSpec((1, m_cap), lambda li: (li, 0),
+                            memory_space=pltpu.SMEM)
+    return pl.pallas_call(
+        _walk_kernel,
+        grid=(n_limbs,),
+        in_specs=[spec_row, spec_row, spec_row,
+                  pl.BlockSpec((1,), lambda li: (li,),
+                               memory_space=pltpu.SMEM)],
+        out_specs=spec_row,
+        out_shape=jax.ShapeDtypeStruct((n_limbs, m_cap), jnp.int32),
+        scratch_shapes=[pltpu.SMEM((k,), jnp.int32),
+                        pltpu.SMEM((k,), jnp.int32)],
+        interpret=interpret,
+    )(jnp.asarray(slot_a, jnp.int32), jnp.asarray(slot_b, jnp.int32),
+      jnp.asarray(valid, jnp.int32), jnp.asarray(limit, jnp.int32))
+
+
+def candidate_walk_reference(slot_a, slot_b, valid, limit):
+    """Host NumPy reference — the literal per-limb walk of
+    ``infer.decode.decode_compact`` (used filter + limit), the
+    semantics both the XLA while_loop and the Pallas kernel implement."""
+    import numpy as np
+
+    n_limbs, m_cap = slot_a.shape
+    sel = np.zeros((n_limbs, m_cap), np.int32)
+    for li in range(n_limbs):
+        used_a, used_b = set(), set()
+        taken = 0
+        for m in range(m_cap):
+            if not valid[li, m] or taken >= limit[li]:
+                break  # validity is a prefix; the host walk stops here
+            sa, sb = int(slot_a[li, m]), int(slot_b[li, m])
+            if sa in used_a or sb in used_b:
+                continue
+            used_a.add(sa)
+            used_b.add(sb)
+            sel[li, m] = 1
+            taken += 1
+    return sel
+
+
+def walk_parity_benchmark(n_limbs: int = 30, m_cap: int = 128,
+                          k: int = 64, trials: int = 8, iters: int = 20,
+                          interpret: bool = False) -> dict:
+    """Parity + timing of the Pallas candidate walk vs the host
+    reference, on randomized rank-ordered candidate sets.  The single
+    check ``tools/pallas_check.py --assembly`` runs."""
+    import time
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    ok = True
+    fixtures = []
+    for _ in range(trials):
+        slot_a = rng.integers(0, k, (n_limbs, m_cap)).astype(np.int32)
+        slot_b = rng.integers(0, k, (n_limbs, m_cap)).astype(np.int32)
+        counts = rng.integers(0, m_cap + 1, n_limbs)
+        valid = (np.arange(m_cap)[None, :] < counts[:, None])
+        limit = rng.integers(0, k + 1, n_limbs).astype(np.int32)
+        fixtures.append((slot_a, slot_b, valid, limit))
+        got = np.asarray(candidate_walk_pallas(
+            slot_a, slot_b, valid, limit, k, interpret=interpret))
+        want = candidate_walk_reference(slot_a, slot_b, valid, limit)
+        ok = ok and bool((got == want).all())
+
+    slot_a, slot_b, valid, limit = fixtures[0]
+    run = jax.jit(lambda a, b, v, li: candidate_walk_pallas(
+        a, b, v, li, k, interpret=interpret))
+    jax.block_until_ready(run(slot_a, slot_b, valid, limit))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run(slot_a, slot_b, valid, limit)
+    jax.block_until_ready(out)
+    pallas_ms = (time.perf_counter() - t0) / iters * 1e3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        candidate_walk_reference(slot_a, slot_b, valid, limit)
+    host_ms = (time.perf_counter() - t0) / iters * 1e3
+    return {"parity_ok": ok, "pallas_ms": pallas_ms, "host_ms": host_ms,
+            "trials": trials}

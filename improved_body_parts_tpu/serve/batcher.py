@@ -22,13 +22,20 @@ Design:
   traffic).  A bucket flushes when it reaches ``max_batch`` occupancy or
   when its oldest request has waited ``max_wait_ms`` — the classic
   throughput/latency knob pair.
-- **Completion**: the device program is dispatched asynchronously; a
-  decode thread pool (the plumbing shared with
-  ``infer.pipeline.compact_decode_fn``, GIL-released under the native
-  decoder) resolves the single packed transfer and fulfils each
-  request's future with decoded skeletons.  Results always map back to
-  their own request (``predict_compact_batch_async`` returns input
-  order), so arrival order is preserved per caller.
+- **Completion**: the device program is dispatched asynchronously.  On
+  the DEFAULT device-decode lane the program is the FUSED end-to-end
+  decode (``Predictor.predict_decoded_batch_async``: forward + peak
+  top-K + limb candidates + greedy assembly — ``ops.assembly`` — in one
+  XLA program per batch); each request finishes with an O(people)
+  coordinate lookup right on the fetch thread.  The decode thread pool
+  (the plumbing shared with ``infer.pipeline.compact_decode_fn``,
+  GIL-released under the native decoder) is demoted to the overflow
+  fallback — and remains the whole completion stage on the host-pool
+  lane (``device_decode=False``).  ``ServeMetrics`` splits
+  ``decode_fused`` from ``decode_host_fallback`` so the fallback rate
+  is observable.  Results always map back to their own request (batch
+  dispatch returns input order), so arrival order is preserved per
+  caller.
 - **Warmup**: :meth:`warmup` precompiles every configured bucket shape at
   every power-of-two batch size ≤ ``max_batch`` through the persistent
   compilation cache (``utils.platform``), so the first request in each
@@ -103,7 +110,7 @@ class DynamicBatcher:
                  use_native: bool = True, devices: Optional[Sequence] = None,
                  eager_idle_flush: bool = True,
                  metrics: Optional[ServeMetrics] = None,
-                 registry=None):
+                 registry=None, device_decode: bool = True):
         from ..infer.predict import trivial_grid
 
         self.predictor = predictor
@@ -131,6 +138,15 @@ class DynamicBatcher:
             # one exposition path for serve + train: the batcher's
             # counters/reservoirs surface on the shared /metrics endpoint
             self.metrics.register_into(registry)
+        # True (default): dispatch the FUSED device-decode programs —
+        # forward + compact extraction + greedy assembly in one XLA
+        # program per batch; the decode pool is demoted to the overflow
+        # fallback.  False: the pre-fusion host-pool lane (every decode
+        # runs decode_compact on the pool) — the parity/A-B arm.
+        self.device_decode = device_decode
+        # compact_decode_fn serves BOTH lanes: the host-pool lane's
+        # per-request decoder, and the device lane's overflow fallback
+        # (fed the compact records the fused buffer ships alongside)
         self._decode_one = compact_decode_fn(predictor, self.params,
                                              self.skeleton, use_native)
         self._decode_workers = max(1, decode_workers)
@@ -332,7 +348,8 @@ class DynamicBatcher:
         out = None
         for replica in self._replicas:
             info = precompile(replica, image_sizes, self.max_batch,
-                              params=self.params, batch_sizes=batch_sizes)
+                              params=self.params, batch_sizes=batch_sizes,
+                              decode=self.device_decode)
             # replicas share the program cache, so only the first pass
             # reports new programs; the later passes still build/warm
             # each device's executable
@@ -402,16 +419,23 @@ class DynamicBatcher:
         with self._in_flight_lock:
             idx = min(range(len(self._replicas)),
                       key=self._in_flight.__getitem__)
+        replica = self._replicas[idx]
+        if self.device_decode:
+            dispatch_one = replica.predict_decoded_async
+            dispatch_batch = replica.predict_decoded_batch_async
+        else:
+            dispatch_one = replica.predict_compact_async
+            dispatch_batch = replica.predict_compact_batch_async
         try:
             if len(reqs) == 1:
-                # singleton flush: the single-image compact program skips
-                # the batch path's stack/group/concat machinery
-                resolve_one = self._replicas[idx].predict_compact_async(
+                # singleton flush: the single-image program skips the
+                # batch path's stack/group/concat machinery
+                resolve_one = dispatch_one(
                     reqs[0].image, thre1=self.params.thre1,
                     params=self.params)
                 resolve = lambda: [resolve_one()]  # noqa: E731
             else:
-                resolve = self._replicas[idx].predict_compact_batch_async(
+                resolve = dispatch_batch(
                     [r.image for r in reqs], thre1=self.params.thre1,
                     params=self.params)
         except Exception as e:  # noqa: BLE001 — delivered per request
@@ -461,6 +485,21 @@ class DynamicBatcher:
                     trace.flow_finish("serve_req", r.rid, ts=t_exec)
             self._batch_done(idx)
             for r, res in zip(reqs, results):
+                if self.device_decode:
+                    if res.ok:
+                        # fused result: the remaining work is an
+                        # O(people) coordinate lookup — finish INLINE on
+                        # this device-program track (no pool hop; the
+                        # `decode` span lands next to `execute`)
+                        self.metrics.on_decode(fused=True)
+                        self._finish_fused(r, res)
+                        continue
+                    # overflow flag: demote to the host decode pool on
+                    # the compact records the fused buffer shipped
+                    self.metrics.on_decode(fused=False)
+                    res = res.compact
+                else:
+                    self.metrics.on_decode(fused=False)
                 try:
                     self._pool.submit(self._decode_and_finish, r, res)
                 except RuntimeError:  # pool draining (stop()) — inline
@@ -476,9 +515,23 @@ class DynamicBatcher:
         if idle and self._running:
             self._queue.put(_KICK)
 
+    def _finish_fused(self, req: _Request, res) -> None:
+        """Finish one fused device-decode result on the calling (fetch)
+        thread: coordinate lookup + COCO reorder only."""
+        from ..infer.decode import decode_device
+
+        try:
+            with get_tracer().span("decode", args={"rid": req.rid,
+                                                   "lane": "device"}):
+                result = decode_device(res, self.skeleton)
+            self._finish(req, result=result)
+        except Exception as e:  # noqa: BLE001 — delivered per request
+            self._finish(req, error=e)
+
     def _decode_and_finish(self, req: _Request, res) -> None:
         try:
-            with get_tracer().span("decode", args={"rid": req.rid}):
+            with get_tracer().span("decode", args={"rid": req.rid,
+                                                   "lane": "host"}):
                 result = self._decode_one(res, req.image)
             self._finish(req, result=result)
         except Exception as e:  # noqa: BLE001 — delivered per request

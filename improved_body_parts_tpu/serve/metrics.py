@@ -27,7 +27,12 @@ class ServeMetrics:
       at 1 means traffic is too sparse for the configured wait);
     - completion: ``completed`` / ``failed`` counts, a latency reservoir
       (submit → decoded-result, seconds), and the wall-clock window for
-      the imgs/sec readout.
+      the imgs/sec readout;
+    - decode routing: ``decode_fused`` (the request's skeletons came out
+      of the fused device program) vs ``decode_host_fallback`` (an
+      overflow flag routed it to the host decode pool) — the observable
+      fallback rate of the device-decode lane.  The host-pool lane
+      (``device_decode=False``) counts everything as fallback.
     """
 
     def __init__(self, latency_reservoir: int = 4096):
@@ -37,6 +42,8 @@ class ServeMetrics:
         self.rejected = 0
         self.completed = 0
         self.failed = 0
+        self.decode_fused = 0
+        self.decode_host_fallback = 0
         self.depth = 0              # in-flight requests (admitted, not done)
         self.depth_peak = 0
         self.occupancy: Dict[int, int] = {}
@@ -60,6 +67,16 @@ class ServeMetrics:
         with self._lock:
             self.occupancy[batch_size] = self.occupancy.get(
                 batch_size, 0) + 1
+
+    def on_decode(self, fused: bool) -> None:
+        """One request routed to its decode stage: the fused device
+        program's inline finish, or the host decode pool (overflow
+        fallback / host-pool lane)."""
+        with self._lock:
+            if fused:
+                self.decode_fused += 1
+            else:
+                self.decode_host_fallback += 1
 
     def on_complete(self, latency_s: float) -> None:
         with self._lock:
@@ -105,7 +122,9 @@ class ServeMetrics:
             counts = (("submitted", self.submitted),
                       ("rejected", self.rejected),
                       ("completed", self.completed),
-                      ("failed", self.failed))
+                      ("failed", self.failed),
+                      ("decode_fused", self.decode_fused),
+                      ("decode_host_fallback", self.decode_host_fallback))
             depth, peak = self.depth, self.depth_peak
             occupancy = dict(self.occupancy)
             lat = self.latency.summary()   # seconds
@@ -156,6 +175,8 @@ class ServeMetrics:
                 "rejected": self.rejected,
                 "completed": self.completed,
                 "failed": self.failed,
+                "decode_fused": self.decode_fused,
+                "decode_host_fallback": self.decode_host_fallback,
                 "queue_depth": self.depth,
                 "queue_depth_peak": self.depth_peak,
                 "occupancy_histogram": {str(k): v

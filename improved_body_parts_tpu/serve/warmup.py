@@ -31,11 +31,14 @@ def pow2_batch_sizes(max_batch: int) -> Tuple[int, ...]:
 
 def precompile(predictor, image_sizes: Sequence[Tuple[int, int]],
                max_batch: int = 8, params=None,
-               batch_sizes: Optional[Sequence[int]] = None) -> dict:
+               batch_sizes: Optional[Sequence[int]] = None,
+               decode: bool = False) -> dict:
     """Warm one predictor for serving: compile (or cache-load) the
     compact-batch program for every bucket the given (H, W) image sizes
     land in, at every batch size ``max_batch``-occupancy dispatch can
-    emit.  Blocks until all executables exist.
+    emit.  Blocks until all executables exist.  ``decode=True`` warms
+    the FUSED device-decode programs instead — what the batcher's
+    default device-decode lane dispatches.
 
     Returns ``{"bucket_shapes", "batch_sizes", "newly_compiled"}`` —
     ``newly_compiled == 0`` means the predictor was already fully warm
@@ -44,6 +47,7 @@ def precompile(predictor, image_sizes: Sequence[Tuple[int, int]],
     shapes = predictor.enumerate_bucket_shapes(image_sizes, params)
     sizes = (tuple(batch_sizes) if batch_sizes is not None
              else pow2_batch_sizes(max_batch))
-    compiled = predictor.precompile_compact(shapes, sizes, params=params)
+    compiled = predictor.precompile_compact(shapes, sizes, params=params,
+                                            decode=decode)
     return {"bucket_shapes": shapes, "batch_sizes": sizes,
             "newly_compiled": compiled}

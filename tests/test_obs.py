@@ -676,8 +676,12 @@ def instrumented_run(tmp_path_factory):
         train_epoch(None, step, ring.batches(0), cfg, 0, mesh=mesh,
                     print_freq=1, telemetry=tele, log_fn=lambda s: None)
 
+    # host-pool lane: the fake predictor fakes compact payloads and the
+    # decode is stubbed below — the trace contract under test (request
+    # spans, execute, decode, flow arrows) is lane-independent
     batcher = DynamicBatcher(_fake_predictor(), max_batch=4,
-                             max_wait_ms=5, registry=registry)
+                             max_wait_ms=5, registry=registry,
+                             device_decode=False)
     batcher._decode_one = lambda res, img: [res]  # skip real decode
     img = np.zeros((64, 64, 3), np.uint8)
     with batcher:

@@ -375,6 +375,34 @@ def test_registry_has_the_shipped_entry_points(registry_sweep):
         assert required in names
 
 
+def test_fused_decode_programs_registered_with_declared_while():
+    """The fused decode serve programs (PR 9's device-assembly lane)
+    are in the registry, their compiled jaxpr really CONTAINS the
+    assembly kernel's bounded candidate-walk `while`, and PRG005
+    accepts it because the spec DECLARES it — while the identical
+    program under an undeclared spec still flags.  Guards both
+    directions: the declaration can't silently stop covering the
+    kernel, and the check can't silently stop seeing the while."""
+    from improved_body_parts_tpu.analysis.program.registry import (
+        get_program,
+    )
+
+    for name in ("serve_decode_b1", "serve_decode_batch_b2"):
+        spec = get_program(name)
+        assert spec is not None, f"{name} missing from the registry"
+        assert spec.allow_while, f"{name} must declare its bounded while"
+        built = spec.build()
+        info = trace_program(built)
+        assert info.while_count > 0, \
+            f"{name}: the assembly while_loop vanished from the jaxpr"
+        assert "PRG005" not in rules_of(
+            audit_program(spec, level="trace"))
+        undeclared = toy_spec(built.fn, built.args, name=name,
+                              expect_bf16=True)
+        assert "PRG005" in rules_of(
+            audit_program(undeclared, level="trace"))
+
+
 def test_registry_sweep_is_clean(registry_sweep):
     """Zero error findings over every real program the repo ships —
     a new host callback, an f64 leak, a lost donation or an

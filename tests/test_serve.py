@@ -131,6 +131,15 @@ class GatedPredictor:
         self._gate.wait()
         return self._inner.predict_compact_batch_async(*a, **kw)
 
+    # the batcher's default device-decode lane dispatches these instead
+    def predict_decoded_async(self, *a, **kw):
+        self._gate.wait()
+        return self._inner.predict_decoded_async(*a, **kw)
+
+    def predict_decoded_batch_async(self, *a, **kw):
+        self._gate.wait()
+        return self._inner.predict_decoded_batch_async(*a, **kw)
+
 
 # --------------------------------------------------------------------- #
 def test_pow2_batch_sizes():
@@ -310,7 +319,52 @@ def test_compact_overflow_falls_back_to_full_maps(person_maps):
                         use_native=False) as server:
         server.warmup([SIZE_A], batch_sizes=(1, 2))
         got = server.submit(img).result(timeout=120)
+        snap = server.metrics.snapshot()
     _assert_same_people(got, want)
+    # the overflow was served by the demoted host decode pool — and the
+    # split metric makes that fallback observable
+    assert snap["decode_host_fallback"] == 1
+    assert snap["decode_fused"] == 0
+
+
+def test_device_decode_is_the_default_lane(warm_pred):
+    """The default lane runs the FUSED device program end to end: every
+    request finishes inline off the device payload (decode_fused) with
+    zero host-pool fallbacks, and the payload matches the host
+    decoder's people exactly."""
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    ref = _reference(warm_pred, img)
+    with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                        use_native=False) as server:
+        assert server.device_decode
+        server.warmup([SIZE_A], batch_sizes=(1, 2))
+        futs = [server.submit(img) for _ in range(4)]
+        for f in futs:
+            _assert_same_people(f.result(timeout=120), ref)
+        snap = server.metrics.snapshot()
+    assert snap["decode_fused"] == 4
+    assert snap["decode_host_fallback"] == 0
+    assert snap["completed"] == 4
+
+
+def test_host_pool_lane_still_serves(warm_pred):
+    """device_decode=False keeps the pre-fusion decode-pool lane alive
+    (the A/B + parity arm): same people, everything counted as
+    host-pool decode."""
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    ref = _reference(warm_pred, img)
+    with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                        use_native=False,
+                        device_decode=False) as server:
+        server.warmup([SIZE_A], batch_sizes=(1, 2))
+        _assert_same_people(server.submit(img).result(timeout=120), ref)
+        snap = server.metrics.snapshot()
+    assert snap["decode_fused"] == 0
+    assert snap["decode_host_fallback"] == 1
 
 
 def test_warmup_precompiles_every_bucket_program(person_maps):

@@ -58,6 +58,11 @@ def main():
     ap.add_argument("--compact-batch", type=int, default=0,
                     help="throughput mode: N images + mirrors per dispatch, "
                          "shape-bucketed (implies the compact path)")
+    ap.add_argument("--device-decode", action="store_true",
+                    help="fused end-to-end decode: greedy person assembly "
+                         "runs ON DEVICE in the same program as the "
+                         "forward (implies the compact path; overflowing "
+                         "crowds fall back to the host decoder)")
     ap.add_argument("--boxsize", type=int, default=0,
                     help="scale val images so their height maps to this "
                          "network input size (the reference's INI "
@@ -98,6 +103,7 @@ def main():
                                  use_native=not args.no_native,
                                  fast=args.fast, compact=args.compact,
                                  compact_batch=args.compact_batch,
+                                 device_decode=args.device_decode,
                                  dump_name=args.dump_name)
         print("AP:", metrics["AP"])
     else:
@@ -106,7 +112,8 @@ def main():
                                max_images=args.max_images,
                                use_native=not args.no_native,
                                fast=args.fast, compact=args.compact,
-                               compact_batch=args.compact_batch)
+                               compact_batch=args.compact_batch,
+                               device_decode=args.device_decode)
         print("AP:", coco_eval.stats[0])
 
 

@@ -11,6 +11,12 @@ chip is available:
     JAX_PLATFORMS=cpu python tools/pallas_check.py --interpret
 
 Flip ``use_pallas_loss`` default only if the kernel wins on hardware.
+
+``--assembly`` checks the OTHER sketched kernel instead: the decode
+assembly's inner candidate walk (ops/pallas_assembly.py, the Mosaic
+variant of the fused decode program's bounded while_loop) — parity
+against the host reference walk plus timing.  Same rule: wire it into
+``ops.assembly.greedy_assemble`` only if it wins on hardware.
 """
 import argparse
 import os
@@ -28,6 +34,10 @@ def main():
     ap.add_argument("--iters", type=int, default=30)
     ap.add_argument("--interpret", action="store_true",
                     help="Pallas interpreter mode (CPU debugging)")
+    ap.add_argument("--assembly", action="store_true",
+                    help="check the decode-assembly candidate-walk "
+                         "kernel (ops/pallas_assembly.py) instead of "
+                         "the focal loss")
     args = ap.parse_args()
     if args.iters < 1:
         ap.error("--iters must be >= 1")
@@ -43,6 +53,20 @@ def main():
     except (RuntimeError, TimeoutError) as e:
         raise SystemExit(str(e))
     print(f"platform={platform} interpret={args.interpret}")
+
+    if args.assembly:
+        from improved_body_parts_tpu.ops.pallas_assembly import (
+            walk_parity_benchmark,
+        )
+
+        r = walk_parity_benchmark(iters=args.iters,
+                                  interpret=args.interpret)
+        print(f"candidate walk: pallas {r['pallas_ms']:7.3f} ms   "
+              f"host reference {r['host_ms']:7.3f} ms "
+              f"({r['trials']} randomized parity trials)")
+        print(f"parity {'OK' if r['parity_ok'] else 'FAIL'}; wire into "
+              "greedy_assemble only if the Mosaic lowering wins on TPU")
+        sys.exit(0 if r["parity_ok"] else 1)
 
     r = parity_benchmark(stacks=args.stacks, batch=args.batch, hw=args.hw,
                          channels=args.channels, iters=args.iters,

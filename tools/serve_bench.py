@@ -18,7 +18,11 @@ overlapping decode with the next batch's forward.
 
 Writes SERVE_BENCH.json: imgs/sec, p50/p95/p99 latency, mean batch
 occupancy and the full occupancy histogram per offered load, plus the
-batched-vs-sequential verdict at the highest load.
+batched-vs-sequential verdict at the highest load — and the
+device-decode vs host-pool A/B (``decode_ab``): the batcher's default
+fused lane (forward + greedy assembly in ONE device program,
+``ops.assembly``) against the pre-fusion decode-thread-pool lane,
+interleaved rounds, median per-round ratio verdict.
 
     python tools/serve_bench.py --clients 1,4,8 --requests 12 \
         --out SERVE_BENCH.json
@@ -115,7 +119,7 @@ def bench_sequential(pred, decode_one, images, n_clients, requests,
 
 
 def make_server(pred, params, args, use_native, n_clients, devices=None,
-                registry=None):
+                registry=None, device_decode=True):
     from improved_body_parts_tpu.serve import DynamicBatcher
 
     # auto: one decode lane per client, but never more threads than
@@ -128,7 +132,55 @@ def make_server(pred, params, args, use_native, n_clients, devices=None,
                           decode_workers=workers,
                           eager_idle_flush=not args.occupancy_first,
                           use_native=use_native, devices=devices,
-                          registry=registry)
+                          registry=registry, device_decode=device_decode)
+
+
+def bench_decode_ab(pred, params, images, sizes, n_clients, requests,
+                    args, use_native, devices, rounds):
+    """Device-decode lane vs host-pool lane, interleaved A/B rounds.
+
+    The two arms are the SAME batcher configuration differing only in
+    ``device_decode`` — fused on-device assembly + inline finish vs the
+    decode thread pool.  Rounds alternate device/host slices and the
+    verdict is the median per-round ratio (the standing ROADMAP bench
+    protocol: slow host drift hits both arms of a round equally, and
+    the median ignores the one round a cron job stole).
+    """
+    out = {"rounds": rounds, "clients": n_clients,
+           "requests_per_round": n_clients * requests,
+           "note": "On a CPU host both lanes share the same few cores, "
+                   "so the fused lane's win is the freed decode-pool "
+                   "CPU only; on-chip the assembly rides the idle "
+                   "accelerator while the host pool serialized on the "
+                   "GIL — the margin is expected to widen there.",
+           "device_imgs_per_sec": [], "host_pool_imgs_per_sec": []}
+    with make_server(pred, params, args, use_native, n_clients,
+                     devices=devices, device_decode=True) as dev_srv, \
+            make_server(pred, params, args, use_native, n_clients,
+                        devices=devices, device_decode=False) as host_srv:
+        dev_srv.warmup(sizes)
+        host_srv.warmup(sizes)
+        for _ in range(rounds):
+            dev = run_serve_slice(dev_srv, images, n_clients, requests)
+            host = run_serve_slice(host_srv, images, n_clients, requests)
+            out["device_imgs_per_sec"].append(dev["imgs_per_sec"])
+            out["host_pool_imgs_per_sec"].append(host["imgs_per_sec"])
+            print(f"decode round: device {dev['imgs_per_sec']} vs "
+                  f"host-pool {host['imgs_per_sec']} imgs/s", flush=True)
+        snap = dev_srv.metrics.snapshot()
+        out["device_p95_ms"] = dev["latency_ms"]["p95"]
+        out["host_pool_p95_ms"] = host["latency_ms"]["p95"]
+    ratios = sorted(d / h for d, h in zip(out["device_imgs_per_sec"],
+                                          out["host_pool_imgs_per_sec"]))
+    out["per_round_ratio"] = [round(r, 3) for r in ratios]
+    out["median_round_ratio"] = round(ratios[len(ratios) // 2], 3)
+    out["device_decode_beats_host_pool"] = bool(
+        out["median_round_ratio"] > 1.0)
+    # the observable fallback rate: every request the fused lane served
+    # inline vs demoted to the pool (capacity overflows)
+    out["decode_fused"] = snap["decode_fused"]
+    out["decode_host_fallback"] = snap["decode_host_fallback"]
+    return out
 
 
 def run_serve_slice(server, images, n_clients, requests):
@@ -189,6 +241,18 @@ def main():
                     help="alternating sequential/serve verdict rounds — "
                          "interleaving makes the comparison robust to "
                          "host load drift between arms")
+    ap.add_argument("--decode-rounds", type=int, default=0,
+                    help="device-decode vs host-pool A/B rounds "
+                         "(0 = same as --rounds)")
+    ap.add_argument("--no-decode-ab", action="store_true",
+                    help="skip the device-decode vs host-pool A/B "
+                         "(bench.py's full-serve key passes this: the "
+                         "A/B has its own budget-gated 'decode' key)")
+    ap.add_argument("--decode-only", action="store_true",
+                    help="run ONLY the device-decode vs host-pool A/B "
+                         "(bench.py's budget-bounded 'decode' key); "
+                         "skips the sequential baselines, the load "
+                         "sweep and the batched-vs-sequential verdict")
     ap.add_argument("--max-batch", type=int, default=8)
     ap.add_argument("--max-wait-ms", type=float, default=100.0,
                     help="coalescing deadline (the idle-device flush "
@@ -333,6 +397,36 @@ def main():
 
     decode_one = compact_decode_fn(pred, params, use_native=use_native)
 
+    # --- device-decode vs host-pool A/B (interleaved rounds) ----------
+    n_peak = max(int(c) for c in args.clients.split(","))
+    if args.decode_only and args.no_decode_ab:
+        ap.error("--decode-only and --no-decode-ab are contradictory")
+    if not args.no_decode_ab:
+        decode_rounds = args.decode_rounds or max(1, args.rounds)
+        report["decode_ab"] = bench_decode_ab(
+            pred, params, images, size_list, n_peak, args.requests, args,
+            use_native, serve_devices, decode_rounds)
+        flush()
+        telemetry.emit("decode_ab", **{
+            k: report["decode_ab"][k]
+            for k in ("median_round_ratio",
+                      "device_decode_beats_host_pool",
+                      "decode_fused", "decode_host_fallback")})
+        print(f"decode A/B: median ratio "
+              f"{report['decode_ab']['median_round_ratio']} "
+              f"(fused {report['decode_ab']['decode_fused']}, fallback "
+              f"{report['decode_ab']['decode_host_fallback']})",
+              flush=True)
+    if args.decode_only:
+        telemetry.close()
+        flush()
+        print(strict_dumps({"device_decode_beats_host_pool":
+                            report["decode_ab"][
+                                "device_decode_beats_host_pool"],
+                            "median_round_ratio":
+                            report["decode_ab"]["median_round_ratio"]}))
+        return
+
     # --- offered-load sweep (context curve) ---------------------------
     for mode, key in (("overlap", "sequential_overlapped"),
                       ("concurrent", "sequential_concurrent")):
@@ -364,7 +458,6 @@ def main():
     # alternating A/B/A/B slices and per-arm TOTALS: slow host drift
     # (shared cores, other tenants) hits both arms equally instead of
     # whichever arm happened to run in the bad minute
-    n_peak = max(int(c) for c in args.clients.split(","))
     seq_rounds, serve_rounds = [], []
     # the verdict server registers into the run registry: its counters/
     # latency reservoir surface on /metrics (when --telemetry-port is
