@@ -247,6 +247,61 @@ def _decode_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _stream_summary(fallback, budget_s):
+    """Run tools/stream_bench.py (the multi-stream streaming workload:
+    N simulated webcams, each an ordered StreamSession pipeline over one
+    engine, interleaved multi/single verdict rounds) and return a
+    compact summary, or an {"error"/"skipped"} marker — the
+    "serve"/"decode" key contract.  Subprocess so a streaming failure
+    can never take down the primary metric; bounded by the REMAINING
+    driver budget.  ``IBP_BENCH_STREAM=0`` skips it unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_STREAM") == "0":
+        return {"skipped": "IBP_BENCH_STREAM=0"}
+    if budget_s < 180:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (STREAM_BENCH.json has the full run)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="stream_bench_"),
+                       "STREAM_BENCH.json")
+    if fallback:
+        # CPU smoke: tiny model at a small frame size, one round — the
+        # committed STREAM_BENCH.json carries the 512-class protocol run
+        argv = ["--config", "tiny", "--size", "128", "--boxsize", "128",
+                "--streams", "4", "--frames", "6", "--video-frames", "6",
+                "--rounds", "1", "--planted", "1", "--max-batch", "4"]
+        timeout = min(600, budget_s)
+    else:
+        argv = ["--config", "canonical", "--size", "512",
+                "--streams", "4", "--frames", "8", "--video-frames", "8",
+                "--rounds", "2", "--planted", "2", "--max-batch", "8"]
+        timeout = min(900, budget_s)
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "stream_bench.py"),
+             "--out", out] + argv,
+            capture_output=True, timeout=timeout, check=True,
+            env=dict(os.environ))
+        with open(out) as f:
+            r = json.load(f)
+        return {
+            "streams": r["streams"],
+            "all_streams_sustained": r["all_streams_sustained"],
+            "min_stream_fps": r["min_stream_fps"],
+            "per_stream_fps": r["per_stream_fps"],
+            "per_stream_p95_ms": r["per_stream_p95_ms"],
+            "frames_dropped_total": r["frames_dropped_total"],
+            "median_scaling_ratio": r["median_scaling_ratio"],
+            "track_ids_stable": r["track_ids_stable_all_rounds"],
+            "recompiles_post_warmup": r["recompiles_post_warmup"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def _feed_rate_summary(fallback, budget_s):
     """Run tools/feed_rate.py (sync vs shm-worker input feed rate) and
     return a compact summary for the bench line, or an {"error"/"skipped"}
@@ -572,6 +627,10 @@ def main():
     # fused device decode vs host decode pool, same budget discipline
     decode = _decode_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # multi-stream streaming workload (sessions + tracker), same
+    # discipline
+    stream = _stream_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     # input feed rate (sync vs shm workers), same budget discipline
     feed = _feed_rate_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
@@ -602,6 +661,7 @@ def main():
         "vs_baseline": round(fps / BASELINE_FPS, 3),
         "serve": serve,
         "decode": decode,
+        "stream": stream,
         "feed": feed,
         "telemetry": telemetry,
         "ckpt": ckpt,

@@ -9,8 +9,9 @@ readback_freq: float(...)``), which is exactly the shape this rule
 passes: a sync guarded by an ``if`` inside the loop runs once per
 window, not once per iteration.
 
-Scope: files under ``improved_body_parts_tpu/{train,serve,infer}`` —
-the per-batch hot paths.  A sync is flagged when all of:
+Scope: files under ``improved_body_parts_tpu/{train,serve,infer,
+stream}`` — the per-batch/per-frame hot paths.  A sync is flagged when
+all of:
 
 - it is a host-sync operation (``float()``, ``int()``, ``.item()``,
   ``.tolist()``, ``np.asarray()``, ``jax.device_get()``,
@@ -41,7 +42,10 @@ class HiddenHostSync(Rule):
 
     SCOPE = ("improved_body_parts_tpu/train",
              "improved_body_parts_tpu/serve",
-             "improved_body_parts_tpu/infer")
+             "improved_body_parts_tpu/infer",
+             # the streaming sessions run per-frame on serve threads —
+             # the same hot-path discipline applies
+             "improved_body_parts_tpu/stream")
 
     def check(self, ctx: ModuleContext) -> None:
         if not ctx.under(*self.SCOPE):

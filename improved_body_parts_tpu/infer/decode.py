@@ -398,6 +398,24 @@ class DeviceDecoded(NamedTuple):
                     or self.person_overflow)
 
 
+def device_subset_candidate(dev: "DeviceDecoded"
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    """(subset, candidate) from a fused device decode, in the host
+    decoder's array convention: the pruned person table (float64) plus a
+    candidate array indexed by the kernel's flat slot ids
+    (``channel * K + slot``), coordinates scaled back to original-image
+    space.  Drawing (``infer.demo.draw_skeletons``) and
+    :func:`subsets_to_keypoints` both consume this pair directly."""
+    pk = dev.compact.peaks
+    sx, sy = dev.compact.coord_scale
+    candidate = np.stack(
+        [pk.x_ref.ravel().astype(np.float64) * sx,
+         pk.y_ref.ravel().astype(np.float64) * sy,
+         pk.score.ravel().astype(np.float64),
+         np.arange(pk.score.size, dtype=np.float64)], axis=1)
+    return dev.subset[dev.mask].astype(np.float64), candidate
+
+
 def decode_device(dev: "DeviceDecoded", skeleton: SkeletonConfig
                   ) -> List[Tuple[List[Optional[Tuple[float, float]]],
                                   float]]:
@@ -414,15 +432,8 @@ def decode_device(dev: "DeviceDecoded", skeleton: SkeletonConfig
     .device_decode_fn`` wraps this with the documented overflow
     fallback); decoding an overflowed result would silently drop people.
     """
-    pk = dev.compact.peaks
-    sx, sy = dev.compact.coord_scale
-    candidate = np.stack(
-        [pk.x_ref.ravel().astype(np.float64) * sx,
-         pk.y_ref.ravel().astype(np.float64) * sy,
-         pk.score.ravel().astype(np.float64),
-         np.arange(pk.score.size, dtype=np.float64)], axis=1)
-    return subsets_to_keypoints(dev.subset[dev.mask].astype(np.float64),
-                                candidate, skeleton)
+    subset, candidate = device_subset_candidate(dev)
+    return subsets_to_keypoints(subset, candidate, skeleton)
 
 
 def decode_compact(compact: CompactResult, params: InferenceParams,

@@ -80,18 +80,48 @@ def limb_flow_bgr(limb_map: np.ndarray) -> np.ndarray:
 
 def run_demo(predictor: Predictor, image_path: str, output_path: str,
              params: Optional[InferenceParams] = None,
-             use_native: bool = True) -> Tuple[np.ndarray, list]:
+             use_native: bool = True,
+             device_decode: bool = False) -> Tuple[np.ndarray, list]:
     """Full demo (reference: demo_image.py __main__): returns (canvas,
-    results) and writes the rendering to ``output_path``."""
-    from .decode import assemble
+    results) and writes the rendering to ``output_path``.
 
-    params = params or default_inference_params()[0]
+    ``device_decode=True`` runs the FUSED end-to-end lane instead
+    (``Predictor.predict_decoded``: forward + compact extraction +
+    greedy assembly in ONE device program) and draws straight off the
+    device person table; an overflowed frame (too many peaks/candidates/
+    people for the compiled capacities) falls back to the host ensemble
+    path — the lane actually used is reported as a ``demo_decode``
+    event through the process sink, stdout when none is installed (this
+    module is a CLI entry point, the JGL007-exempt class).
+    """
+    from ..obs.events import get_sink
+    from .decode import assemble, device_subset_candidate
+
+    # the predictor's own grid, not the module default: a Predictor
+    # built with a custom scale/rotation grid must demo with it
+    params = params or getattr(predictor, "params", None) \
+        or default_inference_params()[0]
     image = cv2.imread(image_path)
     if image is None:
         raise IOError(f"cannot read {image_path}")
     sk = predictor.skeleton
-    heat, paf = predictor.predict(image)
-    subset, candidate = assemble(heat, paf, params, sk, use_native)
+    lane = "host"
+    if device_decode:
+        dev = predictor.predict_decoded(image, params=params)
+        if dev.ok:
+            lane = "device"
+            subset, candidate = device_subset_candidate(dev)
+        else:
+            lane = "host_fallback"      # capacity overflow: degrade
+    if lane != "device":
+        heat, paf = predictor.predict(image, params=params)
+        subset, candidate = assemble(heat, paf, params, sk, use_native)
+    if device_decode:
+        sink = get_sink()
+        if sink.enabled:
+            sink.emit("demo_decode", lane=lane, people=len(subset))
+        else:
+            print(f"decode lane: {lane} ({len(subset)} people)")
     canvas = draw_skeletons(image, subset, candidate, sk)
     cv2.imwrite(output_path, canvas)
     return canvas, (subset, candidate)

@@ -1,0 +1,37 @@
+"""Streaming video pose tracking: stateful per-stream sessions on top of
+the serving engine.
+
+Every workload below this package is an independent single image; real
+pose traffic is video.  This package adds the stateful layer (ROADMAP
+open item 4): per-stream ordered sessions over ``serve.DynamicBatcher``
+(``session``), temporal track identity via frame-to-frame OKS matching
+(``track``), optional confidence-gated temporal smoothing (``smooth``)
+and a deterministic synthetic video generator (``synth``) that makes
+tracker correctness a gateable number instead of an eyeballed demo.
+"""
+from .session import FrameDropped, SessionManager, StreamMetrics, StreamSession
+from .smooth import KeypointSmoother, jitter_rms, keypoint_sequence_jitter
+from .synth import SyntheticVideo
+from .track import (
+    IdentitySwitchCounter,
+    Track,
+    TrackedPerson,
+    Tracker,
+    keypoint_similarity,
+)
+
+__all__ = [
+    "FrameDropped",
+    "IdentitySwitchCounter",
+    "KeypointSmoother",
+    "SessionManager",
+    "StreamMetrics",
+    "StreamSession",
+    "SyntheticVideo",
+    "Track",
+    "TrackedPerson",
+    "Tracker",
+    "jitter_rms",
+    "keypoint_sequence_jitter",
+    "keypoint_similarity",
+]

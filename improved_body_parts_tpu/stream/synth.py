@@ -1,0 +1,190 @@
+"""Deterministic synthetic video: moving planted stick people, reusing
+the SYNTH fixture machinery (``data.fixture``).
+
+Tracker correctness must be a gateable number, not an eyeballed demo.
+This generator produces, for a given seed, an exactly reproducible
+sequence of frames with known per-person identity:
+
+- each person is a ``data.fixture.synthetic_person`` stick figure (the
+  same figures the learnable SYNTH corpus renders, so a trained/planted
+  model can genuinely detect them);
+- motion is constant-velocity with edge bounce; the **non-crossing**
+  protocol confines each person to a private horizontal band (their
+  bounding boxes can never overlap — any identity switch on this suite
+  is a tracker bug, which is what lets tier-1 assert exactly 0);
+- the **crossing** protocol (``crossing=True``) puts exactly two people
+  at the same height moving through each other — the ambiguous case
+  where a bounded number of switches is the honest spec;
+- ``detections()`` derives decoder-shaped output (17 COCO-order
+  keypoints + score) straight from the ground truth with seeded noise /
+  dropout / order shuffling, so the tracker and smoother gates run in
+  milliseconds without a model or a device.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .track import Keypoints
+
+
+class SyntheticVideo:
+    """One deterministic stream of moving stick people.
+
+    ::
+
+        vid = SyntheticVideo(seed=0, num_people=3, num_frames=60)
+        img = vid.frame(t)          # BGR uint8, rendered figures
+        gt = vid.gt(t)              # [(person_id, (17,3) joints), ...]
+        dets = vid.detections(t, noise=1.5)   # decoder-shaped output
+
+    ``frame``/``gt``/``detections`` are pure functions of
+    ``(constructor args, t)`` — any frame can be generated in any order,
+    which is what lets N bench streams share one generator class without
+    shared state.
+    """
+
+    def __init__(self, seed: int = 0, num_people: int = 2,
+                 size: Tuple[int, int] = (240, 320), num_frames: int = 60,
+                 crossing: bool = False, image_size: int = 512,
+                 speed: float = 3.0, appear_at: Optional[Dict[int, int]]
+                 = None, leave_at: Optional[Dict[int, int]] = None):
+        from ..data.fixture import synthetic_person
+
+        if crossing and num_people != 2:
+            raise ValueError("crossing protocol is defined for exactly "
+                             f"2 people, got {num_people}")
+        self.seed = int(seed)
+        self.num_people = int(num_people)
+        self.h, self.w = size
+        self.num_frames = int(num_frames)
+        self.crossing = crossing
+        self.speed = float(speed)
+        # person_id -> first/last frame the person is on canvas (bench
+        # churn + track birth/death tests); default: whole stream
+        self.appear_at = dict(appear_at or {})
+        self.leave_at = dict(leave_at or {})
+        rng = np.random.default_rng(self.seed)
+        self._base: List[np.ndarray] = []      # (17, 3) centered joints
+        self._start: List[np.ndarray] = []     # (2,) figure center at t=0
+        self._vel: List[np.ndarray] = []       # (2,) px/frame
+        self._half: List[np.ndarray] = []      # (2,) half extent (x, y)
+        if crossing:
+            bands = [(0.1, 0.9), (0.1, 0.9)]   # shared band: paths cross
+        else:
+            # private horizontal bands, one per person: boxes never meet
+            edges = np.linspace(0.02, 0.98, self.num_people + 1)
+            bands = [(edges[i], edges[i + 1])
+                     for i in range(self.num_people)]
+        for pid in range(self.num_people):
+            y0, y1 = bands[pid]
+            band_h = (y1 - y0) * self.h
+            p = synthetic_person(rng, self.w, max(int(band_h), 24),
+                                 image_size, all_visible=True)
+            joints = np.asarray(p["joint"], dtype=np.float64)
+            center = np.array([joints[:, 0].mean(), joints[:, 1].mean()])
+            base = joints.copy()
+            base[:, 0] -= center[0]
+            base[:, 1] -= center[1]
+            half = np.array([
+                max(np.abs(base[:, 0]).max(), 1.0) + 3.0,
+                max(np.abs(base[:, 1]).max(), 1.0) + 3.0])
+            cy = (y0 * self.h + band_h / 2.0)
+            if crossing:
+                # two people at the SAME height, opposite horizontal
+                # velocities, starting at opposite edges: they meet and
+                # pass through each other mid-sequence
+                cx = half[0] + 2.0 if pid == 0 else self.w - half[0] - 2.0
+                v = np.array([self.speed if pid == 0 else -self.speed, 0.0])
+                cy = self.h / 2.0
+            else:
+                cx = float(rng.uniform(half[0], self.w - half[0]))
+                direction = 1.0 if rng.uniform() < 0.5 else -1.0
+                v = np.array([direction * self.speed
+                              * float(rng.uniform(0.7, 1.3)), 0.0])
+            self._base.append(base)
+            self._start.append(np.array([cx, cy]))
+            self._vel.append(v)
+            self._half.append(half)
+
+    # ---------------------------------------------------------- geometry
+    def _center(self, pid: int, t: int) -> np.ndarray:
+        """Figure center at frame ``t``: constant velocity, reflecting
+        off the canvas edges (triangle-wave fold — stateless in t)."""
+        c = self._start[pid] + self._vel[pid] * t
+        out = c.copy()
+        for axis in (0, 1):
+            lo = self._half[pid][axis]
+            hi = (self.w if axis == 0 else self.h) - self._half[pid][axis]
+            span = max(hi - lo, 1.0)
+            x = (c[axis] - lo) % (2.0 * span)
+            out[axis] = lo + (x if x <= span else 2.0 * span - x)
+        return out
+
+    def present(self, pid: int, t: int) -> bool:
+        return (self.appear_at.get(pid, 0) <= t
+                < self.leave_at.get(pid, self.num_frames))
+
+    def joints(self, pid: int, t: int) -> np.ndarray:
+        """(17, 3) absolute joints (fixture visibility codes) at ``t``."""
+        j = self._base[pid].copy()
+        c = self._center(pid, t)
+        j[:, 0] += c[0]
+        j[:, 1] += c[1]
+        return j
+
+    # ------------------------------------------------------------ frames
+    def frame(self, t: int) -> np.ndarray:
+        """BGR uint8 frame ``t``: low-amplitude noise background (seeded
+        per frame — deterministic) + the present figures rendered with
+        the fixture's learnable draw protocol."""
+        from ..data.fixture import draw_person
+
+        rng = np.random.default_rng((self.seed, 977, t))
+        img = rng.integers(0, 64, (self.h, self.w, 3), dtype=np.uint8)
+        for pid in range(self.num_people):
+            if self.present(pid, t):
+                draw_person(img, self.joints(pid, t))
+        return img
+
+    def frames(self) -> List[np.ndarray]:
+        return [self.frame(t) for t in range(self.num_frames)]
+
+    def gt(self, t: int) -> List[Tuple[int, Keypoints]]:
+        """Ground truth for frame ``t``: (person_id, 17 COCO-order
+        keypoints) per present person — the ``IdentitySwitchCounter``
+        input shape."""
+        out = []
+        for pid in range(self.num_people):
+            if not self.present(pid, t):
+                continue
+            j = self.joints(pid, t)
+            out.append((pid, [(float(x), float(y)) for x, y, _ in j]))
+        return out
+
+    def detections(self, t: int, noise: float = 0.0,
+                   drop_joint_p: float = 0.0, shuffle: bool = True
+                   ) -> List[Tuple[Keypoints, float]]:
+        """Decoder-shaped detections for frame ``t``, derived from GT:
+        per-joint Gaussian ``noise`` (px), per-joint dropout probability
+        ``drop_joint_p`` (emitted as ``None`` — the occlusion gate's
+        food), and person-order shuffling (a tracker keying on list
+        order instead of geometry fails the gates immediately).  Seeded
+        by ``(seed, t)`` — deterministic, frame-order independent."""
+        rng = np.random.default_rng((self.seed, 1297, t))
+        people = []
+        for pid, coords in self.gt(t):
+            kps: Keypoints = []
+            for x, y in coords:
+                if drop_joint_p > 0.0 and rng.uniform() < drop_joint_p:
+                    kps.append(None)
+                    continue
+                kps.append((float(x + rng.normal(0.0, noise)),
+                            float(y + rng.normal(0.0, noise)))
+                           if noise > 0.0 else (x, y))
+            people.append((kps, float(1.0 - 0.01 * pid)))
+        if shuffle and len(people) > 1:
+            order = rng.permutation(len(people))
+            people = [people[i] for i in order]
+        return people

@@ -1,0 +1,604 @@
+"""Streaming subsystem tests (``improved_body_parts_tpu.stream``).
+
+Two tiers:
+
+- **Tracker / smoother gates** (pure NumPy, no device): the synthetic
+  video suite makes tracker correctness a gateable number — exactly 0
+  identity switches on clean non-crossing streams, bounded switches on
+  the crossing pair, and a measured jitter reduction from the smoothing
+  filter (the ISSUE 10 acceptance criteria, asserted here in tier-1).
+- **Session lifecycle** over a real ``DynamicBatcher`` driven by the
+  constant-maps stub predictor (the ``test_serve`` pattern): in-order
+  delivery, drop-oldest vs block backpressure semantics, per-stream
+  obs wiring, and close-during-batcher-drain (every submitted future
+  still completes).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.stream import (
+    IdentitySwitchCounter,
+    KeypointSmoother,
+    SyntheticVideo,
+    Tracker,
+    keypoint_sequence_jitter,
+    keypoint_similarity,
+)
+from improved_body_parts_tpu.stream.track import _to_arrays, greedy_match
+
+# --------------------------------------------------------------------- #
+# tracker gates (the acceptance numbers)                                #
+# --------------------------------------------------------------------- #
+
+
+def _run_tracker(vid, noise=1.0, max_age=5, frames=None):
+    tracker = Tracker(max_age=max_age)
+    counter = IdentitySwitchCounter()
+    for t in range(frames if frames is not None else vid.num_frames):
+        tracked = tracker.update(vid.detections(t, noise=noise))
+        counter.update(vid.gt(t), tracked)
+    return tracker, counter
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_non_crossing_streams_zero_identity_switches(seed):
+    """THE tracker gate: on clean non-crossing synthetic streams (each
+    person confined to a private band — boxes can never meet) the
+    tracker must produce exactly 0 identity switches, with noisy,
+    order-shuffled detections."""
+    vid = SyntheticVideo(seed=seed, num_people=3, num_frames=40)
+    tracker, counter = _run_tracker(vid, noise=1.5)
+    assert counter.switches == 0
+    assert counter.matched_frames == 3 * 40      # every person, every frame
+    assert tracker.births == 3 and tracker.deaths == 0
+    assert tracker.active == 3
+
+
+def test_crossing_pair_bounded_switches():
+    """Two people walking through each other is the genuinely ambiguous
+    case: the honest spec is a BOUNDED switch count (one crossing can
+    cost at most one swap = 2 per-person switches), not zero."""
+    for seed in range(5):
+        vid = SyntheticVideo(seed=seed, num_people=2, num_frames=80,
+                             crossing=True)
+        tracker, counter = _run_tracker(vid, noise=1.0)
+        assert counter.switches <= 2, f"seed {seed}: {counter.switches}"
+        assert tracker.births == 2       # the crossing never births ghosts
+
+
+def test_track_birth_death_churn_and_monotonic_ids():
+    """A person leaving kills their track after max_age misses (a
+    death); one appearing mid-stream births a NEW monotonically
+    assigned id — ids are never reused."""
+    vid = SyntheticVideo(seed=3, num_people=2, num_frames=60,
+                         appear_at={1: 20}, leave_at={0: 40})
+    tracker = Tracker(max_age=3)
+    seen_ids = []
+    for t in range(60):
+        for p in tracker.update(vid.detections(t, noise=0.5)):
+            if p.track_id not in seen_ids:
+                seen_ids.append(p.track_id)
+    assert tracker.births == 2 and tracker.deaths == 1
+    assert tracker.active == 1
+    assert seen_ids == sorted(seen_ids)          # monotonic assignment
+    snap = tracker.snapshot()
+    assert snap["births"] == 2 and snap["deaths"] == 1
+
+
+def test_reappearance_after_death_is_a_new_id():
+    vid = SyntheticVideo(seed=4, num_people=1, num_frames=30)
+    tracker = Tracker(max_age=1)
+    first = tracker.update(vid.detections(0))[0].track_id
+    for _ in range(3):                           # long gap: track dies
+        tracker.update([])
+    second = tracker.update(vid.detections(10))[0].track_id
+    assert tracker.deaths == 1
+    assert second > first
+
+
+def test_keypoint_similarity_basics():
+    vid = SyntheticVideo(seed=0, num_people=1, num_frames=4)
+    kps = vid.gt(0)[0][1]
+    xy, valid = _to_arrays(kps)
+    assert keypoint_similarity(xy, valid, xy, valid) == pytest.approx(1.0)
+    # no shared joints -> 0
+    half_a = [c if i < 8 else None for i, c in enumerate(kps)]
+    half_b = [c if i >= 8 else None for i, c in enumerate(kps)]
+    xa, va = _to_arrays(half_a)
+    xb, vb = _to_arrays(half_b)
+    assert keypoint_similarity(xa, va, xb, vb) == 0.0
+    # a far-away pose is dissimilar
+    far = [(x + 500.0, y + 500.0) for x, y in kps]
+    xf, vf = _to_arrays(far)
+    assert keypoint_similarity(xy, valid, xf, vf) < 1e-6
+
+
+def test_greedy_match_deterministic_tie_break():
+    sim = np.array([[0.9, 0.9], [0.9, 0.9]])
+    # all tied: lowest ref index takes lowest det index first
+    assert greedy_match(sim, 0.5) == [(0, 0), (1, 1)]
+    assert greedy_match(np.zeros((2, 2)), 0.5) == []
+    assert greedy_match(np.zeros((0, 3)), 0.5) == []
+
+
+def test_identity_switch_counter_counts_a_forced_swap():
+    vid = SyntheticVideo(seed=0, num_people=2, num_frames=4)
+    counter = IdentitySwitchCounter()
+    from improved_body_parts_tpu.stream.track import TrackedPerson
+
+    def as_tracked(t, ids):
+        return [TrackedPerson(tid, coords, 1.0, 0)
+                for tid, (_, coords) in zip(ids, vid.gt(t))]
+
+    counter.update(vid.gt(0), as_tracked(0, [1, 2]))
+    assert counter.switches == 0
+    counter.update(vid.gt(1), as_tracked(1, [1, 2]))
+    assert counter.switches == 0
+    counter.update(vid.gt(2), as_tracked(2, [2, 1]))   # the swap
+    assert counter.switches == 2
+    counter.update(vid.gt(3), as_tracked(3, [2, 1]))   # stable again
+    assert counter.switches == 2
+
+
+def test_tracker_validation():
+    with pytest.raises(ValueError, match="max_age"):
+        Tracker(max_age=-1)
+    with pytest.raises(ValueError, match="min_similarity"):
+        Tracker(min_similarity=0.0)
+
+
+# --------------------------------------------------------------------- #
+# smoothing gates                                                       #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("mode", ["one_euro", "ema"])
+def test_smoothing_measurably_reduces_jitter(mode):
+    """THE smoothing gate: on the same clean synthetic suite, the filter
+    must measurably reduce the per-track jitter metric (RMS second
+    difference — constant velocity cancels, detection noise remains)."""
+    reductions = []
+    for seed in range(3):
+        vid = SyntheticVideo(seed=seed, num_people=1, num_frames=50)
+        tracker = Tracker()
+        smoother = KeypointSmoother(mode=mode, fps=30.0)
+        raw_seq, smooth_seq = [], []
+        for t in range(50):
+            p = tracker.update(vid.detections(t, noise=2.0))[0]
+            raw_seq.append(p.keypoints)
+            smooth_seq.append(smoother.apply(p.track_id, p.keypoints, t))
+        raw = keypoint_sequence_jitter(raw_seq)
+        smoothed = keypoint_sequence_jitter(smooth_seq)
+        assert raw > 0.0
+        reductions.append(smoothed / raw)
+    # "measurably": at least 30% jitter reduction on every seed
+    assert max(reductions) < 0.7, reductions
+
+
+def test_occlusion_gate_resets_instead_of_dragging():
+    """A joint reappearing after > reset_after missed frames must come
+    back EXACTLY where it was detected — not dragged from its stale
+    pre-occlusion position."""
+    sm = KeypointSmoother(mode="one_euro", reset_after=2)
+    kp = [None] * 17
+    kp[0] = (10.0, 10.0)
+    for t in range(5):
+        sm.apply(7, kp, t)
+    gap = [None] * 17
+    out = sm.apply(7, gap, 5)
+    assert out[0] is None                        # absent stays absent
+    far = [None] * 17
+    far[0] = (300.0, 120.0)
+    out = sm.apply(7, far, 12)                   # 7 frames later
+    assert out[0] == (300.0, 120.0)
+    # a SHORT gap (<= reset_after) keeps smoothing: output between the
+    # old filtered position and the new sample
+    out2 = sm.apply(7, [(310.0, 120.0)] + [None] * 16, 14)
+    assert 300.0 < out2[0][0] < 310.0
+
+
+def test_smoother_retain_frees_dead_track_state():
+    sm = KeypointSmoother()
+    kp = [(1.0, 2.0)] + [None] * 16
+    sm.apply(1, kp, 0)
+    sm.apply(2, kp, 0)
+    assert sm.tracked_joints == 2
+    sm.retain([2])
+    assert sm.tracked_joints == 1
+    sm.forget(2)
+    assert sm.tracked_joints == 0
+
+
+def test_smoother_validation():
+    with pytest.raises(ValueError, match="mode"):
+        KeypointSmoother(mode="kalman")
+    with pytest.raises(ValueError, match="fps"):
+        KeypointSmoother(fps=0)
+    with pytest.raises(ValueError, match="ema_alpha"):
+        KeypointSmoother(ema_alpha=1.5)
+    with pytest.raises(ValueError, match="reset_after"):
+        KeypointSmoother(reset_after=0)
+
+
+def test_synthetic_video_determinism_and_gt_shapes():
+    a = SyntheticVideo(seed=5, num_people=2, num_frames=6)
+    b = SyntheticVideo(seed=5, num_people=2, num_frames=6)
+    assert np.array_equal(a.frame(3), b.frame(3))
+    assert a.frame(3).shape == (240, 320, 3)
+    gt = a.gt(3)
+    assert [pid for pid, _ in gt] == [0, 1]
+    assert all(len(kps) == 17 for _, kps in gt)
+    # detections are derived from gt and deterministic per (seed, t)
+    d1 = a.detections(3, noise=1.0)
+    d2 = b.detections(3, noise=1.0)
+    assert len(d1) == 2
+    assert d1[0][0][0] == d2[0][0][0]
+    with pytest.raises(ValueError, match="crossing"):
+        SyntheticVideo(num_people=3, crossing=True)
+
+
+# --------------------------------------------------------------------- #
+# session lifecycle over a real DynamicBatcher (stub predictor)         #
+# --------------------------------------------------------------------- #
+
+SIZE = (256, 256)
+
+
+@pytest.fixture(scope="module")
+def warm_pred():
+    """One stub predictor shared by every session test; the batcher's
+    default device-decode lane programs compile once here."""
+    from test_serve import _make_pred, _person_maps
+
+    pred = _make_pred(_person_maps())
+    pred.precompile_compact([pred.compact_lane_shape(
+        np.zeros((*SIZE, 3), np.uint8), pred.params)],
+        batch_sizes=(1, 2), decode=True)
+    return pred
+
+
+def _img():
+    return np.zeros((*SIZE, 3), np.uint8)
+
+
+def _manager(batcher, **kw):
+    from improved_body_parts_tpu.stream import SessionManager
+
+    return SessionManager(batcher, **kw)
+
+
+def test_session_in_order_tracked_delivery(warm_pred):
+    """Frames deliver strictly in submit order, every frame carries the
+    SAME track id for the planted person, and the per-stream signals
+    ride the shared obs registry labeled by stream."""
+    from improved_body_parts_tpu.obs import Registry
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    reg = Registry()
+    with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                        use_native=False, registry=reg) as server:
+        with _manager(server, registry=reg, max_in_flight=3) as mgr:
+            session = mgr.open("cam0")
+            futs = [session.submit_frame(_img()) for _ in range(6)]
+            results = [f.result(timeout=120) for f in futs]
+            # static planted maps: every frame decodes the same people,
+            # so the id SET must be identical on every frame, and —
+            # in-order delivery — every track's age stamp equals the
+            # frame's submit index (all tracks born on frame 0)
+            ids0 = sorted(p.track_id for p in results[0])
+            assert len(ids0) >= 1
+            for i, r in enumerate(results):
+                assert sorted(p.track_id for p in r) == ids0
+                assert all(p.age == i for p in r)
+            snap = session.snapshot()
+            assert snap["frames_delivered"] == 6
+            assert snap["frames_dropped"] == 0
+            assert snap["tracker"]["births"] == len(ids0)
+            assert snap["e2e_latency_ms"]["p95"] > 0
+            assert snap["fps"] > 0
+            exposition = reg.prometheus()
+    assert ('stream_frames_delivered_total{stream="cam0"} 6.0'
+            in exposition)
+    assert (f'stream_track_births_total{{stream="cam0"}} '
+            f'{float(len(ids0))}' in exposition)
+    assert ('stream_e2e_latency_seconds{quantile="0.95",stream="cam0"}'
+            in exposition)
+
+
+def test_drop_oldest_backpressure_semantics(warm_pred):
+    """With the pipeline full, drop_oldest fails the STALEST undelivered
+    frame with FrameDropped (accounted), admits the new frame, and the
+    newest frames still deliver — every submitted future completes."""
+    from test_serve import GatedPredictor
+
+    from improved_body_parts_tpu.serve import DynamicBatcher
+    from improved_body_parts_tpu.stream import FrameDropped
+
+    gate = threading.Event()
+    gated = GatedPredictor(warm_pred, gate)
+    with DynamicBatcher(gated, max_batch=1, max_wait_ms=5,
+                        use_native=False) as server:
+        with _manager(server, max_in_flight=2,
+                      policy="drop_oldest") as mgr:
+            session = mgr.open("live")
+            futs = [session.submit_frame(_img()) for _ in range(4)]
+            gate.set()
+            outcomes = []
+            for f in futs:
+                try:
+                    outcomes.append(("ok", len(f.result(timeout=120))))
+                except FrameDropped:
+                    outcomes.append(("dropped", None))
+            assert [o for o, _ in outcomes] == [
+                "dropped", "dropped", "ok", "ok"]
+            snap = session.snapshot()
+            assert snap["frames_dropped"] == 2
+            assert snap["frames_delivered"] == 2
+            assert snap["frames_submitted"] == 4
+            # the tracker only saw the delivered frames
+            assert snap["tracker"]["frames"] == 2
+
+
+def test_block_backpressure_semantics(warm_pred):
+    """policy='block' holds the producer at max_in_flight instead of
+    dropping; nothing is ever dropped."""
+    from test_serve import GatedPredictor
+
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    gate = threading.Event()
+    gated = GatedPredictor(warm_pred, gate)
+    with DynamicBatcher(gated, max_batch=1, max_wait_ms=5,
+                        use_native=False) as server:
+        with _manager(server, max_in_flight=2, policy="block") as mgr:
+            session = mgr.open("vod")
+            f1 = session.submit_frame(_img())
+            f2 = session.submit_frame(_img())
+            state = {}
+
+            def third():
+                t0 = time.perf_counter()
+                state["future"] = session.submit_frame(_img())
+                state["blocked_s"] = time.perf_counter() - t0
+
+            th = threading.Thread(target=third, daemon=True)
+            th.start()
+            time.sleep(0.3)
+            assert "blocked_s" not in state      # still parked
+            gate.set()                           # engine drains
+            th.join(timeout=120)
+            assert not th.is_alive()
+            assert state["blocked_s"] > 0.25     # it really blocked
+            for f in (f1, f2, state["future"]):
+                assert len(f.result(timeout=120)) >= 1
+            assert session.snapshot()["frames_dropped"] == 0
+
+
+def test_session_close_during_batcher_drain(warm_pred):
+    """THE composition contract: a session closed while the batcher is
+    draining toward shutdown strands nothing — every submitted future
+    completes (with the drain-deadline error for wedged frames) and
+    close() itself drains."""
+    from test_serve import GatedPredictor
+
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    gate = threading.Event()                     # never set: wedged
+    gated = GatedPredictor(warm_pred, gate)
+    server = DynamicBatcher(gated, max_batch=1, max_wait_ms=5,
+                            use_native=False).start()
+    mgr = _manager(server, max_in_flight=4)
+    session = mgr.open("dying")
+    futs = [session.submit_frame(_img()) for _ in range(3)]
+    time.sleep(0.05)                             # park on the gate
+    stopper = threading.Thread(
+        target=lambda: server.stop(drain_timeout_s=1.5), daemon=True)
+    stopper.start()
+    drained = session.close(timeout_s=120)
+    stopper.join(timeout=120)
+    assert drained                               # close composed w/ drain
+    for f in futs:
+        with pytest.raises(RuntimeError):
+            f.result(timeout=0)                  # completed, not stranded
+    snap = session.snapshot()
+    assert snap["frames_failed"] == 3
+    assert snap["in_flight"] == 0
+    # a closed session rejects new frames
+    with pytest.raises(RuntimeError, match="closed"):
+        session.submit_frame(_img())
+    gate.set()                                   # unpark the daemon
+
+
+def test_session_close_clean_after_delivery(warm_pred):
+    """The orderly path: batcher alive, close() waits for in-flight
+    frames and returns drained; the manager forgets the session."""
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                        use_native=False) as server:
+        mgr = _manager(server, max_in_flight=4)
+        session = mgr.open("cleanly")
+        futs = [session.submit_frame(_img()) for _ in range(3)]
+        assert session.close(timeout_s=120)
+        for f in futs:
+            assert len(f.result(timeout=0)) >= 1
+        assert mgr.get("cleanly") is None
+        # the closed session's accounting survives as monotone manager
+        # totals (stream churn must not un-count delivered work)
+        totals = {name: v for name, labels, _, v in mgr.collect()
+                  if not labels}
+        assert totals["stream_sessions_closed_total"] == 1.0
+        assert totals["stream_all_frames_delivered_total"] == 3.0
+        # reopening the same id after close works
+        again = mgr.open("cleanly")
+        assert len(again.submit_frame(_img()).result(timeout=120)) >= 1
+        mgr.close_all(timeout_s=120)
+        totals = {name: v for name, labels, _, v in mgr.collect()
+                  if not labels}
+        assert totals["stream_all_frames_delivered_total"] == 4.0
+        assert totals["stream_sessions_opened_total"] == 2.0
+
+
+def test_submit_during_batcher_drain_fails_frame_future(warm_pred):
+    """A frame submitted while the batcher is draining is delivered as
+    a FAILED future (ServerOverloaded), in order — never an exception
+    leaking out of submit_frame, never a stranded future."""
+    from improved_body_parts_tpu.serve import (
+        DynamicBatcher, ServerOverloaded)
+
+    server = DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                            use_native=False).start()
+    mgr = _manager(server, max_in_flight=4)
+    session = mgr.open("late")
+    ok = session.submit_frame(_img())
+    assert len(ok.result(timeout=120)) >= 1
+    stopper = threading.Thread(target=server.stop, daemon=True)
+    stopper.start()
+    deadline = time.time() + 30
+    while not server.draining and stopper.is_alive() \
+            and time.time() < deadline:
+        time.sleep(0.002)
+    late = session.submit_frame(_img())
+    with pytest.raises((ServerOverloaded, RuntimeError)):
+        late.result(timeout=120)
+    stopper.join(timeout=120)
+    assert session.close(timeout_s=120)
+
+
+def test_per_stream_trace_lanes(warm_pred):
+    """Spans land on a named per-stream track so Perfetto shows one
+    lane per stream."""
+    from improved_body_parts_tpu.obs.trace import (
+        TraceRecorder, set_tracer)
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    tracer = TraceRecorder()
+    prev = set_tracer(tracer)
+    try:
+        with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                            use_native=False) as server:
+            with _manager(server, max_in_flight=2) as mgr:
+                s0 = mgr.open("a")
+                s1 = mgr.open("b")
+                for _ in range(2):
+                    f0 = s0.submit_frame(_img())
+                    f1 = s1.submit_frame(_img())
+                    f0.result(timeout=120)
+                    f1.result(timeout=120)
+    finally:
+        set_tracer(prev)
+    export = tracer.export()
+    lanes = {ev["args"]["name"] for ev in export["traceEvents"]
+             if ev.get("name") == "thread_name"}
+    assert {"stream/a", "stream/b"} <= lanes
+    frames = [ev for ev in export["traceEvents"]
+              if ev.get("name") == "frame" and ev["ph"] == "X"]
+    assert len(frames) == 4
+    assert {ev["args"]["stream"] for ev in frames} == {"a", "b"}
+    assert any(ev.get("name") == "track_update"
+               for ev in export["traceEvents"])
+
+
+def test_smoothed_session_delivers_smoother_output(warm_pred):
+    """A manager opened with smoothing wires a per-session smoother and
+    delivery still matches the raw lane for a static person (EMA of a
+    constant is the constant — a drift here would mean the smoother
+    corrupts coordinates)."""
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                        use_native=False) as server:
+        with _manager(server, smoothing="ema",
+                      max_in_flight=2) as mgr:
+            session = mgr.open("smooth")
+            assert session.smoother is not None
+            first = session.submit_frame(_img()).result(timeout=120)
+            second = session.submit_frame(_img()).result(timeout=120)
+            for a, b in zip(first[0].keypoints, second[0].keypoints):
+                assert (a is None) == (b is None)
+                if a is not None:
+                    assert a[0] == pytest.approx(b[0], abs=1e-6)
+                    assert a[1] == pytest.approx(b[1], abs=1e-6)
+    with pytest.raises(ValueError, match="mode"):
+        _manager(None, smoothing="bogus")
+
+
+def test_session_validation(warm_pred):
+    from improved_body_parts_tpu.stream import StreamSession
+
+    with pytest.raises(ValueError, match="policy"):
+        StreamSession("x", None, policy="drop_newest")
+    with pytest.raises(ValueError, match="max_in_flight"):
+        StreamSession("x", None, max_in_flight=0)
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                        use_native=False) as server:
+        mgr = _manager(server)
+        mgr.open("dup")
+        with pytest.raises(ValueError, match="already open"):
+            mgr.open("dup")
+        mgr.close_all(timeout_s=60)
+
+
+def test_run_demo_device_decode_lane(warm_pred, tmp_path, capsys):
+    """--device-decode demo satellite: the fused lane draws straight off
+    the device person table and reports the lane used (stdout when no
+    sink is installed)."""
+    import cv2
+
+    from improved_body_parts_tpu.infer.demo import run_demo
+
+    from test_serve import _reference
+
+    src = tmp_path / "in.png"
+    out = tmp_path / "out.png"
+    cv2.imwrite(str(src), _img())
+    canvas, (subset, candidate) = run_demo(
+        warm_pred, str(src), str(out), device_decode=True)
+    assert out.exists()
+    # the fused lane draws exactly the people the host compact decoder
+    # finds on the same image (PR 9's payload-parity contract)
+    assert len(subset) == len(_reference(warm_pred, _img()))
+    assert canvas.shape == (*SIZE, 3)
+    # drawn coordinates index validly into the flat candidate table
+    for part in range(subset.shape[1] - 2):
+        idx = int(subset[0, part, 0])
+        if idx >= 0:
+            assert 0 <= idx < candidate.shape[0]
+    assert "decode lane: device" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_stream_bench_cli(tmp_path):
+    """tools/stream_bench.py end-to-end on the tiny config: writes
+    STREAM_BENCH.json with per-stream FPS + latency percentiles, the
+    interleaved-round scaling verdict and the recompile count."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    out = tmp_path / "STREAM_BENCH.json"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "stream_bench.py"),
+         "--config", "tiny", "--size", "128", "--boxsize", "128",
+         "--streams", "2", "--frames", "4", "--video-frames", "4",
+         "--rounds", "1", "--planted", "1", "--max-batch", "2",
+         "--out", str(out)],
+        check=True, timeout=1500, env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    r = json.loads(out.read_text())
+    assert r["streams"] == 2
+    assert len(r["per_stream_fps"]) == 2
+    assert all(f > 0 for f in r["per_stream_fps"])
+    assert all(p > 0 for p in r["per_stream_p95_ms"])
+    assert r["frames_failed_total"] == 0
+    assert isinstance(r["engine_scales_with_streams"], bool)
+    assert r["recompiles_post_warmup"] == 0
+    assert r["track_ids_stable_all_rounds"] is True

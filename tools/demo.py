@@ -18,6 +18,12 @@ def main():
     ap.add_argument("--image", required=True)
     ap.add_argument("--output", default="result.jpg")
     ap.add_argument("--no-native", action="store_true")
+    ap.add_argument("--device-decode", action="store_true",
+                    help="fused end-to-end decode: greedy person "
+                         "assembly runs ON DEVICE in the same program "
+                         "as the forward (tools/evaluate.py's lane); "
+                         "an overflowing crowd falls back to the host "
+                         "ensemble path and says so")
     ap.add_argument("--boxsize", type=int, default=0,
                     help="scale the image so its height maps to this "
                          "network input size (the reference's INI "
@@ -34,7 +40,8 @@ def main():
                                boxsize=args.boxsize,
                                params_dtype=args.params_dtype)
     _, (subset, _) = run_demo(predictor, args.image, args.output,
-                              use_native=not args.no_native)
+                              use_native=not args.no_native,
+                              device_decode=args.device_decode)
     print(f"{len(subset)} people -> {args.output}")
 
 

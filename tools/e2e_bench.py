@@ -143,8 +143,14 @@ def main():
     ap.add_argument("--decode-workers", type=int, default=2)
     ap.add_argument("--out", default="E2E_BENCH.json")
     ap.add_argument("--modes", default="full,fast,pipelined,compact,"
-                    "compact-pipelined,compact-batch",
+                    "compact-pipelined,compact-batch,device-decode,"
+                    "device-decode-batch",
                     help="comma-separated subset of sections to run")
+    ap.add_argument("--device-decode", action="store_true",
+                    help="run ONLY the fused device-decode sections "
+                         "(forward + peak extraction + greedy assembly "
+                         "in one XLA program; PR 9's serve lane), "
+                         "sequential and batched-pipelined")
     ap.add_argument("--batch", type=int, default=8,
                     help="chunk size for the compact-batch throughput mode")
     ap.add_argument("--params-dtype", default="auto",
@@ -154,7 +160,8 @@ def main():
                     help="plant GT-style maps for N synthetic people into "
                          "the model output (realistic decode workload)")
     args = ap.parse_args()
-    modes = set(args.modes.split(","))
+    modes = (({"device-decode", "device-decode-batch"}
+              if args.device_decode else set(args.modes.split(","))))
 
     from improved_body_parts_tpu.utils import (
         apply_platform_env, devices_with_timeout)
@@ -216,6 +223,9 @@ def main():
     if modes & {"compact", "compact-pipelined", "compact-batch"}:
         run_compact_modes(pred, imgs, decode, cfg, args, report, flush,
                           modes, pipelined_inference)
+    if modes & {"device-decode", "device-decode-batch"}:
+        run_device_decode_modes(pred, imgs, cfg, args, report, flush,
+                                modes, pipelined_inference)
     print(strict_dumps(report))
 
 
@@ -310,6 +320,49 @@ def run_compact_modes(pred, imgs, decode, cfg, args, report, flush, modes,
         report["compact_batch"] = b
         flush()
         print(f"compact batch({b}) pipelined: {1.0 / dt:.2f} FPS",
+              flush=True)
+
+
+def run_device_decode_modes(pred, imgs, cfg, args, report, flush, modes,
+                            pipelined_inference):
+    """The FUSED lane (PR 9): forward + compact extraction + greedy
+    assembly in ONE device program; the host finishes with an O(people)
+    id→coordinate lookup, falling back one level per overflow class
+    (``infer.pipeline.device_decode_fn``)."""
+    from improved_body_parts_tpu.infer.pipeline import device_decode_fn
+
+    finish = device_decode_fn(pred, pred.params, cfg.skeleton)
+
+    if "device-decode" in modes:
+        finish(pred.predict_decoded(imgs[0]), imgs[0])   # compile
+        fused = 0
+        t0 = time.perf_counter()
+        for im in imgs:
+            res = pred.predict_decoded(im)
+            fused += bool(res.ok)
+            finish(res, im)
+        dt = (time.perf_counter() - t0) / len(imgs)
+        report["device_decode_fps"] = round(1.0 / dt, 2)
+        report["device_decode_fused"] = fused
+        report["device_decode_host_fallback"] = len(imgs) - fused
+        flush()
+        print(f"device-decode: {1.0 / dt:.2f} FPS "
+              f"({fused}/{len(imgs)} fused)", flush=True)
+
+    if "device-decode-batch" in modes:
+        b = args.batch
+        list(pipelined_inference(            # compile the batch programs
+            pred, imgs[:b], decode_workers=args.decode_workers,
+            compact_batch=b, device_decode=True))
+        t0 = time.perf_counter()
+        n = sum(1 for _ in pipelined_inference(
+            pred, imgs, decode_workers=args.decode_workers,
+            compact_batch=b, device_decode=True))
+        dt = (time.perf_counter() - t0) / n
+        report["device_decode_batch_fps"] = round(1.0 / dt, 2)
+        report["device_decode_batch"] = b
+        flush()
+        print(f"device-decode batch({b}) pipelined: {1.0 / dt:.2f} FPS",
               flush=True)
 
 
