@@ -488,6 +488,54 @@ def _chaos_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _servechaos_summary(fallback, budget_s):
+    """Run tools/chaos_serve.py (the serve-side fault-injection harness:
+    an EnginePool over shared-nothing batcher replicas under wedge /
+    poison / decode-pool-kill / hard-stop / latency-spike injections)
+    and return a compact summary, or an {"error"/"skipped"} marker —
+    the "chaos" key contract.  Subprocess so a chaos failure can never
+    take down the primary metric; bounded by the REMAINING driver
+    budget.  ``IBP_BENCH_SERVECHAOS=0`` skips it unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_SERVECHAOS") == "0":
+        return {"skipped": "IBP_BENCH_SERVECHAOS=0"}
+    if budget_s < 240:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (SERVE_CHAOS.json has the full "
+                           "sweep)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="chaos_serve_"),
+                       "SERVE_CHAOS.json")
+    # smoke sweep: fewer requests/frames than the committed artifact,
+    # tiny config either way — serve chaos exercises the pool/breaker/
+    # failover machinery, not the model
+    argv = ["--config", "tiny", "--size", "128", "--boxsize", "128",
+            "--replicas", "2", "--requests", "4", "--streams", "2",
+            "--frames", "6", "--planted", "1"]
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "chaos_serve.py"),
+             "--out", out] + argv,
+            capture_output=True, timeout=min(900, budget_s), check=True,
+            env=dict(os.environ))
+        with open(out) as f:
+            r = json.load(f)
+        return {
+            "ok": r["ok"],
+            "injections": [i["kind"] for i in r["injections"]],
+            "futures_tracked": r["futures"]["tracked"],
+            "futures_lost": r["futures"]["lost"],
+            "recompiles_post_warmup": r["recompiles_post_warmup"],
+            "leaked_threads": len(r["leaked_threads"]),
+            "checks_failed": r["checks_failed"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def _audit_summary(budget_s):
     """Run tools/program_audit.py (the graftaudit compiled-program tier:
     jaxpr checks + fingerprint gating over the program registry, at
@@ -644,6 +692,10 @@ def main():
     # discipline
     chaos = _chaos_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # serve-side fault injection (pool wedge/poison/hard-stop sweep),
+    # same discipline
+    servechaos = _servechaos_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     # static-analysis gate (graftlint), same discipline
     lint = _lint_summary(
         TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
@@ -666,6 +718,7 @@ def main():
         "telemetry": telemetry,
         "ckpt": ckpt,
         "chaos": chaos,
+        "servechaos": servechaos,
         "lint": lint,
         "audit": audit,
         "provenance": _provenance(),

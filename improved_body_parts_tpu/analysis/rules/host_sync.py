@@ -41,6 +41,9 @@ class HiddenHostSync(Rule):
                   "device_prefetch; fixed by windowed readback")
 
     SCOPE = ("improved_body_parts_tpu/train",
+             # the whole serve/ tree, including the ISSUE 11 pool/
+             # policy/breaker control plane — failover and health-probe
+             # code runs on completion threads per request
              "improved_body_parts_tpu/serve",
              "improved_body_parts_tpu/infer",
              # the streaming sessions run per-frame on serve threads —

@@ -3,17 +3,32 @@ user requests to the chip).
 
 - ``batcher``  — :class:`DynamicBatcher`: shape-bucketed coalescing,
   ``max_batch``/``max_wait_ms`` flush, bounded admission with explicit
-  load-shedding (:class:`ServerOverloaded`), per-request futures.
+  load-shedding (:class:`ServerOverloaded`), per-request futures and
+  per-request deadlines (:class:`DeadlineExceeded`).
 - ``metrics``  — :class:`ServeMetrics`: queue depth, batch occupancy
-  histogram, p50/p95/p99 latency, imgs/sec.
+  histogram, p50/p95/p99 latency, imgs/sec, deadline/stall accounting.
 - ``warmup``   — startup precompile of every (bucket shape × pow2 batch
   size) program through the persistent compilation cache.
+- ``pool``     — :class:`EnginePool`: N shared-nothing batcher replicas
+  behind a health-checked router — least-loaded routing, circuit
+  breaking, fencing and transparent failover of in-flight work.
+- ``breaker``  — :class:`CircuitBreaker`: sliding-window failure-rate
+  breaker with half-open probing.
+- ``policy``   — :class:`PolicyClient` + :func:`submit_with_retry`:
+  client-side deadlines, jittered retry on ``ServerOverloaded``, hedged
+  dispatch for tail latency.
 
 Load generator / benchmark: ``tools/serve_bench.py`` → SERVE_BENCH.json.
+Fault-injection harness: ``tools/chaos_serve.py`` → SERVE_CHAOS.json.
 """
-from .batcher import DynamicBatcher, ServerOverloaded
+from .batcher import DeadlineExceeded, DynamicBatcher, ServerOverloaded
+from .breaker import CircuitBreaker
 from .metrics import ServeMetrics
+from .policy import PolicyClient, PolicyStats, jittered_backoff, submit_with_retry
+from .pool import EnginePool
 from .warmup import pow2_batch_sizes, precompile
 
-__all__ = ["DynamicBatcher", "ServerOverloaded", "ServeMetrics",
-           "pow2_batch_sizes", "precompile"]
+__all__ = ["CircuitBreaker", "DeadlineExceeded", "DynamicBatcher",
+           "EnginePool", "PolicyClient", "PolicyStats", "ServeMetrics",
+           "ServerOverloaded", "jittered_backoff", "pow2_batch_sizes",
+           "precompile", "submit_with_retry"]

@@ -72,15 +72,36 @@ class ServerOverloaded(RuntimeError):
     a 503; the server keeps serving everything already admitted."""
 
 
-class _Request:
-    __slots__ = ("image", "future", "t_submit", "finished", "rid")
+class DeadlineExceeded(RuntimeError):
+    """The request's ``deadline_s`` passed before its batch reached the
+    device — the request is failed fast instead of occupying a bucket
+    slot with work the caller already gave up on.  The policy layer
+    (``serve.policy``) is the intended producer of deadlines; a retry
+    against another replica is pointless (the deadline is global), so
+    the pool never fails this over."""
 
-    def __init__(self, image: np.ndarray):
+
+class _Request:
+    __slots__ = ("image", "future", "t_submit", "deadline", "finished",
+                 "rid")
+
+    def __init__(self, image: np.ndarray,
+                 deadline_s: Optional[float] = None):
         self.image = image
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
+        # absolute perf_counter instant past which the request is dead
+        # weight (None = no deadline): checked by the dispatcher before
+        # bucketing AND again at dispatch, never on the submit hot path
+        self.deadline = (None if deadline_s is None
+                         else self.t_submit + deadline_s)
         self.finished = False  # server-side once-flag (see _finish)
         self.rid = next(_RID)  # trace flow/async-span key
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None
+                     else time.perf_counter()) >= self.deadline)
 
 
 class DynamicBatcher:
@@ -177,25 +198,55 @@ class DynamicBatcher:
         self._in_flight = [0] * len(self._replicas)
         self._in_flight_lock = threading.Lock()
         self._finish_lock = threading.Lock()
+        # serializes stop() AND start(): double-stop (router fencing
+        # racing a user shutdown) must not raise or double-join — the
+        # first caller does the drain, concurrent callers block until
+        # it finishes and then see the already-clean state — and a
+        # restart waits for an in-progress drain's tail
+        self._stop_lock = threading.Lock()
+        # start generation: stage threads carry their token so one a
+        # wedged drain left parked cannot feed or account against a
+        # later generation's pipeline when it finally resumes
+        self._gen = 0
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "DynamicBatcher":
-        if self._running:
+        # serialized against stop(): a restart racing an in-progress
+        # bounded drain (the pool's fence drain vs an early restart)
+        # must wait for the drain's tail, or the drain would tear down
+        # the NEW generation's queues/threads it never owned
+        with self._stop_lock:
+            if self._running:
+                return self
+            # fresh queues per start GENERATION: a restart after stop()
+            # must not share queues with a previous generation's threads
+            # — a stale _STOP sentinel (or a thread a wedged drain left
+            # parked mid-stage) would otherwise kill or starve the new
+            # pipeline.  Every stage thread carries its generation
+            # token and queue objects; a prior-generation thread that
+            # resumes after a restart no-ops instead of feeding or
+            # accounting against the live pipeline.
+            self._gen += 1
+            self._queue = queue.SimpleQueue()
+            self._fetchqs = [queue.SimpleQueue() for _ in self._replicas]
+            self._in_flight = [0] * len(self._replicas)
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._decode_workers,
+                thread_name_prefix="serve-decode")
+            self._running = True
+            self._dispatcher = threading.Thread(
+                target=self._run, args=(self._gen, self._queue,
+                                        self._fetchqs),
+                name="serve-dispatcher", daemon=True)
+            self._fetchers = [
+                threading.Thread(target=self._run_fetcher,
+                                 args=(i, self._fetchqs[i], self._gen),
+                                 name=f"serve-fetcher-{i}", daemon=True)
+                for i in range(len(self._replicas))]
+            self._dispatcher.start()
+            for t in self._fetchers:
+                t.start()
             return self
-        self._pool = ThreadPoolExecutor(
-            max_workers=self._decode_workers,
-            thread_name_prefix="serve-decode")
-        self._running = True
-        self._dispatcher = threading.Thread(
-            target=self._run, name="serve-dispatcher", daemon=True)
-        self._fetchers = [
-            threading.Thread(target=self._run_fetcher, args=(i,),
-                             name=f"serve-fetcher-{i}", daemon=True)
-            for i in range(len(self._replicas))]
-        self._dispatcher.start()
-        for t in self._fetchers:
-            t.start()
-        return self
 
     @property
     def draining(self) -> bool:
@@ -215,9 +266,18 @@ class DynamicBatcher:
         fail with an explicit error instead of the caller hanging on a
         wedged device — every future returned by :meth:`submit` always
         completes, on time or by deadline.
+
+        Idempotent and thread-safe: concurrent callers (the pool's
+        fence drain racing a user shutdown) serialize on a stop lock —
+        the first caller drains, the rest wait and return.
         """
-        if not self._running:
-            return
+        with self._stop_lock:
+            self._stop_locked(drain_timeout_s)
+
+    def _stop_locked(self, drain_timeout_s: Optional[float]) -> None:
+        if not self._running and self._dispatcher is None \
+                and not self._fetchers:
+            return  # never started, or a previous stop() finished
         deadline = (None if drain_timeout_s is None
                     else time.perf_counter() + drain_timeout_s)
 
@@ -290,16 +350,26 @@ class DynamicBatcher:
         self.stop()
 
     # ------------------------------------------------------------- submit
-    def submit(self, image_bgr: np.ndarray) -> Future:
+    def submit(self, image_bgr: np.ndarray, *,
+               deadline_s: Optional[float] = None) -> Future:
         """Enqueue one BGR image; returns a future resolving to the
         decoded skeletons (``decode_compact`` output: a list of
         (coco_keypoints, score) tuples).
+
+        ``deadline_s`` bounds the request's useful life: a request whose
+        deadline passes before its bucket reaches the device fails fast
+        with :class:`DeadlineExceeded` instead of occupying a batch
+        lane (checked by the dispatcher at bucketing and again at
+        dispatch — a caller that already gave up must not cost device
+        time).
 
         :raises ServerOverloaded: ``max_queue`` requests already in
             flight (fail-fast backpressure, nothing is queued) — or the
             batcher is DRAINING toward shutdown (same retry-with-backoff
             contract: during a rolling restart the replacement instance
             takes the retry).
+        :raises DeadlineExceeded: ``deadline_s`` is already non-positive
+            at submit time (nothing is admitted).
         :raises RuntimeError: the batcher is not running.
         """
         if self._draining:
@@ -310,12 +380,16 @@ class DynamicBatcher:
         if not self._running:
             raise RuntimeError("DynamicBatcher is not running "
                                "(use `with batcher:` or call start())")
+        if deadline_s is not None and deadline_s <= 0:
+            self.metrics.on_expire_rejected()
+            raise DeadlineExceeded(
+                f"deadline_s={deadline_s} already expired at submit")
         if not self._slots.acquire(blocking=False):
             self.metrics.on_reject()
             raise ServerOverloaded(
                 f"{self.max_queue} requests in flight (max_queue); "
                 "retry with backoff")
-        req = _Request(image_bgr)
+        req = _Request(image_bgr, deadline_s)
         with self._finish_lock:
             self._inflight_reqs.add(req)
         trace = get_tracer()
@@ -328,11 +402,15 @@ class DynamicBatcher:
                              args={"shape": list(np.shape(image_bgr))})
             trace.flow_start("serve_req", req.rid)
         self.metrics.on_submit()
-        self._queue.put(req)
-        if not self._running:
-            # raced stop(): the drain may already have passed our queue
-            # entry, which would strand this future forever.  _finish is
-            # idempotent, so if the dispatcher did catch it, this no-ops.
+        q = self._queue
+        q.put(req)
+        if not self._running or q is not self._queue:
+            # raced stop() — or a whole stop()+start() cycle, in which
+            # case the request landed in the PREVIOUS generation's
+            # orphaned queue that no dispatcher will ever read (the
+            # `q is not self._queue` arm; _running alone would look
+            # fine again after the restart).  _finish is idempotent, so
+            # if a dispatcher did catch it, this no-ops.
             self._finish(req, error=RuntimeError("batcher stopped"))
         return req.future
 
@@ -356,8 +434,36 @@ class DynamicBatcher:
             out = out or info
         return out
 
+    # ------------------------------------------------------------- health
+    def health(self) -> dict:
+        """One consistent liveness read for a router's health probe
+        (``serve.pool.EnginePool``), built from signals that already
+        exist: thread liveness plus the ``ServeMetrics`` stall clock.
+
+        A replica is *wedged* when work is admitted but nothing has
+        completed for longer than the router's patience
+        (``stall_age_s``), and *crashed* when its dispatcher or a
+        fetcher thread died — both observable here without touching the
+        device."""
+        dispatcher = self._dispatcher
+        fetchers = list(self._fetchers)
+        with self._in_flight_lock:
+            batches_in_flight = sum(self._in_flight)
+        return {
+            "running": self._running,
+            "draining": self._draining,
+            "dispatcher_alive": bool(dispatcher is not None
+                                     and dispatcher.is_alive()),
+            "fetchers_alive": sum(1 for t in fetchers if t.is_alive()),
+            "fetchers_expected": len(fetchers),
+            "queue_depth": self.metrics.depth,
+            "batches_in_flight": batches_in_flight,
+            "stall_age_s": self.metrics.stall_age_s(),
+        }
+
     # --------------------------------------------------------- dispatcher
-    def _run(self) -> None:
+    def _run(self, gen: int, inq: "queue.SimpleQueue",
+             fetchqs: "list[queue.SimpleQueue]") -> None:
         """The coalescing loop.  A bucket flushes when any of:
 
         - it reached ``max_batch`` occupancy (full lanes — always);
@@ -379,12 +485,18 @@ class DynamicBatcher:
                 timeout = max(0.0, oldest + self.max_wait_s
                               - time.perf_counter())
             try:
-                item = self._queue.get(timeout=timeout)
+                item = inq.get(timeout=timeout)
             except queue.Empty:
                 item = None
             if item is _STOP:
                 stop = True
             elif item is not None and item is not _KICK:
+                if item.expired():
+                    # dead on arrival at the dispatcher: fail fast
+                    # BEFORE the request can occupy a bucket slot
+                    self._finish(item, error=DeadlineExceeded(
+                        "request deadline passed before dispatch"))
+                    continue
                 try:
                     key = self.predictor.compact_lane_shape(item.image,
                                                             self.params)
@@ -395,7 +507,7 @@ class DynamicBatcher:
                 bucket = pending.setdefault(key, [])
                 bucket.append(item)
                 if len(bucket) >= self.max_batch:
-                    self._dispatch(pending.pop(key))
+                    self._dispatch(pending.pop(key), gen, fetchqs)
             now = time.perf_counter()
             with self._in_flight_lock:
                 idle = (self.eager_idle_flush
@@ -406,16 +518,40 @@ class DynamicBatcher:
                               key=lambda k: pending[k][0].t_submit):
                 if stop or idle or (now - pending[key][0].t_submit
                                     >= self.max_wait_s):
-                    self._dispatch(pending.pop(key))
+                    self._dispatch(pending.pop(key), gen, fetchqs)
                     with self._in_flight_lock:
                         idle = (self.eager_idle_flush
                                 and min(self._in_flight) == 0)
 
-    def _dispatch(self, reqs: List[_Request]) -> None:
+    def _dispatch(self, reqs: List[_Request], gen: int,
+                  fetchqs: "list[queue.SimpleQueue]") -> None:
         """Dispatch one shape bucket's batch to the least-loaded device
         replica (async) and queue its fetch.  Runs on the dispatcher
         thread; a dispatch failure fails exactly this batch's futures and
         the loop keeps serving."""
+        if gen != self._gen:
+            # a prior-generation dispatcher resumed after a restart:
+            # its requests were already failed by that generation's
+            # drain (exactly-once _finish no-ops) — don't burn device
+            # time or touch the live generation's accounting
+            for r in reqs:
+                self._finish(r, error=RuntimeError("batcher restarted"))
+            return
+        if any(r.deadline is not None for r in reqs):
+            # last check before device work: expired requests fall out
+            # of the batch here (a bucket that waited out max_wait_ms
+            # can outlive a tight deadline)
+            now = time.perf_counter()
+            live = []
+            for r in reqs:
+                if r.expired(now):
+                    self._finish(r, error=DeadlineExceeded(
+                        "request deadline passed before dispatch"))
+                else:
+                    live.append(r)
+            reqs = live
+            if not reqs:
+                return
         with self._in_flight_lock:
             idx = min(range(len(self._replicas)),
                       key=self._in_flight.__getitem__)
@@ -442,6 +578,14 @@ class DynamicBatcher:
             for r in reqs:
                 self._finish(r, error=e)
             return
+        if gen != self._gen:
+            # the dispatch call itself can block (a wedged device); a
+            # restart may have happened while this thread was parked in
+            # it — re-check before touching the live generation's
+            # accounting or enqueueing to a dead fetcher
+            for r in reqs:
+                self._finish(r, error=RuntimeError("batcher restarted"))
+            return
         trace = get_tracer()
         if trace.enabled:
             # dispatcher-track marker: when the bucket left coalescing
@@ -450,9 +594,10 @@ class DynamicBatcher:
         self.metrics.on_dispatch(len(reqs))
         with self._in_flight_lock:
             self._in_flight[idx] += 1
-        self._fetchqs[idx].put((reqs, resolve))
+        fetchqs[idx].put((reqs, resolve))
 
-    def _run_fetcher(self, idx: int) -> None:
+    def _run_fetcher(self, idx: int, inq: "queue.SimpleQueue",
+                     gen: int) -> None:
         """One replica's fetch stage: block on each batch's single
         device→host transfer (FIFO per replica — a device executes its
         dispatches in order, so waiting in dispatch order is optimal),
@@ -461,7 +606,7 @@ class DynamicBatcher:
         with every worker stuck fetching, nothing would decode and the
         pipeline would stall."""
         while True:
-            item = self._fetchqs[idx].get()
+            item = inq.get()
             if item is _STOP:
                 return
             reqs, resolve = item
@@ -470,7 +615,7 @@ class DynamicBatcher:
             try:
                 results = resolve()
             except Exception as e:  # noqa: BLE001 — delivered per request
-                self._batch_done(idx)
+                self._batch_done(idx, gen)
                 for r in reqs:
                     self._finish(r, error=e)
                 continue
@@ -483,7 +628,7 @@ class DynamicBatcher:
                     # arrowheads bind to the execute slice (ts at its
                     # start): each admitted request's flow ends here
                     trace.flow_finish("serve_req", r.rid, ts=t_exec)
-            self._batch_done(idx)
+            self._batch_done(idx, gen)
             for r, res in zip(reqs, results):
                 if self.device_decode:
                     if res.ok:
@@ -505,10 +650,14 @@ class DynamicBatcher:
                 except RuntimeError:  # pool draining (stop()) — inline
                     self._decode_and_finish(r, res)
 
-    def _batch_done(self, idx: int) -> None:
+    def _batch_done(self, idx: int, gen: int) -> None:
         """One batch's device results landed: drop the replica's
         in-flight count and wake the dispatcher so an idle device gets
-        fed at once."""
+        fed at once.  Generation-guarded: a prior-generation fetcher
+        resuming after a restart must not decrement (or kick) the live
+        pipeline's accounting."""
+        if gen != self._gen:
+            return
         with self._in_flight_lock:
             self._in_flight[idx] -= 1
             idle = self._in_flight[idx] == 0
@@ -555,7 +704,8 @@ class DynamicBatcher:
                             args={"error": error is not None})
         try:
             if error is not None:
-                self.metrics.on_fail()
+                self.metrics.on_fail(
+                    expired=isinstance(error, DeadlineExceeded))
                 req.future.set_exception(error)
             else:
                 self.metrics.on_complete(time.perf_counter()

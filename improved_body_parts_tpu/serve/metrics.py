@@ -42,6 +42,12 @@ class ServeMetrics:
         self.rejected = 0
         self.completed = 0
         self.failed = 0
+        # deadline accounting: requests that died of DeadlineExceeded.
+        # An ADMITTED request that expires is also counted in `failed`
+        # (conservation: submitted == completed + failed + depth must
+        # keep holding); a submit-time expiry is counted here only —
+        # like `rejected`, it was never admitted.
+        self.expired = 0
         self.decode_fused = 0
         self.decode_host_fallback = 0
         self.depth = 0              # in-flight requests (admitted, not done)
@@ -49,6 +55,7 @@ class ServeMetrics:
         self.occupancy: Dict[int, int] = {}
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
+        self._t_busy: Optional[float] = None  # last idle->busy instant
 
     # ------------------------------------------------------------- hooks
     def on_submit(self) -> None:
@@ -56,6 +63,15 @@ class ServeMetrics:
             self.submitted += 1
             self.depth += 1
             self.depth_peak = max(self.depth_peak, self.depth)
+            if self.depth == 1:
+                # idle -> busy transition: the stall clock anchors HERE,
+                # never at the last completion of a previous busy period
+                # — or a request admitted after an idle gap would be
+                # born with stall_age == the idle time, and a router
+                # would false-fence a healthy replica the instant a
+                # failover re-submission lands on it (the serve chaos
+                # harness caught exactly that cascade)
+                self._t_busy = time.perf_counter()
             if self._t_first is None:
                 self._t_first = time.perf_counter()
 
@@ -85,11 +101,38 @@ class ServeMetrics:
             self.latency.update(latency_s)
             self._t_last = time.perf_counter()
 
-    def on_fail(self) -> None:
+    def on_fail(self, expired: bool = False) -> None:
         with self._lock:
             self.failed += 1
+            if expired:
+                self.expired += 1
             self.depth -= 1
             self._t_last = time.perf_counter()
+
+    def on_expire_rejected(self) -> None:
+        """A submit whose deadline was already non-positive: refused at
+        the door, never admitted (no depth/submitted movement)."""
+        with self._lock:
+            self.expired += 1
+
+    def stall_age_s(self) -> Optional[float]:
+        """Seconds since the pipeline last made progress while work is
+        IN FLIGHT — ``None`` when idle.  Progress is a completion or
+        failure; the anchor is the LATER of the last progress and the
+        start of the current busy period (idle time before the current
+        work was admitted is not a stall).  The health-probe signal a
+        router uses to call a replica wedged: depth stuck above zero
+        with a growing stall age means admitted work stopped moving."""
+        with self._lock:
+            if self.depth <= 0:
+                return None
+            anchor = self._t_busy
+            if self._t_last is not None and (anchor is None
+                                             or self._t_last > anchor):
+                anchor = self._t_last
+            if anchor is None:
+                return None
+            return time.perf_counter() - anchor
 
     # --------------------------------------------------------- telemetry
     def register_into(self, registry, prefix: str = "serve"
@@ -123,6 +166,7 @@ class ServeMetrics:
                       ("rejected", self.rejected),
                       ("completed", self.completed),
                       ("failed", self.failed),
+                      ("expired", self.expired),
                       ("decode_fused", self.decode_fused),
                       ("decode_host_fallback", self.decode_host_fallback))
             depth, peak = self.depth, self.depth_peak
@@ -175,6 +219,7 @@ class ServeMetrics:
                 "rejected": self.rejected,
                 "completed": self.completed,
                 "failed": self.failed,
+                "expired": self.expired,
                 "decode_fused": self.decode_fused,
                 "decode_host_fallback": self.decode_host_fallback,
                 "queue_depth": self.depth,

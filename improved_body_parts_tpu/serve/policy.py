@@ -1,0 +1,356 @@
+"""Client-side request policy: deadlines, bounded retry-with-backoff,
+hedged dispatch.
+
+The server side already speaks the right statuses — ``ServerOverloaded``
+is the explicit load-shed/draining signal and ``DeadlineExceeded`` the
+server-side fail-fast for stale work — but every caller so far
+re-implemented the client half by hand (the benches' fixed 2 ms retry
+sleep, the stream session's overload loop).  This module is that half,
+once:
+
+- :func:`jittered_backoff` / :func:`submit_with_retry` — the shared
+  retry discipline for ``ServerOverloaded``: exponential backoff with
+  multiplicative jitter (a fleet of shedding clients must not re-arrive
+  in lockstep), bounded attempts, abort hook for draining targets.
+- :class:`PolicyClient` — per-request deadlines (enforced client-side
+  by a timer AND server-side via ``submit(deadline_s=)``), admission
+  retry, and an optional **hedged second dispatch**: past
+  ``hedge_after_s`` with no result, the same image is submitted again
+  (through the pool's least-loaded routing that usually lands on a
+  different replica) and the first result wins — the classic
+  tail-latency-at-scale trade of a little extra work for a bounded p99.
+
+Everything here is host-side bookkeeping around futures; no device
+state, no threads beyond ``threading.Timer`` fired per armed deadline/
+hedge (cancelled on completion).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Optional, Tuple
+
+from .batcher import DeadlineExceeded, ServerOverloaded
+
+
+def jittered_backoff(attempt: int, base_s: float = 0.002,
+                     max_s: float = 0.25, jitter: float = 0.5,
+                     rng: Optional[random.Random] = None) -> float:
+    """Delay before retry ``attempt`` (1-based): exponential growth
+    capped at ``max_s``, scaled by a uniform multiplicative jitter in
+    ``[1 - jitter, 1 + jitter]`` so retrying clients decorrelate."""
+    if attempt < 1:
+        raise ValueError(f"attempt={attempt} is 1-based")
+    delay = min(base_s * (2.0 ** (attempt - 1)), max_s)
+    r = rng.random() if rng is not None else random.random()
+    return delay * (1.0 - jitter + 2.0 * jitter * r)
+
+
+def submit_with_retry(submit: Callable[..., Future], *args,
+                      max_attempts: Optional[int] = None,
+                      base_s: float = 0.002, max_s: float = 0.25,
+                      jitter: float = 0.5,
+                      rng: Optional[random.Random] = None,
+                      should_abort: Optional[Callable[[], bool]] = None,
+                      **kwargs) -> Tuple[Future, int]:
+    """Call ``submit(*args, **kwargs)``, retrying ``ServerOverloaded``
+    with jittered exponential backoff; returns ``(future, retries)`` so
+    load generators can report how often they were shed instead of
+    counting a shed as a failure.
+
+    ``max_attempts=None`` retries until admitted (the closed-loop bench
+    contract); ``should_abort`` (e.g. ``lambda: server.draining``) stops
+    retrying against a target that will never admit again and re-raises
+    the last ``ServerOverloaded``.
+    """
+    attempt = 0
+    while True:
+        try:
+            return submit(*args, **kwargs), attempt
+        except ServerOverloaded:
+            if should_abort is not None and should_abort():
+                raise
+            attempt += 1
+            if max_attempts is not None and attempt >= max_attempts:
+                raise
+            time.sleep(jittered_backoff(attempt, base_s, max_s, jitter,
+                                        rng))
+
+
+class PolicyStats:
+    """Thread-safe counters for one :class:`PolicyClient` (snapshot is
+    the JSON-artifact shape; ``register_into`` follows the ServeMetrics
+    collector discipline)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.admission_retries = 0
+        self.hedges = 0
+        self.hedge_wins = 0
+        self.deadline_expired = 0
+
+    def add(self, **deltas: int) -> None:
+        with self._lock:
+            for name, d in deltas.items():
+                setattr(self, name, getattr(self, name) + d)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "submitted": self.submitted,
+                "admission_retries": self.admission_retries,
+                "hedges": self.hedges,
+                "hedge_wins": self.hedge_wins,
+                "deadline_expired": self.deadline_expired,
+            }
+
+    def register_into(self, registry, prefix: str = "policy"
+                      ) -> "PolicyStats":
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _collect():
+            s = ref()
+            if s is None:
+                return []
+            return [(f"{prefix}_{name}_total", {}, "counter", float(v))
+                    for name, v in s.snapshot().items()]
+
+        registry.register_collector(_collect)
+        return self
+
+
+class _Flight:
+    """One policy-level request: its caller-facing future, the set of
+    engine attempts still outstanding, and the timers armed for it."""
+
+    __slots__ = ("future", "lock", "outstanding", "last_error", "timers",
+                 "won_by")
+
+    def __init__(self):
+        self.future: Future = Future()
+        self.lock = threading.Lock()
+        self.outstanding = 0
+        self.last_error: Optional[BaseException] = None
+        self.timers: list = []
+        self.won_by: Optional[str] = None
+
+
+class PolicyClient:
+    """Deadline / retry / hedge wrapper around anything with the
+    ``submit(image, deadline_s=...)`` contract (a ``DynamicBatcher`` or
+    an ``EnginePool``).
+
+    ::
+
+        client = PolicyClient(pool, deadline_s=2.0, hedge_after_s=0.5)
+        skeletons = client.submit(img).result()
+
+    - **deadline**: the remaining budget rides into every engine submit
+      (server-side fail-fast before device dispatch) AND a client timer
+      fails the caller's future with :class:`DeadlineExceeded` the
+      moment the budget lapses — the latency promise holds even when
+      the engine is wedged.
+    - **retry**: admission (``ServerOverloaded``) retries with jittered
+      backoff on the caller's thread, bounded by ``max_attempts`` and
+      the deadline.
+    - **hedge**: with ``hedge_after_s`` set, a request still unresolved
+      past that age dispatches a second copy; first RESULT wins, an
+      error only surfaces once every outstanding attempt failed.  At
+      most one hedge per request — the tail is the target, not a
+      retry storm.
+    """
+
+    def __init__(self, engine, *, deadline_s: Optional[float] = None,
+                 max_attempts: int = 4, backoff_base_s: float = 0.002,
+                 backoff_max_s: float = 0.25, jitter: float = 0.5,
+                 hedge_after_s: Optional[float] = None, seed: int = 0,
+                 stats: Optional[PolicyStats] = None):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts={max_attempts} must be >= 1")
+        if hedge_after_s is not None and hedge_after_s <= 0:
+            raise ValueError(f"hedge_after_s={hedge_after_s} must be > 0")
+        self.engine = engine
+        self.deadline_s = deadline_s
+        self.max_attempts = max_attempts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.jitter = jitter
+        self.hedge_after_s = hedge_after_s
+        self.stats = stats or PolicyStats()
+        self._locked_rng = self._LockedRng(random.Random(seed),
+                                           threading.Lock())
+
+    # ------------------------------------------------------------ submit
+    def submit(self, image, *,
+               deadline_s: Optional[float] = None) -> Future:
+        """Submit under policy; returns a future that ALWAYS resolves —
+        with the decoded result, the engine's error once every attempt
+        failed, or :class:`DeadlineExceeded`.
+
+        :raises ServerOverloaded: admission still shed after
+            ``max_attempts`` (nothing in flight — the caller's cue to
+            back off at its own layer).
+        :raises DeadlineExceeded: the deadline lapsed while still
+            retrying admission (nothing was ever admitted).
+        """
+        budget = self.deadline_s if deadline_s is None else deadline_s
+        deadline = (None if budget is None
+                    else time.perf_counter() + budget)
+        flight = _Flight()
+        fut = self._admit(image, deadline)   # raises if never admitted
+        self.stats.add(submitted=1)
+        with flight.lock:
+            flight.outstanding += 1
+        fut.add_done_callback(
+            lambda f: self._on_attempt_done(flight, f, "primary"))
+        if deadline is not None:
+            self._arm(flight, max(0.0, deadline - time.perf_counter()),
+                      lambda: self._on_deadline(flight))
+        if self.hedge_after_s is not None:
+            self._arm(flight, self.hedge_after_s,
+                      lambda: self._hedge(flight, image, deadline))
+        return flight.future
+
+    def call(self, image, *, deadline_s: Optional[float] = None):
+        """Blocking convenience: ``submit(...).result()``."""
+        return self.submit(image, deadline_s=deadline_s).result()
+
+    # ---------------------------------------------------------- plumbing
+    class _LockedRng:
+        """Thread-safe ``random()`` view over the client's seeded RNG
+        (submits come from many caller threads)."""
+
+        def __init__(self, rng: random.Random, lock: threading.Lock):
+            self._rng, self._lock = rng, lock
+
+        def random(self) -> float:
+            with self._lock:
+                return self._rng.random()
+
+    def _admit(self, image, deadline: Optional[float]) -> Future:
+        """Engine admission with bounded jittered retry; the caller's
+        thread sleeps the backoff (a closed-loop client by design)."""
+        attempt = 0
+        while True:
+            if deadline is not None:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    self.stats.add(deadline_expired=1)
+                    raise DeadlineExceeded(
+                        "deadline lapsed before admission")
+            else:
+                remaining = None
+            try:
+                return self.engine.submit(image, deadline_s=remaining)
+            except ServerOverloaded:
+                attempt += 1
+                if attempt >= self.max_attempts or \
+                        getattr(self.engine, "draining", False):
+                    raise
+                self.stats.add(admission_retries=1)
+                # the ONE retry discipline (no inline fork of the
+                # formula that could drift from the helper's)
+                delay = jittered_backoff(
+                    attempt, self.backoff_base_s, self.backoff_max_s,
+                    self.jitter, rng=self._locked_rng)
+                if remaining is not None:
+                    delay = min(delay, max(0.0, remaining))
+                time.sleep(delay)
+
+    def _arm(self, flight: _Flight, delay_s: float,
+             fire: Callable[[], None]) -> None:
+        timer = threading.Timer(delay_s, fire)
+        timer.daemon = True
+        with flight.lock:
+            if flight.future.done():
+                return
+            flight.timers.append(timer)
+        timer.start()
+
+    @staticmethod
+    def _cancel_timers(flight: _Flight) -> None:
+        # caller holds flight.lock
+        for t in flight.timers:
+            t.cancel()
+        flight.timers.clear()
+
+    def _resolve(self, flight: _Flight, kind: str, result=None,
+                 error: Optional[BaseException] = None) -> bool:
+        with flight.lock:
+            if flight.future.done():
+                return False
+            self._cancel_timers(flight)
+            flight.won_by = kind
+            try:
+                if error is not None:
+                    flight.future.set_exception(error)
+                else:
+                    flight.future.set_result(result)
+            except Exception:  # noqa: BLE001 — caller cancelled; the
+                # outcome is still accounted below
+                pass
+        return True
+
+    def _on_attempt_done(self, flight: _Flight, fut: Future,
+                         kind: str) -> None:
+        try:
+            result = fut.result()
+            error = None
+        except BaseException as e:  # noqa: BLE001 — delivered or held
+            result, error = None, e
+        if error is None:
+            if self._resolve(flight, kind, result=result) \
+                    and kind == "hedge":
+                self.stats.add(hedge_wins=1)
+            return
+        with flight.lock:
+            flight.outstanding -= 1
+            flight.last_error = error
+            deliver = flight.outstanding <= 0
+        if deliver:
+            # every outstanding attempt failed: surface the last error
+            self._resolve(flight, kind, error=error)
+
+    def _on_deadline(self, flight: _Flight) -> None:
+        if self._resolve(flight, "deadline", error=DeadlineExceeded(
+                "request deadline exceeded (client policy)")):
+            self.stats.add(deadline_expired=1)
+
+    def _hedge(self, flight: _Flight, image,
+               deadline: Optional[float]) -> None:
+        remaining = (None if deadline is None
+                     else deadline - time.perf_counter())
+        if remaining is not None and remaining <= 0:
+            return
+        with flight.lock:
+            if flight.future.done():
+                return
+            # RESERVE the attempt slot before the submit window: a
+            # primary failing while this hedge is mid-admission must
+            # wait for it (the hedge exists exactly to cover that
+            # failure), not race past outstanding==0 and deliver the
+            # error while a winnable attempt is seconds from flight
+            flight.outstanding += 1
+        try:
+            fut = self.engine.submit(image, deadline_s=remaining)
+        except Exception:  # noqa: BLE001 — a shed/draining hedge is
+            # simply not taken; release the reservation, and if the
+            # primary already failed while waiting on us, deliver now
+            self._attempt_abandoned(flight)
+            return
+        self.stats.add(hedges=1)
+        fut.add_done_callback(
+            lambda f: self._on_attempt_done(flight, f, "hedge"))
+
+    def _attempt_abandoned(self, flight: _Flight) -> None:
+        with flight.lock:
+            flight.outstanding -= 1
+            error = flight.last_error
+            deliver = flight.outstanding <= 0 and error is not None
+        if deliver:
+            self._resolve(flight, "primary", error=error)

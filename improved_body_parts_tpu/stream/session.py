@@ -35,6 +35,7 @@ import numpy as np
 
 from ..obs.trace import get_tracer
 from ..serve.batcher import ServerOverloaded
+from ..serve.policy import jittered_backoff
 from ..utils.meters import PercentileMeter
 from .smooth import KeypointSmoother
 from .track import Tracker
@@ -49,9 +50,9 @@ class FrameDropped(RuntimeError):
 
 class _Frame:
     __slots__ = ("seq", "future", "t_submit", "tr0", "ready", "dropped",
-                 "result", "error")
+                 "result", "error", "image", "epoch", "engine_submitted")
 
-    def __init__(self, seq: int, t_submit: float, tr0: float):
+    def __init__(self, seq: int, t_submit: float, tr0: float, image):
         self.seq = seq
         self.future: Future = Future()
         self.t_submit = t_submit
@@ -60,6 +61,16 @@ class _Frame:
         self.dropped = False        # future already failed FrameDropped
         self.result = None
         self.error: Optional[BaseException] = None
+        # retained until the frame resolves so a migration off a fenced
+        # replica can RE-SUBMIT it (bounded by max_in_flight frames per
+        # stream); freed the moment ready/dropped lands
+        self.image = image
+        # engine-attempt generation: a migration bumps it, and an ERROR
+        # from a stale attempt (the fenced replica's drain failure) is
+        # discarded — the re-submitted attempt owns the frame's outcome.
+        # A RESULT from any epoch wins (real work is never thrown away).
+        self.epoch = 0
+        self.engine_submitted = False   # an engine future is wired
 
 
 class StreamMetrics:
@@ -73,6 +84,9 @@ class StreamMetrics:
         self.delivered = 0
         self.dropped = 0
         self.failed = 0
+        # engine-admission retries (ServerOverloaded absorbed by the
+        # session's jittered backoff instead of surfacing as a failure)
+        self.shed_retries = 0
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
 
@@ -91,6 +105,10 @@ class StreamMetrics:
     def on_drop(self) -> None:
         with self._lock:
             self.dropped += 1
+
+    def on_shed_retry(self) -> None:
+        with self._lock:
+            self.shed_retries += 1
 
     def on_fail(self) -> None:
         with self._lock:
@@ -113,7 +131,8 @@ class StreamMetrics:
             counts = (("frames_submitted", self.submitted),
                       ("frames_delivered", self.delivered),
                       ("frames_dropped", self.dropped),
-                      ("frames_failed", self.failed))
+                      ("frames_failed", self.failed),
+                      ("engine_shed_retries", self.shed_retries))
             return counts, self.latency.summary(), self.latency.sum
 
     def snapshot(self) -> dict:
@@ -123,6 +142,7 @@ class StreamMetrics:
                 "frames_delivered": self.delivered,
                 "frames_dropped": self.dropped,
                 "frames_failed": self.failed,
+                "engine_shed_retries": self.shed_retries,
                 "e2e_latency_ms": self.latency.summary(scale=1e3),
             }
         out["fps"] = round(self.fps(), 3)
@@ -224,7 +244,8 @@ class StreamSession:
                 while self._depth_locked() >= self.max_in_flight:
                     self._drop_oldest_locked(trace)
             frame = _Frame(self._seq, time.perf_counter(),
-                           trace.now() if trace.enabled else 0.0)
+                           trace.now() if trace.enabled else 0.0,
+                           image_bgr)
             self._seq += 1
             self._pending.append(frame)
             self._unresolved += 1
@@ -244,6 +265,7 @@ class StreamSession:
         else:
             return
         victim.dropped = True
+        victim.image = None
         self.metrics.on_drop()
         if trace.enabled:
             trace.instant("frame_dropped", track=self._track,
@@ -256,43 +278,124 @@ class StreamSession:
         self._unresolved -= 1       # caller holds _cond (re-entrant)
         self._cond.notify_all()
 
-    def _submit_to_engine(self, frame: _Frame, image_bgr) -> None:
-        """Hand the frame to the batcher; bounded retry on load-shed.
-        Admission failure is delivered ON the frame's future (in order),
-        so a pipelined producer never loses a frame silently."""
+    def _submit_to_engine(self, frame: _Frame, image_bgr,
+                          epoch: int = 0) -> None:
+        """Hand the frame to the engine (batcher or pool); bounded
+        jittered-backoff retry on load-shed (``serve.policy`` is the one
+        retry discipline).  Admission failure is delivered ON the
+        frame's future (in order), so a pipelined producer never loses
+        a frame silently.  ``epoch`` tags the engine attempt so a
+        migration can supersede it (see :meth:`migrate`)."""
         deadline = time.perf_counter() + self.overload_timeout_s
+        attempt = 0
         while True:
+            # re-read each attempt: migrate() may swap the engine while
+            # this producer is parked in backoff
+            engine = self.batcher
             try:
-                bf = self.batcher.submit(image_bgr)
+                bf = engine.submit(image_bgr)
                 break
             except ServerOverloaded as e:
                 draining = getattr(self.batcher, "draining", False)
-                if draining or time.perf_counter() >= deadline:
-                    with self._cond:
-                        frame.error = e
-                        frame.ready = True
-                    self._advance()
+                now = time.perf_counter()
+                if draining or now >= deadline:
+                    self._ready_with(frame, error=e, epoch=epoch)
                     return
-                time.sleep(0.002)
+                attempt += 1
+                self.metrics.on_shed_retry()
+                time.sleep(min(jittered_backoff(attempt, base_s=0.002,
+                                                max_s=0.05),
+                               max(0.0, deadline - now)))
             except Exception as e:  # noqa: BLE001 — batcher stopped, bad
                 # frame: deliver on the future, keep the stream alive
-                with self._cond:
-                    frame.error = e
-                    frame.ready = True
-                self._advance()
+                self._ready_with(frame, error=e, epoch=epoch)
                 return
+        resubmit_epoch = None
+        with self._cond:
+            frame.engine_submitted = True
+            if (self.batcher is not engine and not frame.ready
+                    and not frame.dropped):
+                # a migrate() ran while this admission was in flight:
+                # it skipped the frame (engine_submitted was still
+                # False), so the attempt just placed on the OLD engine
+                # must be superseded HERE — bump the epoch (the old
+                # attempt's errors become stale) and re-submit on the
+                # engine the stream migrated to
+                frame.epoch += 1
+                resubmit_epoch = frame.epoch
         bf.add_done_callback(
-            lambda f, frame=frame: self._on_engine_done(frame, f))
+            lambda f, frame=frame, epoch=epoch:
+            self._on_engine_done(frame, f, epoch))
+        if resubmit_epoch is not None:
+            self._submit_to_engine(frame, image_bgr, resubmit_epoch)
+
+    # --------------------------------------------------------- migration
+    def migrate(self, engine, _trace_kind: str = "migrated") -> int:
+        """Rebind this stream to a new engine (a healthy replica or the
+        pool itself) and RE-SUBMIT every in-flight frame that is still
+        waiting on the old one.  In-order delivery is preserved by
+        construction: the pending deque is the delivery order, and a
+        re-submitted frame simply resolves from its new engine future —
+        ``_advance`` never delivers a frame before its predecessors
+        regardless of which engine (or which attempt) resolved it.
+
+        The two halves of the machinery are the ones the repo already
+        trusts: the fenced engine's bounded drain completes every OLD
+        future (its late errors are discarded as stale epochs), and the
+        session's unresolved-futures accounting keeps ``close()`` exact
+        across the swap.  Returns the number of frames re-submitted.
+        """
+        trace = get_tracer()
+        with self._cond:
+            self.batcher = engine
+            victims = []
+            for f in self._pending:
+                if (f.dropped or f.ready or not f.engine_submitted
+                        or f.image is None):
+                    continue
+                f.epoch += 1
+                victims.append((f, f.image, f.epoch))
+        if trace.enabled:
+            trace.instant("session_migrated", track=self._track,
+                          args={"stream": self.stream_id,
+                                "resubmitted": len(victims),
+                                "kind": _trace_kind})
+        for f, img, epoch in victims:
+            self._submit_to_engine(f, img, epoch)
+        return len(victims)
 
     # ---------------------------------------------------------- delivery
-    def _on_engine_done(self, frame: _Frame, bf: Future) -> None:
-        try:
-            frame.result = bf.result()
-        except BaseException as e:  # noqa: BLE001 — delivered per frame
-            frame.error = e
+    def _ready_with(self, frame: _Frame, *, result=None,
+                    error: Optional[BaseException] = None,
+                    epoch: int = 0) -> None:
+        """Land one engine outcome on the frame, exactly once, with the
+        epoch rule: stale ERRORS (an attempt a migration superseded)
+        are discarded — the live attempt owns the frame — while a
+        RESULT wins from any epoch."""
         with self._cond:
-            frame.ready = True
+            if frame.ready:
+                return
+            if frame.dropped:
+                # future already failed at drop time; mark ready so
+                # _advance can discard the husk from the deque
+                frame.ready = True
+                frame.image = None
+            else:
+                if error is not None and epoch != frame.epoch:
+                    return
+                frame.result = result
+                frame.error = error
+                frame.ready = True
+                frame.image = None  # no further re-submission possible
         self._advance()
+
+    def _on_engine_done(self, frame: _Frame, bf: Future,
+                        epoch: int = 0) -> None:
+        try:
+            result, error = bf.result(), None
+        except BaseException as e:  # noqa: BLE001 — delivered per frame
+            result, error = None, e
+        self._ready_with(frame, result=result, error=error, epoch=epoch)
 
     def _advance(self) -> None:
         """Deliver every ready frame at the head of the queue, in order.
@@ -473,6 +576,7 @@ class SessionManager:
         # labeled series end with their stream, Prometheus-style)
         self._retired = {"frames_submitted": 0, "frames_delivered": 0,
                          "frames_dropped": 0, "frames_failed": 0,
+                         "engine_shed_retries": 0,
                          "track_births": 0, "track_deaths": 0}
         if registry is not None:
             import weakref
@@ -536,6 +640,21 @@ class SessionManager:
     def get(self, stream_id: str) -> Optional[StreamSession]:
         with self._lock:
             return self._sessions.get(str(stream_id))
+
+    # --------------------------------------------------------- migration
+    def migrate(self, engine) -> int:
+        """Move every live session (and the manager default) onto a new
+        engine — the fleet-level half of replica failover: when a
+        router fences the replica these streams were bound to, the
+        manager rebinds them to a healthy one and each session
+        re-submits its in-flight frames with delivery order preserved
+        (see :meth:`StreamSession.migrate`).  Sessions opened from here
+        on land on the new engine.  Returns total frames re-submitted.
+        """
+        with self._lock:
+            self.batcher = engine
+            sessions = list(self._sessions.values())
+        return sum(s.migrate(engine) for s in sessions)
 
     @property
     def sessions(self) -> List[StreamSession]:

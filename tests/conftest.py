@@ -40,6 +40,8 @@ _HEAVY_MODULES = frozenset({
     "test_learning.py",         # 82s: real overfit run
     "test_serve.py",            # compiles compact batch programs for
                                 # several (bucket x batch-size) combos
+    "test_serve_pool.py",       # pool integration arm shares test_serve's
+                                # stub-predictor compiles (per-replica)
     "test_checkpoint_async.py", # real donated train-step compile + a
                                 # SIGKILLed subprocess + many orbax writes
     "test_supervisor.py",       # chaos smoke = several full train.py

@@ -14,7 +14,7 @@ CLIS = [
     "scaling_test.py", "pallas_check.py", "tpu_session.py",
     "export_model.py", "import_torch_checkpoint.py", "make_corpus.py",
     "build_native.py", "list_coco.py", "lint.py", "program_audit.py",
-    "stream_bench.py",
+    "stream_bench.py", "chaos_serve.py",
 ]
 
 

@@ -955,3 +955,32 @@ def test_bench_provenance_carries_linter_stamp():
 
     assert prov["graftaudit"]["version"] == GRAFTAUDIT_VERSION
     assert prov["graftaudit"]["ruleset"] == audit_ruleset_hash()
+
+
+def test_scope_covers_fault_tolerant_serving_modules():
+    """ISSUE 11 satellite: the new pool/policy/breaker layer lives in
+    the JGL002 hot-path scope (serving threads run its code per
+    request/failover) and JGL005 sees its thread/executor lifecycles —
+    locked on the files' actual paths so a future move out of serve/
+    can't silently drop them from the sweep."""
+    hot = """
+        import jax.numpy as jnp
+
+        def failover_loop(requests):
+            for r in requests:
+                out = jnp.sum(r)
+                route(float(out))
+    """
+    for path in ("improved_body_parts_tpu/serve/pool.py",
+                 "improved_body_parts_tpu/serve/policy.py",
+                 "improved_body_parts_tpu/serve/breaker.py"):
+        assert "JGL002" in rules_of(lint(hot, path=path)), path
+    leak = """
+        import threading
+
+        def fence(engine):
+            t = threading.Thread(target=engine.stop)
+            t.start()
+    """
+    assert "JGL005" in rules_of(
+        lint(leak, path="improved_body_parts_tpu/serve/pool.py"))

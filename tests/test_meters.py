@@ -121,6 +121,7 @@ class TestServeMetricsConcurrency:
         threads_n, ops = 8, 300
         leave_inflight = 2   # per thread: submitted but never finished
         rejects = 5          # per thread
+        expire_rejects = 3   # per thread: DeadlineExceeded at the door
         barrier = threading.Barrier(threads_n)
 
         def hammer(tid):
@@ -129,11 +130,17 @@ class TestServeMetricsConcurrency:
                 m.on_submit()
                 m.on_dispatch((tid + i) % 4 + 1)
                 if i % 3 == 0:
-                    m.on_fail()
+                    # every other failure is a DeadlineExceeded of an
+                    # ADMITTED request: counted in failed AND expired
+                    m.on_fail(expired=(i % 6 == 0))
                 else:
                     m.on_complete(0.001 * (i % 7))
             for _ in range(rejects):
                 m.on_reject()
+            for _ in range(expire_rejects):
+                # submit-time deadline rejection: never admitted, so
+                # expired moves WITHOUT touching submitted/depth
+                m.on_expire_rejected()
             for _ in range(leave_inflight):
                 m.on_submit()
 
@@ -151,6 +158,10 @@ class TestServeMetricsConcurrency:
         assert m.submitted == m.completed + m.failed + m.depth
         assert m.depth_peak >= m.depth
         assert m.failed == threads_n * len(range(0, ops, 3))
+        # deadline accounting: admitted expiries (a subset of failed) +
+        # door rejections, exactly
+        assert m.expired == threads_n * (len(range(0, ops, 6))
+                                         + expire_rejects)
         # the latency reservoir saw exactly the completions
         assert m.latency.count == m.completed
         # occupancy histogram counts every dispatch

@@ -1211,3 +1211,59 @@ class TestBenchProvenance:
         assert prov["git_sha"] and re.match(r"^[0-9a-f]{40}$",
                                             prov["git_sha"])
         assert json.dumps(prov, allow_nan=False)  # JSON-ready, always
+
+
+class TestPoolExpositionNames:
+    """ISSUE 11 obs satellite: the pool's breaker-state gauges,
+    retry/hedge/failover counters and per-replica labeled engine
+    metrics ride the same registry path — and every name they emit
+    passes the Prometheus lint, collectors included."""
+
+    def test_pool_and_policy_samples_are_prometheus_legal(self):
+        from improved_body_parts_tpu.serve import (
+            EnginePool,
+            PolicyStats,
+            ServeMetrics,
+        )
+
+        class _Eng:
+            def __init__(self):
+                self.metrics = ServeMetrics()
+                self.draining = False
+
+            def start(self):
+                return self
+
+            def stop(self, drain_timeout_s=None):
+                pass
+
+            def health(self):
+                return {"running": True, "draining": False,
+                        "dispatcher_alive": True, "fetchers_alive": 1,
+                        "fetchers_expected": 1, "queue_depth": 0,
+                        "batches_in_flight": 0, "stall_age_s": None}
+
+        r = Registry()
+        pool = EnginePool([_Eng(), _Eng()], registry=r)
+        stats = PolicyStats().register_into(r)  # held: weakref collector
+        assert stats is not None
+        with pool:
+            name_re = TestMetricNameLint.NAME_RE
+            label_re = TestMetricNameLint.LABEL_RE
+            names = set()
+            for name, labels, kind, value, help in r._flat():
+                names.add(name)
+                assert name_re.match(name), name
+                for k in labels:
+                    assert label_re.match(str(k)), (name, k)
+                if kind == "counter":
+                    assert name.endswith(("_total", "_sum", "_count")), \
+                        name
+        # the signals the satellite names: breaker state, replica
+        # state, failover/retry/hedge counters, per-replica labels
+        assert "pool_breaker_state_code" in names
+        assert "pool_replica_state_code" in names
+        assert "pool_failovers_total" in names
+        assert "pool_engine_submitted_total" in names
+        assert "policy_hedges_total" in names
+        assert "policy_admission_retries_total" in names

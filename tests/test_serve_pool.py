@@ -1,0 +1,873 @@
+"""Fault-tolerant serving tests: ``serve.breaker`` / ``serve.policy`` /
+``serve.pool`` (ISSUE 11).
+
+Three tiers:
+
+- **Breaker / policy units** — pure host logic, injectable clocks and
+  fake engines: state machine transitions, jittered backoff bounds,
+  deadline/retry/hedge semantics.
+- **Pool logic on fake engines** — deterministic failover, fencing,
+  breaker-trip routing, zero-lost-futures accounting, without paying a
+  single XLA compile.
+- **Pool integration on real batchers** — the constant-maps stub
+  predictor (the ``test_serve`` pattern), one per replica
+  (shared-nothing): routing correctness, wedge → fence → failover on a
+  gated device, warm-pool no-recompile, and the metric-conservation
+  acceptance (`submitted == completed + failed + depth` exactly across
+  a fence-and-failover cycle and across DeadlineExceeded rejections).
+"""
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.serve import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    EnginePool,
+    PolicyClient,
+    ServeMetrics,
+    ServerOverloaded,
+    jittered_backoff,
+    submit_with_retry,
+)
+
+from test_serve import (  # noqa: F401 — shared fixtures/pattern
+    SIZE_A,
+    GatedPredictor,
+    _assert_same_people,
+    _make_pred,
+    _reference,
+    person_maps,
+    warm_pred,
+)
+
+
+def join_serve_threads(timeout_s: float = 30.0) -> None:
+    """After releasing a wedge gate, wait for the parked serve/pool
+    daemon threads to run out — a thread still inside an XLA dispatch
+    at interpreter teardown aborts the process from C++."""
+    deadline = time.time() + timeout_s
+    for t in threading.enumerate():
+        if t.name.startswith(("serve-", "pool-")):
+            t.join(max(0.0, deadline - time.time()))
+
+
+# --------------------------------------------------------------------- #
+# circuit breaker                                                       #
+# --------------------------------------------------------------------- #
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestCircuitBreaker:
+    def test_stays_closed_below_volume_floor(self):
+        b = CircuitBreaker(failure_threshold=0.5, min_requests=8)
+        for _ in range(7):
+            b.record_failure()      # 100% failure rate, but 7 < 8
+        assert b.state == "closed" and b.allow()
+
+    def test_trips_at_threshold_and_blocks(self):
+        b = CircuitBreaker(failure_threshold=0.5, min_requests=4,
+                           window=8)
+        for _ in range(2):
+            b.record_success()
+        for _ in range(2):
+            b.record_failure()      # 2/4 = 50% >= threshold
+        assert b.state == "open"
+        assert not b.allow()
+        assert b.trips == 1
+
+    def test_cooldown_half_open_probes_then_close(self):
+        clock = FakeClock()
+        b = CircuitBreaker(failure_threshold=0.5, min_requests=2,
+                           cooldown_s=5.0, half_open_probes=2,
+                           clock=clock)
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "open"
+        clock.t = 4.9
+        assert not b.allow()
+        clock.t = 5.1
+        assert b.state == "half_open"
+        # exactly half_open_probes probes are admitted
+        assert b.allow() and b.allow() and not b.allow()
+        b.record_success()
+        assert b.state == "half_open"   # one probe back, one to go
+        b.record_success()
+        assert b.state == "closed"      # healed: full traffic
+        assert b.allow()
+
+    def test_half_open_failure_reopens_with_fresh_cooldown(self):
+        clock = FakeClock()
+        b = CircuitBreaker(failure_threshold=0.5, min_requests=2,
+                           cooldown_s=5.0, clock=clock)
+        b.record_failure()
+        b.record_failure()
+        clock.t = 6.0
+        assert b.allow()                # half-open probe
+        b.record_failure()
+        assert b.state == "open" and b.trips == 2
+        clock.t = 10.0                  # 4s into the NEW cooldown
+        assert not b.allow()
+        clock.t = 11.5
+        assert b.state == "half_open"
+
+    def test_release_probe_returns_the_slot(self):
+        clock = FakeClock()
+        b = CircuitBreaker(failure_threshold=0.5, min_requests=2,
+                           cooldown_s=1.0, half_open_probes=1,
+                           clock=clock)
+        b.record_failure()
+        b.record_failure()
+        clock.t = 2.0
+        assert b.allow() and not b.allow()
+        b.release_probe()               # the submission was shed
+        assert b.allow()                # slot is usable again
+
+    def test_probation_enters_half_open_directly(self):
+        b = CircuitBreaker(min_requests=2, half_open_probes=1)
+        b.probation()
+        assert b.state == "half_open"
+        assert b.allow() and not b.allow()
+
+    def test_reset_closes(self):
+        b = CircuitBreaker(min_requests=1, failure_threshold=1.0)
+        b.record_failure()
+        assert b.state == "open"
+        b.reset()
+        assert b.state == "closed" and b.allow()
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError):
+            CircuitBreaker(failure_threshold=0.0)
+        with pytest.raises(ValueError):
+            CircuitBreaker(min_requests=4, window=2)
+        with pytest.raises(ValueError):
+            CircuitBreaker(half_open_probes=0)
+
+
+# --------------------------------------------------------------------- #
+# policy: backoff / retry / deadline / hedge                            #
+# --------------------------------------------------------------------- #
+def test_jittered_backoff_bounds():
+    import random
+
+    rng = random.Random(0)
+    for attempt in range(1, 12):
+        d = jittered_backoff(attempt, base_s=0.002, max_s=0.25,
+                             jitter=0.5, rng=rng)
+        nominal = min(0.002 * 2 ** (attempt - 1), 0.25)
+        assert 0.5 * nominal <= d <= 1.5 * nominal
+    # growth: later attempts are (nominally) longer until the cap
+    assert jittered_backoff(20, base_s=0.002, max_s=0.25, jitter=0.0) \
+        == pytest.approx(0.25)
+    with pytest.raises(ValueError):
+        jittered_backoff(0)
+
+
+def test_submit_with_retry_counts_and_bounds():
+    calls = {"n": 0}
+
+    def shed_twice(img):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ServerOverloaded("shed")
+        f = Future()
+        f.set_result(img)
+        return f
+
+    fut, retries = submit_with_retry(shed_twice, "img", base_s=1e-4)
+    assert fut.result() == "img" and retries == 2
+
+    def always_shed(img):
+        raise ServerOverloaded("shed")
+
+    with pytest.raises(ServerOverloaded):
+        submit_with_retry(always_shed, "img", max_attempts=3,
+                          base_s=1e-4)
+    aborted = {"n": 0}
+
+    def shed_and_drain(img):
+        aborted["n"] += 1
+        raise ServerOverloaded("draining")
+
+    with pytest.raises(ServerOverloaded):
+        submit_with_retry(shed_and_drain, "img",
+                          should_abort=lambda: True)
+    assert aborted["n"] == 1        # no blind retry against a drain
+
+
+class FakeEngine:
+    """Deadline-/overload-capable stand-in for a DynamicBatcher: futures
+    resolve only when the test says so — deterministic control of every
+    pool/policy race, zero compiles."""
+
+    def __init__(self):
+        self.metrics = ServeMetrics()
+        self._running = True
+        self._draining = False
+        self._lock = threading.Lock()
+        self.pending = []           # (image, future)
+        self.mode = "hold"          # hold | ok | fail | shed
+        self.result_value = "ok"
+        self.fail_with = RuntimeError("replica exploded")
+        self.submits = 0
+        self.stop_delay_s = 0.0     # holds the drain window open
+
+    # --- contract -----------------------------------------------------
+    @property
+    def draining(self):
+        return self._draining
+
+    def start(self):
+        self._running = True
+        return self
+
+    def submit(self, image, *, deadline_s=None):
+        with self._lock:
+            if self._draining:
+                self.metrics.on_reject()
+                raise ServerOverloaded("draining")
+            if not self._running:
+                raise RuntimeError("not running")
+            if self.mode == "shed":
+                self.metrics.on_reject()
+                raise ServerOverloaded("shed")
+            if deadline_s is not None and deadline_s <= 0:
+                self.metrics.on_expire_rejected()
+                raise DeadlineExceeded("expired at submit")
+            self.submits += 1
+            f = Future()
+            self.metrics.on_submit()
+            if self.mode == "ok":
+                self.metrics.on_complete(0.001)
+                f.set_result(self.result_value)
+            elif self.mode == "fail":
+                self.metrics.on_fail()
+                f.set_exception(self.fail_with)
+            else:
+                self.pending.append((image, f))
+            return f
+
+    def stop(self, drain_timeout_s=None):
+        if self.stop_delay_s:
+            time.sleep(self.stop_delay_s)
+        with self._lock:
+            self._running = False
+            pending, self.pending = self.pending, []
+        for _, f in pending:
+            self.metrics.on_fail()
+            try:
+                f.set_exception(RuntimeError(
+                    "batcher stopped before completion (drain deadline "
+                    f"{drain_timeout_s}s exceeded)"))
+            except Exception:  # noqa: BLE001
+                pass
+
+    def health(self):
+        return {"running": self._running, "draining": self._draining,
+                "dispatcher_alive": self._running, "fetchers_alive": 1,
+                "fetchers_expected": 1,
+                "queue_depth": self.metrics.depth,
+                "batches_in_flight": 0,
+                "stall_age_s": self.metrics.stall_age_s()}
+
+    # --- test controls ------------------------------------------------
+    def complete_all(self, value=None):
+        with self._lock:
+            pending, self.pending = self.pending, []
+        for _, f in pending:
+            self.metrics.on_complete(0.001)
+            f.set_result(value if value is not None
+                         else self.result_value)
+
+    def expire_all(self):
+        """Fail every pending future with DeadlineExceeded — what the
+        real dispatcher does when a held request's deadline lapses."""
+        with self._lock:
+            pending, self.pending = self.pending, []
+        for _, f in pending:
+            self.metrics.on_fail(expired=True)
+            f.set_exception(DeadlineExceeded("deadline passed"))
+
+
+class TestPolicyClient:
+    def test_result_passthrough_and_admission_retry(self):
+        eng = FakeEngine()
+        eng.mode = "shed"
+        client = PolicyClient(eng, max_attempts=3, backoff_base_s=1e-4)
+        with pytest.raises(ServerOverloaded):
+            client.submit("img")
+        assert client.stats.admission_retries == 2
+        eng.mode = "ok"
+        assert client.submit("img").result(timeout=5) == "ok"
+        assert client.stats.submitted == 1
+
+    def test_client_deadline_fails_wedged_engine(self):
+        eng = FakeEngine()              # mode=hold: never resolves
+        client = PolicyClient(eng, deadline_s=0.15)
+        fut = client.submit("img")
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=5)
+        assert client.stats.deadline_expired == 1
+
+    def test_deadline_lapsed_during_admission_raises(self):
+        eng = FakeEngine()
+        eng.mode = "shed"
+        client = PolicyClient(eng, deadline_s=0.05, max_attempts=1000,
+                              backoff_base_s=0.02, backoff_max_s=0.02)
+        with pytest.raises(DeadlineExceeded):
+            client.submit("img")
+
+    def test_hedge_second_dispatch_first_result_wins(self):
+        eng = FakeEngine()              # hold: primary parks
+        client = PolicyClient(eng, hedge_after_s=0.05)
+        fut = client.submit("img")
+        deadline = time.time() + 5
+        while eng.submits < 2 and time.time() < deadline:
+            time.sleep(0.005)           # hedge timer fired a 2nd submit
+        assert eng.submits == 2
+        eng.complete_all("late-pair")
+        assert fut.result(timeout=5) == "late-pair"
+        assert client.stats.hedges == 1
+        # one of the two attempts won; the loser's result was discarded
+        assert client.stats.hedge_wins in (0, 1)
+
+    def test_fast_result_never_hedges(self):
+        eng = FakeEngine()
+        eng.mode = "ok"
+        client = PolicyClient(eng, hedge_after_s=0.2)
+        assert client.submit("img").result(timeout=5) == "ok"
+        time.sleep(0.3)                 # past the hedge point
+        assert client.stats.hedges == 0 and eng.submits == 1
+
+    def test_error_waits_for_all_attempts(self):
+        """With a hedge outstanding, one attempt's failure must NOT
+        surface while the other can still win."""
+        eng = FakeEngine()
+        client = PolicyClient(eng, hedge_after_s=0.05)
+        fut = client.submit("img")
+        deadline = time.time() + 5
+        while eng.submits < 2 and time.time() < deadline:
+            time.sleep(0.005)
+        # fail the first attempt only
+        img, f0 = eng.pending.pop(0)
+        eng.metrics.on_fail()
+        f0.set_exception(RuntimeError("first attempt died"))
+        time.sleep(0.05)
+        assert not fut.done()           # hedge still pending
+        eng.complete_all("rescued")
+        assert fut.result(timeout=5) == "rescued"
+        assert client.stats.hedge_wins == 1
+
+
+# --------------------------------------------------------------------- #
+# pool logic on fake engines                                            #
+# --------------------------------------------------------------------- #
+def _mk_pool(engines, **kw):
+    kw.setdefault("probe_interval_s", 0.03)
+    kw.setdefault("wedge_timeout_s", 30.0)
+    kw.setdefault("drain_timeout_s", 0.5)
+    return EnginePool(engines, **kw)
+
+
+class TestEnginePoolLogic:
+    def test_least_loaded_routing(self):
+        a, b = FakeEngine(), FakeEngine()
+        with _mk_pool([a, b]) as pool:
+            f1 = pool.submit("x")       # both empty: replica 0
+            assert a.submits == 1
+            f2 = pool.submit("y")       # a has depth 1: replica 1
+            assert b.submits == 1
+            a.complete_all()
+            b.complete_all()
+            assert f1.result(timeout=5) == "ok"
+            assert f2.result(timeout=5) == "ok"
+        snap = pool.metrics.snapshot()
+        assert snap["submitted"] == snap["completed"] == 2
+        assert snap["queue_depth"] == 0
+
+    def test_failover_on_replica_failure(self):
+        a, b = FakeEngine(), FakeEngine()
+        a.mode = "fail"
+        b.mode = "ok"
+        with _mk_pool([a, b]) as pool:
+            # every request first lands on a (depth ties route to 0),
+            # fails, and must transparently fail over to b
+            futs = [pool.submit(f"img{i}") for i in range(3)]
+            for f in futs:
+                assert f.result(timeout=5) == "ok"
+            c = pool.counters()
+        assert c["failovers"] >= 3 and c["resubmitted"] >= 3
+        snap = pool.metrics.snapshot()
+        assert snap["submitted"] == snap["completed"] == 3
+        assert snap["failed"] == 0      # callers never saw the failures
+
+    def test_failover_exhaustion_delivers_typed_error(self):
+        a, b = FakeEngine(), FakeEngine()
+        a.mode = b.mode = "fail"
+        with _mk_pool([a, b]) as pool:
+            fut = pool.submit("img")
+            with pytest.raises(RuntimeError, match="replica exploded"):
+                fut.result(timeout=5)
+        snap = pool.metrics.snapshot()
+        assert snap["submitted"] == snap["failed"] == 1
+        assert snap["completed"] == 0
+
+    def test_all_replicas_shedding_raises_overloaded(self):
+        a, b = FakeEngine(), FakeEngine()
+        a.mode = b.mode = "shed"
+        with _mk_pool([a, b]) as pool:
+            with pytest.raises(ServerOverloaded, match="no healthy"):
+                pool.submit("img")
+            assert pool.metrics.rejected == 1
+            assert pool.metrics.submitted == 0
+
+    def test_breaker_trip_fences_and_drains(self):
+        a, b = FakeEngine(), FakeEngine()
+        a.mode = "fail"
+        b.mode = "ok"
+        with _mk_pool([a, b], breaker_kw=dict(
+                failure_threshold=0.5, min_requests=2,
+                cooldown_s=60.0)) as pool:
+            futs = [pool.submit(f"i{i}") for i in range(4)]
+            for f in futs:
+                assert f.result(timeout=5) == "ok"
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                states = pool.replica_states()
+                if states[0]["state"] == "fenced":
+                    break
+                time.sleep(0.01)
+            assert states[0]["state"] == "fenced"
+            assert states[0]["fence_reason"] == "breaker_open"
+            # fenced replica takes no traffic; b serves everything
+            before = a.submits
+            assert pool.submit("late").result(timeout=5) == "ok"
+            assert a.submits == before
+        assert pool.counters()["fenced"] == 1
+
+    def test_stopped_replica_is_fenced_and_pool_keeps_serving(self):
+        a, b = FakeEngine(), FakeEngine()
+        a.mode = b.mode = "ok"
+        with _mk_pool([a, b]) as pool:
+            assert pool.submit("x").result(timeout=5) == "ok"
+            a.stop()                    # dies out from under the pool
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if pool.replica_states()[0]["state"] == "fenced":
+                    break
+                time.sleep(0.01)
+            assert pool.replica_states()[0]["state"] == "fenced"
+            assert pool.replica_states()[0]["fence_reason"] == "stopped"
+            for i in range(3):
+                assert pool.submit(f"y{i}").result(timeout=5) == "ok"
+
+    def test_in_flight_resubmitted_when_replica_hard_stops(self):
+        """THE failover acceptance on fakes: requests in flight on a
+        replica that hard-stops land on the healthy one — zero lost
+        futures, failures invisible to callers."""
+        a, b = FakeEngine(), FakeEngine()
+        with _mk_pool([a, b]) as pool:
+            futs = [pool.submit(f"r{i}") for i in range(4)]
+            assert a.submits >= 1 and len(a.pending) >= 1
+            t0 = time.perf_counter()
+            a.stop(drain_timeout_s=0.0)   # strands its in-flight work
+            b.complete_all("moved")       # resubmissions land on b
+            deadline = time.time() + 5
+            while time.time() < deadline and \
+                    not all(f.done() for f in futs):
+                b.complete_all("moved")
+                time.sleep(0.01)
+            for f in futs:
+                assert f.result(timeout=5) in ("moved", "ok")
+            failover_s = time.perf_counter() - t0
+        assert failover_s < 5.0           # bounded, not hanging
+        snap = pool.metrics.snapshot()
+        assert snap["submitted"] == snap["completed"] == 4
+        assert snap["failed"] == 0
+        assert pool.counters()["resubmitted"] >= 1
+
+    def test_restart_after_fence_rejoins_routing(self):
+        a, b = FakeEngine(), FakeEngine()
+        a.mode = b.mode = "ok"
+        with _mk_pool([a, b]) as pool:
+            a.stop()
+            deadline = time.time() + 5
+            while time.time() < deadline and \
+                    pool.replica_states()[0]["state"] != "fenced":
+                time.sleep(0.01)
+            assert pool.restart(0)
+            assert pool.replica_states()[0]["state"] == "live"
+            assert not pool.restart(0)    # idempotent: already live
+            assert pool.submit("z").result(timeout=5) == "ok"
+        assert pool.counters()["restarts"] == 1
+
+    def test_pool_deadline_and_conservation_across_failure_cycle(self):
+        """Acceptance satellite: submitted == completed + failed + depth
+        EXACTLY across a fence-and-failover cycle AND DeadlineExceeded
+        rejections, at the pool level."""
+        a, b = FakeEngine(), FakeEngine()
+        with _mk_pool([a, b]) as pool:
+            with pytest.raises(DeadlineExceeded):
+                pool.submit("dead", deadline_s=0.0)   # door rejection
+            ok = [pool.submit(f"k{i}") for i in range(3)]
+            a.stop(drain_timeout_s=0.0)               # fence + failover
+            b.complete_all()
+            deadline = time.time() + 5
+            while time.time() < deadline and \
+                    not all(f.done() for f in ok):
+                b.complete_all()
+                time.sleep(0.01)
+            for f in ok:
+                f.result(timeout=5)
+            m = pool.metrics
+            assert m.submitted == m.completed + m.failed + m.depth
+            assert m.expired == 1 and m.submitted == 3
+        m = pool.metrics
+        assert m.submitted == m.completed + m.failed + m.depth
+
+    def test_pool_draining_rejects_and_resolves_everything(self):
+        a, b = FakeEngine(), FakeEngine()
+        # hold the drain window open so the submit-during-drain probe
+        # deterministically lands INSIDE it (instant fake drains made
+        # this a race under load)
+        a.stop_delay_s = b.stop_delay_s = 0.75
+        pool = _mk_pool([a, b]).start()
+        futs = [pool.submit(f"p{i}") for i in range(4)]
+        stopper = threading.Thread(
+            target=lambda: pool.stop(drain_timeout_s=5.0))
+        stopper.start()
+        deadline = time.time() + 5
+        while not pool.draining and time.time() < deadline:
+            time.sleep(0.005)
+        with pytest.raises(ServerOverloaded, match="draining"):
+            pool.submit("late")
+        stopper.join(timeout=30)
+        assert not stopper.is_alive()
+        # EVERY submitted future resolved (result or typed error)
+        for f in futs:
+            assert f.done()
+            try:
+                f.result(timeout=0)
+            except RuntimeError:
+                pass
+
+    def test_expiring_probe_releases_the_half_open_slot(self):
+        """Review regression: a half-open probe whose request dies of
+        DeadlineExceeded records NO outcome — the probe slot must come
+        back, or enough expiring probes wedge the breaker half-open
+        forever (it could then never close OR reopen)."""
+        a = FakeEngine()
+        with _mk_pool([a], breaker_kw=dict(
+                min_requests=2, half_open_probes=1)) as pool:
+            r = pool._replicas[0]
+            r.breaker.probation()
+            assert r.breaker.state == "half_open"
+            fut = pool.submit("probe", deadline_s=0.05)  # takes the slot
+            a.expire_all()
+            with pytest.raises(DeadlineExceeded):
+                fut.result(timeout=5)
+            # the slot is back: the next probe can be routed
+            assert r.breaker.allow()
+        m = pool.metrics
+        assert m.expired == 1
+        assert m.submitted == m.completed + m.failed + m.depth
+
+    def test_restart_during_fence_drain_is_serialized(self, warm_pred,
+                                                      person_maps):
+        """Review regression: restart() racing the fence's background
+        drain must wait out the drain's tail (engine start/stop share a
+        lock) instead of having the old drain tear down the fresh
+        pipeline — and the replica re-enters routing able to serve."""
+        from improved_body_parts_tpu.serve import DynamicBatcher
+
+        img = np.zeros((*SIZE_A, 3), np.uint8)
+        gate = threading.Event()                 # wedged device
+        wedged = GatedPredictor(_make_pred(person_maps), gate)
+        engines = [DynamicBatcher(wedged, max_batch=1, max_wait_ms=5,
+                                  use_native=False)]
+        # wedge_timeout WELL above the host's contended service time
+        # (the §3c production rule): after the gate opens, the old
+        # generation's ghost dispatch computes alongside the real
+        # post-restart request on the same cores — neither may be
+        # false-fenced as wedged while legitimately slow
+        pool = EnginePool(engines, probe_interval_s=0.05,
+                          wedge_timeout_s=8.0, drain_timeout_s=1.0)
+        with pool:
+            fut = pool.submit(img)               # wedges replica 0
+            deadline = time.time() + 30
+            while time.time() < deadline and \
+                    pool.replica_states()[0]["state"] != "fenced":
+                time.sleep(0.01)
+            assert pool.replica_states()[0]["state"] == "fenced"
+            # restart IMMEDIATELY, while the drain thread is still
+            # inside engine.stop(drain_timeout_s=1.0)
+            assert pool.restart(0)
+            assert pool.replica_states()[0]["state"] == "live"
+            with pytest.raises(RuntimeError):
+                fut.result(timeout=60)           # single replica: no
+            gate.set()                           # failover target
+            # the restarted pipeline is intact and serves
+            pool.submit(img).result(timeout=120)
+        join_serve_threads()
+
+    def test_registry_exposition_with_replica_labels(self):
+        from improved_body_parts_tpu.obs import Registry
+
+        reg = Registry()
+        a, b = FakeEngine(), FakeEngine()
+        a.mode = b.mode = "ok"
+        with _mk_pool([a, b], registry=reg) as pool:
+            pool.submit("x").result(timeout=5)
+            text = reg.prometheus()
+        assert "pool_submitted_total 1.0" in text
+        assert 'pool_replica_state_code{replica="0"}' in text
+        assert 'pool_breaker_state_code{replica="1"}' in text
+        assert "pool_failovers_total 0.0" in text
+        assert 'pool_engine_submitted_total{replica="0"}' in text
+
+    def test_needs_at_least_one_engine(self):
+        with pytest.raises(ValueError):
+            EnginePool([])
+
+
+# --------------------------------------------------------------------- #
+# batcher hooks (deadline / idempotent stop / health)                   #
+# --------------------------------------------------------------------- #
+class TestBatcherHooks:
+    def test_submit_deadline_nonpositive_raises(self, warm_pred):
+        from improved_body_parts_tpu.serve import DynamicBatcher
+
+        img = np.zeros((*SIZE_A, 3), np.uint8)
+        with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                            use_native=False) as server:
+            with pytest.raises(DeadlineExceeded):
+                server.submit(img, deadline_s=0.0)
+            assert server.metrics.expired == 1
+            assert server.metrics.submitted == 0
+            # the batcher still serves normally afterwards
+            server.warmup([SIZE_A], batch_sizes=(1, 2))
+            server.submit(img).result(timeout=120)
+
+    def test_expired_request_fails_before_dispatch(self, warm_pred):
+        """A request whose deadline lapses while the device is busy is
+        failed by the dispatcher BEFORE device dispatch — it never
+        occupies a batch lane — and conservation holds exactly."""
+        from improved_body_parts_tpu.serve import DynamicBatcher
+
+        img = np.zeros((*SIZE_A, 3), np.uint8)
+        gate = threading.Event()
+        gated = GatedPredictor(warm_pred, gate)
+        with DynamicBatcher(gated, max_batch=1, max_wait_ms=5,
+                            max_queue=8, use_native=False) as server:
+            f1 = server.submit(img)             # occupies the device
+            time.sleep(0.05)                    # dispatcher parks on gate
+            f2 = server.submit(img, deadline_s=0.05)
+            time.sleep(0.1)                     # deadline lapses while
+            gate.set()                          # the device was busy
+            with pytest.raises(DeadlineExceeded):
+                f2.result(timeout=120)          # never dispatched
+            f1.result(timeout=120)
+            m = server.metrics
+            assert m.expired == 1 and m.failed == 1
+            assert m.submitted == m.completed + m.failed + m.depth
+        # no batch was dispatched for the expired request
+        assert sum(server.metrics.occupancy.values()) == 1
+
+    def test_stop_is_idempotent_and_concurrent_safe(self, warm_pred):
+        """Double-stop from router fencing + user shutdown must not
+        raise or double-join (satellite regression)."""
+        from improved_body_parts_tpu.serve import DynamicBatcher
+
+        img = np.zeros((*SIZE_A, 3), np.uint8)
+        server = DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                                use_native=False)
+        server.stop()                   # never started: no-op
+        server.start()
+        server.warmup([SIZE_A], batch_sizes=(1, 2))
+        futs = [server.submit(img) for _ in range(3)]
+        errors = []
+
+        def stopper():
+            try:
+                server.stop(drain_timeout_s=60.0)
+            except Exception as e:  # noqa: BLE001 — the regression
+                errors.append(e)
+
+        threads = [threading.Thread(target=stopper) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive()
+        assert errors == []
+        for f in futs:
+            f.result(timeout=0)         # drained, not stranded
+        server.stop()                   # stop-after-stop: no-op
+        # restartable after the double-stop
+        server.start()
+        server.submit(img).result(timeout=120)
+        server.stop()
+
+    def test_health_readout(self, warm_pred):
+        from improved_body_parts_tpu.serve import DynamicBatcher
+
+        server = DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                                use_native=False)
+        h = server.health()
+        assert not h["running"] and not h["dispatcher_alive"]
+        with server:
+            h = server.health()
+            assert h["running"] and h["dispatcher_alive"]
+            assert h["fetchers_alive"] == h["fetchers_expected"] == 1
+            assert h["stall_age_s"] is None      # idle
+        h = server.health()
+        assert not h["running"]
+
+    def test_stall_age_tracks_wedged_device(self, warm_pred):
+        from improved_body_parts_tpu.serve import DynamicBatcher
+
+        img = np.zeros((*SIZE_A, 3), np.uint8)
+        gate = threading.Event()
+        gated = GatedPredictor(warm_pred, gate)
+        with DynamicBatcher(gated, max_batch=1, max_wait_ms=5,
+                            use_native=False) as server:
+            f = server.submit(img)
+            time.sleep(0.15)
+            stall = server.health()["stall_age_s"]
+            assert stall is not None and stall >= 0.1
+            gate.set()
+            f.result(timeout=120)
+            assert server.health()["stall_age_s"] is None
+
+
+# --------------------------------------------------------------------- #
+# pool integration on real batchers                                     #
+# --------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def replica_preds(person_maps):
+    """Two shared-nothing predictors (one per replica); module-scoped so
+    their program caches persist across tests."""
+    return _make_pred(person_maps), _make_pred(person_maps)
+
+
+def _real_pool(preds, **pool_kw):
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    engines = [DynamicBatcher(p, max_batch=2, max_wait_ms=20,
+                              use_native=False) for p in preds]
+    pool_kw.setdefault("probe_interval_s", 0.05)
+    pool_kw.setdefault("drain_timeout_s", 1.0)
+    return EnginePool(engines, **pool_kw)
+
+
+def test_pool_serves_real_traffic_with_correct_results(replica_preds):
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    ref = _reference(replica_preds[0], img)
+    with _real_pool(replica_preds) as pool:
+        pool.warmup([SIZE_A], batch_sizes=(1, 2))
+        futs = [pool.submit(img) for _ in range(6)]
+        for f in futs:
+            _assert_same_people(f.result(timeout=120), ref)
+        m = pool.metrics
+        assert m.submitted == 6
+        assert m.submitted == m.completed + m.failed + m.depth
+    assert pool.metrics.completed == 6
+
+
+def test_pool_warm_serves_with_zero_new_programs(replica_preds):
+    """Acceptance: a warm pool serves with 0 post-warmup recompiles per
+    replica — asserted on each predictor's program-cache keys (the
+    test_serve no-compile-stall discipline)."""
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    with _real_pool(replica_preds) as pool:
+        pool.warmup([SIZE_A], batch_sizes=(1, 2))
+        keys = [set(p._fns) for p in replica_preds]
+        futs = [pool.submit(img) for _ in range(5)]
+        for f in futs:
+            f.result(timeout=120)
+    for p, k in zip(replica_preds, keys):
+        assert set(p._fns) == k
+
+
+def test_pool_wedge_fence_failover_end_to_end(replica_preds, person_maps):
+    """Integration acceptance: a replica wedges on a gated device →
+    probe fences it → bounded drain fails its in-flight work → the pool
+    re-submits to the healthy replica → the caller's future resolves
+    with the CORRECT result; conservation holds at every level."""
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    ref = _reference(replica_preds[0], img)
+    gate = threading.Event()                 # never set: wedged device
+    wedged = GatedPredictor(_make_pred(person_maps), gate)
+    engines = [DynamicBatcher(wedged, max_batch=1, max_wait_ms=5,
+                              use_native=False),
+               DynamicBatcher(replica_preds[1], max_batch=2,
+                              max_wait_ms=20, use_native=False)]
+    # wedge_timeout WELL above the 2-core host's contended service time
+    # (§3c rule): the gated replica's stall is infinite so it still
+    # fences promptly at this margin, while the HEALTHY replica's
+    # legitimately slow forwards under parallel-suite load must not be
+    # collateral-fenced (seen flaking at 0.3s)
+    pool = EnginePool(engines, probe_interval_s=0.05,
+                      wedge_timeout_s=8.0, drain_timeout_s=1.0)
+    with pool:
+        engines[1].warmup([SIZE_A], batch_sizes=(1, 2))
+        t0 = time.perf_counter()
+        fut = pool.submit(img)               # ties route to replica 0
+        got = fut.result(timeout=120)        # must fail over to 1
+        failover_s = time.perf_counter() - t0
+        _assert_same_people(got, ref)
+        states = pool.replica_states()
+        assert states[0]["state"] == "fenced"
+        assert states[0]["fence_reason"] in ("wedged", "stopped")
+        c = pool.counters()
+        assert c["fenced"] == 1 and c["resubmitted"] >= 1
+        m = pool.metrics
+        assert m.submitted == m.completed + m.failed + m.depth
+        assert m.completed == 1 and m.failed == 0
+        # the pool keeps serving on the healthy replica
+        _assert_same_people(pool.submit(img).result(timeout=120), ref)
+    gate.set()                               # unpin the parked thread
+    join_serve_threads()
+    assert failover_s < 60.0
+
+
+@pytest.mark.slow
+def test_chaos_serve_cli(tmp_path):
+    """tools/chaos_serve.py end-to-end smoke: every injection fires,
+    zero lost futures, no leaks, 0 post-warmup recompiles — the
+    SERVE_CHAOS.json contract (the committed artifact carries the full
+    sweep)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    out = tmp_path / "SERVE_CHAOS.json"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "chaos_serve.py"),
+         "--replicas", "2", "--requests", "4", "--streams", "2",
+         "--frames", "6", "--strict", "--out", str(out)],
+        check=True, timeout=1500, env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    r = json.loads(out.read_text())
+    assert r["ok"] is True
+    assert [i["kind"] for i in r["injections"]] == [
+        "wedged_fetcher", "poisoned_program", "killed_decode_pool",
+        "replica_hard_stop_mid_stream", "latency_spike"]
+    assert r["futures"]["lost"] == 0
+    assert r["recompiles_post_warmup"] == 0
+    assert r["leaked_threads"] == []
+    assert r["checks_failed"] == 0

@@ -602,3 +602,138 @@ def test_stream_bench_cli(tmp_path):
     assert isinstance(r["engine_scales_with_streams"], bool)
     assert r["recompiles_post_warmup"] == 0
     assert r["track_ids_stable_all_rounds"] is True
+
+
+# --------------------------------------------------------------------- #
+# session migration off a fenced replica (ISSUE 11)                     #
+# --------------------------------------------------------------------- #
+def _join_serve_threads(timeout_s=30.0):
+    """Bounded wait for parked serve/pool daemon threads after a gate
+    release — a thread still inside an XLA dispatch at interpreter
+    teardown aborts the process from C++."""
+    deadline = time.time() + timeout_s
+    for t in threading.enumerate():
+        if t.name.startswith(("serve-", "pool-")):
+            t.join(max(0.0, deadline - time.time()))
+
+
+@pytest.fixture(scope="module")
+def second_pred():
+    """A second shared-nothing stub predictor (replica B for the
+    migration/failover tests); module-scoped so its programs compile
+    once."""
+    from test_serve import _make_pred, _person_maps
+
+    pred = _make_pred(_person_maps())
+    pred.precompile_compact([pred.compact_lane_shape(
+        np.zeros((*SIZE, 3), np.uint8), pred.params)],
+        batch_sizes=(1, 2), decode=True)
+    return pred
+
+
+def test_session_migrate_preserves_frame_order(warm_pred, second_pred):
+    """THE migration acceptance: frames in flight on a WEDGED engine are
+    re-submitted to a healthy one by migrate(), every future resolves
+    with a real result, and delivery (tracker updates) stays strictly
+    in frame order — the wedged engine's late drain errors are
+    discarded as stale, never delivered."""
+    from test_serve import GatedPredictor
+
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    gate = threading.Event()                 # never set: A is wedged
+    gated = GatedPredictor(second_pred, gate)
+    a = DynamicBatcher(gated, max_batch=1, max_wait_ms=5,
+                       use_native=False).start()
+    with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                        use_native=False) as b:
+        session = _manager(a, max_in_flight=4).open("cam0")
+        futs = [session.submit_frame(_img()) for _ in range(3)]
+        time.sleep(0.05)                     # A's dispatcher parks
+        assert not any(f.done() for f in futs)
+        moved = session.migrate(b)
+        assert moved == 3
+        results = [f.result(timeout=120) for f in futs]
+        # the fenced replica's bounded drain fails the OLD futures —
+        # stale epochs, discarded (frames already delivered above)
+        a.stop(drain_timeout_s=0.5)
+        ids0 = sorted(p.track_id for p in results[0])
+        assert len(ids0) >= 1
+        for i, r in enumerate(results):
+            assert sorted(p.track_id for p in r) == ids0
+            assert all(p.age == i for p in r)  # in-order tracker updates
+        snap = session.snapshot()
+        assert snap["frames_delivered"] == 3
+        assert snap["frames_failed"] == 0 and snap["frames_dropped"] == 0
+        assert session.close(timeout_s=60)
+    gate.set()                               # unpin the parked thread
+    _join_serve_threads()
+
+
+def test_manager_migrate_moves_every_session(warm_pred, second_pred):
+    """SessionManager.migrate rebinds every live session AND the
+    manager default: in-flight frames re-submit, later opens land on
+    the new engine."""
+    from test_serve import GatedPredictor
+
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    gate = threading.Event()
+    gated = GatedPredictor(second_pred, gate)
+    a = DynamicBatcher(gated, max_batch=1, max_wait_ms=5,
+                       use_native=False).start()
+    with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                        use_native=False) as b:
+        mgr = _manager(a, max_in_flight=4)
+        s0, s1 = mgr.open("cam0"), mgr.open("cam1")
+        futs = [s.submit_frame(_img()) for s in (s0, s1) for _ in range(2)]
+        time.sleep(0.05)
+        moved = mgr.migrate(b)
+        assert moved == 4
+        for f in futs:
+            assert len(f.result(timeout=120)) >= 1
+        late = mgr.open("cam2")
+        assert late.batcher is b             # new opens use the new engine
+        assert len(late.submit_frame(_img()).result(timeout=120)) >= 1
+        mgr.close_all(timeout_s=60)
+        a.stop(drain_timeout_s=0.5)
+    gate.set()
+    _join_serve_threads()
+
+
+def test_sessions_over_pool_survive_replica_hard_stop(warm_pred,
+                                                      second_pred):
+    """Streams driven through an EnginePool survive a replica hard-stop
+    MID-STREAM with no session-side involvement: the pool re-submits
+    the stranded frames to the healthy replica and the session's
+    in-order delivery machinery never notices which replica resolved a
+    frame."""
+    from test_serve import GatedPredictor
+
+    from improved_body_parts_tpu.serve import DynamicBatcher, EnginePool
+
+    gate = threading.Event()
+    gated = GatedPredictor(second_pred, gate)
+    engines = [DynamicBatcher(gated, max_batch=1, max_wait_ms=5,
+                              use_native=False),
+               DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                              use_native=False)]
+    with EnginePool(engines, probe_interval_s=0.05, wedge_timeout_s=30.0,
+                    drain_timeout_s=0.5) as pool:
+        session = _manager(pool, max_in_flight=6).open("cam0")
+        futs = [session.submit_frame(_img()) for _ in range(4)]
+        time.sleep(0.1)                      # some frames park on A
+        engines[0].stop(drain_timeout_s=0.1)   # replica hard-stop
+        results = [f.result(timeout=120) for f in futs]
+        for i, r in enumerate(results):
+            assert len(r) >= 1
+            assert all(p.age == i for p in r)  # order preserved
+        snap = session.snapshot()
+        assert snap["frames_delivered"] == 4
+        assert snap["frames_failed"] == 0
+        assert pool.counters()["resubmitted"] >= 1
+        m = pool.metrics
+        assert m.submitted == m.completed + m.failed + m.depth
+        assert session.close(timeout_s=60)
+    gate.set()
+    _join_serve_threads()

@@ -1,0 +1,613 @@
+#!/usr/bin/env python
+"""Fault-injection harness for fault-tolerant serving (serve.pool).
+
+The serve-side twin of ``tools/chaos_train.py``: a replica pool
+(``serve.EnginePool`` over shared-nothing ``DynamicBatcher`` replicas)
+serves real traffic — single-image submits, policy-layer hedged
+submits, and live stream sessions — while deterministic faults are
+injected INTO the serving machinery:
+
+- **wedged fetcher**: a replica's device resolve parks forever — the
+  health probe must see the stall, fence the replica, and the bounded
+  drain must fail its in-flight work over to a healthy replica;
+- **poisoned program**: a replica's execute raises mid-flight until its
+  failure-rate circuit breaker trips — callers must never see the
+  failures (failover), the replica is fenced, and after restart it
+  re-enters through HALF-OPEN probation and closes its breaker;
+- **killed decode pool**: a host-pool-lane replica's decode executor is
+  shut down out from under it — the fetcher's inline-decode fallback
+  must keep the replica serving (degraded, not dead: no fence);
+- **replica hard-stop mid-stream**: a replica is stopped abruptly while
+  live ``StreamSession`` traffic is pinned on it — the pool re-submits
+  the stranded frames and every stream must deliver ALL frames strictly
+  in order (the tracker's age stamp is the proof);
+- **latency spike**: a replica turns slow — the policy layer's hedged
+  second dispatch must bound the tail (hedges fire and win).
+
+Asserted end to end, the ISSUE 11 acceptance: **zero lost futures**
+(every submit() of any kind resolves with a result or a typed error),
+**bounded failover time**, **frame-order-preserving migration**, a
+thread/descendant **leak scan**, and a **0 post-warmup recompile** count
+per replica (the pool's program warmup covers every shape the chaos
+traffic can hit).
+
+Writes SERVE_CHAOS.json; registered as bench.py's ``"servechaos"`` key
+(``IBP_BENCH_SERVECHAOS=0`` skips).
+
+    python tools/chaos_serve.py                          # full sweep
+    python tools/chaos_serve.py --requests 4 --frames 6  # smoke
+"""
+import argparse
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
+
+# ------------------------------------------------------------ chaos preds
+class ChaosBox:
+    """Per-replica fault controls, armed/disarmed by the harness."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.wedge = threading.Event()   # set = wedged
+        self.release = threading.Event()  # set = wedged resolves may pass
+        self.poison_left = 0
+        self.delay_s = 0.0
+
+    def apply(self):
+        """Runs INSIDE a wrapped resolve(), on the replica's fetch
+        thread — the mid-execute injection point."""
+        if self.delay_s > 0:
+            time.sleep(self.delay_s)
+        if self.wedge.is_set():
+            self.release.wait()          # parks the fetcher
+        with self.lock:
+            if self.poison_left > 0:
+                self.poison_left -= 1
+                raise RuntimeError(
+                    "chaos: poisoned program raised mid-execute")
+
+
+class ChaosPredictor:
+    """Wraps a real Predictor; every async dispatch's resolve() first
+    passes through the replica's ChaosBox (wedge / poison / delay land
+    mid-execute, exactly where a sick device or program would)."""
+
+    def __init__(self, inner, box: ChaosBox):
+        self._inner, self._box = inner, box
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _wrap(self, resolve):
+        def wrapped():
+            self._box.apply()
+            return resolve()
+
+        return wrapped
+
+    def predict_compact_async(self, *a, **kw):
+        return self._wrap(self._inner.predict_compact_async(*a, **kw))
+
+    def predict_compact_batch_async(self, *a, **kw):
+        return self._wrap(
+            self._inner.predict_compact_batch_async(*a, **kw))
+
+    def predict_decoded_async(self, *a, **kw):
+        return self._wrap(self._inner.predict_decoded_async(*a, **kw))
+
+    def predict_decoded_batch_async(self, *a, **kw):
+        return self._wrap(
+            self._inner.predict_decoded_batch_async(*a, **kw))
+
+
+class LedgeredFutures:
+    """Every future the harness ever hands out, so 'zero lost futures'
+    is a checkable number, not a vibe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._futs = []
+
+    def track(self, fut, kind):
+        with self._lock:
+            self._futs.append((fut, kind))
+        return fut
+
+    def audit(self, timeout_s=30.0):
+        deadline = time.monotonic() + timeout_s
+        unresolved = []
+        ok = err = 0
+        by_error = {}
+        with self._lock:
+            futs = list(self._futs)
+        for fut, kind in futs:
+            remaining = max(0.0, deadline - time.monotonic())
+            try:
+                fut.result(timeout=remaining)
+                ok += 1
+            except Exception as e:  # noqa: BLE001 — typed errors are a
+                # RESOLUTION; only a never-resolving future is a loss
+                if fut.done():
+                    err += 1
+                    name = type(e).__name__
+                    by_error[name] = by_error.get(name, 0) + 1
+                else:
+                    unresolved.append(kind)
+        return {"tracked": len(futs), "resolved_ok": ok,
+                "resolved_error": err, "errors_by_type": by_error,
+                "lost": len(unresolved), "lost_kinds": unresolved}
+
+
+def wait_until(pred, timeout_s=30.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return pred()
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", default="tiny")
+    ap.add_argument("--size", type=int, default=128,
+                    help="square image size of the chaos traffic")
+    ap.add_argument("--boxsize", type=int, default=128)
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="pool replicas (>= 2 so failover has a target; "
+                         "replica 1 runs the host-pool decode lane for "
+                         "the killed-decode-pool injection)")
+    ap.add_argument("--requests", type=int, default=6,
+                    help="requests per single-image injection phase")
+    ap.add_argument("--streams", type=int, default=2)
+    ap.add_argument("--frames", type=int, default=8,
+                    help="frames per stream in the hard-stop phase")
+    ap.add_argument("--planted", type=int, default=1)
+    ap.add_argument("--wedge-timeout", type=float, default=8.0,
+                    help="pool wedge_timeout_s (stall age before fence); "
+                         "must sit WELL above the host's burst-case "
+                         "batch service time or a merely-busy replica "
+                         "gets false-fenced — on this harness's 2-core "
+                         "class hosts, replica forwards contend for the "
+                         "same cores, so the margin is generous")
+    ap.add_argument("--failover-bound", type=float, default=60.0,
+                    help="per-injection wall bound on full recovery")
+    ap.add_argument("--out", default="SERVE_CHAOS.json")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero when any assertion fails")
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.utils import (
+        apply_platform_env, devices_with_timeout)
+    apply_platform_env()
+
+    import jax
+    import numpy as np
+
+    platform = devices_with_timeout(900)[0].platform
+    print(f"platform={platform}", flush=True)
+
+    from e2e_bench import PlantedModel, planted_maps
+
+    from improved_body_parts_tpu.config import (
+        InferenceModelParams, get_config)
+    from improved_body_parts_tpu.infer.predict import Predictor
+    from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.obs import Registry, RunTelemetry
+    from improved_body_parts_tpu.serve import (
+        DynamicBatcher, EnginePool, PolicyClient)
+    from improved_body_parts_tpu.stream import SessionManager
+
+    import jax.numpy as jnp
+
+    cfg = get_config(args.config)
+    model = build_model(cfg)
+    rng = np.random.default_rng(0)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, args.size, args.size, 3)),
+                           train=False)
+    if args.planted > 0:
+        canvas = max(int(args.size / 0.6) + 64, 640)
+        model = PlantedModel(model, planted_maps(cfg.skeleton,
+                                                 args.planted, rng,
+                                                 canvas=canvas),
+                             cfg.skeleton)
+    model_params = (InferenceModelParams(boxsize=args.boxsize)
+                    if args.boxsize else None)
+
+    n_rep = max(2, args.replicas)
+    boxes = [ChaosBox() for _ in range(n_rep)]
+    # shared-nothing replicas: one Predictor per replica (never two
+    # dispatchers driving one program cache), each wrapped in its chaos
+    # controls.  Replica 1 runs the pre-fusion HOST-POOL decode lane so
+    # the killed-decode-pool injection targets a load-bearing executor.
+    preds = [ChaosPredictor(
+        Predictor(model, variables, cfg.skeleton,
+                  model_params=model_params), boxes[i])
+        for i in range(n_rep)]
+    engines = [DynamicBatcher(preds[i], max_batch=2, max_wait_ms=15,
+                              max_queue=64, decode_workers=2,
+                              device_decode=(i != 1))
+               for i in range(n_rep)]
+
+    sink_path = os.path.splitext(args.out)[0] + "_events.jsonl"
+    telemetry = RunTelemetry(
+        sink_path, registry=Registry(),
+        run_meta={"tool": "chaos_serve", "config": args.config,
+                  "platform": platform})
+
+    img = np.zeros((args.size, args.size, 3), np.uint8)
+    ledger = LedgeredFutures()
+    report = {
+        "protocol": (
+            "in-process EnginePool over shared-nothing DynamicBatcher "
+            "replicas serving real traffic (submits, hedged policy "
+            "submits, stream sessions) while deterministic faults are "
+            "injected mid-execute; every future tracked; zero-lost/"
+            "bounded-failover/frame-order/leak-scan asserted"),
+        "platform": platform, "config": args.config,
+        "size": args.size, "replicas": n_rep,
+        "requests_per_phase": args.requests,
+        "streams": args.streams, "frames_per_stream": args.frames,
+        "wedge_timeout_s": args.wedge_timeout,
+        "telemetry_events": sink_path,
+        "injections": [],
+    }
+    failures = []
+
+    def check(cond, label):
+        print(("PASS " if cond else "FAIL ") + label, flush=True)
+        if not cond:
+            failures.append(label)
+        return bool(cond)
+
+    # thread/descendant baseline BEFORE any serving machinery exists
+    threads_before = {t.ident for t in threading.enumerate()}
+
+    def proc_children():
+        out = []
+        for name in os.listdir("/proc"):
+            if not name.isdigit():
+                continue
+            try:
+                with open(f"/proc/{name}/stat") as f:
+                    if int(f.read().split()[3]) == os.getpid():
+                        out.append(int(name))
+            except (OSError, IndexError, ValueError):
+                continue
+        return out
+
+    children_before = set(proc_children())
+
+    pool = EnginePool(
+        engines, probe_interval_s=0.05,
+        wedge_timeout_s=args.wedge_timeout, drain_timeout_s=1.0,
+        breaker_kw=dict(failure_threshold=0.5, min_requests=4,
+                        window=8, cooldown_s=1.0, half_open_probes=1),
+        registry=telemetry.registry)
+    pool.start()
+    warm = pool.warmup([(args.size, args.size)])
+    # untimed warm slice over every traffic shape the phases use (pool
+    # submits + stream frames), then arm the recompile watch: any
+    # compile past this line is a failing number
+    for f in [pool.submit(img) for _ in range(n_rep * 2)]:
+        f.result(timeout=600)
+    with SessionManager(pool, max_in_flight=3) as warm_mgr:
+        ws = warm_mgr.open("warm")
+        for f in [ws.submit_frame(img) for _ in range(4)]:
+            f.result(timeout=600)
+    telemetry.mark_warm("pool warmup + warm slice")
+    report["warmup"] = {"newly_compiled": warm["newly_compiled"],
+                        "bucket_shapes": [list(s) for s in
+                                          warm["bucket_shapes"]],
+                        "batch_sizes": list(warm["batch_sizes"])}
+    check(all(s["state"] == "live" for s in pool.replica_states()),
+          "warm pool: every replica live before the first injection "
+          "(no false wedge-fence under ordinary load)")
+
+    # ---------------------------------------------------- 1: wedged fetcher
+    def inject_wedged_fetcher():
+        t0 = time.perf_counter()
+        boxes[0].wedge.set()
+        futs = [ledger.track(pool.submit(img), "wedged_fetcher")
+                for _ in range(args.requests)]
+        results = [f.result(timeout=300) for f in futs]
+        recovered_s = time.perf_counter() - t0
+        fenced = wait_until(
+            lambda: pool.replica_states()[0]["state"] == "fenced",
+            timeout_s=30)
+        reason = pool.replica_states()[0]["fence_reason"]
+        boxes[0].wedge.clear()
+        boxes[0].release.set()           # unpin the parked fetcher
+        time.sleep(0.1)
+        boxes[0].release.clear()
+        restarted = pool.restart(0)
+        rec = {
+            "kind": "wedged_fetcher", "futures": len(futs),
+            "all_resolved_ok": all(isinstance(r, list) for r in results),
+            "fenced": fenced, "fence_reason": reason,
+            "restarted": restarted,
+            "recovery_s": round(recovered_s, 3),
+        }
+        check(rec["all_resolved_ok"], "wedged: every future resolved ok")
+        check(fenced and reason in ("wedged", "stopped"),
+              "wedged: replica fenced by the health probe")
+        check(recovered_s < args.failover_bound,
+              f"wedged: recovery bounded ({recovered_s:.2f}s)")
+        check(restarted, "wedged: replica restarted into routing")
+        return rec
+
+    # -------------------------------------------------- 2: poisoned program
+    def inject_poisoned_program():
+        t0 = time.perf_counter()
+        with boxes[0].lock:
+            boxes[0].poison_left = 2 * args.requests
+        # sequential closed loop: at submit time every replica is idle,
+        # so the depth tie deterministically routes each first attempt
+        # to replica 0 — the poisoned one — until the breaker fences it
+        futs = []
+        for _ in range(args.requests):
+            f = ledger.track(pool.submit(img), "poisoned_program")
+            f.result(timeout=300)        # raises = a LOST failover
+            futs.append(f)
+        recovered_s = time.perf_counter() - t0
+        fenced = wait_until(
+            lambda: pool.replica_states()[0]["state"] == "fenced",
+            timeout_s=30)
+        reason = pool.replica_states()[0]["fence_reason"]
+        with boxes[0].lock:
+            boxes[0].poison_left = 0     # the program "heals"
+        restarted = pool.restart(0)
+        breaker_after_restart = pool.replica_states()[0]["breaker"]
+        # half-open probation: traffic closes the breaker again
+        probed = [ledger.track(pool.submit(img), "poison_probe")
+                  for _ in range(4)]
+        for f in probed:
+            f.result(timeout=300)
+        closed = wait_until(
+            lambda: pool.replica_states()[0]["breaker"] == "closed",
+            timeout_s=30)
+        rec = {
+            "kind": "poisoned_program", "futures": len(futs) + len(probed),
+            "fenced": fenced, "fence_reason": reason,
+            "restarted": restarted,
+            "breaker_after_restart": breaker_after_restart,
+            "breaker_closed_after_probes": closed,
+            "recovery_s": round(recovered_s, 3),
+        }
+        check(fenced and reason == "breaker_open",
+              "poison: breaker tripped and fenced the replica")
+        check(breaker_after_restart == "half_open",
+              "poison: restart re-enters through half-open probation")
+        check(closed, "poison: probes closed the breaker")
+        check(recovered_s < args.failover_bound,
+              f"poison: recovery bounded ({recovered_s:.2f}s)")
+        return rec
+
+    # ------------------------------------------------ 3: killed decode pool
+    def inject_killed_decode_pool():
+        # replica 1 is the host-pool decode lane: its executor is load-
+        # bearing.  Kill it; the fetcher's inline-decode fallback must
+        # keep the replica serving — degraded, NOT fenced.
+        before = engines[1].metrics.completed
+        engines[1]._pool.shutdown(wait=False)
+        futs = [ledger.track(
+            engines[1].submit(img), "killed_decode_pool")
+            for _ in range(args.requests)]
+        results = [f.result(timeout=300) for f in futs]
+        ok = all(isinstance(r, list) for r in results)
+        still_live = pool.replica_states()[1]["state"] == "live"
+        rec = {
+            "kind": "killed_decode_pool", "futures": len(futs),
+            "all_resolved_ok": ok,
+            "completed_before": before,
+            "completed_after": engines[1].metrics.completed,
+            "replica_still_live": still_live,
+        }
+        check(ok, "decode-pool: inline fallback served every request")
+        check(still_live,
+              "decode-pool: degraded replica stays live (no fence)")
+        return rec
+
+    # --------------------------------------- 4: replica hard-stop mid-stream
+    def inject_hard_stop_mid_stream():
+        t0 = time.perf_counter()
+        resub_before = pool.counters()["resubmitted"]
+        # slow replica 0 down so frames are deterministically pinned on
+        # it when the hard-stop lands (otherwise a fast host could have
+        # drained it and the stop would strand nothing)
+        boxes[0].delay_s = 0.3
+        mgr = SessionManager(pool, max_in_flight=3)
+        sessions = [mgr.open(f"chaos{i}") for i in range(args.streams)]
+        stop_at = args.frames // 2
+        per_stream = []
+
+        def client(si):
+            s = sessions[si]
+            futs = []
+            for t in range(args.frames):
+                if si == 0 and t == stop_at:
+                    # hard-stop a replica while frames are pinned on it
+                    engines[0].stop(drain_timeout_s=0.1)
+                futs.append(ledger.track(s.submit_frame(img),
+                                         "stream_frame"))
+            ordered = True
+            delivered = 0
+            for i, f in enumerate(futs):
+                tracked = f.result(timeout=300)
+                delivered += 1
+                # static planted crowd: the tracker age stamp equals the
+                # frame index IFF every earlier frame was delivered, in
+                # order, exactly once — the frame-order proof
+                if not all(p.age == i for p in tracked):
+                    ordered = False
+            per_stream.append({"stream": si, "delivered": delivered,
+                               "ordered": ordered})
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(args.streams)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        boxes[0].delay_s = 0.0
+        recovered_s = time.perf_counter() - t0
+        fenced = wait_until(
+            lambda: pool.replica_states()[0]["state"] == "fenced",
+            timeout_s=30)
+        snaps = [s.snapshot() for s in sessions]
+        mgr.close_all(timeout_s=60)
+        restarted = pool.restart(0)
+        resubmitted = pool.counters()["resubmitted"] - resub_before
+        rec = {
+            "kind": "replica_hard_stop_mid_stream",
+            "streams": per_stream,
+            "fenced": fenced,
+            "fence_reason": pool.replica_states()[0]["fence_reason"],
+            "restarted": restarted,
+            "frames_failed": sum(s["frames_failed"] for s in snaps),
+            "frames_resubmitted": resubmitted,
+            "recovery_s": round(recovered_s, 3),
+        }
+        check(all(p["delivered"] == args.frames for p in per_stream),
+              "hard-stop: every stream delivered every frame")
+        check(all(p["ordered"] for p in per_stream),
+              "hard-stop: frame order preserved across migration")
+        check(rec["frames_failed"] == 0,
+              "hard-stop: zero frame failures (failover was invisible)")
+        check(resubmitted >= 1,
+              "hard-stop: stranded in-flight frames were re-submitted")
+        check(recovered_s < args.failover_bound,
+              f"hard-stop: recovery bounded ({recovered_s:.2f}s)")
+        check(restarted, "hard-stop: replica restarted into routing")
+        return rec
+
+    # ------------------------------------------------------ 5: latency spike
+    def inject_latency_spike():
+        boxes[0].delay_s = 0.4
+        client = PolicyClient(pool, hedge_after_s=0.1, max_attempts=6)
+        client.stats.register_into(telemetry.registry)
+        # sequential closed loop: every primary lands on the (idle,
+        # tie-preferred) slow replica, so every request exercises the
+        # hedge path against a healthy one
+        futs, lat = [], []
+        for _ in range(args.requests):
+            t0 = time.perf_counter()
+            f = ledger.track(client.submit(img), "hedged_submit")
+            f.result(timeout=300)
+            lat.append(time.perf_counter() - t0)
+            futs.append(f)
+        boxes[0].delay_s = 0.0
+        stats = client.stats.snapshot()
+        rec = {
+            "kind": "latency_spike", "futures": len(futs),
+            "policy": stats,
+            "max_wait_s": round(max(lat), 3),
+        }
+        check(stats["hedges"] >= 1,
+              "latency: hedged second dispatch fired")
+        check(stats["hedge_wins"] >= 1,
+              "latency: a hedge beat the slow replica")
+        return rec
+
+    def ensure_all_live(after_kind):
+        """Between-injection hygiene: only the TARGETED replica may
+        have been fenced (and each phase restarts it); a healthy
+        replica fenced by collateral (e.g. a false wedge verdict on a
+        merely-busy replica) is a named failing check — and is
+        restarted so one phase's fallout cannot cascade into the
+        next phase's verdict."""
+        stray = [s for s in pool.replica_states()
+                 if s["state"] != "live"]
+        check(not stray,
+              f"{after_kind}: no collateral fences "
+              f"({[(s['replica'], s['fence_reason']) for s in stray]})")
+        for s in stray:
+            pool.restart(s["replica"])
+
+    for inject in (inject_wedged_fetcher, inject_poisoned_program,
+                   inject_killed_decode_pool, inject_hard_stop_mid_stream,
+                   inject_latency_spike):
+        rec = inject()
+        report["injections"].append(rec)
+        ensure_all_live(rec["kind"])
+        telemetry.emit("injection_done", kind=rec["kind"])
+        print(f"injection {rec['kind']}: done", flush=True)
+
+    # ----------------------------------------------------------- teardown
+    # steady-state proof: after every injection + recovery, the pool
+    # still serves clean traffic
+    tail = [ledger.track(pool.submit(img), "steady_tail")
+            for _ in range(n_rep)]
+    for f in tail:
+        f.result(timeout=300)
+    pool.stop(drain_timeout_s=30.0)
+    for b in boxes:
+        b.release.set()                 # unpin anything still parked
+
+    audit = ledger.audit()
+    report["futures"] = audit
+    check(audit["lost"] == 0,
+          f"zero lost futures ({audit['tracked']} tracked, "
+          f"{audit['resolved_ok']} ok, {audit['resolved_error']} typed "
+          "errors)")
+
+    # recompiles: the warm pool must have served the WHOLE sweep —
+    # failovers, restarts, migrations — with zero new programs
+    recompiles = int(telemetry.compile_watch.recompiles.value)
+    report["recompiles_post_warmup"] = recompiles
+    check(recompiles == 0, "0 post-warmup recompiles across the sweep")
+
+    # thread leak scan: every serving thread must be gone (the wedged
+    # fetcher was released above; timers cancelled; pools shut down)
+    def leaked():
+        return [t.name for t in threading.enumerate()
+                if t.ident not in threads_before and t.is_alive()]
+
+    wait_until(lambda: not leaked(), timeout_s=30)
+    report["leaked_threads"] = leaked()
+    check(not report["leaked_threads"],
+          f"no leaked threads ({report['leaked_threads']})")
+    report["leaked_children"] = sorted(
+        set(proc_children()) - children_before)
+    check(not report["leaked_children"], "no leaked descendants")
+
+    report["pool_final"] = pool.snapshot()
+    m = pool.metrics
+    check(m.submitted == m.completed + m.failed + m.depth,
+          "pool accounting conserved (submitted == completed + failed "
+          "+ depth)")
+
+    report["failed_checks"] = failures
+    report["checks_failed"] = len(failures)
+    report["ok"] = not failures
+    telemetry.emit("chaos_serve_verdict", ok=report["ok"],
+                   checks_failed=len(failures))
+    telemetry.close()
+    with open(args.out, "w") as f:
+        strict_dump(report, f, indent=2)
+    print(strict_dumps({
+        "ok": report["ok"],
+        "injections": [r["kind"] for r in report["injections"]],
+        "futures_tracked": audit["tracked"],
+        "futures_lost": audit["lost"],
+        "recompiles_post_warmup": recompiles,
+        "leaked_threads": len(report["leaked_threads"]),
+        "checks_failed": len(failures)}))
+    if args.strict and failures:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

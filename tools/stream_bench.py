@@ -106,6 +106,10 @@ def arm_summary(wall, snaps, stable):
         "frames_delivered": delivered,
         "frames_dropped": sum(s["frames_dropped"] for s in snaps),
         "frames_failed": sum(s["frames_failed"] for s in snaps),
+        # engine-admission sheds absorbed by the sessions' jittered
+        # backoff (serve.policy) — reported, never counted as failures
+        "engine_shed_retries": sum(s["engine_shed_retries"]
+                                   for s in snaps),
         "track_births": sum(s["tracker"]["births"] for s in snaps),
         "track_deaths": sum(s["tracker"]["deaths"] for s in snaps),
         "track_ids_stable": all(stable),
@@ -347,6 +351,8 @@ def main():
     report["frames_delivered_total"] = delivered
     report["frames_dropped_total"] = dropped
     report["frames_failed_total"] = failed
+    report["engine_shed_retries_total"] = sum(
+        r["multi"]["engine_shed_retries"] for r in rounds)
     report["track_ids_stable_all_rounds"] = all(
         r["multi"]["track_ids_stable"] for r in rounds)
     report["mean_batch_occupancy"] = serve_snap["mean_batch_occupancy"]
