@@ -577,6 +577,62 @@ def _audit_summary(budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _scaling_summary(fallback, budget_s):
+    """Run tools/scaling_test.py (the GSPMD weak-scaling curve: the
+    partitioned donated train step over virtual CPU meshes, interleaved
+    rounds, monotone-throughput verdict) as a budget-bounded smoke and
+    return a compact summary, or an {"error"/"skipped"} marker — the
+    "serve"/"feed" key contract.  Subprocess so a partitioning failure
+    can never take down the primary metric; the committed SCALING.json
+    carries the full protocol run.  ``IBP_BENCH_SCALING=0`` skips it
+    unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_SCALING") == "0":
+        return {"skipped": "IBP_BENCH_SCALING=0"}
+    if budget_s < 240:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (SCALING.json has the full run)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="scaling_"), "SCALING.json")
+    # smoke shape: two mesh sizes, short segments, small canvas — the
+    # committed SCALING.json carries the full n=1/2/4/8 curve.
+    # imhn_fsdp shards over the composite ('data','model') axis, so
+    # even the 2-device smoke carries sharded state; the CPU-fallback
+    # host gets the shortest segments (same discipline as the other
+    # fallback-aware keys)
+    segs = (["--steps", "4", "--rounds", "2"] if fallback
+            else ["--steps", "6", "--rounds", "3"])
+    argv = ["--devices", "1", "2", "--image-size", "64",
+            "--config", "tiny", "--rules", "imhn_fsdp",
+            "--tolerance", "0.5"] + segs
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # virtual mesh — never claims the chip
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "scaling_test.py"),
+             "--out", out] + argv,
+            capture_output=True, timeout=min(600, budget_s), check=True,
+            env=env)
+        with open(out) as f:
+            r = json.load(f)
+        largest = str(max(int(n) for n in r["results"]))
+        return {
+            "devices": r["devices"],
+            "imgs_per_sec_medians": r["imgs_per_sec_medians"],
+            "monotone_ok": r["monotone_ok"],
+            "partition_rules": r["partition_rules"]["name"],
+            "sharded_state_leaves":
+                r["results"][largest]["state_leaves"]["sharded"],
+            "loss_parity_rel": r["loss_parity"]["rel_diff"],
+            "loss_parity_ok": r["loss_parity"]["ok"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def _lint_summary(budget_s):
     """Run tools/lint.py (the graftlint static-analysis gate) and return
     finding counts by severity, or an {"error"/"skipped"} marker — the
@@ -696,6 +752,10 @@ def main():
     # same discipline
     servechaos = _servechaos_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # GSPMD weak-scaling smoke (partitioned step, virtual meshes), same
+    # discipline
+    scaling = _scaling_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     # static-analysis gate (graftlint), same discipline
     lint = _lint_summary(
         TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
@@ -719,6 +779,7 @@ def main():
         "ckpt": ckpt,
         "chaos": chaos,
         "servechaos": servechaos,
+        "scaling": scaling,
         "lint": lint,
         "audit": audit,
         "provenance": _provenance(),

@@ -127,6 +127,7 @@ def _declarations(spec: ProgramSpec) -> Dict:
         "allow_f64": spec.allow_f64,
         "allow_while": spec.allow_while,
         "meshed": spec.meshed,
+        "expect_sharded_params": spec.expect_sharded_params,
         "requires_devices": spec.requires_devices,
     }
 

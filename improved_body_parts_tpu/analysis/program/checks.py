@@ -15,7 +15,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from ..config import SEVERITIES
-from .compiled import CompiledInfo
+from .compiled import CompiledInfo, _spec_is_sharded
 from .config import AuditConfig
 from .registry import ProgramSpec
 from .trace import TraceInfo, donated_leaves
@@ -77,8 +77,11 @@ PROGRAM_RULES = (
     ProgramRule(
         "PRG006", "sharding-coverage", "error",
         "a meshed program whose inputs are all left unconstrained by "
-        "the partition rules, or a donated leaf whose input/output "
-        "shardings diverge (the alias cannot be established)"),
+        "the partition rules, a program declaring sharded parameters "
+        "(expect_sharded_params) whose compiled state leaves are all "
+        "replicated — rules that shard zero leaves — or a donated leaf "
+        "whose input/output shardings diverge (the alias cannot be "
+        "established)"),
     ProgramRule(
         "PRG007", "fingerprint-drift", "error",
         "the program's fingerprint (cost analysis, structure) drifted "
@@ -193,10 +196,27 @@ def check_donation(spec: ProgramSpec, built, compiled: CompiledInfo,
     return out
 
 
-def check_sharding_coverage(spec: ProgramSpec, compiled: CompiledInfo,
+def check_sharding_coverage(spec: ProgramSpec, built,
+                            compiled: CompiledInfo,
                             config: AuditConfig) -> List[AuditFinding]:
     if not spec.meshed:
+        if spec.expect_sharded_params:
+            # the declaration would be serialized into the audited
+            # declarations while checking NOTHING — refuse the inert
+            # combination instead of quietly skipping
+            return [_make(
+                config, spec, "PRG006",
+                "expect_sharded_params declared on a non-meshed "
+                "program — the sharded-param facet only applies to "
+                "meshed programs; the declaration is unenforceable")]
         return []
+    if spec.expect_sharded_params and not spec.donate_argnums:
+        return [_make(
+            config, spec, "PRG006",
+            "expect_sharded_params declared without donate_argnums — "
+            "the facet locates the state through the donated "
+            "arguments, so the declaration is unenforceable as "
+            "written")]
     out = []
     specs = compiled.input_specs
     if not specs:
@@ -205,13 +225,38 @@ def check_sharding_coverage(spec: ProgramSpec, compiled: CompiledInfo,
             "meshed program but the compiled executable exposes no "
             "sharding metadata — the mesh never reached the program"))
         return out
-    nontrivial = [s for s in specs if s not in ("PartitionSpec()", "None")]
+    nontrivial = [s for s in specs if _spec_is_sharded(s)]
     if not nontrivial:
         out.append(_make(
             config, spec, "PRG006",
             f"all {len(specs)} input leaves are fully replicated — "
             "nothing is sharded over the mesh; the partition rules "
             "cover no input"))
+    elif spec.expect_sharded_params and spec.donate_argnums:
+        # the PARTITIONED-program facet: a batch-only sharding (every
+        # state leaf replicated) means the rules shard zero leaves —
+        # exactly the silent regression a pod run would discover as an
+        # OOM.  Flattened inputs follow argument order, so each donated
+        # argnum's leaves occupy the slice between its neighbours'
+        # cumulative leaf counts (NOT necessarily a front prefix).
+        import jax
+
+        leaf_counts = [len(jax.tree.leaves(a)) for a in built.args]
+        offsets = [0]
+        for c in leaf_counts:
+            offsets.append(offsets[-1] + c)
+        state_specs = []
+        for i in spec.donate_argnums:
+            state_specs.extend(specs[offsets[i]:offsets[i + 1]])
+        n_sharded = sum(1 for s in state_specs if _spec_is_sharded(s))
+        if n_sharded == 0:
+            out.append(_make(
+                config, spec, "PRG006",
+                f"program declares sharded parameters but all "
+                f"{len(state_specs)} state leaves compiled fully "
+                "replicated — the partition rules shard ZERO "
+                "param/optimizer leaves (batch-only sharding is the "
+                "dryrun regime this program exists to retire)"))
     for out_idx, param_idx in sorted(compiled.aliases.items()):
         if (param_idx < len(compiled.input_specs)
                 and out_idx < len(compiled.output_specs)
@@ -246,5 +291,5 @@ def run_compiled_checks(spec: ProgramSpec, built, compiled: CompiledInfo,
     config = config or AuditConfig()
     out: List[AuditFinding] = []
     out += check_donation(spec, built, compiled, config)
-    out += check_sharding_coverage(spec, compiled, config)
+    out += check_sharding_coverage(spec, built, compiled, config)
     return out

@@ -23,6 +23,16 @@ _INSTR_RE = re.compile(r"^\s+(?:ROOT\s+)?%?[\w.\-]+\s+=\s+", re.M)
 _ALIAS_ENTRY_RE = re.compile(r"\{([\d,\s]*)\}:\s*\((\d+)")
 
 
+def _spec_is_sharded(spec_str: str) -> bool:
+    """True only for a ``PartitionSpec(...)`` with at least one named
+    axis.  A single-device program's ``SingleDeviceSharding(...)``
+    strings (and any future non-PartitionSpec sharding text) are NOT
+    sharded: nothing is split — treating unknown strings as sharded
+    would make every replicated input count."""
+    return (spec_str.startswith("PartitionSpec(")
+            and spec_str != "PartitionSpec()")
+
+
 @dataclass
 class CompiledInfo:
     """Summary of one compiled executable."""
@@ -46,6 +56,14 @@ class CompiledInfo:
     @property
     def aliased_param_count(self) -> int:
         return len(set(self.aliases.values()))
+
+    @property
+    def sharded_input_count(self) -> int:
+        """Inputs whose realized spec actually splits an axis — the
+        number PRG006 gates on (>0 for a meshed program) and the
+        fingerprint pins so a layout can't silently collapse to
+        replicated between blessings."""
+        return sum(1 for s in self.input_specs if _spec_is_sharded(s))
 
 
 def parse_input_output_aliases(hlo_text: str) -> Dict[int, int]:

@@ -34,7 +34,7 @@ TRACE_EXACT = ("dtypes", "callbacks", "while_count", "scan_count",
 TRACE_NUMERIC = ("eqn_count", "const_total", "const_max")
 #: compiled-fingerprint fields compared exactly
 COMPILED_EXACT = ("argument_bytes", "output_bytes", "alias_bytes",
-                  "aliased_params")
+                  "aliased_params", "sharded_inputs")
 #: compiled-fingerprint fields compared under tolerance
 COMPILED_NUMERIC = ("flops", "bytes_accessed", "temp_bytes",
                     "hlo_instruction_count")
@@ -80,6 +80,7 @@ def compiled_fingerprint(compiled: CompiledInfo) -> Dict:
         "temp_bytes": compiled.temp_bytes,
         "hlo_instruction_count": compiled.hlo_instruction_count,
         "aliased_params": compiled.aliased_param_count,
+        "sharded_inputs": compiled.sharded_input_count,
         "input_spec_kinds": sorted(set(compiled.input_specs)),
         "output_spec_kinds": sorted(set(compiled.output_specs)),
     }

@@ -6,9 +6,10 @@ step (health sentinel on and off, device-GT variant), the eval step,
 the compact and FUSED-decode serve programs per bucket shape (the
 latter with a declared bounded `while`: the assembly kernel's
 candidate walk), the flip-TTA peaks program, the SWA running average,
-and the meshed GSPMD train step — together with the declarations the
-checks verify (donated argnums, bf16-compute, hot-path status, mesh
-expectations).
+the legacy replicated meshed step, and the fully GSPMD-PARTITIONED
+train step (rule-sharded state; ISSUE 12) — together with the
+declarations the checks verify (donated argnums, bf16-compute,
+hot-path status, mesh/sharded-param expectations).
 
 ``build()`` returns the jitted callable plus ``ShapeDtypeStruct``
 example arguments: tracing/lowering/compiling them runs ZERO model
@@ -64,6 +65,10 @@ class ProgramSpec:
     allow_while: bool = False
     #: sharding-coverage checks (PRG006) apply
     meshed: bool = False
+    #: the program's partition rules must shard >0 donated state leaves
+    #: (PRG006's partitioned facet): batch-only sharding — rules that
+    #: shard zero leaves — is a failing audit, not a quiet fallback
+    expect_sharded_params: bool = False
     #: minimum device count the program needs (the meshed step needs the
     #: virtual 8-device CPU mesh); short hosts record a skip, not a crash
     requires_devices: int = 1
@@ -253,9 +258,11 @@ def _build_flip_tta_peaks() -> BuiltProgram:
 
 
 def _build_train_step_mesh() -> BuiltProgram:
-    """The GSPMD train step: state replicated, batch sharded over
-    'data' on a ('data', 'model') mesh — the program ROADMAP item 2
-    promotes to pod scale, audited for sharding coverage (PRG006)."""
+    """The legacy meshed train step: state REPLICATED, batch sharded
+    over 'data' on a ('data', 'model') mesh — the dryrun regime
+    ``train_step_partitioned`` retires, kept registered so the two
+    layouts stay separately fingerprinted (and the replicated program
+    keeps compiling for topology-adjust resumes of old checkpoints)."""
     from ...parallel.mesh import (
         abstract_with_sharding,
         batch_sharding,
@@ -271,6 +278,38 @@ def _build_train_step_mesh() -> BuiltProgram:
     images, mask, gt = (abstract_with_sharding(a, batch_sharding(mesh))
                         for a in _train_batch(cfg, 4))
     fn = make_train_step(model, cfg, optimizer)
+    return BuiltProgram(fn=fn, args=(state, images, mask, gt))
+
+
+def _build_train_step_partitioned(rules=None) -> BuiltProgram:
+    """The fully GSPMD-PARTITIONED train step (ISSUE 12's tentpole):
+    param/optimizer state sharded by the IMHN partition ruleset (wide
+    conv kernels' output channels over 'model'), batch over 'data',
+    activations pinned by with_sharding_constraint, state donated with
+    in==out shardings.  PRG003 verifies the alias held UNDER sharding
+    (per-device shard bytes), PRG006 that the rules sharded >0 state
+    leaves.  ``rules`` overrides the ruleset — the seeded-regression
+    fixture passes the all-replicated set to prove the zero-leaf case
+    flags."""
+    from ...parallel.mesh import abstract_with_sharding, batch_sharding, \
+        make_mesh
+    from ...parallel.partition import (
+        abstract_with_shardings,
+        imhn_partition_rules,
+        train_state_shardings,
+    )
+    from ...train.step import make_train_step
+
+    cfg, model, optimizer = _tiny_setup()
+    rules = imhn_partition_rules() if rules is None else rules
+    mesh = make_mesh(data=4, model=2)
+    state_sh = train_state_shardings(model, cfg, optimizer, mesh, rules)
+    state = abstract_with_shardings(
+        _abstract_state(cfg, model, optimizer), state_sh)
+    images, mask, gt = (abstract_with_sharding(a, batch_sharding(mesh))
+                        for a in _train_batch(cfg, 4))
+    fn = make_train_step(model, cfg, optimizer, mesh=mesh, rules=rules,
+                         state_shardings=state_sh)
     return BuiltProgram(fn=fn, args=(state, images, mask, gt))
 
 
@@ -353,10 +392,22 @@ def program_registry() -> List[ProgramSpec]:
         ProgramSpec(
             name="train_step_mesh",
             description="GSPMD train step on a ('data': 4, 'model': 2) "
-                        "mesh — state replicated, batch sharded",
+                        "mesh — state replicated, batch sharded (the "
+                        "legacy dryrun layout, kept for old-checkpoint "
+                        "resumes)",
             build=_build_train_step_mesh,
             donate_argnums=donate, expect_bf16=True, meshed=True,
             requires_devices=8),
+        ProgramSpec(
+            name="train_step_partitioned",
+            description="fully GSPMD-PARTITIONED train step on a "
+                        "('data': 4, 'model': 2) mesh — param/optimizer "
+                        "state sharded by the IMHN partition rules "
+                        "(wide conv kernels over 'model'), batch over "
+                        "'data', donated with in==out shardings",
+            build=_build_train_step_partitioned,
+            donate_argnums=donate, expect_bf16=True, meshed=True,
+            expect_sharded_params=True, requires_devices=8),
     ]
 
 

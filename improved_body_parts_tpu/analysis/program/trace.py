@@ -136,10 +136,34 @@ def trace_program(built) -> TraceInfo:
     return info
 
 
+def _shard_factor(leaf) -> int:
+    """How many devices split this leaf: the product of mesh-axis sizes
+    named in its ``NamedSharding`` spec (1 for replicated / unsharded
+    leaves).  A PARTITIONED donated leaf aliases only its per-device
+    shard, so PRG003's expected alias bytes must divide accordingly —
+    ``memory_analysis`` reports per-device bytes."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if spec is None or mesh is None:
+        return 1
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    factor = 1
+    for axes in spec:
+        if axes is None:
+            continue
+        for name in (axes if isinstance(axes, tuple) else (axes,)):
+            factor *= int(sizes.get(name, 1))
+    return max(factor, 1)
+
+
 def donated_leaves(built, donate_argnums: Tuple[int, ...]
                    ) -> Tuple[int, int]:
-    """(leaf count, total bytes) of the flattened donated arguments —
-    what PRG003 expects the compiled executable to alias."""
+    """(leaf count, total per-device bytes) of the flattened donated
+    arguments — what PRG003 expects the compiled executable to alias.
+    Sharded leaves (``ShapeDtypeStruct.sharding`` carrying a spec)
+    count their per-device shard, matching ``memory_analysis``'s
+    per-device accounting."""
     import jax
 
     count = 0
@@ -147,5 +171,5 @@ def donated_leaves(built, donate_argnums: Tuple[int, ...]
     for i in donate_argnums:
         for leaf in jax.tree.leaves(built.args[i]):
             count += 1
-            total += _nbytes(leaf)
+            total += _nbytes(leaf) // _shard_factor(leaf)
     return count, total

@@ -48,7 +48,11 @@ class HiddenHostSync(Rule):
              "improved_body_parts_tpu/infer",
              # the streaming sessions run per-frame on serve threads —
              # the same hot-path discipline applies
-             "improved_body_parts_tpu/stream")
+             "improved_body_parts_tpu/stream",
+             # the parallel tree: device_prefetch's producer thread runs
+             # per batch, and the ISSUE 12 partition module's
+             # sharding/resharding helpers sit on the train entry path
+             "improved_body_parts_tpu/parallel")
 
     def check(self, ctx: ModuleContext) -> None:
         if not ctx.under(*self.SCOPE):

@@ -246,6 +246,25 @@ class TrainConfig:
     lr_late_step_epochs: int = 5
     abnormal_loss_thre: float = 2e5   # drop batch if loss explodes (:259-261)
     max_grad_norm: float = 0.0        # 0 disables (flag kept; ref has it disabled)
+    # --- large-batch recipe (train.schedule.large_batch_schedule;
+    # "Extremely Large Minibatch SGD", PAPERS.md) ---
+    # reference global batch the base LR was tuned at: LR scales
+    # linearly by global_batch / lr_batch_ref.  0 = per-device
+    # convention (ref = batch_size_per_device, i.e. LR x world_size)
+    lr_batch_ref: int = 0
+    # gradual-warmup epochs for the base->scaled LR ramp; 0 = reuse
+    # warmup_epochs
+    large_batch_warmup_epochs: int = 0
+    # --- GSPMD partitioned training (parallel.partition) ---
+    # run the rule-partitioned train step (state sharded per
+    # partition_rules, batch over 'data', activations constrained)
+    # instead of the replicated-state program
+    partition: bool = False
+    # named ruleset (parallel.partition.NAMED_RULESETS): "imhn" shards
+    # wide conv kernels' output channels over 'model'
+    partition_rules: str = "imhn"
+    # 'model' mesh-axis size for make_mesh (data = devices // model)
+    mesh_model_axis: int = 1
     print_freq: int = 10
     checkpoint_dir: str = "checkpoints"
     # --- checkpointing cadence + async manager (train.checkpoint) ---

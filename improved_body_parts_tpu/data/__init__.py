@@ -3,7 +3,9 @@ from .dataset import (
     batches,
     convert_joints,
     epoch_permutation,
+    host_batch_shard,
     host_shard,
+    resolve_host_shard,
 )
 from .fixture import (build_coco_train_set, build_fixture,
                       build_val_set, draw_person)
@@ -14,6 +16,7 @@ from .transformer import AugmentParams, Transformer
 __all__ = [
     "CocoPoseDataset", "ShmRingInput", "batch_wire_format", "batches",
     "convert_joints", "epoch_permutation",
-    "host_shard", "build_fixture", "build_coco_train_set", "build_val_set", "draw_person", "Heatmapper", "OffsetMapper", "AugmentParams",
+    "host_batch_shard", "host_shard", "resolve_host_shard",
+    "build_fixture", "build_coco_train_set", "build_val_set", "draw_person", "Heatmapper", "OffsetMapper", "AugmentParams",
     "Transformer",
 ]

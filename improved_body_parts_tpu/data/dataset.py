@@ -174,11 +174,57 @@ def host_shard(indices: np.ndarray, process_index: int, process_count: int,
     return shard[: n_batches * batch_size]
 
 
+def host_batch_shard(indices: np.ndarray, process_index: int,
+                     process_count: int, batch_size: int) -> np.ndarray:
+    """This host's CONTIGUOUS slab of every global batch — the
+    partitioned-training input shard.
+
+    Global batch ``k`` is ``indices[k*G : (k+1)*G]`` (``G = batch_size *
+    process_count`` — exactly the batch a single-host run of the same
+    permutation would form), and host ``p`` renders rows ``[p*batch_size,
+    (p+1)*batch_size)`` of it.  Because host ``p``'s addressable devices
+    hold shard ``p`` of the 'data' axis, ``parallel.shard_batch``'s
+    ``jax.make_array_from_process_local_data`` assembly reconstructs the
+    single-host global batch BIT-IDENTICALLY, row order included — so
+    scaling the host count changes which process renders a row, never
+    which rows a step trains on.
+
+    (The strided :func:`host_shard` yields the same per-epoch sample
+    *multiset* but groups rows into different batches than a single-host
+    run; it remains the replicated regime's historical shard.  Both
+    truncate to full global batches — drop_last semantics.)
+    """
+    global_batch = batch_size * process_count
+    n_batches = len(indices) // global_batch
+    rows = [indices[k * global_batch + process_index * batch_size:
+                    k * global_batch + (process_index + 1) * batch_size]
+            for k in range(n_batches)]
+    if not rows:
+        return indices[:0]
+    return np.concatenate(rows)
+
+
+def resolve_host_shard(indices: np.ndarray, process_index: int,
+                       process_count: int, batch_size: int,
+                       shard: str = "strided") -> np.ndarray:
+    """Dispatch on the shard mode: ``"strided"`` (historical) or
+    ``"batch"`` (contiguous per-global-batch slabs — the partitioned
+    path).  ONE dispatch shared by the sync/pool paths and the shm
+    ring, so the two transports can never disagree on which rows a
+    host renders."""
+    if shard not in ("strided", "batch"):
+        raise ValueError(f"unknown host shard mode {shard!r}; "
+                         "use 'strided' or 'batch'")
+    fn = host_batch_shard if shard == "batch" else host_shard
+    return fn(indices, process_index, process_count, batch_size)
+
+
 def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
             process_index: int = 0, process_count: int = 1,
             num_workers: int = 0, prefetch: int = 2, raw_gt: int = 0,
             pipeline: Optional[str] = None, wire: str = "f32",
-            ring_slots: int = 0) -> Iterator[Tuple[np.ndarray, ...]]:
+            ring_slots: int = 0, shard: str = "strided"
+            ) -> Iterator[Tuple[np.ndarray, ...]]:
     """Yield batched (images, mask_miss, labels) for one epoch.
 
     ``pipeline`` selects the worker transport (default: ``"shm"`` when
@@ -212,6 +258,12 @@ def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
     ``wire="uint8"`` ships images as uint8 HWC — 4x fewer bytes across IPC
     and host->device — normalized to [0, 1] inside the jitted train step
     (bit-identical to the f32 wire; ``train.step``).
+
+    ``shard`` selects the multi-host row assignment: ``"strided"`` (the
+    historical ``host_shard``) or ``"batch"`` (``host_batch_shard`` —
+    contiguous per-global-batch slabs, whose ``shard_batch`` assembly
+    reconstructs the single-host global batch bit-identically; the
+    partitioned-training path).
     """
     if pipeline is None:
         pipeline = "shm" if num_workers > 0 else "sync"
@@ -231,7 +283,8 @@ def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
             # contract (yielded arrays stay valid indefinitely, list() is
             # safe).  The zero-copy contract — views valid until advance —
             # is ShmRingInput.batches(), which the hot paths use directly.
-            for batch in ring.batches(epoch, process_index, process_count):
+            for batch in ring.batches(epoch, process_index, process_count,
+                                      shard=shard):
                 yield tuple(np.copy(x) for x in batch)
                 batch = None  # drop the view before close() unmaps
         finally:
@@ -239,7 +292,8 @@ def batches(dataset: CocoPoseDataset, batch_size: int, epoch: int,
         return
 
     perm = epoch_permutation(len(dataset), epoch, dataset.seed)
-    shard = host_shard(perm, process_index, process_count, batch_size)
+    shard = resolve_host_shard(perm, process_index, process_count,
+                               batch_size, shard=shard)
 
     def gen(i):
         if raw_gt > 0:

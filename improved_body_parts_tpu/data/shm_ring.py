@@ -612,36 +612,44 @@ class ShmRingInput:
                         consecutive=self._consecutive_rebuilds)
 
     def _epoch_tasks(self, epoch: int, process_index: int,
-                     process_count: int):
+                     process_count: int, shard: str = "strided"):
         """(epoch, batch_idx, indices) task triples for one epoch — the
-        same permutation/shard/batching as the synchronous path."""
-        from .dataset import epoch_permutation, host_shard
+        same permutation/shard/batching as the synchronous path
+        (``shard`` dispatches through the one ``resolve_host_shard``
+        the sync path uses, so the transports cannot disagree)."""
+        from .dataset import epoch_permutation, resolve_host_shard
 
         perm = epoch_permutation(len(self.dataset), epoch, self.dataset.seed)
-        shard = host_shard(perm, process_index, process_count,
-                           self.batch_size)
-        for batch_idx, s in enumerate(range(0, len(shard), self.batch_size)):
+        rows = resolve_host_shard(perm, process_index, process_count,
+                                  self.batch_size, shard=shard)
+        for batch_idx, s in enumerate(range(0, len(rows), self.batch_size)):
             yield epoch, batch_idx, [int(i) for i in
-                                     shard[s: s + self.batch_size]]
+                                     rows[s: s + self.batch_size]]
 
     def batches(self, epoch: int, process_index: int = 0,
-                process_count: int = 1) -> Iterator[Tuple[np.ndarray, ...]]:
+                process_count: int = 1, shard: str = "strided"
+                ) -> Iterator[Tuple[np.ndarray, ...]]:
         """Yield this host's batches for ``epoch`` in deterministic order.
 
         Identical stream to ``data.batches(..., num_workers=0)`` on the
         same wire format: same epoch permutation, same host shard, same
         per-sample ``(seed, epoch, index)`` RNG, yields in batch order.
-        Worker failures raise (with the worker traceback) — except a
-        *dead* worker under ``supervise=True``, which triggers a ring
-        rebuild (:meth:`_rebuild`) and the stream continues, still
-        bit-identical.  An abandoned generator leaves in-flight slots to
-        be reclaimed lazily by the next generator.
+        ``shard="batch"`` selects the contiguous per-global-batch slab
+        assignment (``data.dataset.host_batch_shard``) whose multi-host
+        assembly reconstructs the single-host global batch bit-identically
+        — the partitioned-training feed.  Worker failures raise (with the
+        worker traceback) — except a *dead* worker under
+        ``supervise=True``, which triggers a ring rebuild
+        (:meth:`_rebuild`) and the stream continues, still bit-identical.
+        An abandoned generator leaves in-flight slots to be reclaimed
+        lazily by the next generator.
         """
         return self._run(self._epoch_tasks(epoch, process_index,
-                                           process_count))
+                                           process_count, shard))
 
     def stream(self, start_epoch: int = 0, process_index: int = 0,
-               process_count: int = 1) -> Iterator[Tuple[np.ndarray, ...]]:
+               process_count: int = 1, shard: str = "strided"
+               ) -> Iterator[Tuple[np.ndarray, ...]]:
         """Endless multi-epoch batch stream, pipelined ACROSS epoch
         boundaries: epoch N+1 tasks enter the ring while N's last batches
         drain, so workers never idle at the boundary.  Same per-epoch
@@ -654,7 +662,7 @@ class ShmRingInput:
             epoch = start_epoch
             while True:
                 yield from self._epoch_tasks(epoch, process_index,
-                                             process_count)
+                                             process_count, shard)
                 epoch += 1
 
         return self._run(endless())

@@ -10,10 +10,28 @@ from .mesh import (
     shard_batch,
     topology_mismatch,
 )
+from .partition import (
+    NAMED_RULESETS,
+    UnmatchedLeafError,
+    constrain_batch_sharded,
+    get_ruleset,
+    imhn_partition_rules,
+    match_partition_rules,
+    reshard_tree,
+    rules_fingerprint,
+    shard_tree,
+    sharding_summary,
+    train_state_shardings,
+    tree_shardings,
+)
 from .prefetch import device_prefetch
 
 __all__ = [
     "barrier", "batch_sharding", "batch_spec", "device_prefetch",
     "initialize_distributed", "make_mesh", "mesh_topology", "replicated",
     "reshard_replicated", "shard_batch", "topology_mismatch",
+    "NAMED_RULESETS", "UnmatchedLeafError", "constrain_batch_sharded",
+    "get_ruleset", "imhn_partition_rules", "match_partition_rules",
+    "reshard_tree", "rules_fingerprint", "shard_tree", "sharding_summary",
+    "train_state_shardings", "tree_shardings",
 ]

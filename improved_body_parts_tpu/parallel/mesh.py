@@ -77,7 +77,8 @@ def make_mesh(data: Optional[int] = None, model: int = 1,
     return Mesh(arr, axis_names=("data", "model"))
 
 
-def mesh_topology(mesh: Optional[Mesh] = None) -> Dict:
+def mesh_topology(mesh: Optional[Mesh] = None,
+                  partition_rules: Optional[str] = None) -> Dict:
     """JSON-able description of the device layout a run executes on.
 
     Stamped into every checkpoint's ``COMMIT.json`` so a restart on a
@@ -86,6 +87,15 @@ def mesh_topology(mesh: Optional[Mesh] = None) -> Dict:
     time — not discovered as a cryptic sharding error deep inside the
     first donated step.  ``train.supervisor`` compares this against the
     restart's mesh via :func:`topology_mismatch`.
+
+    ``partition_rules`` is the 12-hex ruleset fingerprint
+    (``parallel.partition.rules_fingerprint``) of a PARTITIONED run:
+    the state's layout is a function of the rules, so a resume under
+    different rules is a layout change exactly like a different device
+    count — and one the supervisor must refuse loudly (the compiled
+    step would silently re-place every restored leaf).  Omitted (the
+    replicated regime) the key is absent, and legacy checkpoints
+    without it keep resuming unchecked, like every other stamped field.
     """
     devices = jax.devices()
     topo = {
@@ -97,11 +107,14 @@ def mesh_topology(mesh: Optional[Mesh] = None) -> Dict:
         topo["mesh_devices"] = int(mesh.devices.size)
         topo["mesh_axes"] = {str(name): int(size) for name, size in
                              zip(mesh.axis_names, mesh.devices.shape)}
+    if partition_rules is not None:
+        topo["partition_rules"] = str(partition_rules)
     return topo
 
 
 def topology_mismatch(stamped: Optional[Dict], mesh: Mesh,
-                      process_count: Optional[int] = None
+                      process_count: Optional[int] = None,
+                      partition_rules: Optional[str] = None
                       ) -> Optional[Dict[str, Tuple]]:
     """Compare a checkpoint's stamped topology against the current one.
 
@@ -111,10 +124,18 @@ def topology_mismatch(stamped: Optional[Dict], mesh: Mesh,
     Platform changes (tpu -> cpu) are reported too: numerically legal
     after a reshard, but the operator should know their resume is not
     running where the checkpoint was trained.
+
+    ``partition_rules`` is the CURRENT run's ruleset fingerprint (None
+    for the replicated regime).  A checkpoint stamped with a ruleset
+    diffs against it like any other layout field — including against
+    None, because resuming a partitioned checkpoint without rules would
+    silently re-replicate a layout the operator asked for.  A stamp
+    WITHOUT the key (legacy / replicated checkpoint) checks nothing, so
+    adopting partitioning on an old run stays possible.
     """
     if not stamped:
         return None
-    current = mesh_topology(mesh)
+    current = mesh_topology(mesh, partition_rules=partition_rules)
     if process_count is not None:
         current["process_count"] = int(process_count)
     diff = {}
@@ -123,6 +144,10 @@ def topology_mismatch(stamped: Optional[Dict], mesh: Mesh,
         if key in stamped and key in current \
                 and stamped[key] != current[key]:
             diff[key] = (stamped[key], current[key])
+    if "partition_rules" in stamped \
+            and stamped["partition_rules"] != current.get("partition_rules"):
+        diff["partition_rules"] = (stamped["partition_rules"],
+                                   current.get("partition_rules"))
     return diff or None
 
 

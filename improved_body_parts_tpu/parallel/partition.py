@@ -1,0 +1,347 @@
+"""Rule-based GSPMD partitioning of the train state.
+
+The reference's entire answer to model size was "replicate and
+all-reduce" (Apex DDP, train_distributed.py:129-139).  The meshed train
+step inherited that: ``MULTICHIP_r0*.json`` ran an 8-device
+('data', 'model') mesh with EVERY parameter and optimizer slot
+replicated — the 'model' axis existed but carried nothing.  This module
+promotes the state itself to first-class GSPMD residents:
+
+- :func:`match_partition_rules` maps **regex rules over the flattened
+  pytree path names** (``params/Backbone_0/ConvBlock_0/Conv_0/kernel``,
+  ``opt_state/1/0/trace/.../kernel``) to ``PartitionSpec``s — the
+  pattern every large-scale JAX codebase converges on (SNIPPETS.md [2]).
+  Because optimizer momentum mirrors the parameter tree under its own
+  prefix, ONE ruleset shards parameters and their optimizer slots
+  identically — which is exactly what donation aliasing needs.
+- ``strict=True`` makes an unmatched non-scalar leaf a hard
+  :class:`UnmatchedLeafError` instead of a silent replicate: on a pod,
+  "the rule didn't match" means "this tensor is materialized on every
+  chip", and that must be a diff in review, not an OOM at scale.
+- :func:`imhn_partition_rules` is the IMHN-specific default: wide
+  convolution kernels shard their output-channel axis over ``'model'``
+  (channels-last NHWC — the out-channel axis is the reduction-free axis
+  a conv can split without halo exchange); biases, BN scale/stats and
+  scalars replicate.  Specs are REFINED against real leaf shapes
+  (:func:`refine_spec`): an axis the mesh cannot divide evenly, or one
+  that would shard below ``min_shard_dim`` elements per device, drops
+  to replicated — deterministically, per leaf, never at XLA's whim.
+- :func:`train_state_shardings` turns (abstract state, mesh, rules)
+  into the ``NamedSharding`` pytree ``make_train_step(mesh=, rules=)``
+  compiles with, and :func:`reshard_tree` re-places a *sharded* state
+  onto a new mesh on topology-change resume (the sharded twin of
+  ``mesh.reshard_replicated``, which silently assumed replication).
+- :func:`rules_fingerprint` is the 12-hex hash stamped into every
+  checkpoint's ``COMMIT.json`` topology block: resuming under a
+  DIFFERENT ruleset recompiles the step with a different layout — the
+  stamp turns that into a loud refusal
+  (``train.supervisor.reshard_on_topology_change``).
+
+Verification: the partitioned step is a registered graftaudit program
+(``train_step_partitioned``) whose compiled executable must show >0
+sharded state leaves and full donation aliasing (PRG003/PRG006), and
+``tools/scaling_test.py`` drives it into the SCALING.json weak-scaling
+artifact.
+"""
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: a rule: (regex over the '/'-joined leaf path, PartitionSpec).  First
+#: match wins; compile-order is the precedence order.
+PartitionRule = Tuple[str, P]
+
+#: leaves at/below this many elements are never worth sharding (and
+#: scalar step counters/SWA counts must stay replicated for free)
+_SCALAR_ELEMS = 1
+
+#: default floor on per-device shard extent along a sharded axis: a
+#: conv kernel whose out-channel axis would split below this many
+#: channels per device gains nothing from the shard (the all-gather
+#: latency dominates) — the "wide kernels only" half of the IMHN rules
+DEFAULT_MIN_SHARD_DIM = 8
+
+
+class UnmatchedLeafError(ValueError):
+    """strict-mode failure: at least one non-scalar leaf matched no
+    partition rule.  On a pod, an unmatched leaf is silently replicated
+    onto every chip — the error names every offender so the ruleset is
+    fixed in review, not discovered as an OOM at scale."""
+
+
+def _key_name(entry) -> str:
+    """One path entry -> its bare name (DictKey 'Conv_0', SequenceKey
+    '1', GetAttrKey 'params'), without keystr()'s bracket syntax."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def tree_path_names(tree) -> List[Tuple[str, object]]:
+    """(name, leaf) pairs for every leaf, names '/'-joined in flatten
+    order: ``params/Backbone_0/ConvBlock_0/Conv_0/kernel``,
+    ``opt_state/1/0/trace/Backbone_0/.../kernel``, ``step``."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [("/".join(_key_name(k) for k in path), leaf)
+            for path, leaf in flat]
+
+
+def refine_spec(spec: P, shape: Sequence[int], mesh: Mesh,
+                min_shard_dim: int = DEFAULT_MIN_SHARD_DIM) -> P:
+    """Drop sharded axes a leaf cannot actually support.
+
+    An axis is kept only when the mesh axis size divides the dimension
+    EXACTLY (uneven GSPMD shards pad — and padding breaks the donation
+    alias the train step depends on) and the per-device extent stays at
+    least ``min_shard_dim``.  Deterministic per (shape, mesh): the
+    layout is decided here, in auditable Python, never left to XLA.
+    """
+    if not spec:
+        return spec
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for dim, axes in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axes is None:
+            out.append(None)
+            continue
+        names = axes if isinstance(axes, tuple) else (axes,)
+        total = int(np.prod([axis_sizes.get(a, 1) for a in names]))
+        if total <= 1 or dim % total != 0 or dim // total < min_shard_dim:
+            out.append(None)
+        else:
+            out.append(axes)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def match_partition_rules(rules: Sequence[PartitionRule], tree, *,
+                          strict: bool = False, mesh: Optional[Mesh] = None,
+                          min_shard_dim: int = DEFAULT_MIN_SHARD_DIM):
+    """PartitionSpec pytree for ``tree`` from first-match-wins regex
+    rules over '/'-joined leaf paths (``re.search`` semantics,
+    SNIPPETS.md [2]).
+
+    Scalar / single-element leaves short-circuit to ``P()`` (a sharded
+    step counter is meaningless).  ``strict=True`` raises
+    :class:`UnmatchedLeafError` naming EVERY unmatched non-scalar leaf;
+    the default replicates them.  With ``mesh`` given, each matched
+    spec is refined against the leaf's shape (:func:`refine_spec`) so
+    undividable / too-narrow axes replicate deterministically.
+    """
+    import jax
+
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    named = tree_path_names(tree)
+    unmatched: List[str] = []
+    specs: List[P] = []
+    for name, leaf in named:
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if int(np.prod(shape)) <= _SCALAR_ELEMS:
+            specs.append(P())
+            continue
+        for pat, spec in compiled:
+            if pat.search(name):
+                if mesh is not None:
+                    spec = refine_spec(spec, shape, mesh,
+                                       min_shard_dim=min_shard_dim)
+                specs.append(spec)
+                break
+        else:
+            unmatched.append(name)
+            specs.append(P())
+    if strict and unmatched:
+        shown = ", ".join(unmatched[:8])
+        more = f" (+{len(unmatched) - 8} more)" if len(unmatched) > 8 else ""
+        raise UnmatchedLeafError(
+            f"{len(unmatched)} leaves matched no partition rule under "
+            f"strict mode: {shown}{more}. Every leaf must be covered — "
+            "add a rule (a trailing ('.*', PartitionSpec()) replicates "
+            "the remainder explicitly).")
+    structure = jax.tree.structure(tree)
+    return jax.tree.unflatten(structure, specs)
+
+
+def imhn_partition_rules() -> Tuple[PartitionRule, ...]:
+    """The IMHN default ruleset: wide conv / transposed-conv kernels
+    shard their output-channel (last) axis over ``'model'``; everything
+    else — biases, BN scale/bias, batch statistics, SE dense layers
+    (tiny), the step counter — replicates via the explicit catch-all,
+    so the set is STRICT-complete by construction.
+
+    Flax conv kernels are HWIO (channels last); the optimizer's
+    momentum trace mirrors the parameter paths under
+    ``opt_state/.../trace/``, so the same two rules shard it
+    identically — a donated update leaf keeps one layout across the
+    step, which is what PRG003's alias needs.
+    """
+    return (
+        (r"(Conv|ConvTranspose)_\d+/kernel$", P(None, None, None, "model")),
+        (r".*", P()),
+    )
+
+
+def imhn_fsdp_rules() -> Tuple[PartitionRule, ...]:
+    """FSDP/ZeRO-style variant: wide conv kernels shard over the FULL
+    mesh — ``('data', 'model')`` composite axis — so even a pure
+    data-parallel mesh (model=1) splits the state across its devices
+    and XLA all-gathers each kernel at its use site.  This is the
+    memory-first layout (per-device state shrinks ∝ world size); the
+    plain ``imhn`` rules are the compute-first layout ('model'-axis
+    tensor parallelism).  The weak-scaling artifact
+    (``tools/scaling_test.py``) drives this set so every mesh size on
+    the curve carries sharded state."""
+    return (
+        (r"(Conv|ConvTranspose)_\d+/kernel$",
+         P(None, None, None, ("data", "model"))),
+        (r".*", P()),
+    )
+
+
+#: named rulesets for config/CLI selection (tools/train.py
+#: ``--partition-rules``); "replicated" is the explicit everything-P()
+#: set — the A/B arm and the PRG006 seeded-regression fixture
+NAMED_RULESETS: Dict[str, Tuple[PartitionRule, ...]] = {
+    "imhn": imhn_partition_rules(),
+    "imhn_fsdp": imhn_fsdp_rules(),
+    "replicated": ((r".*", P()),),
+}
+
+
+def get_ruleset(name: str) -> Tuple[PartitionRule, ...]:
+    if name not in NAMED_RULESETS:
+        raise KeyError(f"unknown partition ruleset {name!r}; "
+                       f"available: {sorted(NAMED_RULESETS)}")
+    return NAMED_RULESETS[name]
+
+
+def rules_fingerprint(rules: Sequence[PartitionRule],
+                      min_shard_dim: int = DEFAULT_MIN_SHARD_DIM) -> str:
+    """12-hex identity of a LAYOUT — stamped into every checkpoint's
+    COMMIT.json topology block so a resume under a DIFFERENT layout is
+    refused loudly (the compiled step would otherwise silently relayout
+    the restored state).  Hashes pattern order + spec content AND the
+    refinement floor: ``min_shard_dim`` changes which leaves the same
+    rules actually shard, so two fingerprints agree iff (rules, floor)
+    partition every tree identically.  Callers using a non-default
+    floor must pass the same value here that they build shardings
+    with."""
+    h = hashlib.sha256()
+    for pat, spec in rules:
+        h.update(pat.encode())
+        h.update(repr(tuple(spec)).encode())
+        h.update(b"\0")
+    h.update(f"min_shard_dim={int(min_shard_dim)}".encode())
+    return h.hexdigest()[:12]
+
+
+def tree_shardings(tree, mesh: Mesh, rules: Sequence[PartitionRule], *,
+                   strict: bool = False,
+                   min_shard_dim: int = DEFAULT_MIN_SHARD_DIM):
+    """``NamedSharding`` pytree for ``tree``: the rules matched
+    (shape-refined against ``mesh``) and bound to it."""
+    import jax
+
+    specs = match_partition_rules(rules, tree, strict=strict, mesh=mesh,
+                                  min_shard_dim=min_shard_dim)
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def train_state_shardings(model, config, optimizer, mesh: Mesh,
+                          rules: Sequence[PartitionRule], *,
+                          strict: bool = True,
+                          min_shard_dim: int = DEFAULT_MIN_SHARD_DIM):
+    """The TrainState's NamedSharding pytree, built ABSTRACTLY (zero
+    FLOPs, zero data — ``jax.eval_shape`` over the real constructor) so
+    ``make_train_step(mesh=, rules=)`` and the graftaudit registry
+    derive the layout from one place.  Strict by default: the shipped
+    rulesets cover every leaf, and a new parameter that escapes them
+    should fail the build, not silently replicate."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..train.state import create_train_state
+
+    h, w = config.skeleton.height, config.skeleton.width
+    abstract = jax.eval_shape(lambda: create_train_state(
+        model, config, optimizer, jax.random.PRNGKey(0),
+        jnp.zeros((1, h, w, 3), jnp.float32)))
+    return tree_shardings(abstract, mesh, rules, strict=strict,
+                          min_shard_dim=min_shard_dim)
+
+
+def shard_tree(tree, shardings):
+    """Place a (host- or device-resident) pytree onto its shardings —
+    the materializing twin of :func:`tree_shardings`' abstract map."""
+    import jax
+
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s, may_alias=False), tree, shardings)
+
+
+def reshard_tree(tree, mesh: Mesh, rules: Sequence[PartitionRule], *,
+                 min_shard_dim: int = DEFAULT_MIN_SHARD_DIM):
+    """Re-place a restored state pytree onto ``mesh`` under ``rules`` —
+    the SHARDED twin of ``mesh.reshard_replicated``, which blindly
+    broadcast every leaf (correct only for the replicated regime this
+    module retires).  Call ONLY on an actual topology change, for the
+    same donated-executable reasons ``reshard_replicated`` documents:
+    an unchanged mesh keeps host leaves and lets the jit entry (whose
+    ``in_shardings`` carry the same rules) place them."""
+    shardings = tree_shardings(tree, mesh, rules,
+                               min_shard_dim=min_shard_dim)
+    return shard_tree(tree, shardings)
+
+
+def constrain_batch_sharded(tree, mesh: Optional[Mesh]):
+    """``with_sharding_constraint`` every array in ``tree`` to
+    batch-over-'data' — the activation annotation inside the
+    partitioned train step.  Without it XLA is free to resolve a
+    sharding conflict by ALL-GATHERING an activation onto every device
+    and carrying on, silently: the program stays correct and quietly
+    stops scaling.  No-op when ``mesh`` is None (the single-device and
+    replicated paths compile the exact same jaxpr as before)."""
+    if mesh is None:
+        return tree
+    import jax
+
+    def constrain(x):
+        spec = P("data", *([None] * (x.ndim - 1)))
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(constrain, tree)
+
+
+def abstract_with_shardings(tree, shardings):
+    """Leafwise twin of ``mesh.abstract_with_sharding``: stamp a
+    PER-LEAF sharding pytree onto an abstract tree (the partitioned
+    registry program's state, where every leaf has its own spec)."""
+    import jax
+
+    return jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        tree, shardings)
+
+
+def sharding_summary(shardings) -> Dict[str, int]:
+    """{sharded, replicated} leaf counts of a NamedSharding pytree —
+    the realized-layout number artifacts record (DIST_DRIVE.json,
+    SCALING.json) and logs print."""
+    import jax
+
+    def is_ns(x):
+        return isinstance(x, NamedSharding)
+
+    leaves = jax.tree.leaves(shardings, is_leaf=is_ns)
+    sharded = sum(1 for s in leaves
+                  if is_ns(s) and any(a is not None for a in s.spec))
+    return {"sharded": sharded, "replicated": len(leaves) - sharded}

@@ -8,7 +8,11 @@ from .checkpoint import (
     save_checkpoint,
 )
 from .loop import eval_epoch, fit, train_epoch
-from .schedule import cyclic_swa_schedule, step_decay_schedule
+from .schedule import (
+    cyclic_swa_schedule,
+    large_batch_schedule,
+    step_decay_schedule,
+)
 from .state import (
     TrainState,
     create_train_state,
@@ -19,6 +23,7 @@ from .state import (
 )
 from .step import make_eval_step, make_train_step, normalize_images
 from .supervisor import (
+    PartitionRulesChanged,
     RunSupervisor,
     StopRequested,
     SupervisorGaveUp,
@@ -32,10 +37,11 @@ __all__ = [
     "read_commit_meta", "restore_checkpoint", "restore_latest",
     "save_checkpoint",
     "eval_epoch", "fit", "train_epoch",
-    "cyclic_swa_schedule", "step_decay_schedule",
+    "cyclic_swa_schedule", "large_batch_schedule", "step_decay_schedule",
     "TrainState", "create_train_state", "make_optimizer", "start_swa",
     "swap_swa_params", "update_swa",
     "make_eval_step", "make_train_step", "normalize_images",
-    "RunSupervisor", "StopRequested", "SupervisorGaveUp",
-    "TopologyChanged", "milestone_eval", "reshard_on_topology_change",
+    "PartitionRulesChanged", "RunSupervisor", "StopRequested",
+    "SupervisorGaveUp", "TopologyChanged", "milestone_eval",
+    "reshard_on_topology_change",
 ]

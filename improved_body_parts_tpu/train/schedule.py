@@ -12,6 +12,15 @@ import jax.numpy as jnp
 from ..config import TrainConfig
 
 
+def _decay_factor(cfg: TrainConfig, epoch):
+    """The reference's staircase exponent: epoch // 15, switching to
+    (epoch - 78) // 5 after epoch 78 (train_distributed.py:385-396)."""
+    return jnp.where(
+        epoch >= cfg.lr_late_epoch,
+        (epoch - cfg.lr_late_epoch) // cfg.lr_late_step_epochs,
+        epoch // cfg.lr_step_epochs)
+
+
 def step_decay_schedule(cfg: TrainConfig, steps_per_epoch: int,
                         world_size: int = 1, use_warmup: bool = True):
     """LR = base·world_size·0.2^factor with a 3-epoch linear warmup.
@@ -24,12 +33,66 @@ def step_decay_schedule(cfg: TrainConfig, steps_per_epoch: int,
     def schedule(step):
         step = jnp.asarray(step)
         epoch = step // steps_per_epoch
-        factor = jnp.where(
-            epoch >= cfg.lr_late_epoch,
-            (epoch - cfg.lr_late_epoch) // cfg.lr_late_step_epochs,
-            epoch // cfg.lr_step_epochs)
+        factor = _decay_factor(cfg, epoch)
         lr = base * cfg.lr_decay_factor ** factor.astype(jnp.float32)
         if use_warmup:
+            warm_steps = cfg.warmup_epochs * steps_per_epoch
+            warm = lr * (1.0 + step).astype(jnp.float32) / warm_steps
+            lr = jnp.where(epoch < cfg.warmup_epochs, warm, lr)
+        return lr
+
+    return schedule
+
+
+def large_batch_schedule(cfg: TrainConfig, steps_per_epoch: int,
+                         global_batch: int, use_warmup: bool = True):
+    """The large-batch recipe ("Extremely Large Minibatch SGD",
+    PAPERS.md; Goyal et al.'s linear-scaling + gradual-warmup rule) —
+    what makes a pod-slice global batch *trainable*, not just runnable:
+
+    - **linear scaling**: LR = base · (global_batch / lr_batch_ref).
+      ``cfg.lr_batch_ref`` anchors the scale to the batch the base LR
+      was tuned at (0 falls back to ``batch_size_per_device`` — the
+      repo's historical per-device convention, under which the
+      POST-WARMUP LR matches ``step_decay_schedule(world_size=
+      n_devices)``; the warmup ramps deliberately differ — gradual
+      base→scaled here vs 0→lr there.  Exact equality with the plain
+      schedule holds only at scale ≤ 1, where this degenerates to the
+      small-batch ramp);
+    - **gradual warmup**: instead of ramping 0 → lr like the small-batch
+      warmup, the LR climbs from the UNSCALED base to the scaled value
+      over ``cfg.large_batch_warmup_epochs`` (0 = ``warmup_epochs``)
+      epochs — the early-epoch instability of a large batch comes from
+      the scale factor, not from the base rate;
+    - the step-decay staircase then applies to the scaled LR with the
+      reference's original breakpoints.
+
+    Returns an optax-compatible pure ``step -> lr``.
+    """
+    ref = cfg.lr_batch_ref if cfg.lr_batch_ref > 0 \
+        else cfg.batch_size_per_device
+    scale = float(global_batch) / float(ref)
+    scaled = cfg.learning_rate_per_device * scale
+    warm_epochs = (cfg.large_batch_warmup_epochs
+                   if cfg.large_batch_warmup_epochs > 0
+                   else cfg.warmup_epochs)
+
+    def schedule(step):
+        step = jnp.asarray(step)
+        epoch = step // steps_per_epoch
+        factor = _decay_factor(cfg, epoch)
+        lr = scaled * cfg.lr_decay_factor ** factor.astype(jnp.float32)
+        if use_warmup and scale > 1.0:
+            warm_steps = warm_epochs * steps_per_epoch
+            frac = jnp.minimum(
+                (1.0 + step).astype(jnp.float32) / warm_steps, 1.0)
+            # base -> scaled ramp (Goyal et al. §2.2 gradual warmup)
+            warm = (scaled / scale) * (1.0 + (scale - 1.0) * frac) \
+                * cfg.lr_decay_factor ** factor.astype(jnp.float32)
+            lr = jnp.where(epoch < warm_epochs, warm, lr)
+        elif use_warmup:
+            # at/below the reference batch the recipe degenerates to the
+            # plain small-batch ramp
             warm_steps = cfg.warmup_epochs * steps_per_epoch
             warm = lr * (1.0 + step).astype(jnp.float32) / warm_steps
             lr = jnp.where(epoch < cfg.warmup_epochs, warm, lr)

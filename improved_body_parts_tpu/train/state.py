@@ -49,14 +49,25 @@ def make_optimizer(config: Config, schedule: Callable) -> optax.GradientTransfor
 
 
 def create_train_state(model, config: Config, optimizer, rng,
-                       sample_images) -> TrainState:
+                       sample_images, shardings=None) -> TrainState:
+    """Initialize the TrainState.  ``shardings`` (a NamedSharding
+    pytree matching the state, e.g. from
+    ``parallel.partition.train_state_shardings``) places every leaf as
+    it is created — the partitioned-training entry path, where
+    materializing a replicated flagship state first would briefly hold
+    world_size full copies before the reshard."""
     variables = model.init(rng, sample_images, train=True)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     opt_state = optimizer.init(params)
-    return TrainState(params=params, batch_stats=batch_stats,
-                      opt_state=opt_state, step=jnp.zeros((), jnp.int32),
-                      swa_params=None, swa_count=None)
+    state = TrainState(params=params, batch_stats=batch_stats,
+                       opt_state=opt_state, step=jnp.zeros((), jnp.int32),
+                       swa_params=None, swa_count=None)
+    if shardings is not None:
+        from ..parallel.partition import shard_tree
+
+        state = shard_tree(state, shardings)
+    return state
 
 
 def start_swa(state: TrainState) -> TrainState:

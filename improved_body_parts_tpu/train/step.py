@@ -15,7 +15,7 @@ host round-trip in the hot loop.
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,7 +57,11 @@ def make_train_step(model, config: Config,
                     donate: bool = True,
                     freeze_bn: bool = False,
                     device_gt: bool = False,
-                    health: bool = False) -> Callable:
+                    health: bool = False,
+                    mesh=None,
+                    rules: Optional[Sequence] = None,
+                    min_shard_dim: Optional[int] = None,
+                    state_shardings=None) -> Callable:
     """Build the jitted (state, images, mask_miss, gt) -> (state, loss) step.
 
     ``health=True`` additionally returns the global gradient norm —
@@ -87,11 +91,30 @@ def make_train_step(model, config: Config,
     normalizes to [0, 1] on device, bit-identical to the host pipeline's
     ``astype(float32) / 255``.  The dtype is static under jit, so the f32
     path compiles with no extra ops.
+
+    ``mesh`` + ``rules`` select the fully GSPMD-PARTITIONED program:
+    the TrainState's in/out shardings come from the partition ruleset
+    (``parallel.partition.train_state_shardings`` — strict, so an
+    uncovered leaf fails the build), the batch arguments pin to
+    batch-over-'data', and the network inputs/predictions carry
+    ``with_sharding_constraint`` annotations so XLA cannot resolve a
+    layout conflict by silently all-gathering an activation.  Input and
+    output state shardings are THE SAME tree, which is what lets the
+    donated update keep its input_output_alias under sharding (verified
+    compiled-level by graftaudit PRG003/PRG006 on the registered
+    ``train_step_partitioned`` program).  ``mesh=None`` (the default)
+    compiles the exact program this function always built.
     """
+    if (mesh is None) != (rules is None):
+        raise ValueError("make_train_step: mesh and rules select the "
+                         "partitioned program together — pass both or "
+                         "neither")
     if device_gt:
         from ..ops.gt_device import make_gt_synthesizer
 
         synthesize = make_gt_synthesizer(config.skeleton)
+
+    from ..parallel.partition import constrain_batch_sharded
 
     def train_step(state: TrainState, images, mask_miss, *gt_args
                    ) -> Tuple[TrainState, jnp.ndarray]:
@@ -101,11 +124,21 @@ def make_train_step(model, config: Config,
             gt = jax.vmap(synthesize)(joints, mask_all[..., 0])
         else:
             (gt,) = gt_args
+        # pin the network inputs to batch-over-'data' (no-op when
+        # mesh is None): the hourglass activations inherit the
+        # constraint through the forward, so a rule/layout conflict
+        # surfaces as a propagation error, never a silent all-gather
+        images, mask_miss, gt = constrain_batch_sharded(
+            (images, mask_miss, gt), mesh)
+
         def loss_fn(params):
             if freeze_bn:
                 preds = model.apply(
                     {"params": params, "batch_stats": state.batch_stats},
                     images, train=False)
+                # per-stack hourglass outputs stay batch-sharded into
+                # the loss (each stack re-anchors the constraint chain)
+                preds = constrain_batch_sharded(preds, mesh)
                 return (multi_task_loss(
                     preds, gt, mask_miss, config, use_focal=use_focal,
                     use_pallas=config.train.use_pallas_loss),
@@ -114,6 +147,7 @@ def make_train_step(model, config: Config,
                 {"params": params, "batch_stats": state.batch_stats},
                 images, train=True, mutable=["batch_stats"])
             preds, mutated = outputs
+            preds = constrain_batch_sharded(preds, mesh)
             loss = multi_task_loss(preds, gt, mask_miss, config,
                                    use_focal=use_focal,
                                    use_pallas=config.train.use_pallas_loss)
@@ -151,9 +185,35 @@ def make_train_step(model, config: Config,
             return state, loss, gnorm
         return state, loss
 
-    return jax.jit(train_step,
-                   donate_argnums=TRAIN_STEP_DONATE_ARGNUMS if donate
-                   else ())
+    donate_argnums = TRAIN_STEP_DONATE_ARGNUMS if donate else ()
+    if mesh is None:
+        return jax.jit(train_step, donate_argnums=donate_argnums)
+
+    from ..parallel.mesh import batch_sharding, replicated
+    from ..parallel.partition import (
+        DEFAULT_MIN_SHARD_DIM,
+        train_state_shardings,
+    )
+
+    # ONE sharding tree for the state on BOTH sides of the step: the
+    # donated update can only alias when input and output layouts agree
+    # (PRG006's divergent-alias check is the compiled-level proof).
+    # Callers that already built the tree to PLACE the state pass it as
+    # ``state_shardings`` — one layout source, so the placed leaves and
+    # the jit's in_shardings can never disagree (a mismatch is a silent
+    # re-place at the jit boundary that breaks the donation alias).
+    state_sh = state_shardings
+    if state_sh is None:
+        state_sh = train_state_shardings(
+            model, config, optimizer, mesh, rules,
+            min_shard_dim=min_shard_dim or DEFAULT_MIN_SHARD_DIM)
+    bsh = batch_sharding(mesh)
+    scalar = replicated(mesh)
+    n_batch_args = 4 if device_gt else 3  # images, mask_miss, gt-or-(joints, mask_all)
+    in_shardings = (state_sh,) + (bsh,) * n_batch_args
+    out_shardings = (state_sh, scalar) + ((scalar,) if health else ())
+    return jax.jit(train_step, donate_argnums=donate_argnums,
+                   in_shardings=in_shardings, out_shardings=out_shardings)
 
 
 def make_eval_step(model, config: Config, use_focal: bool = True) -> Callable:

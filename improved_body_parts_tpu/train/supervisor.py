@@ -104,6 +104,15 @@ class TopologyChanged(RuntimeError):
     checkpoint was written under and ``reshard="refuse"`` is set."""
 
 
+class PartitionRulesChanged(TopologyChanged):
+    """Restore refused UNCONDITIONALLY: the checkpoint was written
+    under a different partition ruleset.  Unlike a device-count change
+    (where ``reshard="adjust"`` is a well-defined re-placement), a
+    ruleset change silently recompiles the step with a different state
+    layout — the operator must either restore under the original rules
+    or explicitly migrate the run."""
+
+
 # --------------------------------------------------------------- chaos
 _chaos_lock = threading.Lock()
 _chaos_state: Optional[list] = None
@@ -155,20 +164,45 @@ def classify_error(error: str) -> str:
 
 
 def reshard_on_topology_change(state, meta, mesh, num_processes, policy,
-                               path, log_fn: Callable[[str], None] = print):
+                               path, log_fn: Callable[[str], None] = print,
+                               rules=None):
     """Shared topology policy for a just-restored ``state`` — the ONE
     implementation behind :meth:`RunSupervisor.resume` and
     tools/train.py's plain ``--resume`` (the refusal text, the loud
     adjust log and the reshard-only-on-change rule must never drift
     apart between them).
 
+    ``rules`` is the current run's partition ruleset (None for the
+    replicated regime).  Two consequences:
+
+    - a checkpoint stamped under a DIFFERENT ruleset (or stamped
+      partitioned while this run is not) raises
+      :class:`PartitionRulesChanged` under EITHER policy — "adjust"
+      covers device-count re-placement, not silent relayout;
+    - on an actual device-topology change, a partitioned run re-places
+      the restored state per its rules
+      (``parallel.partition.reshard_tree``) instead of broadcasting it
+      replicated (``mesh.reshard_replicated`` — whose blind spot was
+      exactly assuming replication).
+
     Returns ``(state, change)`` where ``change`` is the
     :func:`parallel.mesh.topology_mismatch` dict (or None); raises
     :class:`TopologyChanged` under ``policy="refuse"``.
     """
     from ..parallel.mesh import reshard_replicated, topology_mismatch
+    from ..parallel.partition import reshard_tree, rules_fingerprint
 
-    change = topology_mismatch(meta.get("topology"), mesh, num_processes)
+    rules_hash = rules_fingerprint(rules) if rules is not None else None
+    change = topology_mismatch(meta.get("topology"), mesh, num_processes,
+                               partition_rules=rules_hash)
+    if change and "partition_rules" in change:
+        stamped_h, current_h = change["partition_rules"]
+        raise PartitionRulesChanged(
+            f"checkpoint {path} was written under partition ruleset "
+            f"{stamped_h}, this run uses {current_h or 'none (replicated)'}"
+            ". A ruleset change relayouts the whole state — restore "
+            "under the original rules, or migrate explicitly (restore "
+            "replicated, then restart partitioned from a fresh stamp).")
     if not change:
         # re-place ONLY on an actual topology change (where the new
         # mesh forces a fresh step compile anyway).  Re-placing on an
@@ -194,6 +228,10 @@ def reshard_on_topology_change(state, meta, mesh, num_processes, policy,
            "restored state onto the current mesh; global batch and "
            "world-size LR scaling now follow the new device count "
            f"(epoch {meta['epoch']} continues)")
+    if rules is not None:
+        # sharded regime: re-place per the rules (same fingerprint as
+        # the stamp — checked above), not a blind broadcast
+        return reshard_tree(state, mesh, rules), change
     return reshard_replicated(state, mesh), change
 
 
@@ -244,10 +282,15 @@ class RunSupervisor:
                  backoff_max_s: float = 60.0, reshard: str = "adjust",
                  is_lead_host: bool = True,
                  sleep: Callable[[float], None] = time.sleep,
-                 log_fn: Callable[[str], None] = print):
+                 log_fn: Callable[[str], None] = print,
+                 rules=None):
         if reshard not in ("adjust", "refuse"):
             raise ValueError(f"reshard policy {reshard!r}; use "
                              "'adjust' or 'refuse'")
+        # partition ruleset of a GSPMD-partitioned run (None =
+        # replicated): resume() reshards per the rules on a topology
+        # change and REFUSES a checkpoint stamped under different rules
+        self.rules = rules
         self.directory = os.path.abspath(checkpoint_dir)
         self.max_restarts = int(max_restarts)
         self.crash_budget = int(crash_budget)
@@ -529,7 +572,8 @@ class RunSupervisor:
         state, meta = restore_checkpoint(path, state_template)
         state, change = reshard_on_topology_change(
             state, meta, mesh, num_processes, self.reshard, path,
-            log_fn=lambda s: self._log(f"supervisor: {s}"))
+            log_fn=lambda s: self._log(f"supervisor: {s}"),
+            rules=self.rules)
         if change:
             self._emit("topology_change",
                        **{k: {"from": a, "to": b}

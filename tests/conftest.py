@@ -48,6 +48,9 @@ _HEAVY_MODULES = frozenset({
                                 # subprocesses; topology subprocess pair
     "test_program_audit.py",    # registry sweep traces every shipped
                                 # program (eval_shape of the full state)
+    "test_partition.py",        # compiles the GSPMD-partitioned train
+                                # step on 4x2 / 2x2 meshes + spawns a
+                                # ring worker
 })
 # Individually heavy tests inside otherwise-quick modules.
 _HEAVY_TESTS = frozenset({

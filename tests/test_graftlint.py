@@ -984,3 +984,23 @@ def test_scope_covers_fault_tolerant_serving_modules():
     """
     assert "JGL005" in rules_of(
         lint(leak, path="improved_body_parts_tpu/serve/pool.py"))
+
+
+def test_scope_covers_partition_module():
+    """ISSUE 12 satellite: the GSPMD partition module (and the rest of
+    parallel/) lives in the JGL002 hot-path scope — its
+    sharding/resharding helpers run on the train entry path and
+    device_prefetch's producer thread runs per batch.  Locked on the
+    actual paths so a future move can't silently drop them."""
+    hot = """
+        import jax.numpy as jnp
+
+        def reshard_loop(leaves):
+            for leaf in leaves:
+                placed = jnp.asarray(leaf) * 2
+                record(placed.item())
+    """
+    for path in ("improved_body_parts_tpu/parallel/partition.py",
+                 "improved_body_parts_tpu/parallel/prefetch.py",
+                 "improved_body_parts_tpu/parallel/mesh.py"):
+        assert "JGL002" in rules_of(lint(hot, path=path)), path

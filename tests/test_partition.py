@@ -1,0 +1,410 @@
+"""GSPMD partitioned training (parallel/partition, ISSUE 12).
+
+What the virtual 8-device CPU mesh can PROVE about the partitioned
+regime, pinned here:
+
+1. **Partitioned-vs-single-device loss equivalence**: the rule-sharded
+   donated step produces the same loss and update as the plain
+   single-device step for one global batch.  Tolerance is the
+   documented XLA:CPU cross-program drift (reduction order differs
+   between layouts; rel 2e-5, the same bound test_scaling.py uses for
+   cross-mesh equivalence — measured drift is ~1e-6).
+2. **Rule matching semantics**: regex precedence, scalar
+   short-circuit, optimizer-momentum mirroring, strict-mode
+   unmatched-leaf error, shape refinement (divisibility + min width).
+3. **Per-host input sharding**: the contiguous-slab shard
+   (``host_batch_shard``) reassembles the exact single-host global
+   batch bit-identically, through both the sync path and the shm ring.
+4. **Resume safety**: the partition-rules stamp round-trips through
+   the topology block and a resume under different rules (or without
+   rules) raises ``PartitionRulesChanged`` under either policy;
+   ``reshard_tree`` re-places a sharded state onto a new mesh.
+5. **Large-batch recipe**: linear LR scaling anchored at
+   ``lr_batch_ref`` with the gradual base→scaled warmup.
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from improved_body_parts_tpu.config import get_config
+from improved_body_parts_tpu.parallel import (
+    get_ruleset,
+    imhn_partition_rules,
+    make_mesh,
+    match_partition_rules,
+    replicated,
+    reshard_tree,
+    rules_fingerprint,
+    shard_batch,
+    sharding_summary,
+    train_state_shardings,
+    tree_shardings,
+)
+from improved_body_parts_tpu.parallel.partition import (
+    DEFAULT_MIN_SHARD_DIM,
+    UnmatchedLeafError,
+    refine_spec,
+)
+from improved_body_parts_tpu.train import (
+    PartitionRulesChanged,
+    large_batch_schedule,
+    make_train_step,
+    reshard_on_topology_change,
+    step_decay_schedule,
+)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_training import _tiny_setup  # noqa: E402
+
+RULES = imhn_partition_rules()
+
+
+def _batch(rng, n, cfg, size=32):
+    label = size // cfg.skeleton.stride
+    images = np.asarray(rng.uniform(0, 1, (n, size, size, 3)), np.float32)
+    labels = np.asarray(
+        rng.uniform(0, 1, (n, label, label, cfg.skeleton.num_layers)),
+        np.float32)
+    mask = np.ones((n, label, label, 1), np.float32)
+    return images, mask, labels
+
+
+# --------------------------------------------------------- rule matching
+
+
+class TestMatchPartitionRules:
+    def test_imhn_rules_shard_wide_kernels_and_their_momentum(self):
+        cfg, model, opt, state = _tiny_setup()
+        mesh = make_mesh(data=4, model=2)
+        specs = match_partition_rules(RULES, state, mesh=mesh)
+        flat = jax.tree_util.tree_flatten_with_path(specs)[0]
+        sharded = {jax.tree_util.keystr(p) for p, s in flat
+                   if any(a is not None for a in s)}
+        assert sharded, "the IMHN rules sharded nothing"
+        # every sharded leaf is a conv kernel (params or momentum trace)
+        assert all("kernel" in name for name in sharded), sorted(sharded)[:5]
+        # the optimizer momentum mirrors the param layout 1:1 — the
+        # donated update cannot alias otherwise
+        param_kernels = {n for n in sharded if n.startswith(".params")}
+        trace_kernels = {n for n in sharded if ".trace" in n}
+        assert len(param_kernels) == len(trace_kernels) > 0
+        # biases / BN never shard
+        for path, spec in flat:
+            name = jax.tree_util.keystr(path)
+            if name.endswith("['bias']") or name.endswith("['scale']"):
+                assert spec == P(), name
+
+    def test_scalars_short_circuit_to_replicated(self):
+        specs = match_partition_rules(
+            ((r".*", P("model")),), {"step": jnp.zeros((), jnp.int32),
+                                     "one": jnp.zeros((1,), jnp.float32)})
+        assert specs["step"] == P() and specs["one"] == P()
+
+    def test_first_match_wins(self):
+        tree = {"a": {"kernel": jnp.zeros((4, 16))},
+                "b": {"kernel": jnp.zeros((4, 16))}}
+        specs = match_partition_rules(
+            ((r"a/kernel$", P(None, "model")), (r".*", P())), tree)
+        assert specs["a"]["kernel"] == P(None, "model")
+        assert specs["b"]["kernel"] == P()
+
+    def test_strict_mode_errors_on_unmatched_leaf(self):
+        tree = {"covered": {"kernel": jnp.zeros((4, 16))},
+                "orphan": {"weird": jnp.zeros((4, 16))}}
+        with pytest.raises(UnmatchedLeafError, match="orphan/weird"):
+            match_partition_rules(((r"kernel$", P(None, "model")),),
+                                  tree, strict=True)
+        # the explicit catch-all makes the same tree strict-complete
+        match_partition_rules(
+            ((r"kernel$", P(None, "model")), (r".*", P())), tree,
+            strict=True)
+
+    def test_shipped_rulesets_are_strict_complete_over_the_state(self):
+        cfg, model, opt, state = _tiny_setup()
+        mesh = make_mesh(data=4, model=2)
+        for name in ("imhn", "replicated"):
+            match_partition_rules(get_ruleset(name), state, strict=True,
+                                  mesh=mesh)
+
+    def test_refine_spec_divisibility_and_width(self):
+        mesh = make_mesh(data=4, model=2)
+        spec = P(None, None, None, "model")
+        # 64 channels / 2 = 32 per device: kept
+        assert refine_spec(spec, (3, 3, 16, 64), mesh) == spec
+        # odd channel count cannot divide: dropped to replicated
+        assert refine_spec(spec, (3, 3, 16, 69), mesh) == P()
+        # divisible but below the per-device width floor: dropped
+        thin = DEFAULT_MIN_SHARD_DIM * 2 - 2
+        assert refine_spec(spec, (3, 3, 16, thin), mesh) == P()
+
+    def test_rules_fingerprint_tracks_content_and_order(self):
+        a = ((r"kernel$", P(None, "model")), (r".*", P()))
+        b = ((r".*", P()), (r"kernel$", P(None, "model")))
+        c = ((r"kernel$", P("model", None)), (r".*", P()))
+        assert rules_fingerprint(a) == rules_fingerprint(a)
+        assert len({rules_fingerprint(a), rules_fingerprint(b),
+                    rules_fingerprint(c)}) == 3
+
+
+# -------------------------------------- the partitioned step: equivalence
+
+
+class TestPartitionedStep:
+    @pytest.fixture(scope="class")
+    def setup(self, eight_devices):
+        cfg, model, opt, state = _tiny_setup()
+        rng = np.random.default_rng(7)
+        return cfg, model, opt, state, _batch(rng, 8, cfg)
+
+    def test_partitioned_matches_single_device(self, setup):
+        """The tentpole equivalence: rule-sharded state + sharded batch
+        + sharding-constrained activations computes the same training
+        step as one device, within the documented XLA:CPU cross-layout
+        drift (2e-5 rel — reduction order differs)."""
+        cfg, model, opt, state, batch = setup
+        step1 = make_train_step(model, cfg, opt, donate=False)
+        ref_state, ref_loss = step1(state, *batch)
+        ref_leaf = np.asarray(jax.tree.leaves(ref_state.params)[0])
+
+        mesh = make_mesh(data=4, model=2)
+        shardings = train_state_shardings(model, cfg, opt, mesh, RULES)
+        summary = sharding_summary(shardings)
+        assert summary["sharded"] > 0, summary
+        p_state = jax.device_put(state, shardings)
+        p_batch = shard_batch(batch, mesh)
+        stepp = make_train_step(model, cfg, opt, donate=False,
+                                mesh=mesh, rules=RULES)
+        new_state, loss = stepp(p_state, *p_batch)
+        assert float(loss) == pytest.approx(float(ref_loss), rel=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(new_state.params)[0]), ref_leaf,
+            atol=2e-6)
+        # the update preserved every leaf's rule sharding (the donated
+        # path aliases only because in == out layout)
+        out_sh = jax.tree.leaves(
+            jax.tree.map(lambda x: x.sharding, new_state.params))
+        in_sh = jax.tree.leaves(
+            jax.tree.map(lambda s: s, shardings.params))
+        assert [s.spec for s in out_sh] == [s.spec for s in in_sh]
+
+    def test_donated_partitioned_step_runs_chained(self, setup):
+        """Donation under sharding: the REAL donated program (what
+        tools/train.py --partition runs) survives chained steps — the
+        configuration PRG003 verifies aliases at the compiled level."""
+        cfg, model, opt, state, batch = setup
+        mesh = make_mesh(data=2, model=2)
+        shardings = train_state_shardings(model, cfg, opt, mesh, RULES)
+        p_state = jax.device_put(state, shardings)
+        p_batch = shard_batch(batch, mesh)
+        stepd = make_train_step(model, cfg, opt, mesh=mesh, rules=RULES)
+        losses = []
+        for _ in range(3):
+            p_state, loss = stepd(p_state, *p_batch)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses)), losses
+        assert int(p_state.step) == 3
+
+    def test_mesh_without_rules_is_a_build_error(self, setup):
+        cfg, model, opt, state, batch = setup
+        with pytest.raises(ValueError, match="mesh and rules"):
+            make_train_step(model, cfg, opt, mesh=make_mesh(data=2,
+                                                            model=1))
+
+
+# ---------------------------------------------- per-host input sharding
+
+
+class TestHostBatchShard:
+    def test_slabs_reassemble_the_exact_global_batch(self):
+        from improved_body_parts_tpu.data import (
+            host_batch_shard, host_shard)
+
+        perm = np.random.default_rng(0).permutation(37)
+        hb, procs = 4, 2
+        gb = hb * procs
+        slabs = [host_batch_shard(perm, p, procs, hb) for p in range(procs)]
+        n_batches = len(perm) // gb
+        for k in range(n_batches):
+            assembled = np.concatenate(
+                [s[k * hb:(k + 1) * hb] for s in slabs])
+            np.testing.assert_array_equal(
+                assembled, perm[k * gb:(k + 1) * gb])
+        # the strided shard yields the same per-epoch sample multiset
+        # but NOT the same batches — the two modes are genuinely
+        # different assignments
+        strided = [host_shard(perm, p, procs, hb) for p in range(procs)]
+        assert sorted(np.concatenate(slabs)) == \
+            sorted(np.concatenate(strided))
+
+    def test_ring_and_sync_agree_and_reassemble_bit_identically(
+            self, tmp_path):
+        """Per-host ring sharding (shard="batch"): P simulated hosts'
+        shm-ring streams concatenate to the single-host global-batch
+        stream BIT-IDENTICALLY, and match the sync path exactly."""
+        from improved_body_parts_tpu.data import (
+            CocoPoseDataset, ShmRingInput, batches, build_fixture)
+
+        cfg = get_config("tiny")
+        h5 = str(tmp_path / "corpus.h5")
+        build_fixture(h5, num_images=8, people_per_image=1,
+                      img_size=(256, 256), image_size=128, seed=0,
+                      drawn=True)
+        ds = CocoPoseDataset(h5, cfg, augment=True, seed=0)
+        gb, procs = 4, 2
+        hb = gb // procs
+        single = list(batches(ds, gb, epoch=1, shard="batch",
+                              wire="uint8"))
+        per_host = [list(batches(ds, hb, epoch=1, process_index=p,
+                                 process_count=procs, shard="batch",
+                                 wire="uint8"))
+                    for p in range(procs)]
+        assert len(single) == len(per_host[0]) == len(per_host[1]) > 0
+        for k, ref in enumerate(single):
+            for field in range(len(ref)):
+                assembled = np.concatenate(
+                    [per_host[p][k][field] for p in range(procs)])
+                np.testing.assert_array_equal(assembled, ref[field])
+        # the ring transport produces the identical per-host stream
+        with ShmRingInput(ds, hb, num_workers=1) as ring:
+            for p in range(procs):
+                got = [tuple(np.copy(x) for x in b)
+                       for b in ring.batches(1, p, procs, shard="batch")]
+                assert len(got) == len(per_host[p])
+                for a, b in zip(got, per_host[p]):
+                    for x, y in zip(a, b):
+                        np.testing.assert_array_equal(x, y)
+        ds.close()
+
+
+# --------------------------------------------------- resume / reshard
+
+
+class TestPartitionResume:
+    def _meta(self, mesh, rules):
+        from improved_body_parts_tpu.parallel import mesh_topology
+
+        return {"epoch": 3, "topology": mesh_topology(
+            mesh, partition_rules=rules_fingerprint(rules))}
+
+    def test_rules_change_refused_under_both_policies(self, eight_devices):
+        mesh = make_mesh(data=4, model=2)
+        meta = self._meta(mesh, RULES)
+        other = get_ruleset("replicated")
+        for policy in ("adjust", "refuse"):
+            with pytest.raises(PartitionRulesChanged, match="ruleset"):
+                reshard_on_topology_change(
+                    {"w": np.zeros((4, 16), np.float32)}, meta, mesh, 1,
+                    policy, "ckpt/epoch_3", rules=other)
+        # dropping the rules entirely is also a refused layout change
+        with pytest.raises(PartitionRulesChanged, match="replicated"):
+            reshard_on_topology_change(
+                {"w": np.zeros((4, 16), np.float32)}, meta, mesh, 1,
+                "adjust", "ckpt/epoch_3", rules=None)
+
+    def test_same_rules_same_mesh_keeps_host_leaves(self, eight_devices):
+        """Unchanged topology + unchanged rules: no re-placement (the
+        donated-executable safety rule reshard_replicated documents)."""
+        mesh = make_mesh(data=4, model=2)
+        meta = self._meta(mesh, RULES)
+        tree = {"w": np.zeros((4, 16), np.float32)}
+        out, change = reshard_on_topology_change(
+            tree, meta, mesh, 1, "adjust", "ckpt/epoch_3", rules=RULES)
+        assert change is None and out["w"] is tree["w"]
+
+    def test_legacy_stamp_without_rules_resumes_partitioned(
+            self, eight_devices):
+        """A replicated-era checkpoint (no partition_rules stamp) may
+        adopt partitioning — nothing to check, like every legacy
+        field."""
+        from improved_body_parts_tpu.parallel import mesh_topology
+
+        mesh = make_mesh(data=4, model=2)
+        meta = {"epoch": 1, "topology": mesh_topology(mesh)}
+        out, change = reshard_on_topology_change(
+            {"w": np.zeros((4, 16), np.float32)}, meta, mesh, 1,
+            "adjust", "p", rules=RULES)
+        assert change is None
+
+    def test_reshard_tree_replaces_sharded_state_onto_new_mesh(
+            self, eight_devices):
+        """The reshard_replicated blind-spot fix: a state sharded on one
+        mesh re-places onto a DIFFERENT mesh under the same rules, leaf
+        layouts following the rules on the new mesh."""
+        tree = {"conv": {"kernel": np.arange(3 * 3 * 8 * 32,
+                                             dtype=np.float32
+                                             ).reshape(3, 3, 8, 32)},
+                "bias": np.zeros((32,), np.float32)}
+        rules = ((r"kernel$", P(None, None, None, "model")), (r".*", P()))
+        mesh_a = make_mesh(data=4, model=2)
+        placed = reshard_tree(tree, mesh_a, rules)
+        assert placed["conv"]["kernel"].sharding.spec == P(
+            None, None, None, "model")
+        mesh_b = make_mesh(data=2, model=4,
+                           devices=jax.devices())
+        moved = reshard_tree(placed, mesh_b, rules)
+        assert moved["conv"]["kernel"].sharding.mesh.shape["model"] == 4
+        np.testing.assert_array_equal(np.asarray(moved["conv"]["kernel"]),
+                                      tree["conv"]["kernel"])
+        # topology change with rules routes through reshard_tree
+        from improved_body_parts_tpu.parallel import mesh_topology
+
+        meta = {"epoch": 0, "topology": mesh_topology(
+            mesh_a, partition_rules=rules_fingerprint(rules))}
+        out, change = reshard_on_topology_change(
+            tree, meta, mesh_b, 1, "adjust", "p", rules=rules)
+        assert change is not None and "mesh_axes" in change
+        assert out["conv"]["kernel"].sharding.spec == P(
+            None, None, None, "model")
+
+
+# ------------------------------------------------- large-batch schedule
+
+
+class TestLargeBatchSchedule:
+    def _cfg(self, **kw):
+        import dataclasses
+
+        return dataclasses.replace(get_config("tiny").train, **kw)
+
+    def test_linear_scaling_after_warmup(self):
+        cfg = self._cfg(lr_batch_ref=8, warmup_epochs=1)
+        sched = large_batch_schedule(cfg, steps_per_epoch=10,
+                                     global_batch=64)
+        # epoch 2 (past warmup, before any decay step): scaled LR
+        assert float(sched(25)) == pytest.approx(
+            cfg.learning_rate_per_device * 64 / 8)
+
+    def test_gradual_warmup_ramps_base_to_scaled(self):
+        cfg = self._cfg(lr_batch_ref=8, warmup_epochs=2)
+        sched = large_batch_schedule(cfg, steps_per_epoch=10,
+                                     global_batch=64)
+        base = cfg.learning_rate_per_device
+        first = float(sched(0))
+        last_warm = float(sched(19))
+        after = float(sched(20))
+        # starts near the UNSCALED base (not near zero), ends at scaled
+        assert base <= first < 2.0 * base
+        assert last_warm == pytest.approx(base * 8, rel=1e-6)
+        assert after == pytest.approx(base * 8, rel=1e-6)
+        assert first < last_warm
+
+    def test_at_reference_batch_matches_plain_schedule(self):
+        cfg = self._cfg(lr_batch_ref=4)
+        lb = large_batch_schedule(cfg, steps_per_epoch=10, global_batch=4)
+        plain = step_decay_schedule(cfg, steps_per_epoch=10, world_size=1)
+        for step in (0, 5, 25, 155, 800):
+            assert float(lb(step)) == pytest.approx(float(plain(step)),
+                                                    rel=1e-6)
+
+    def test_decay_staircase_applies_to_scaled_lr(self):
+        cfg = self._cfg(lr_batch_ref=8, warmup_epochs=1)
+        sched = large_batch_schedule(cfg, steps_per_epoch=10,
+                                     global_batch=64)
+        at_20 = float(sched(cfg.lr_step_epochs * 10 + 5))
+        assert at_20 == pytest.approx(
+            cfg.learning_rate_per_device * 8 * cfg.lr_decay_factor)

@@ -285,6 +285,46 @@ class TestShardingCoverage:
                         requires_devices=8)
         assert audit_program(spec, level="compile").findings == []
 
+    def _donated_state_args(self, state_sharded):
+        """(state, batch) for a donated toy step: batch always sharded
+        over 'data'; the state sharded over 'model' or fully replicated
+        — the latter is what "rules that shard zero leaves" compiles
+        to."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from improved_body_parts_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(data=4, model=2)
+        rep = NamedSharding(mesh, P())
+        wsh = NamedSharding(mesh, P(None, "model")) if state_sharded else rep
+        bsh = NamedSharding(mesh, P("data"))
+        state = {"w": SDS((16, 64), F32, sharding=wsh)}
+        batch = SDS((8, 16), F32, sharding=bsh)
+        fn = jax.jit(
+            lambda s, b: ({"w": s["w"] + (b.sum(0)[:, None] * 0.0)},
+                          b.sum()),
+            donate_argnums=(0,),
+            in_shardings=({"w": wsh}, bsh),
+            out_shardings=({"w": wsh}, rep))
+        return fn, (state, batch)
+
+    def test_rules_sharding_zero_state_leaves_flags(self):
+        """The ISSUE 12 seeded regression: a program DECLARING sharded
+        parameters whose state leaves all compiled replicated (the
+        batch still sharded — the old dryrun layout) must flag PRG006,
+        and the genuinely partitioned twin must pass."""
+        fn, args = self._donated_state_args(state_sharded=False)
+        spec = toy_spec(fn, args, meshed=True, expect_sharded_params=True,
+                        donate_argnums=(0,), requires_devices=8)
+        verdict = audit_program(spec, level="compile")
+        assert "PRG006" in rules_of(verdict)
+        assert "ZERO" in " ".join(f.message for f in verdict.findings)
+
+        fn, args = self._donated_state_args(state_sharded=True)
+        good = toy_spec(fn, args, meshed=True, expect_sharded_params=True,
+                        donate_argnums=(0,), requires_devices=8)
+        assert audit_program(good, level="compile").findings == []
+
     def test_short_host_records_skip_not_crash(self):
         spec = toy_spec(jax.jit(lambda x: x), (SDS((4,), F32),),
                         requires_devices=4096)
@@ -371,8 +411,13 @@ def test_registry_has_the_shipped_entry_points(registry_sweep):
     # train step both ways, eval, serve-compact, flip-TTA and SWA
     assert len(names) >= 6
     for required in ("train_step", "train_step_health", "eval_step",
-                     "serve_compact_b1", "flip_tta_peaks", "swa_update"):
+                     "serve_compact_b1", "flip_tta_peaks", "swa_update",
+                     "train_step_partitioned"):
         assert required in names
+    part = next(s for s in program_registry()
+                if s.name == "train_step_partitioned")
+    assert part.meshed and part.expect_sharded_params, \
+        "the partitioned step must gate under PRG006's param facet"
 
 
 def test_fused_decode_programs_registered_with_declared_while():

@@ -90,10 +90,14 @@ class TestSchedules:
             expect = 1e-5 - (1e-5 - 1e-6) / 4 * e
             assert float(sched((90 + e) * spe)) == pytest.approx(expect), e
 
-    def test_step_decay_world_size_is_global_device_count(self):
+    def test_step_decay_world_size_is_global_data_extent(self):
         """Multi-host LR scaling: the reference multiplies base LR by
         world_size exactly once (train_distributed.py:388).  tools/train.py
-        must pass the GLOBAL device count, with no extra process factor."""
+        must pass the global BATCH-CARRYING device count — the 'data'
+        mesh extent (== all devices whenever the model axis is 1, i.e.
+        every replicated run; 'model'-axis devices split tensors, not
+        rows, so they must not inflate the LR) — with no extra process
+        factor."""
         import ast
         import os
 
@@ -105,8 +109,10 @@ class TestSchedules:
         assert calls, "tools/train.py no longer calls step_decay_schedule"
         for call in calls:
             ws = [k.value for k in call.keywords if k.arg == "world_size"]
-            assert ws and isinstance(ws[0], ast.Name) and ws[0].id == "n_dev", (
-                "world_size must be the global device count n_dev alone")
+            assert ws and isinstance(ws[0], ast.Name) \
+                and ws[0].id == "data_ax", (
+                "world_size must be the global data-axis extent data_ax "
+                "alone")
 
 
 class TestSWA:

@@ -1,20 +1,40 @@
 #!/usr/bin/env python
-"""Weak-scaling measurement: steps/s at increasing device counts with a
-fixed per-device batch (north star: linear data-parallel scaling,
-BASELINE.md:25).
+"""Weak-scaling curve artifact for the GSPMD-partitioned train step.
 
-On real multi-chip hardware this reports weak-scaling efficiency directly.
-On a virtual CPU mesh (``JAX_PLATFORMS=cpu`` with
-``--xla_force_host_platform_device_count=N``) the numbers measure
-*correct compilation and execution*, not speedup — all virtual devices
-timeshare the host's cores, so efficiency trends toward 1/N there; use
-tests/test_scaling.py for the cross-mesh equivalence proof instead.
+Drives the REAL partitioned program (``make_train_step(mesh=, rules=)``
+— rule-sharded param/optimizer state, batch over 'data', donated) at a
+fixed per-device batch across increasing device counts and writes
+``SCALING.json``.
+
+Protocol (the ROADMAP standing constraint: single-shot wall-clock on a
+shared CPU host is noise — perf claims use interleaved verdict rounds):
+
+- every mesh size n ∈ ``--devices`` is set up and warmed FIRST (one
+  compile each, outside every timing window);
+- then ``--rounds`` rounds run; each round times ``--steps`` chained
+  steps (step i+1 consumes step i's state — donation makes this the
+  real training dependence chain) at EVERY n back-to-back, so slow
+  host phases hit all mesh sizes alike instead of biasing one;
+- the per-n verdict is the MEDIAN over rounds; the curve verdict is
+  monotone non-decreasing global imgs/s within ``--tolerance``.
+
+On real multi-chip hardware this reports weak-scaling efficiency
+directly.  On a virtual CPU mesh (the committed artifact's host) all
+devices timeshare the host's cores, so per-device efficiency trends to
+1/n and the honest claim is the one gated here: growing the mesh grows
+GLOBAL throughput monotonically — partitioning overhead (collectives,
+sharded layouts) does not eat the added devices.  Numerical equivalence
+across mesh shapes is pinned separately (tests/test_scaling.py,
+tests/test_partition.py); this tool additionally records per-n
+first-step loss parity vs n=1 for the artifact.
 
 Example:
-    python tools/scaling_test.py --config tiny --devices 1 2 4 8 --steps 20
+    python tools/scaling_test.py --devices 1 2 4 8 --steps 10 \
+        --rounds 3 --out SCALING.json
 """
 import argparse
 import os
+import statistics
 import sys
 import time
 
@@ -22,14 +42,46 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    ap = argparse.ArgumentParser(description="weak-scaling steps/s")
+    ap = argparse.ArgumentParser(description="weak-scaling curve artifact")
     ap.add_argument("--config", default="tiny")
     ap.add_argument("--devices", type=int, nargs="+", default=[1, 2, 4, 8])
     ap.add_argument("--batch-per-device", type=int, default=1)
-    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--steps", type=int, default=10,
+                    help="chained steps per (round, n) timing segment")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="interleaved verdict rounds (median wins)")
     ap.add_argument("--image-size", type=int, default=None,
                     help="override H=W (default: the config's input size)")
+    ap.add_argument("--rules", default="imhn",
+                    help="partition ruleset (parallel.partition."
+                         "NAMED_RULESETS); 'replicated' reproduces the "
+                         "retired dryrun layout as an A/B arm")
+    ap.add_argument("--model-axis", type=int, default=1,
+                    help="'model' mesh-axis size at the LARGEST n "
+                         "(smaller n fall back to 1 when indivisible)")
+    ap.add_argument("--min-shard-dim", type=int, default=None,
+                    help="per-device shard-extent floor for the rule "
+                         "refinement (default: the library's 8 — the "
+                         "flagship-width setting; the tiny bench model "
+                         "is narrow, so smaller floors shard more of "
+                         "it at large n)")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed fractional dip between consecutive "
+                         "median imgs/s points before the curve is "
+                         "non-monotone")
+    ap.add_argument("--out", default=None,
+                    help="write the SCALING.json artifact here")
     args = ap.parse_args()
+
+    # the committed artifact runs on a virtual CPU mesh: force the
+    # device count BEFORE jax initializes (no-op when enough exist)
+    want = max(args.devices)
+    flag = f"--xla_force_host_platform_device_count={want}"
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = \
+            (os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     import jax
 
@@ -41,27 +93,41 @@ def main():
 
     from improved_body_parts_tpu.config import get_config
     from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.obs.events import strict_dump, strict_dumps
     from improved_body_parts_tpu.parallel import (
-        make_mesh, replicated, shard_batch)
+        get_ruleset, make_mesh, rules_fingerprint, shard_batch,
+        sharding_summary, train_state_shardings)
     from improved_body_parts_tpu.train import (
         create_train_state, make_optimizer, make_train_step,
         step_decay_schedule)
+
+    from improved_body_parts_tpu.parallel.partition import \
+        DEFAULT_MIN_SHARD_DIM
 
     cfg = get_config(args.config)
     size = args.image_size or cfg.skeleton.height
     label = size // cfg.skeleton.stride
     model = build_model(cfg)
+    rules = get_ruleset(args.rules)
+    min_shard = args.min_shard_dim or DEFAULT_MIN_SHARD_DIM
     rng = np.random.default_rng(0)
 
     n_avail = len(jax.devices())
-    print(f"platform={jax.devices()[0].platform} devices={n_avail}")
-    base = None
+    platform = jax.devices()[0].platform
+    print(f"platform={platform} devices={n_avail} rules={args.rules}"
+          f"#{rules_fingerprint(rules, min_shard_dim=min_shard)}")
+
+    # ---- setup + warm every mesh size OUTSIDE the timing rounds ------
+    arms = {}
     for n in args.devices:
         if n > n_avail:
             print(f"n={n}: skipped (only {n_avail} devices)")
             continue
-        mesh = make_mesh(data=n, model=1, devices=jax.devices()[:n])
-        gb = args.batch_per_device * n
+        model_ax = args.model_axis if n % args.model_axis == 0 \
+            and n >= args.model_axis else 1
+        mesh = make_mesh(data=n // model_ax, model=model_ax,
+                         devices=jax.devices()[:n])
+        gb = args.batch_per_device * (n // model_ax)
         images = np.asarray(rng.uniform(0, 1, (gb, size, size, 3)),
                             np.float32)
         labels = np.asarray(
@@ -71,26 +137,164 @@ def main():
 
         sched = step_decay_schedule(cfg.train, steps_per_epoch=100)
         opt = make_optimizer(cfg, sched)
+        shardings = train_state_shardings(model, cfg, opt, mesh, rules,
+                                          min_shard_dim=min_shard)
         state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
-                                   jnp.zeros((gb, size, size, 3)))
-        state = jax.device_put(state, replicated(mesh))
+                                   jnp.zeros((gb, size, size, 3)),
+                                   shardings=shardings)
         batch = shard_batch((images, mask, labels), mesh)
-        step = make_train_step(model, cfg, opt, donate=False)
-
-        state, loss = step(state, *batch)  # compile + warm
-        jax.block_until_ready(loss)
+        # the REAL donated partitioned program — what tools/train.py
+        # --partition runs and graftaudit registers; the placed state's
+        # OWN sharding tree feeds the jit (one layout source)
+        step = make_train_step(model, cfg, opt, mesh=mesh, rules=rules,
+                               state_shardings=shardings)
         t0 = time.perf_counter()
-        for _ in range(args.steps):
-            state, loss = step(state, *batch)
+        state, loss = step(state, *batch)
         jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
-        sps = args.steps / dt
-        ips = sps * gb
-        if base is None:
-            base = ips / n
-        eff = ips / (base * n)
-        print(f"n={n}: {sps:6.2f} steps/s  {ips:7.2f} imgs/s  "
-              f"weak-scaling eff {eff:5.1%}")
+        warm_s = time.perf_counter() - t0
+        realized = sharding_summary(shardings)
+        arms[n] = {"mesh": {"data": n // model_ax, "model": model_ax},
+                   "mesh_obj": mesh, "shardings": shardings,
+                   "global_batch": gb, "state": state, "batch": batch,
+                   "step": step, "first_loss": float(loss),
+                   "warm_s": round(warm_s, 2), "sharding": realized}
+        print(f"n={n}: warmed in {warm_s:.1f}s, global_batch={gb}, "
+              f"state sharding {realized}")
+
+    sizes = sorted(arms)
+    assert sizes, "no runnable mesh sizes"
+
+    # ---- partitioned-vs-single-device loss parity --------------------
+    # SAME fixture batch, SAME initial state (same PRNGKey), the
+    # largest partitioned mesh vs one device: the documented XLA:CPU
+    # cross-layout drift bounds the difference (different float
+    # reduction orders; tests/test_partition.py pins rel 2e-5 on the
+    # update too).  The partitioned side reuses the warmed arm's
+    # compiled donated program (same shapes); only the single-device
+    # twin compiles extra.
+    n_big = sizes[-1]
+    big = arms[n_big]
+    gbp = big["global_batch"]
+    prng = np.random.default_rng(1234)
+    p_images = np.asarray(prng.uniform(0, 1, (gbp, size, size, 3)),
+                          np.float32)
+    p_labels = np.asarray(
+        prng.uniform(0, 1, (gbp, label, label, cfg.skeleton.num_layers)),
+        np.float32)
+    p_mask = np.ones((gbp, label, label, 1), np.float32)
+    sched = step_decay_schedule(cfg.train, steps_per_epoch=100)
+    opt = make_optimizer(cfg, sched)
+    single_step = make_train_step(model, cfg, opt, donate=False)
+    s_state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
+                                 jnp.zeros((gbp, size, size, 3)))
+    _, loss_s = single_step(s_state, p_images, p_mask, p_labels)
+    p_state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
+                                 jnp.zeros((gbp, size, size, 3)),
+                                 shardings=big["shardings"])
+    pb = shard_batch((p_images, p_mask, p_labels), big["mesh_obj"])
+    _, loss_p = big["step"](p_state, *pb)
+    parity_rel = abs(float(loss_p) - float(loss_s)) \
+        / max(abs(float(loss_s)), 1e-12)
+    parity = {
+        "global_batch": gbp,
+        "partitioned_mesh": big["mesh"],
+        "single_device_loss": float(loss_s),
+        "partitioned_loss": float(loss_p),
+        "rel_diff": round(parity_rel, 9),
+        "tolerance": 2e-5,
+        "ok": bool(parity_rel <= 2e-5),
+    }
+    print(f"parity: single {float(loss_s):.6f} vs partitioned "
+          f"{float(loss_p):.6f} (rel {parity_rel:.2e}) "
+          f"ok={parity['ok']}")
+
+    # ---- interleaved verdict rounds ----------------------------------
+    per_round = {n: [] for n in sizes}
+    for r in range(args.rounds):
+        for n in sizes:
+            arm = arms[n]
+            state, step, batch = arm["state"], arm["step"], arm["batch"]
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                state, loss = step(state, *batch)
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            arm["state"] = state  # graftlint: disable=JGL001 -- ownership handoff, not a stale read: `state` was rebound from the donated call's result each iteration and the arms dict is its only holder between rounds
+            ips = args.steps * arm["global_batch"] / dt
+            per_round[n].append(round(ips, 3))
+        print(f"round {r}: " + "  ".join(
+            f"n={n}:{per_round[n][-1]:7.2f} img/s" for n in sizes))
+
+    # ---- verdicts -----------------------------------------------------
+    results = {}
+    for n in sizes:
+        med = statistics.median(per_round[n])
+        results[n] = {
+            "mesh": arms[n]["mesh"],
+            "global_batch": arms[n]["global_batch"],
+            "imgs_per_sec_rounds": per_round[n],
+            "imgs_per_sec_median": round(med, 3),
+            "per_device_imgs_per_sec": round(med / n, 3),
+            "warm_compile_s": arms[n]["warm_s"],
+            "state_leaves": arms[n]["sharding"],
+            # the per-arm loss is over the arm's OWN global batch (weak
+            # scaling grows the batch with n) — comparable parity lives
+            # in the dedicated same-batch block below
+            "first_step_loss": arms[n]["first_loss"],
+            "first_step_finite": bool(np.isfinite(arms[n]["first_loss"])),
+        }
+    medians = [results[n]["imgs_per_sec_median"] for n in sizes]
+    monotone = all(b >= a * (1.0 - args.tolerance)
+                   for a, b in zip(medians, medians[1:]))
+    eff = {n: round(results[n]["imgs_per_sec_median"]
+                    / (medians[0] * n), 4) for n in sizes}
+
+    artifact = {
+        "config": args.config,
+        "image_size": size,
+        "batch_per_device": args.batch_per_device,
+        "devices": sizes,
+        "platform": platform,
+        "partition_rules": {
+            "name": args.rules,
+            "fingerprint": rules_fingerprint(rules,
+                                             min_shard_dim=min_shard),
+            "min_shard_dim": min_shard},
+        "steps_per_segment": args.steps,
+        "rounds": args.rounds,
+        "results": {str(n): results[n] for n in sizes},
+        "imgs_per_sec_medians": medians,
+        "weak_scaling_efficiency": {str(n): eff[n] for n in sizes},
+        "loss_parity": parity,
+        "monotone_tolerance": args.tolerance,
+        "monotone_ok": bool(monotone),
+        "protocol": "interleaved rounds (every mesh size timed per "
+                    "round, chained donated steps, median-of-rounds "
+                    "verdict); compile warm-up outside all timing "
+                    "windows.  On a virtual CPU mesh all devices "
+                    "timeshare the host cores, so the gated claim is "
+                    "monotone GLOBAL throughput, not per-device "
+                    "efficiency (see module docstring).",
+    }
+    for n in sizes:
+        e = results[n]
+        print(f"n={n}: median {e['imgs_per_sec_median']:7.2f} imgs/s  "
+              f"({e['per_device_imgs_per_sec']:6.2f}/dev, eff {eff[n]:.0%})"
+              f"  gb={e['global_batch']}")
+    print(f"monotone_ok={monotone} (tolerance {args.tolerance:.0%}) "
+          f"parity_ok={parity['ok']}")
+    if args.out:
+        with open(args.out, "w") as f:
+            strict_dump(artifact, f, indent=2)
+        print(f"wrote {args.out}")
+    else:
+        print(strict_dumps(artifact))
+    if not parity["ok"]:
+        raise SystemExit("partitioned-vs-single-device loss parity "
+                         f"failed: rel {parity['rel_diff']}")
+    if not monotone:
+        raise SystemExit("weak-scaling curve is not monotone: "
+                         f"{medians}")
 
 
 if __name__ == "__main__":

@@ -149,6 +149,30 @@ def main():
                          "the new device count, reported loudly), "
                          "'refuse' errors out. Default: refuse for plain "
                          "resumes, adjust under --supervised")
+    # GSPMD partitioned training (parallel.partition; TRAINING.md §1d)
+    ap.add_argument("--partition", action="store_true",
+                    help="run the fully GSPMD-partitioned train step: "
+                         "param/optimizer state sharded per the "
+                         "partition ruleset (wide conv kernels over the "
+                         "'model' mesh axis), batch over 'data', "
+                         "activations sharding-constrained, per-host "
+                         "contiguous-slab input sharding; the ruleset "
+                         "hash is stamped into every checkpoint and a "
+                         "resume under different rules is refused")
+    ap.add_argument("--partition-rules", default=None,
+                    help="named ruleset (default: the config's "
+                         "partition_rules, normally 'imhn'; 'replicated' "
+                         "is the explicit everything-replicated A/B arm)")
+    ap.add_argument("--mesh-model", type=int, default=None,
+                    help="'model' mesh-axis size (default: the config's "
+                         "mesh_model_axis; data axis = devices // model)")
+    ap.add_argument("--lr-batch-ref", type=int, default=None,
+                    help="large-batch recipe: reference global batch the "
+                         "base LR was tuned at — enables linear LR "
+                         "scaling by global_batch/ref with a gradual "
+                         "base->scaled warmup (default: the config's "
+                         "lr_batch_ref; 0 keeps the per-device "
+                         "world_size convention)")
     # multi-host (jax.distributed)
     ap.add_argument("--coordinator", default=None)
     ap.add_argument("--num-processes", type=int, default=1)
@@ -199,10 +223,23 @@ def main():
     if (args.checkpoint_dir or args.lr or args.print_freq
             or args.on_divergence or args.save_freq or args.eval_freq
             or args.sync_checkpoint or args.keep_last_n is not None
-            or args.milestone_every is not None):
+            or args.milestone_every is not None or args.partition
+            or args.partition_rules or args.mesh_model is not None
+            or args.lr_batch_ref is not None):
         import dataclasses
 
         overrides = {}
+        # partitioning and the large-batch recipe fold into the config:
+        # the step program, the schedule and the topology stamp must
+        # all derive from ONE process-symmetric source
+        if args.partition:
+            overrides["partition"] = True
+        if args.partition_rules:
+            overrides["partition_rules"] = args.partition_rules
+        if args.mesh_model is not None:
+            overrides["mesh_model_axis"] = args.mesh_model
+        if args.lr_batch_ref is not None:
+            overrides["lr_batch_ref"] = args.lr_batch_ref
         if args.checkpoint_dir:
             overrides["checkpoint_dir"] = args.checkpoint_dir
         if args.lr:
@@ -232,6 +269,21 @@ def main():
             overrides["milestone_every"] = args.milestone_every
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **overrides))
 
+    if not cfg.train.partition and (args.mesh_model is not None
+                                    or args.partition_rules):
+        # these flags only take effect on the partitioned path — an
+        # explicit flag silently doing nothing is worse than an error
+        raise SystemExit("--mesh-model/--partition-rules require "
+                         "--partition (or a config with partition=True)")
+    # partition ruleset resolved ONCE, next to the config it came from:
+    # the supervisor's resume check, the step program, the state
+    # placement and the topology stamp all consume this one value
+    partition_rules_resolved = None
+    if cfg.train.partition:
+        from improved_body_parts_tpu.parallel import get_ruleset
+
+        partition_rules_resolved = get_ruleset(cfg.train.partition_rules)
+
     # elastic supervision (train.supervisor): created BEFORE telemetry so
     # the segment's run_id lands in the run_start header — that id is
     # what telemetry_report.py stitches the segments back together on
@@ -251,7 +303,8 @@ def main():
             crash_budget=args.crash_budget,
             backoff_base_s=args.backoff_base,
             backoff_max_s=args.backoff_max, reshard=reshard_policy,
-            is_lead_host=args.process_id == 0)
+            is_lead_host=args.process_id == 0,
+            rules=partition_rules_resolved)
         # classification of the previous segment's end + backoff happen
         # here, before any device work
         supervisor.open_segment({"argv": sys.argv[1:]})
@@ -343,14 +396,39 @@ def main():
     val_ds = (CocoPoseDataset(val_h5, cfg, augment=False)
               if os.path.exists(val_h5) else None)
 
-    mesh = make_mesh()
+    partitioned = cfg.train.partition
+    if partitioned and args.swa:
+        # the SWA swap grafts swa_params into the state, changing the
+        # pytree the sharding rules were matched against; run the SWA
+        # fine-tune on the replicated path (it is short and cheap)
+        raise SystemExit("--partition covers the main fit only; run the "
+                         "SWA stage without it")
+    model_ax = cfg.train.mesh_model_axis if partitioned else 1
+    mesh = make_mesh(model=model_ax) if model_ax > 1 else make_mesh()
     n_dev = int(mesh.devices.size)  # devices across ALL processes
-    global_batch = cfg.train.batch_size_per_device * n_dev
+    # the batch shards over the 'data' axis only — 'model'-axis devices
+    # split tensors, not rows — so the data extent is the batch multiplier
+    data_ax = n_dev // model_ax
+    global_batch = cfg.train.batch_size_per_device * data_ax
     # each host loads only its slice; shard_batch assembles the global array
     host_batch = global_batch // args.num_processes
     steps_per_epoch = max(len(ds) // global_batch, 1)
-    print(f"devices={n_dev} global_batch={global_batch} "
-          f"host_batch={host_batch} steps/epoch={steps_per_epoch}")
+    rules = partition_rules_resolved
+    rules_hash = None
+    if partitioned:
+        from improved_body_parts_tpu.parallel import rules_fingerprint
+
+        rules_hash = rules_fingerprint(rules)
+    # per-host row assignment: the partitioned path uses contiguous
+    # per-global-batch slabs so the assembled global batch is
+    # bit-identical to a single-host run (data.host_batch_shard); the
+    # replicated path keeps the historical strided shard
+    input_shard = "batch" if partitioned else "strided"
+    print(f"devices={n_dev} mesh=data:{data_ax},model:{model_ax} "
+          f"global_batch={global_batch} host_batch={host_batch} "
+          f"steps/epoch={steps_per_epoch}"
+          + (f" partition_rules={cfg.train.partition_rules}"
+             f"#{rules_hash}" if partitioned else ""))
 
     model = build_model(cfg)
 
@@ -364,23 +442,50 @@ def main():
         # provisional (start anchor unknown until resume resolves); rebuilt
         # below once start_epoch is known — opt_state structure is identical
         schedule = swa_schedule()
+    elif cfg.train.lr_batch_ref > 0:
+        # large-batch recipe ("Extremely Large Minibatch SGD"): linear
+        # LR scaling by global_batch / lr_batch_ref with a gradual
+        # base->scaled warmup — what makes the pod-slice batch
+        # trainable, not just runnable
+        from improved_body_parts_tpu.train import large_batch_schedule
+
+        schedule = large_batch_schedule(cfg.train, steps_per_epoch,
+                                        global_batch,
+                                        use_warmup=not args.no_warmup)
     else:
-        # n_dev already counts devices across ALL processes (jax.devices()
-        # is global under jax.distributed), so it IS the reference's
-        # world_size LR multiplier (train_distributed.py:388) — no extra
-        # num_processes factor.
+        # data_ax counts batch-carrying devices across ALL processes
+        # (jax.devices() is global under jax.distributed; the 'model'
+        # axis splits tensors, not rows), so it IS the reference's
+        # world_size LR multiplier (train_distributed.py:388) — no
+        # extra num_processes factor.
         schedule = step_decay_schedule(cfg.train, steps_per_epoch,
-                                       world_size=n_dev,
+                                       world_size=data_ax,
                                        use_warmup=not args.no_warmup)
     optimizer = make_optimizer(cfg, schedule)
     sample = jnp.zeros((global_batch, cfg.skeleton.height,
                         cfg.skeleton.width, 3))
+    state_shardings = None
+    if partitioned:
+        from improved_body_parts_tpu.parallel import train_state_shardings
+
+        # strict: a parameter the ruleset misses fails HERE, at build,
+        # naming the leaf — never a silent replicate at pod scale
+        state_shardings = train_state_shardings(model, cfg, optimizer,
+                                                mesh, rules)
     state = create_train_state(model, cfg, optimizer,
                                jax.random.PRNGKey(args.seed), sample)
-    # re-align ranks before the FIRST collective: per-host init/compile
-    # skew can exceed the transport bring-up window (see parallel.barrier)
+    # re-align ranks between the heavy per-host init compile above and
+    # the FIRST collective placement below: per-host init/compile skew
+    # can exceed the transport bring-up window (see parallel.barrier)
     barrier("pre_state_replication")
-    state = jax.device_put(state, replicated(mesh))
+    if partitioned:
+        from improved_body_parts_tpu.parallel import (
+            shard_tree, sharding_summary)
+
+        state = shard_tree(state, state_shardings)
+        print(f"partitioned state: {sharding_summary(state_shardings)}")
+    else:
+        state = jax.device_put(state, replicated(mesh))
 
     start_epoch = 0
     resumed_swa = False
@@ -405,10 +510,11 @@ def main():
             state, meta = restore_checkpoint(path, state)
             try:
                 # one policy implementation with the supervised path
-                # (detection, refusal text, reshard-only-on-change rule)
+                # (detection, refusal text, reshard-only-on-change rule,
+                # partition-ruleset refusal)
                 state, _ = reshard_on_topology_change(
                     state, meta, mesh, args.num_processes,
-                    reshard_policy, path)
+                    reshard_policy, path, rules=rules)
             except TopologyChanged as e:
                 raise SystemExit(str(e)) from None
             start_epoch = meta["epoch"] + 1
@@ -443,7 +549,10 @@ def main():
     train_step = make_train_step(model, cfg, optimizer, use_focal=use_focal,
                                  freeze_bn=args.swa,
                                  device_gt=args.device_gt > 0,
-                                 health=with_health)
+                                 health=with_health,
+                                 mesh=mesh if partitioned else None,
+                                 rules=rules,
+                                 state_shardings=state_shardings)
     eval_step = make_eval_step(model, cfg, use_focal=use_focal)
     is_lead = args.process_id == 0
 
@@ -477,11 +586,12 @@ def main():
     def make_train_batches(epoch):
         if train_ring is not None:
             it = train_ring.batches(epoch, args.process_id,
-                                    args.num_processes)
+                                    args.num_processes, shard=input_shard)
         else:
             it = batches(ds, host_batch, epoch, args.process_id,
                          args.num_processes, num_workers=args.workers,
-                         raw_gt=args.device_gt, pipeline=pipeline, wire=wire)
+                         raw_gt=args.device_gt, pipeline=pipeline, wire=wire,
+                         shard=input_shard)
         if not (args.debug_overlays and is_lead) or args.device_gt:
             return it
 
@@ -506,19 +616,23 @@ def main():
         def make_eval_batches(epoch):
             if eval_ring is not None:
                 return eval_ring.batches(0, args.process_id,
-                                         args.num_processes)
+                                         args.num_processes,
+                                         shard=input_shard)
             return batches(val_ds, host_batch, 0, args.process_id,
                            args.num_processes, num_workers=args.workers,
-                           pipeline=pipeline, wire=wire)
+                           pipeline=pipeline, wire=wire, shard=input_shard)
 
     # ONE checkpoint manager for both stages (fit and SWA): async
     # snapshot + background Orbax write + atomic commit markers +
     # retention GC, from the config knobs (process-symmetric).  The mesh
     # topology rides every commit marker so a restart on a different
     # device layout is detected at restore time, not mid-step.
-    manager = CheckpointManager.from_config(cfg.train.checkpoint_dir,
-                                            cfg.train, is_lead_host=is_lead,
-                                            topology=mesh_topology(mesh))
+    # the partition-ruleset hash rides the topology stamp: a resume
+    # under different rules is then a refused layout change, exactly
+    # like a different device count (train.supervisor)
+    manager = CheckpointManager.from_config(
+        cfg.train.checkpoint_dir, cfg.train, is_lead_host=is_lead,
+        topology=mesh_topology(mesh, partition_rules=rules_hash))
 
     def shutdown():
         # flush the in-flight checkpoint write FIRST: its commit event
@@ -563,8 +677,11 @@ def main():
             # the logical run converges to across restarts
             def fresh_state():
                 s = create_train_state(model, cfg, optimizer,
-                                       jax.random.PRNGKey(args.seed), sample)
-                return jax.device_put(s, replicated(mesh))
+                                       jax.random.PRNGKey(args.seed), sample,
+                                       shardings=state_shardings)
+                if state_shardings is None:
+                    s = jax.device_put(s, replicated(mesh))
+                return s
 
             def resume_milestone(epoch):
                 # lightweight eval right after a restore: recovery
