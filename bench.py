@@ -633,6 +633,55 @@ def _scaling_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _cascade_summary(fallback, budget_s):
+    """Run tools/cascade_bench.py (two-tier student/teacher cascade vs
+    teacher-only, interleaved rounds) and return a compact summary, or
+    an {"error"/"skipped"} marker — the "serve"/"decode" key contract.
+    Subprocess so a cascade failure can never take down the primary
+    metric; the committed CASCADE_BENCH.json carries the full protocol
+    run.  ``IBP_BENCH_CASCADE=0`` skips it unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_CASCADE") == "0":
+        return {"skipped": "IBP_BENCH_CASCADE=0"}
+    if budget_s < 420:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (CASCADE_BENCH.json has the full "
+                           "run)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="cascade_"),
+                       "CASCADE_BENCH.json")
+    # smoke shape: fewer/shorter rounds than the committed artifact;
+    # the production-shape synth_deep pair keeps the ratio meaningful
+    # (the tiny pair's shared extraction cost drowns the forward delta)
+    argv = ["--rounds", "2", "--clients", "2", "--requests", "4"]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # CPU protocol — never claims the chip
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "cascade_bench.py"),
+             "--out", out] + argv,
+            capture_output=True, timeout=min(900, budget_s), check=True,
+            env=env)
+        with open(out) as f:
+            r = json.load(f)
+        return {
+            "median_round_ratio": r["median_round_ratio"],
+            "cascade_beats_target": r["cascade_beats_target"],
+            "escalation_rate": r["escalation_rate"],
+            "answered_student": r["cascade_routing"]["answered_student"],
+            "escalated_teacher":
+                r["cascade_routing"]["escalated_teacher"],
+            "ap_rel_diff": r["quality"]["rel_diff"],
+            "ap_within_tolerance": r["quality"]["within_tolerance"],
+            "recompiles_post_warmup": r["recompiles_post_warmup"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def _lint_summary(budget_s):
     """Run tools/lint.py (the graftlint static-analysis gate) and return
     finding counts by severity, or an {"error"/"skipped"} marker — the
@@ -756,6 +805,10 @@ def main():
     # discipline
     scaling = _scaling_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # two-tier cascade serving (student lane + teacher escalation),
+    # same discipline
+    cascade = _cascade_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     # static-analysis gate (graftlint), same discipline
     lint = _lint_summary(
         TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
@@ -780,6 +833,7 @@ def main():
         "chaos": chaos,
         "servechaos": servechaos,
         "scaling": scaling,
+        "cascade": cascade,
         "lint": lint,
         "audit": audit,
         "provenance": _provenance(),

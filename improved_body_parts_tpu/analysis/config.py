@@ -38,7 +38,8 @@ class LintConfig:
     #: per-rule severity overrides, e.g. {"JGL005": "info"}
     severity: Dict[str, str] = field(default_factory=dict)
     #: callables whose RESULT is a donating jitted step: "name:pos[,pos]"
-    donating_factories: Tuple[str, ...] = ("make_train_step:0",)
+    donating_factories: Tuple[str, ...] = ("make_train_step:0",
+                                           "make_distill_train_step:0")
     #: extra regexes over dotted callee names that produce device values
     extra_device_producers: Tuple[str, ...] = ()
     #: error-severity findings in tests/ are reported as warnings — test

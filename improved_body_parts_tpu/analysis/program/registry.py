@@ -9,7 +9,12 @@ candidate walk), the flip-TTA peaks program, the SWA running average,
 the legacy replicated meshed step, and the fully GSPMD-PARTITIONED
 train step (rule-sharded state; ISSUE 12) — together with the
 declarations the checks verify (donated argnums, bf16-compute,
-hot-path status, mesh/sharded-param expectations).
+hot-path status, mesh/sharded-param expectations).  The distilled fast
+tier (ISSUE 13) adds three: the student forward and student fused
+decode with bf16 PARAM storage (the quantized artifact's programs —
+``tools/export_model.py`` gates exports on their blessed fingerprints),
+and the distillation train step (student state donated, frozen teacher
+variables a non-donated argument).
 
 ``build()`` returns the jitted callable plus ``ShapeDtypeStruct``
 example arguments: tracing/lowering/compiling them runs ZERO model
@@ -79,16 +84,18 @@ class ProgramSpec:
 # --------------------------------------------------------- shared builders
 
 
-def _tiny_setup():
+def _tiny_setup(name: str = "tiny"):
     """(config, model, optimizer) for the registry's programs — one
     construction path shared by every spec so the audited programs are
-    built exactly like ``tools/train.py`` builds them."""
+    built exactly like ``tools/train.py`` builds them.  ``name`` selects
+    the config (``tiny_student`` for the distilled fast tier's
+    programs)."""
     from ...config import get_config
     from ...models import build_model
     from ...train.schedule import step_decay_schedule
     from ...train.state import make_optimizer
 
-    cfg = get_config("tiny")
+    cfg = get_config(name)
     model = build_model(cfg)
     optimizer = make_optimizer(cfg, step_decay_schedule(cfg.train, 10))
     return cfg, model, optimizer
@@ -176,15 +183,21 @@ def _build_swa_update() -> BuiltProgram:
     return BuiltProgram(fn=jax.jit(update_swa), args=(swa_state,))
 
 
-def _abstract_predictor():
+def _abstract_predictor(name: str = "tiny", bf16_params: bool = False):
     """A Predictor over abstract variables: ``_ensemble_fn`` only ever
     threads the variables through to the jitted program, so the
-    ShapeDtypeStruct tree traces/lowers exactly like real weights."""
+    ShapeDtypeStruct tree traces/lowers exactly like real weights.
+
+    ``bf16_params=True`` casts the abstract parameter tree to bf16
+    storage (via ``utils.precision.bf16_params`` under ``eval_shape`` —
+    the SAME cast ``tools/export_model.py --dtype bf16`` applies to real
+    weights, so the audited program and the exported artifact share one
+    fingerprint)."""
     import jax
 
     from ...infer.predict import Predictor
 
-    cfg, model, _ = _tiny_setup()
+    cfg, model, _ = _tiny_setup(name)
     h, w = cfg.skeleton.height, cfg.skeleton.width
 
     def init():
@@ -194,6 +207,10 @@ def _abstract_predictor():
                           jnp.zeros((1, h, w, 3), jnp.float32), train=False)
 
     variables = jax.eval_shape(init)
+    if bf16_params:
+        from ...utils.precision import bf16_params as cast
+
+        variables = jax.eval_shape(cast, variables)
     return cfg, Predictor(model, variables, cfg.skeleton)
 
 
@@ -243,6 +260,64 @@ def _build_serve_decode_batch() -> BuiltProgram:
     imgs = jax.ShapeDtypeStruct((_B, b, b, 3), jnp.float32)
     valid = jax.ShapeDtypeStruct((_B,), jnp.int32)
     return BuiltProgram(fn=fn, args=(p.variables, imgs, valid, valid))
+
+
+def _build_student_forward() -> BuiltProgram:
+    """The student fast tier's flip-TTA forward + on-device NMS, with
+    bf16 PARAM STORAGE — the quantized artifact's program
+    (``tools/export_model.py --config tiny_student --dtype bf16``)."""
+    import jax
+    import jax.numpy as jnp
+
+    _, p = _abstract_predictor("tiny_student", bf16_params=True)
+    b = p.bucket
+    fn = p.peaks_program((b, b))
+    img = jax.ShapeDtypeStruct((b, b, 3), jnp.float32)
+    valid = jax.ShapeDtypeStruct((), jnp.int32)
+    return BuiltProgram(fn=fn, args=(p.variables, img, valid, valid))
+
+
+def _build_student_serve_decode() -> BuiltProgram:
+    """The student tier's FUSED end-to-end decode serve program (bf16
+    param storage): what the cascade's fast lane actually dispatches,
+    and what the gated export serializes."""
+    import jax
+    import jax.numpy as jnp
+
+    _, p = _abstract_predictor("tiny_student", bf16_params=True)
+    b = p.bucket
+    fn = p.decode_program((b, b))
+    img = jax.ShapeDtypeStruct((b, b, 3), jnp.float32)
+    valid = jax.ShapeDtypeStruct((), jnp.int32)
+    return BuiltProgram(fn=fn, args=(p.variables, img, valid, valid))
+
+
+def _build_distill_train_step() -> BuiltProgram:
+    """The heatmap-distillation step (``train.distill``): student state
+    DONATED, the frozen teacher's variables a second NON-donated
+    argument — PRG003 verifies the alias realized on the student state
+    only, with the teacher buffers untouched across steps."""
+    import jax
+
+    from ...train.distill import make_distill_train_step
+
+    s_cfg, s_model, s_opt = _tiny_setup("tiny_student")
+    t_cfg, t_model, _ = _tiny_setup("tiny")
+    state = _abstract_state(s_cfg, s_model, s_opt)
+    h, w = s_cfg.skeleton.height, s_cfg.skeleton.width
+
+    def t_init():
+        import jax.numpy as jnp
+
+        return t_model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, h, w, 3), jnp.float32),
+                            train=False)
+
+    teacher_vars = jax.eval_shape(t_init)
+    images, mask, gt = _train_batch(s_cfg, _B)
+    fn = make_distill_train_step(s_model, t_model, s_cfg, s_opt)
+    return BuiltProgram(fn=fn,
+                        args=(state, teacher_vars, images, mask, gt))
 
 
 def _build_flip_tta_peaks() -> BuiltProgram:
@@ -384,6 +459,34 @@ def program_registry() -> List[ProgramSpec]:
             build=_build_serve_decode_batch,
             expect_bf16=True, allow_while=True,
             tags=("bucket=128x128", f"batch={_B}")),
+        ProgramSpec(
+            name="student_forward",
+            description="student fast-tier flip-TTA ensemble + "
+                        "on-device NMS (tiny_student, bf16 param "
+                        "storage — the quantized artifact's forward)",
+            build=_build_student_forward, expect_bf16=True,
+            tags=("tier=student", "params=bf16")),
+        ProgramSpec(
+            name="student_serve_decode_b1",
+            description="student FUSED end-to-end decode serve "
+                        "program, bucket 128, batch 1, bf16 param "
+                        "storage — the cascade fast lane's program and "
+                        "the gated export's subject; declared bounded "
+                        "while, as serve_decode_b1",
+            build=_build_student_serve_decode,
+            expect_bf16=True, allow_while=True,
+            tags=("tier=student", "params=bf16", "bucket=128x128",
+                  "batch=1")),
+        ProgramSpec(
+            name="distill_train_step",
+            description="heatmap-distillation train step "
+                        "(tiny_student from tiny): student state "
+                        "donated, teacher variables a non-donated "
+                        "second argument, teacher forward folded in "
+                        "under stop_gradient",
+            build=_build_distill_train_step,
+            donate_argnums=donate, expect_bf16=True,
+            tags=("tier=student",)),
         ProgramSpec(
             name="flip_tta_peaks",
             description="flip-TTA ensemble + on-device NMS peaks "

@@ -336,6 +336,18 @@ class TrainConfig:
     on_divergence: str = "warn"
     # grad-norm ceiling for the sentinel; 0 = finiteness checks only
     health_grad_norm_limit: float = 0.0
+    # --- heatmap distillation (train.distill; "Fast Human Pose
+    # Estimation", PAPERS.md) ---
+    # blend weight of the GT term:
+    #   loss = alpha * focal(student, gt)
+    #        + (1 - alpha) * focal(student, stop_grad(teacher))
+    # 1.0 degenerates exactly to the plain supervised loss
+    distill_alpha: float = 0.5
+    # linear ramp of alpha from 1.0 (pure GT) down to distill_alpha over
+    # the first N steps — the teacher term fades IN once the student's
+    # early layers stop thrashing; 0 = constant alpha from step 0.
+    # Computed on device from state.step, so the ramp costs no retraces
+    distill_alpha_warmup_steps: int = 0
 
 
 @dataclass(frozen=True)
@@ -549,6 +561,69 @@ def _synth_canonical_512() -> Config:
     )
 
 
+def _synth_deep_student() -> Config:
+    """Student twin of ``synth_deep`` (the production-SHAPE pair a CPU
+    host can actually run): 2 stacks at a quarter of the width, depth-4
+    hourglasses and the full 5-scale supervision kept.  The cascade
+    bench's default fast tier (tools/cascade_bench.py: its fused decode
+    dispatch measures ~2.8x cheaper than synth_deep's at 256px on the
+    2-core host), and the distillation smoke target
+    (``--distill-from <synth_deep ckpt> --teacher-config synth_deep``).
+    """
+    return Config(
+        name="synth_deep_student",
+        skeleton=SkeletonConfig(width=256, height=256),
+        model=ModelConfig(nstack=2, inp_dim=16, increase=8,
+                          hourglass_depth=4, se_reduction=8),
+        train=TrainConfig(batch_size_per_device=4,
+                          learning_rate_per_device=5e-4,
+                          nstack_weight=(1.0, 1.0),
+                          scale_weight=(0.1, 0.2, 0.4, 1.6, 6.4),
+                          epochs=30, warmup_epochs=2,
+                          bf16_compute=True,
+                          distill_alpha=0.5),
+    )
+
+
+def _canonical_student() -> Config:
+    """The distilled FAST TIER of the canonical flagship (ROADMAP open
+    item 2; "Fast Human Pose Estimation" / "FasterPose", PAPERS.md): a
+    2-stack, half-width IMHN trained with heatmap distillation from the
+    4-stack/256-ch teacher (``tools/train.py --distill-from``), served
+    as the cascade's student lane (``serve.cascade``) with escalation to
+    the teacher on hard frames.  Architecture follows the papers' recipe
+    — halve the stacks AND the width (~1/8 the FLOPs); the skeleton,
+    channel layout and bucket geometry are the teacher's exactly, so the
+    two tiers share serve buckets and the escalation decode is
+    layout-compatible."""
+    return Config(
+        name="canonical_student",
+        model=ModelConfig(nstack=2, inp_dim=128, increase=64),
+        train=TrainConfig(batch_size_per_device=8,
+                          nstack_weight=(1.0, 1.0),
+                          distill_alpha=0.5),
+    )
+
+
+def _tiny_student() -> Config:
+    """Student twin of ``tiny`` for CPU tests, the graftaudit registry
+    and the cascade bench: ONE stack at half the width (the narrow 1-2
+    stack variant of the distillation recipe, scaled to smoke size).
+    Same 18-part skeleton and 128px canvas as ``tiny``, so a
+    tiny_student/tiny cascade shares bucket shapes end to end."""
+    return Config(
+        name="tiny_student",
+        skeleton=SkeletonConfig(width=128, height=128),
+        model=ModelConfig(nstack=1, inp_dim=8, increase=4,
+                          hourglass_depth=2, se_reduction=4),
+        train=TrainConfig(batch_size_per_device=1,
+                          nstack_weight=(1.0,),
+                          scale_weight=(0.5, 1.0, 2.0),
+                          epochs=2, warmup_epochs=1,
+                          distill_alpha=0.5),
+    )
+
+
 def _ae() -> Config:
     """Associative-Embedding-style classic hourglass (reference:
     models/ae_pose.py, kept for ablation): ONE full-resolution output per
@@ -567,8 +642,11 @@ _REGISTRY = {
     "dense_384": _dense_384,
     "final_384": _final_384,
     "tiny": _tiny,
+    "tiny_student": _tiny_student,
+    "canonical_student": _canonical_student,
     "synth": _synth,
     "synth_deep": _synth_deep,
+    "synth_deep_student": _synth_deep_student,
     "synth_canonical": _synth_canonical,
     "synth_canonical_512": _synth_canonical_512,
     "ae": _ae,

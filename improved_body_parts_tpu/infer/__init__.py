@@ -2,10 +2,12 @@ from .decode import (
     CompactOverflow,
     CompactResult,
     DeviceDecoded,
+    EscalationSignals,
     assemble,
     decode,
     decode_compact,
     decode_device,
+    device_signals,
     find_connections,
     find_peaks,
     find_people,
@@ -25,8 +27,10 @@ from .pipeline import device_decode_fn, pipelined_inference
 from .predict import Predictor, center_pad, pad_right_down
 
 __all__ = [
-    "CompactOverflow", "CompactResult", "DeviceDecoded", "assemble",
-    "decode", "decode_compact", "decode_device", "find_connections",
+    "CompactOverflow", "CompactResult", "DeviceDecoded",
+    "EscalationSignals", "assemble",
+    "decode", "decode_compact", "decode_device", "device_signals",
+    "find_connections",
     "find_peaks", "find_people", "subsets_to_keypoints", "draw_skeletons",
     "limb_flow_bgr", "run_demo", "format_results",
     "load_coco_ground_truth", "process_image", "validation",

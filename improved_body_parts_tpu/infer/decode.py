@@ -398,6 +398,45 @@ class DeviceDecoded(NamedTuple):
                     or self.person_overflow)
 
 
+class EscalationSignals(NamedTuple):
+    """The free per-request difficulty readout of the fused decode
+    payload (``serve.cascade`` escalation input): person count, the
+    three capacity-overflow flags and the weakest kept person's mean
+    per-part assembly score — all already in the single fetch, so the
+    cascade's routing decision costs zero extra device work.
+    """
+    n_people: int
+    peak_overflow: bool
+    cand_overflow: bool
+    person_overflow: bool
+    #: min over kept people of (total score / part count) — the
+    #: assembly's own pruning statistic; +inf when nobody was kept
+    min_mean_score: float
+    #: True when the signals came from the authoritative device assembly
+    #: (False = an overflow routed this request to the host fallback;
+    #: the flags above still say WHY)
+    fused: bool
+
+
+def device_signals(dev: "DeviceDecoded") -> EscalationSignals:
+    """Extract :class:`EscalationSignals` from a fused device decode —
+    O(people) reads on the already-fetched buffer, no decode needed."""
+    n = dev.subset.shape[1] - 2
+    kept = dev.subset[dev.mask]
+    if len(kept):
+        counts = np.maximum(kept[:, n + 1, 0], 1.0)
+        min_mean = float(np.min(kept[:, n, 0] / counts))
+    else:
+        min_mean = float("inf")
+    return EscalationSignals(
+        n_people=int(dev.n_people),
+        peak_overflow=bool(dev.peak_overflow),
+        cand_overflow=bool(dev.cand_overflow),
+        person_overflow=bool(dev.person_overflow),
+        min_mean_score=min_mean,
+        fused=dev.ok)
+
+
 def device_subset_candidate(dev: "DeviceDecoded"
                             ) -> Tuple[np.ndarray, np.ndarray]:
     """(subset, candidate) from a fused device decode, in the host

@@ -9,6 +9,10 @@ user requests to the chip).
   histogram, p50/p95/p99 latency, imgs/sec, deadline/stall accounting.
 - ``warmup``   — startup precompile of every (bucket shape × pow2 batch
   size) program through the persistent compilation cache.
+- ``cascade``  — :class:`CascadeEngine`: two-tier serving — a distilled
+  student lane answers first, the fused decode payload's free
+  escalation signals (:class:`EscalationPolicy`) route hard frames to
+  the teacher bucket as a second submit on the same machinery.
 - ``pool``     — :class:`EnginePool`: N shared-nothing batcher replicas
   behind a health-checked router — least-loaded routing, circuit
   breaking, fencing and transparent failover of in-flight work.
@@ -23,12 +27,14 @@ Fault-injection harness: ``tools/chaos_serve.py`` → SERVE_CHAOS.json.
 """
 from .batcher import DeadlineExceeded, DynamicBatcher, ServerOverloaded
 from .breaker import CircuitBreaker
+from .cascade import CascadeEngine, CascadeMetrics, EscalationPolicy
 from .metrics import ServeMetrics
 from .policy import PolicyClient, PolicyStats, jittered_backoff, submit_with_retry
 from .pool import EnginePool
 from .warmup import pow2_batch_sizes, precompile
 
-__all__ = ["CircuitBreaker", "DeadlineExceeded", "DynamicBatcher",
-           "EnginePool", "PolicyClient", "PolicyStats", "ServeMetrics",
-           "ServerOverloaded", "jittered_backoff", "pow2_batch_sizes",
-           "precompile", "submit_with_retry"]
+__all__ = ["CascadeEngine", "CascadeMetrics", "CircuitBreaker",
+           "DeadlineExceeded", "DynamicBatcher", "EnginePool",
+           "EscalationPolicy", "PolicyClient", "PolicyStats",
+           "ServeMetrics", "ServerOverloaded", "jittered_backoff",
+           "pow2_batch_sizes", "precompile", "submit_with_retry"]

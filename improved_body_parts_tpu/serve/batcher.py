@@ -131,7 +131,8 @@ class DynamicBatcher:
                  use_native: bool = True, devices: Optional[Sequence] = None,
                  eager_idle_flush: bool = True,
                  metrics: Optional[ServeMetrics] = None,
-                 registry=None, device_decode: bool = True):
+                 registry=None, device_decode: bool = True,
+                 emit_signals: bool = False):
         from ..infer.predict import trivial_grid
 
         self.predictor = predictor
@@ -165,6 +166,18 @@ class DynamicBatcher:
         # fallback.  False: the pre-fusion host-pool lane (every decode
         # runs decode_compact on the pool) — the parity/A-B arm.
         self.device_decode = device_decode
+        # True: every future resolves to (skeletons, EscalationSignals)
+        # instead of bare skeletons — the cascade layer's input
+        # (serve.cascade).  The signals are free: person count, overflow
+        # flags and the min assembly score already ride the fused decode
+        # payload's single fetch.  Requires the device-decode lane (the
+        # host-pool lane never sees the device assembly).
+        self.emit_signals = emit_signals
+        if emit_signals and not device_decode:
+            raise ValueError(
+                "emit_signals needs the fused device-decode lane "
+                "(device_decode=True): the escalation signals live in "
+                "the device assembly's payload")
         # compact_decode_fn serves BOTH lanes: the host-pool lane's
         # per-request decoder, and the device lane's overflow fallback
         # (fed the compact records the fused buffer ships alongside)
@@ -420,19 +433,37 @@ class DynamicBatcher:
         """Precompile the batch programs the configured traffic needs:
         every bucket the given (H, W) image sizes land in × every
         power-of-two batch size ≤ ``max_batch`` (or an explicit
-        ``batch_sizes``), on EVERY device replica.  Call before
-        accepting traffic; see :func:`serve.warmup.precompile` for the
-        returned summary."""
-        out = None
+        ``batch_sizes``), on EVERY device replica — plus one untimed
+        dispatch of every NON-pow2 occupancy, whose pow2 chunks join
+        through an on-device row-concat program the (bucket × pow2)
+        precompile cannot reach (the PR 10 stream-bench finding, now
+        covered here for every caller).  Call before accepting traffic;
+        see :func:`serve.warmup.precompile` for the returned summary."""
+        # ONE warmup path (serve.warmup.precompile over a predictor
+        # set) shared with the pool's per-replica warmup and the
+        # cascade tiers; replicas share the program cache, so only the
+        # first pass reports new programs while later passes still
+        # build/warm each device's executable
+        info = precompile(self._replicas, image_sizes, self.max_batch,
+                          params=self.params, batch_sizes=batch_sizes,
+                          decode=self.device_decode)
+        # an explicit batch_sizes is the caller's occupancy cap (the
+        # pool warms singleton flushes with (1,)): the chunk-join loop
+        # must not dispatch — and compile — the pow2 chunk programs
+        # that restriction just excluded
+        occupancy_cap = (max(batch_sizes) if batch_sizes
+                         else self.max_batch)
         for replica in self._replicas:
-            info = precompile(replica, image_sizes, self.max_batch,
-                              params=self.params, batch_sizes=batch_sizes,
-                              decode=self.device_decode)
-            # replicas share the program cache, so only the first pass
-            # reports new programs; the later passes still build/warm
-            # each device's executable
-            out = out or info
-        return out
+            dispatch = (replica.predict_decoded_batch_async
+                        if self.device_decode
+                        else replica.predict_compact_batch_async)
+            for h, w in image_sizes:
+                img = np.zeros((int(h), int(w), 3), np.uint8)
+                for n in range(3, occupancy_cap + 1):
+                    if n & (n - 1):  # non-pow2: chunk-join flush shape
+                        dispatch([img] * n, thre1=self.params.thre1,
+                                 params=self.params)()
+        return info
 
     # ------------------------------------------------------------- health
     def health(self) -> dict:
@@ -630,14 +661,22 @@ class DynamicBatcher:
                     trace.flow_finish("serve_req", r.rid, ts=t_exec)
             self._batch_done(idx, gen)
             for r, res in zip(reqs, results):
+                signals = None
                 if self.device_decode:
+                    if self.emit_signals:
+                        from ..infer.decode import device_signals
+
+                        # captured BEFORE the overflow demotion below:
+                        # the flags are exactly what tells the cascade
+                        # WHY a fallback-decoded frame is hard
+                        signals = device_signals(res)
                     if res.ok:
                         # fused result: the remaining work is an
                         # O(people) coordinate lookup — finish INLINE on
                         # this device-program track (no pool hop; the
                         # `decode` span lands next to `execute`)
                         self.metrics.on_decode(fused=True)
-                        self._finish_fused(r, res)
+                        self._finish_fused(r, res, signals)
                         continue
                     # overflow flag: demote to the host decode pool on
                     # the compact records the fused buffer shipped
@@ -646,9 +685,10 @@ class DynamicBatcher:
                 else:
                     self.metrics.on_decode(fused=False)
                 try:
-                    self._pool.submit(self._decode_and_finish, r, res)
+                    self._pool.submit(self._decode_and_finish, r, res,
+                                      signals)
                 except RuntimeError:  # pool draining (stop()) — inline
-                    self._decode_and_finish(r, res)
+                    self._decode_and_finish(r, res, signals)
 
     def _batch_done(self, idx: int, gen: int) -> None:
         """One batch's device results landed: drop the replica's
@@ -664,7 +704,7 @@ class DynamicBatcher:
         if idle and self._running:
             self._queue.put(_KICK)
 
-    def _finish_fused(self, req: _Request, res) -> None:
+    def _finish_fused(self, req: _Request, res, signals=None) -> None:
         """Finish one fused device-decode result on the calling (fetch)
         thread: coordinate lookup + COCO reorder only."""
         from ..infer.decode import decode_device
@@ -673,15 +713,20 @@ class DynamicBatcher:
             with get_tracer().span("decode", args={"rid": req.rid,
                                                    "lane": "device"}):
                 result = decode_device(res, self.skeleton)
+            if self.emit_signals:
+                result = (result, signals)
             self._finish(req, result=result)
         except Exception as e:  # noqa: BLE001 — delivered per request
             self._finish(req, error=e)
 
-    def _decode_and_finish(self, req: _Request, res) -> None:
+    def _decode_and_finish(self, req: _Request, res,
+                           signals=None) -> None:
         try:
             with get_tracer().span("decode", args={"rid": req.rid,
                                                    "lane": "host"}):
                 result = self._decode_one(res, req.image)
+            if self.emit_signals:
+                result = (result, signals)
             self._finish(req, result=result)
         except Exception as e:  # noqa: BLE001 — delivered per request
             self._finish(req, error=e)

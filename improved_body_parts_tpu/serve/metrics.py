@@ -33,9 +33,17 @@ class ServeMetrics:
       overflow flag routed it to the host decode pool) — the observable
       fallback rate of the device-decode lane.  The host-pool lane
       (``device_decode=False``) counts everything as fallback.
+
+    ``model`` adds a ``{model="..."}`` label dimension to every exported
+    sample: a multi-model deployment (the cascade's student and teacher
+    tiers, ``serve.cascade``) registers one ServeMetrics per tier into
+    the SAME registry and the traffic split stays separable in
+    ``/metrics`` without a second registry or prefix forks.
     """
 
-    def __init__(self, latency_reservoir: int = 4096):
+    def __init__(self, latency_reservoir: int = 4096,
+                 model: Optional[str] = None):
+        self.model = model
         self._lock = threading.Lock()
         self.latency = PercentileMeter(latency_reservoir)
         self.submitted = 0
@@ -173,23 +181,32 @@ class ServeMetrics:
             occupancy = dict(self.occupancy)
             lat = self.latency.summary()   # seconds
             lat_sum = self.latency.sum
-        samples = [(f"{prefix}_{name}_total", {}, "counter", float(v))
+        # the per-tier label dimension: one dict merged into EVERY
+        # sample's labels, so a shared registry separates student vs
+        # teacher traffic without a second registry or prefix fork
+        base = {"model": self.model} if self.model else {}
+        samples = [(f"{prefix}_{name}_total", dict(base), "counter",
+                    float(v))
                    for name, v in counts]
         samples += [
-            (f"{prefix}_queue_depth", {}, "gauge", float(depth)),
-            (f"{prefix}_queue_depth_peak", {}, "gauge", float(peak)),
+            (f"{prefix}_queue_depth", dict(base), "gauge", float(depth)),
+            (f"{prefix}_queue_depth_peak", dict(base), "gauge",
+             float(peak)),
         ]
         for size, n in sorted(occupancy.items()):
             samples.append((f"{prefix}_batches_total",
-                            {"size": str(size)}, "counter", float(n)))
+                            {**base, "size": str(size)}, "counter",
+                            float(n)))
         for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
             samples.append((f"{prefix}_latency_seconds",
-                            {"quantile": q}, "gauge", lat[key]))
+                            {**base, "quantile": q}, "gauge", lat[key]))
         samples += [
-            (f"{prefix}_latency_seconds_sum", {}, "counter", lat_sum),
-            (f"{prefix}_latency_seconds_count", {}, "counter",
+            (f"{prefix}_latency_seconds_sum", dict(base), "counter",
+             lat_sum),
+            (f"{prefix}_latency_seconds_count", dict(base), "counter",
              float(lat["count"])),
-            (f"{prefix}_imgs_per_sec", {}, "gauge", self.throughput()),
+            (f"{prefix}_imgs_per_sec", dict(base), "gauge",
+             self.throughput()),
         ]
         return samples
 
@@ -215,6 +232,7 @@ class ServeMetrics:
         with self._lock:
             occupancy = dict(sorted(self.occupancy.items()))
             out = {
+                **({"model": self.model} if self.model else {}),
                 "submitted": self.submitted,
                 "rejected": self.rejected,
                 "completed": self.completed,

@@ -29,25 +29,42 @@ def pow2_batch_sizes(max_batch: int) -> Tuple[int, ...]:
                  if (1 << i) <= max_batch)
 
 
-def precompile(predictor, image_sizes: Sequence[Tuple[int, int]],
+def precompile(predictors, image_sizes: Sequence[Tuple[int, int]],
                max_batch: int = 8, params=None,
                batch_sizes: Optional[Sequence[int]] = None,
                decode: bool = False) -> dict:
-    """Warm one predictor for serving: compile (or cache-load) the
-    compact-batch program for every bucket the given (H, W) image sizes
-    land in, at every batch size ``max_batch``-occupancy dispatch can
-    emit.  Blocks until all executables exist.  ``decode=True`` warms
-    the FUSED device-decode programs instead — what the batcher's
-    default device-decode lane dispatches.
+    """Warm a predictor — or a whole predictor SET — for serving:
+    compile (or cache-load) the compact-batch program for every bucket
+    the given (H, W) image sizes land in, at every batch size
+    ``max_batch``-occupancy dispatch can emit.  Blocks until all
+    executables exist.  ``decode=True`` warms the FUSED device-decode
+    programs instead — what the batcher's default device-decode lane
+    dispatches.
+
+    ``predictors`` may be one predictor or a sequence: the batcher's
+    device replicas and the cascade's student/teacher tiers
+    (``serve.cascade``) all warm through THIS one path, so a new
+    program family added here warms every deployment shape at once
+    instead of growing per-caller warmup loops.  Bucket shapes are
+    enumerated PER predictor (tiers may bucket differently) and the
+    summary reports their union.
 
     Returns ``{"bucket_shapes", "batch_sizes", "newly_compiled"}`` —
-    ``newly_compiled == 0`` means the predictor was already fully warm
-    (the signal the no-compile-stall test asserts on).
+    ``newly_compiled == 0`` means every predictor was already fully
+    warm (the signal the no-compile-stall test asserts on; replicas
+    sharing one program cache report their programs once).
     """
-    shapes = predictor.enumerate_bucket_shapes(image_sizes, params)
+    preds = (list(predictors) if isinstance(predictors, (list, tuple))
+             else [predictors])
     sizes = (tuple(batch_sizes) if batch_sizes is not None
              else pow2_batch_sizes(max_batch))
-    compiled = predictor.precompile_compact(shapes, sizes, params=params,
-                                            decode=decode)
-    return {"bucket_shapes": shapes, "batch_sizes": sizes,
+    all_shapes = set()
+    compiled = 0
+    for predictor in preds:
+        shapes = predictor.enumerate_bucket_shapes(image_sizes, params)
+        all_shapes.update(shapes)
+        compiled += predictor.precompile_compact(shapes, sizes,
+                                                 params=params,
+                                                 decode=decode)
+    return {"bucket_shapes": sorted(all_shapes), "batch_sizes": sizes,
             "newly_compiled": compiled}

@@ -7,6 +7,7 @@ from .checkpoint import (
     restore_latest,
     save_checkpoint,
 )
+from .distill import bind_teacher, make_distill_train_step
 from .loop import eval_epoch, fit, train_epoch
 from .schedule import (
     cyclic_swa_schedule,
@@ -36,6 +37,7 @@ __all__ = [
     "CheckpointManager", "is_committed", "latest_checkpoint",
     "read_commit_meta", "restore_checkpoint", "restore_latest",
     "save_checkpoint",
+    "bind_teacher", "make_distill_train_step",
     "eval_epoch", "fit", "train_epoch",
     "cyclic_swa_schedule", "large_batch_schedule", "step_decay_schedule",
     "TrainState", "create_train_state", "make_optimizer", "start_swa",

@@ -1,0 +1,135 @@
+"""Heatmap-distillation train step: the student IMHN learns from GT and
+a frozen teacher in ONE jitted program.
+
+The fast-tier recipe ("Fast Human Pose Estimation", arXiv:1811.05419;
+"FasterPose", arXiv:2107.03215 — PAPERS.md): a narrow 1-2 stack student
+(``tiny_student`` / ``canonical_student`` configs) trains against a
+blend of the ground truth and the teacher's predicted heatmaps,
+
+    loss = alpha * focal_L2(student, gt)
+         + (1 - alpha) * focal_L2(student, stop_grad(teacher)),
+
+where both terms are the EXISTING masked multi-task focal-L2
+(``ops.multi_task_loss``) — the teacher's last-stack scale-0 maps simply
+take the GT tensor's slot in the second term, so per-scale downsampling,
+mask modulation and task weighting all apply identically to both
+targets.
+
+The teacher forward is folded INTO the jitted step (one XLA program per
+step, no second dispatch), runs in inference mode on its own frozen
+``{"params", "batch_stats"}`` variables, and is wrapped in
+``stop_gradient``; the teacher variables are a NON-donated argument —
+the registry's ``distill_train_step`` program is audited (PRG003) to
+realize the donation alias on the student state ONLY, with the teacher
+buffers untouched and re-usable across every step.
+
+Wired through ``tools/train.py --distill-from <teacher-ckpt>
+--teacher-config <name>``; the supervisor / checkpoint / telemetry stack
+is unchanged — the step factory returns the same (state, *batch) ->
+(state, loss[, grad_norm]) contract once the caller binds the teacher
+variables (``bind_teacher``).
+"""
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..ops import multi_task_loss
+from .state import TrainState
+from .step import (
+    TRAIN_STEP_DONATE_ARGNUMS,
+    apply_guarded_update,
+    normalize_images,
+)
+
+
+def distill_alpha(config: Config, step) -> jnp.ndarray:
+    """The blend weight at ``step`` (traced): ``distill_alpha`` after
+    the ramp, linearly annealed FROM 1.0 (pure GT) over
+    ``distill_alpha_warmup_steps`` — the teacher term fades in once the
+    student's early layers stop thrashing.  Derived from the on-device
+    step counter, so the schedule costs zero retraces."""
+    tr = config.train
+    alpha = jnp.asarray(tr.distill_alpha, jnp.float32)
+    if tr.distill_alpha_warmup_steps > 0:
+        frac = jnp.clip(step.astype(jnp.float32)
+                        / tr.distill_alpha_warmup_steps, 0.0, 1.0)
+        alpha = 1.0 + (alpha - 1.0) * frac
+    return alpha
+
+
+def make_distill_train_step(student_model, teacher_model, config: Config,
+                            optimizer, use_focal: bool = True,
+                            donate: bool = True,
+                            health: bool = False) -> Callable:
+    """Build the jitted distillation step::
+
+        (state, teacher_variables, images, mask_miss, gt)
+            -> (state, loss)               # health=False
+            -> (state, loss, grad_norm)    # health=True
+
+    ``state`` (the student's TrainState) is the ONLY donated argument —
+    ``teacher_variables`` (``{"params", "batch_stats"}``) must stay
+    readable across steps, exactly like the eval step's state.  The
+    abnormal-loss rescue, the ``skip_step`` divergence gate and the
+    health grad-norm output are the supervised step's own
+    (``step.apply_guarded_update`` — one implementation).
+
+    ``config`` is the STUDENT's config: it owns the loss weights, the
+    alpha schedule and the divergence policy.  The teacher model only
+    contributes its forward; its architecture may differ freely as long
+    as the skeleton (channel layout + stride) matches — the distill
+    target is the teacher's last-stack scale-0 map, which both tiers
+    emit at the same grid.
+    """
+
+    def distill_step(state: TrainState, teacher_variables, images,
+                     mask_miss, gt) -> Tuple:
+        images = normalize_images(images)
+        # frozen teacher forward, folded into the same XLA program:
+        # inference mode (running BN averages), gradients cut — the
+        # teacher is a constant target for this step
+        teacher_preds = teacher_model.apply(teacher_variables, images,
+                                            train=False)
+        teacher_maps = jax.lax.stop_gradient(teacher_preds[-1][0])
+        alpha = distill_alpha(config, state.step)
+
+        def loss_fn(params):
+            preds, mutated = student_model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                images, train=True, mutable=["batch_stats"])
+            loss_gt = multi_task_loss(
+                preds, gt, mask_miss, config, use_focal=use_focal,
+                use_pallas=config.train.use_pallas_loss)
+            loss_kd = multi_task_loss(
+                preds, teacher_maps, mask_miss, config,
+                use_focal=use_focal,
+                use_pallas=config.train.use_pallas_loss)
+            return (alpha * loss_gt + (1.0 - alpha) * loss_kd,
+                    mutated["batch_stats"])
+
+        (loss, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+
+        return apply_guarded_update(state, loss, grads, new_bs, config,
+                                    optimizer, health)
+
+    donate_argnums = TRAIN_STEP_DONATE_ARGNUMS if donate else ()
+    return jax.jit(distill_step, donate_argnums=donate_argnums)
+
+
+def bind_teacher(distill_step: Callable, teacher_variables) -> Callable:
+    """Adapt the distillation step to the train loop's
+    ``step(state, *batch)`` contract by binding the teacher variables as
+    the fixed second argument.  The variables stay a real program
+    argument (NOT a baked-in constant — closing over them inside the
+    jitted function would embed the whole teacher as literals and bloat
+    every executable), so one compiled program serves the entire run."""
+
+    def step(state, *batch):
+        return distill_step(state, teacher_variables, *batch)
+
+    return step

@@ -51,6 +51,48 @@ def normalize_images(images: jnp.ndarray) -> jnp.ndarray:
     return images
 
 
+def apply_guarded_update(state: TrainState, loss, grads, new_bs,
+                         config: Config, optimizer, health: bool):
+    """The shared tail of every train step (traced inside the jitted
+    program): SGD update + the branchless abnormal-loss/divergence
+    select + the optional health grad-norm output.
+
+    One implementation for the supervised step (``make_train_step``) and
+    the distillation step (``train.distill.make_distill_train_step``) so
+    the skip_step policy and the rescue select can never drift between
+    them.  Returns ``(state, loss)`` — or ``(state, loss, grad_norm)``
+    when ``health`` — exactly the step's own return contract.
+    """
+    updates, new_opt = optimizer.update(grads, state.opt_state,
+                                        state.params)
+    new_params = optax.apply_updates(state.params, updates)
+
+    ok = jnp.isfinite(loss) & (loss <= config.train.abnormal_loss_thre)
+    # the skip_step gate keys off the CONFIG alone: the policy is a
+    # training-semantics promise and must hold for every caller of
+    # the step factories, not just the ones that asked for the health
+    # return value — `health` controls only the extra output
+    if health or config.train.on_divergence == "skip_step":
+        gnorm = optax.global_norm(grads)
+        if config.train.on_divergence == "skip_step":
+            gok = jnp.isfinite(gnorm)
+            if config.train.health_grad_norm_limit > 0:
+                gok &= gnorm <= config.train.health_grad_norm_limit
+            ok &= gok
+
+    def keep(new, old):
+        return jax.tree.map(lambda a, b: jnp.where(ok, a, b), new, old)
+
+    state = state.replace(
+        params=keep(new_params, state.params),
+        batch_stats=keep(new_bs, state.batch_stats),
+        opt_state=keep(new_opt, state.opt_state),
+        step=state.step + 1)
+    if health:
+        return state, loss, gnorm
+    return state, loss
+
+
 def make_train_step(model, config: Config,
                     optimizer: optax.GradientTransformation,
                     use_focal: bool = True,
@@ -156,34 +198,8 @@ def make_train_step(model, config: Config,
         (loss, new_bs), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
 
-        updates, new_opt = optimizer.update(grads, state.opt_state,
-                                            state.params)
-        new_params = optax.apply_updates(state.params, updates)
-
-        ok = jnp.isfinite(loss) & (loss <= config.train.abnormal_loss_thre)
-        # the skip_step gate keys off the CONFIG alone: the policy is a
-        # training-semantics promise and must hold for every caller of
-        # make_train_step, not just the ones that asked for the health
-        # return value — `health` controls only the extra output
-        if health or config.train.on_divergence == "skip_step":
-            gnorm = optax.global_norm(grads)
-            if config.train.on_divergence == "skip_step":
-                gok = jnp.isfinite(gnorm)
-                if config.train.health_grad_norm_limit > 0:
-                    gok &= gnorm <= config.train.health_grad_norm_limit
-                ok &= gok
-
-        def keep(new, old):
-            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), new, old)
-
-        state = state.replace(
-            params=keep(new_params, state.params),
-            batch_stats=keep(new_bs, state.batch_stats),
-            opt_state=keep(new_opt, state.opt_state),
-            step=state.step + 1)
-        if health:
-            return state, loss, gnorm
-        return state, loss
+        return apply_guarded_update(state, loss, grads, new_bs, config,
+                                    optimizer, health)
 
     donate_argnums = TRAIN_STEP_DONATE_ARGNUMS if donate else ()
     if mesh is None:

@@ -1,0 +1,344 @@
+"""Cascade serving (serve.cascade): escalation-predicate boundaries over
+all three signal classes, the free-signals plumbing through the batcher,
+end-to-end student/teacher routing (GatedPredictor-driven), degradation
+semantics, both-tier warmup, and the routing metrics."""
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import (
+    InferenceModelParams,
+    get_config,
+)
+from improved_body_parts_tpu.infer import Predictor
+from improved_body_parts_tpu.infer.decode import (
+    DeviceDecoded,
+    EscalationSignals,
+    device_signals,
+)
+from improved_body_parts_tpu.serve import (
+    CascadeEngine,
+    DynamicBatcher,
+    EscalationPolicy,
+    ServeMetrics,
+    ServerOverloaded,
+)
+from improved_body_parts_tpu.serve.batcher import DeadlineExceeded
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+from cascade_bench import TieredPlantedModel, plant_people  # noqa: E402
+
+CFG = get_config("tiny")
+SK = CFG.skeleton
+SIZE = 128
+
+
+def _sig(n_people=1, peak=False, cand=False, person=False,
+         min_mean_score=2.0, fused=True):
+    return EscalationSignals(n_people=n_people, peak_overflow=peak,
+                             cand_overflow=cand, person_overflow=person,
+                             min_mean_score=min_mean_score, fused=fused)
+
+
+class TestEscalationPolicy:
+    def test_person_count_boundary(self):
+        p = EscalationPolicy(max_people=4)
+        assert p.reason(_sig(n_people=4)) is None      # == stays
+        assert p.reason(_sig(n_people=5)) == "people"  # > escalates
+        assert p.reason(_sig(n_people=0)) is None
+
+    def test_each_overflow_flag_escalates(self):
+        p = EscalationPolicy(max_people=100)
+        assert p.reason(_sig(peak=True)) == "overflow"
+        assert p.reason(_sig(cand=True)) == "overflow"
+        assert p.reason(_sig(person=True)) == "overflow"
+        # disabled: the flags fall through to the other signals
+        off = EscalationPolicy(max_people=100,
+                               escalate_on_overflow=False)
+        assert off.reason(_sig(peak=True, cand=True, person=True)) is None
+
+    def test_score_floor_boundary(self):
+        p = EscalationPolicy(max_people=100, score_floor=1.5)
+        assert p.reason(_sig(min_mean_score=1.5)) is None  # == stays
+        assert p.reason(_sig(min_mean_score=1.4999)) == "score"
+        # floor 0 disables the signal entirely
+        assert EscalationPolicy(max_people=100).reason(
+            _sig(min_mean_score=0.0)) is None
+        # nobody kept -> +inf score never trips the floor
+        assert p.reason(_sig(n_people=0,
+                             min_mean_score=float("inf"))) is None
+
+    def test_overflow_outranks_people_and_score(self):
+        p = EscalationPolicy(max_people=1, score_floor=1.5)
+        sig = _sig(n_people=9, peak=True, min_mean_score=0.1)
+        assert p.reason(sig) == "overflow"
+
+
+def test_device_signals_reads_masked_people_only():
+    """min_mean_score comes from KEPT (masked-in) rows only, and
+    n_people/flags pass straight through."""
+    n = SK.num_parts
+    subset = np.zeros((4, n + 2, 2), np.float32)
+    subset[0, n, 0], subset[0, n + 1, 0] = 6.0, 3.0   # mean 2.0
+    subset[1, n, 0], subset[1, n + 1, 0] = 1.0, 2.0   # mean 0.5
+    subset[2, n, 0], subset[2, n + 1, 0] = 0.1, 1.0   # pruned out
+    mask = np.array([True, True, False, False])
+    dev = DeviceDecoded(subset=subset, mask=mask, n_people=2,
+                        peak_overflow=False, cand_overflow=True,
+                        person_overflow=False, compact=None)
+    sig = device_signals(dev)
+    assert sig.n_people == 2
+    assert sig.cand_overflow and not sig.peak_overflow
+    assert sig.min_mean_score == pytest.approx(0.5)
+    assert sig.fused is False  # cand_overflow -> not authoritative
+    # nobody kept: the score signal reads +inf, not a crash
+    empty = dev._replace(mask=np.zeros(4, bool), cand_overflow=False,
+                         n_people=0)
+    s2 = device_signals(empty)
+    assert s2.min_mean_score == float("inf") and s2.fused is True
+
+
+# ------------------------------------------------------------------ #
+# real two-tier fixtures: flip-aware planted maps, brightness-selected
+# (easy = 1 person, hard = 2) so the student's device payload separates
+# the stream exactly
+
+@pytest.fixture(scope="module")
+def planted():
+    rng = np.random.default_rng(3)
+    easy_maps, easy_gt = plant_people(SK, 1, rng, SIZE)
+    hard_maps, hard_gt = plant_people(SK, 2, rng, SIZE)
+    return easy_maps, hard_maps
+
+
+def _tier_pred(maps_pair):
+    """A predictor whose decode payload reports 1 person on dark frames
+    and len(hard) people on bright ones (honest tiny forward)."""
+    from improved_body_parts_tpu.models import build_model
+
+    import jax
+    import jax.numpy as jnp
+
+    easy_maps, hard_maps = maps_pair
+    model = build_model(CFG)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, SIZE, SIZE, 3)), train=False)
+    planted = TieredPlantedModel(model, easy_maps, hard_maps, SK)
+    return Predictor(planted, variables, SK,
+                     model_params=InferenceModelParams(
+                         boxsize=SIZE, max_downsample=64), bucket=64)
+
+
+@pytest.fixture(scope="module")
+def student_pred(planted):
+    easy_maps, hard_maps = planted
+    return _tier_pred((easy_maps, hard_maps))
+
+
+@pytest.fixture(scope="module")
+def teacher_pred(planted):
+    # the teacher "solves" hard frames: it always sees the easy map, so
+    # a teacher-answered frame is distinguishable by its person count
+    easy_maps, _ = planted
+    return _tier_pred((easy_maps, easy_maps))
+
+
+DARK = np.zeros((SIZE, SIZE, 3), np.uint8)
+BRIGHT = np.full((SIZE, SIZE, 3), 255, np.uint8)
+
+
+def test_emit_signals_plumbing(student_pred):
+    """emit_signals=True resolves futures to (skeletons, signals) with
+    the payload's free difficulty readout; the knob requires the
+    device-decode lane."""
+    with pytest.raises(ValueError):
+        DynamicBatcher(student_pred, device_decode=False,
+                       emit_signals=True)
+    with DynamicBatcher(student_pred, max_batch=2,
+                        emit_signals=True) as server:
+        server.warmup([(SIZE, SIZE)], batch_sizes=(1,))
+        skel_e, sig_e = server.submit(DARK).result(timeout=120)
+        skel_h, sig_h = server.submit(BRIGHT).result(timeout=120)
+    assert sig_e.fused and sig_h.fused
+    assert sig_e.n_people == 1 and len(skel_e) == 1
+    assert sig_h.n_people == 2 and len(skel_h) == 2
+
+
+def test_easy_from_student_hard_from_teacher(student_pred, teacher_pred):
+    """The tentpole routing claim: an easy frame's skeletons come from
+    the STUDENT (1 planted person), a hard frame's from the TEACHER —
+    whose always-easy maps make its answer (1 person) distinguishable
+    from the student's own hard answer (2 people)."""
+    cascade = CascadeEngine.build(student_pred, teacher_pred,
+                                  policy=EscalationPolicy(max_people=1),
+                                  max_batch=2)
+    with cascade:
+        cascade.warmup([(SIZE, SIZE)], batch_sizes=(1,))
+        easy = cascade.submit(DARK).result(timeout=120)
+        hard = cascade.submit(BRIGHT).result(timeout=120)
+    assert len(easy) == 1
+    # answered by the teacher: 1 person (the student itself would have
+    # returned the hard map's 2)
+    assert len(hard) == 1
+    snap = cascade.metrics.snapshot()
+    assert snap["answered_student"] == 1
+    assert snap["escalated_teacher"] == 1
+    assert snap["escalations"] == {"overflow": 0, "people": 1,
+                                   "score": 0}
+    assert snap["failed"] == 0 and snap["depth"] == 0
+    # conservation across the routing split
+    assert snap["submitted"] == (snap["answered_student"]
+                                 + snap["escalated_teacher"]
+                                 + snap["degraded_student_answer"]
+                                 + snap["failed"] + snap["depth"])
+
+
+def test_hard_frame_waits_on_the_gated_teacher(student_pred,
+                                               teacher_pred):
+    """GatedPredictor-driven proof the hard result really comes from the
+    teacher's device path: with the teacher's dispatch gated shut, the
+    escalated frame stays pending AFTER the student answered; opening
+    the gate resolves it with the teacher's answer."""
+    from test_serve import GatedPredictor
+
+    gate = threading.Event()
+    gate.set()  # open for warmup
+    gated = GatedPredictor(teacher_pred, gate)
+    cascade = CascadeEngine.build(student_pred, gated,
+                                  policy=EscalationPolicy(max_people=1),
+                                  max_batch=2)
+    with cascade:
+        cascade.warmup([(SIZE, SIZE)], batch_sizes=(1,))
+        # easy traffic never touches the teacher: serve one with the
+        # gate SHUT to prove it
+        gate.clear()
+        assert len(cascade.submit(DARK).result(timeout=120)) == 1
+        fut = cascade.submit(BRIGHT)
+        # the student's leg completes and escalates; the teacher's
+        # dispatcher is parked at the gate, so the future must wait
+        deadline = time.perf_counter() + 30
+        while (cascade.metrics.snapshot()["escalations"]["people"] < 1
+               and time.perf_counter() < deadline):
+            time.sleep(0.01)
+        assert cascade.metrics.snapshot()["escalations"]["people"] >= 1
+        time.sleep(0.1)
+        assert not fut.done()
+        gate.set()
+        assert len(fut.result(timeout=120)) == 1  # the teacher's answer
+
+
+class _FakeTeacher:
+    """Duck-typed teacher engine with scripted submit behavior."""
+
+    def __init__(self, behavior):
+        self.behavior = behavior
+        self.emit_signals = False
+
+    def start(self):
+        return self
+
+    def stop(self, drain_timeout_s=None):
+        pass
+
+    def warmup(self, *a, **kw):
+        return {"newly_compiled": 0}
+
+    def submit(self, image, deadline_s=None):
+        return self.behavior(image, deadline_s)
+
+
+def _student_server(student_pred):
+    server = DynamicBatcher(student_pred, max_batch=2,
+                            metrics=ServeMetrics(model="student"),
+                            emit_signals=True)
+    return server
+
+
+def test_teacher_shed_degrades_to_student_answer(student_pred):
+    """An escalation the teacher sheds delivers the STUDENT's answer —
+    a deliberate quality degrade, never a failed request."""
+    def shed(image, deadline_s=None):
+        raise ServerOverloaded("teacher full")
+
+    cascade = CascadeEngine(_student_server(student_pred),
+                            _FakeTeacher(shed),
+                            policy=EscalationPolicy(max_people=1))
+    with cascade:
+        cascade.student.warmup([(SIZE, SIZE)], batch_sizes=(1,))
+        hard = cascade.submit(BRIGHT).result(timeout=120)
+    assert len(hard) == 2  # the student's own (hard-map) people
+    snap = cascade.metrics.snapshot()
+    assert snap["degraded_student_answer"] == 1
+    assert snap["escalated_teacher"] == 0 and snap["failed"] == 0
+
+
+def test_teacher_failure_mid_flight_degrades_deadline_propagates(
+        student_pred):
+    from concurrent.futures import Future
+
+    failures = {"n": 0}
+
+    def fail_async(image, deadline_s=None):
+        f = Future()
+        failures["n"] += 1
+        if failures["n"] == 1:
+            f.set_exception(RuntimeError("teacher died mid-batch"))
+        else:
+            f.set_exception(DeadlineExceeded("too late"))
+        return f
+
+    cascade = CascadeEngine(_student_server(student_pred),
+                            _FakeTeacher(fail_async),
+                            policy=EscalationPolicy(max_people=1))
+    with cascade:
+        cascade.student.warmup([(SIZE, SIZE)], batch_sizes=(1,))
+        # teacher error -> degrade to the student's answer
+        assert len(cascade.submit(BRIGHT).result(timeout=120)) == 2
+        # DeadlineExceeded -> propagates (the caller already gave up)
+        with pytest.raises(DeadlineExceeded):
+            cascade.submit(BRIGHT).result(timeout=120)
+    snap = cascade.metrics.snapshot()
+    assert snap["degraded_student_answer"] == 1
+    assert snap["failed"] == 1
+
+
+def test_warmup_covers_both_tiers_and_drain_rejects(student_pred,
+                                                    teacher_pred):
+    cascade = CascadeEngine.build(student_pred, teacher_pred,
+                                  policy=EscalationPolicy(max_people=1),
+                                  max_batch=2)
+    with cascade:
+        warm = cascade.warmup([(SIZE, SIZE)], batch_sizes=(1,))
+        assert set(warm) == {"student", "teacher"}
+        # module fixtures already compiled these shapes: a second pass
+        # must find every program warm on BOTH tiers (the
+        # zero-post-warmup-recompile property the bench gates on)
+        again = cascade.warmup([(SIZE, SIZE)], batch_sizes=(1,))
+        assert again["student"]["newly_compiled"] == 0
+        assert again["teacher"]["newly_compiled"] == 0
+    cascade._draining = True
+    with pytest.raises(ServerOverloaded):
+        cascade.submit(DARK)
+
+
+def test_cascade_metrics_exposition_names():
+    """The collector's samples ride the shared registry with lint-clean
+    names and the per-reason label."""
+    from improved_body_parts_tpu.obs import Registry
+    from improved_body_parts_tpu.serve import CascadeMetrics
+
+    reg = Registry()
+    m = CascadeMetrics().register_into(reg)
+    m.on_submit()
+    m.on_escalate("people")
+    m.on_answer("teacher")
+    text = reg.prometheus()
+    assert "cascade_submitted_total 1.0" in text
+    assert 'cascade_escalations_total{reason="people"} 1.0' in text
+    assert "cascade_escalated_teacher_total 1.0" in text
+    assert "cascade_escalation_rate 1.0" in text

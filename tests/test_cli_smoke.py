@@ -14,7 +14,7 @@ CLIS = [
     "scaling_test.py", "pallas_check.py", "tpu_session.py",
     "export_model.py", "import_torch_checkpoint.py", "make_corpus.py",
     "build_native.py", "list_coco.py", "lint.py", "program_audit.py",
-    "stream_bench.py", "chaos_serve.py",
+    "stream_bench.py", "chaos_serve.py", "cascade_bench.py",
 ]
 
 
@@ -25,6 +25,31 @@ def test_cli_help(cli):
         [sys.executable, os.path.join(ROOT, "tools", cli), "--help"],
         capture_output=True, timeout=120, env=env)
     assert r.returncode == 0, r.stderr.decode()[-500:]
+
+
+def test_distill_flags_in_train_help():
+    """The distillation CLI path (train.py --distill-from et al.) stays
+    wired — the flags must surface in --help, not just parse."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "train.py"),
+         "--help"], capture_output=True, timeout=120, env=env)
+    assert r.returncode == 0
+    out = r.stdout.decode()
+    for flag in ("--distill-from", "--teacher-config", "--distill-alpha",
+                 "--distill-alpha-warmup"):
+        assert flag in out, flag
+
+
+def test_export_gate_flags_in_export_help():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "export_model.py"),
+         "--help"], capture_output=True, timeout=120, env=env)
+    assert r.returncode == 0
+    out = r.stdout.decode()
+    for flag in ("--audit-program", "--dtype", "--program"):
+        assert flag in out, flag
 
 
 def test_list_coco_without_pycocotools():

@@ -1,0 +1,252 @@
+"""Heatmap-distillation step (train.distill): blend semantics, the
+alpha schedule's endpoints, teacher freezing/donation safety, and exact
+equivalence with the supervised step at alpha=1.
+
+Budget discipline: the fast tier compiles exactly THREE programs (the
+donated distill step, one non-donated ramp program whose two endpoints
+prove the alpha=1 and alpha=0 semantics, and the supervised twin),
+shared via module fixtures; the architecture-asymmetric teacher
+(tiny_student FROM tiny), the health arity and the full CLI journey
+live in the slow tier — the graftaudit registry's ``distill_train_step``
+(tiny teacher) keeps the asymmetric pair traced in tier-1 regardless.
+"""
+import dataclasses
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from improved_body_parts_tpu.config import get_config
+from improved_body_parts_tpu.models import build_model
+from improved_body_parts_tpu.train import (
+    bind_teacher,
+    create_train_state,
+    make_distill_train_step,
+    make_optimizer,
+    make_train_step,
+    step_decay_schedule,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# the ramp program's schedule knobs: alpha anneals 1.0 -> 0.0 over
+# RAMP_STEPS, so step 0 IS the supervised objective and step RAMP_STEPS
+# IS pure distillation — one compiled program, both endpoints
+RAMP_STEPS = 100
+
+
+@pytest.fixture(scope="module")
+def setup():
+    """Model/optimizer/state + a teacher-variables tree (same tiny_student
+    architecture, different weights — the distill machinery is
+    architecture-agnostic; the asymmetric tiny->tiny_student pair is
+    compiled by the registry sweep and the slow CLI journey)."""
+    cfg = get_config("tiny_student")
+    model = build_model(cfg)
+    opt = make_optimizer(cfg, step_decay_schedule(cfg.train, 10))
+    h, w = cfg.skeleton.height, cfg.skeleton.width
+    sample = jnp.zeros((2, h, w, 3))
+    state = create_train_state(model, cfg, opt, jax.random.PRNGKey(0),
+                               sample)
+    t_init = model.init(jax.random.PRNGKey(1), sample, train=False)
+    t_vars = {"params": t_init["params"],
+              "batch_stats": t_init["batch_stats"]}
+    return cfg, model, opt, state, t_vars
+
+
+@pytest.fixture(scope="module")
+def donated_step(setup):
+    cfg, model, opt, _, _ = setup
+    return make_distill_train_step(model, model, cfg, opt)
+
+
+@pytest.fixture(scope="module")
+def ramp_step(setup):
+    cfg, model, opt, _, _ = setup
+    ramp_cfg = cfg.replace(train=dataclasses.replace(
+        cfg.train, distill_alpha=0.0,
+        distill_alpha_warmup_steps=RAMP_STEPS))
+    return make_distill_train_step(model, model, ramp_cfg, opt,
+                                   donate=False)
+
+
+@pytest.fixture(scope="module")
+def supervised_step(setup):
+    cfg, model, opt, _, _ = setup
+    return make_train_step(model, cfg, opt, donate=False)
+
+
+def _batch(cfg, n=2, seed=0):
+    rng = np.random.default_rng(seed)
+    h, w = cfg.skeleton.height, cfg.skeleton.width
+    gh, gw = cfg.skeleton.grid_shape
+    images = rng.integers(0, 255, (n, h, w, 3), dtype=np.uint8)
+    mask = np.ones((n, gh, gw, 1), np.float32)
+    gt = rng.uniform(0, 1, (n, gh, gw,
+                            cfg.skeleton.num_layers)).astype(np.float32)
+    return images, mask, gt
+
+
+def test_step_trains_and_teacher_survives_donation(setup, donated_step):
+    """The donated step must leave the NON-donated teacher variables
+    readable and bit-identical across steps — a donation leak into the
+    teacher arg would delete (or silently overwrite) the frozen weights
+    the whole run reuses."""
+    cfg, model, opt, state, t_vars = setup
+    # a private COPY: the donated step consumes its input buffers, and
+    # the module-scoped state must stay readable for the other tests
+    state = jax.tree.map(jnp.copy, state)
+    images, mask, gt = _batch(cfg)
+    step = bind_teacher(donated_step, t_vars)
+    t_leaf_before = np.asarray(jax.tree.leaves(t_vars)[0]).copy()
+    p_before = np.asarray(jax.tree.leaves(state.params)[0]).copy()
+    state, loss = step(state, images, mask, gt)
+    state, loss2 = step(state, images, mask, gt)
+    assert np.isfinite(float(loss)) and np.isfinite(float(loss2))
+    assert int(state.step) == 2
+    # teacher unchanged and still readable (donated buffers raise)
+    np.testing.assert_array_equal(t_leaf_before,
+                                  np.asarray(jax.tree.leaves(t_vars)[0]))
+    # the student actually moved
+    assert not np.array_equal(p_before,
+                              np.asarray(jax.tree.leaves(state.params)[0]))
+
+
+def test_ramp_start_equals_supervised_exactly(setup, ramp_step,
+                                              supervised_step):
+    """Endpoint 1 of the alpha schedule: at step 0 the ramp is alpha=1,
+    i.e. the plain supervised objective — loss AND updated params must
+    match make_train_step bit-for-bit (the distill factory is a
+    superset, not a fork, of the training semantics)."""
+    cfg, model, opt, state, t_vars = setup
+    images, mask, gt = _batch(cfg)
+    s_d, loss_d = ramp_step(state, t_vars, images, mask, gt)
+    s_p, loss_p = supervised_step(state, images, mask, gt)
+    assert float(loss_d) == float(loss_p)
+    for a, b in zip(jax.tree.leaves(s_d.params),
+                    jax.tree.leaves(s_p.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ramp_end_is_pure_distillation(setup, ramp_step,
+                                       supervised_step):
+    """Endpoint 2: past the ramp alpha=0 — the GT tensor's weight is
+    exactly zero (two different GTs, identical loss), and with the
+    teacher's maps as the only target the loss differs from the
+    supervised one (the teacher branch is live, not dead code)."""
+    cfg, model, opt, state, t_vars = setup
+    past = state.replace(step=jnp.asarray(RAMP_STEPS, jnp.int32))
+    images, mask, gt = _batch(cfg, seed=0)
+    _, _, gt2 = _batch(cfg, seed=9)
+    _, loss_a = ramp_step(past, t_vars, images, mask, gt)
+    _, loss_b = ramp_step(past, t_vars, images, mask, gt2)
+    assert float(loss_a) == float(loss_b)
+    _, loss_sup = supervised_step(past, images, mask, gt)
+    assert float(loss_a) != float(loss_sup)
+
+
+def test_midramp_blends_between_the_endpoints(setup, ramp_step,
+                                              supervised_step):
+    """Halfway through the ramp the loss sits strictly between the two
+    endpoint objectives' values — the anneal is a real blend, computed
+    from the on-device step counter."""
+    cfg, model, opt, state, t_vars = setup
+    images, mask, gt = _batch(cfg)
+    half = state.replace(step=jnp.asarray(RAMP_STEPS // 2, jnp.int32))
+    past = state.replace(step=jnp.asarray(RAMP_STEPS, jnp.int32))
+    _, loss_half = ramp_step(half, t_vars, images, mask, gt)
+    _, loss_gt = supervised_step(half, images, mask, gt)
+    _, loss_kd = ramp_step(past, t_vars, images, mask, gt)
+    lo, hi = sorted([float(loss_gt), float(loss_kd)])
+    assert lo < float(loss_half) < hi
+    # and exactly the linear blend (alpha = 0.5 at the half step)
+    assert float(loss_half) == pytest.approx(
+        0.5 * float(loss_gt) + 0.5 * float(loss_kd), rel=1e-6)
+
+
+def test_distill_cli_refusal_is_loud(tmp_path):
+    """--distill-from without --teacher-config is a SystemExit naming
+    the missing flag, not a silently defaulted teacher."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "train.py"),
+         "--config", "tiny_student", "--distill-from", "x"],
+        env=env, capture_output=True, text=True, timeout=300,
+        cwd=str(tmp_path))
+    assert proc.returncode != 0
+    assert "--teacher-config" in proc.stdout + proc.stderr
+
+
+@pytest.mark.slow
+def test_health_variant_and_asymmetric_teacher():
+    """Slow tier: the health arity and a genuinely different teacher
+    architecture (tiny teaching tiny_student) in one compiled
+    program."""
+    s_cfg = get_config("tiny_student")
+    t_cfg = get_config("tiny")
+    s_model, t_model = build_model(s_cfg), build_model(t_cfg)
+    opt = make_optimizer(s_cfg, step_decay_schedule(s_cfg.train, 10))
+    h, w = s_cfg.skeleton.height, s_cfg.skeleton.width
+    sample = jnp.zeros((2, h, w, 3))
+    state = create_train_state(s_model, s_cfg, opt,
+                               jax.random.PRNGKey(0), sample)
+    t_vars = t_model.init(jax.random.PRNGKey(1), sample, train=False)
+    images, mask, gt = _batch(s_cfg)
+    step = make_distill_train_step(s_model, t_model, s_cfg, opt,
+                                   donate=False, health=True)
+    _, loss, gnorm = step(state, t_vars, images, mask, gt)
+    assert np.isfinite(float(loss))
+    assert float(gnorm) > 0
+
+
+@pytest.mark.slow
+def test_distill_cli_journey(tmp_path):
+    """The wired path end to end: teacher checkpoint -> student distill
+    run through the real CLI (supervisor/checkpoint/telemetry stack
+    unchanged) -> committed student checkpoint; plus the remaining
+    flag-combination refusals."""
+    from improved_body_parts_tpu.data import build_fixture
+
+    corpus = str(tmp_path / "fixture.h5")
+    build_fixture(corpus, num_images=2, people_per_image=1, seed=3)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    train = os.path.join(REPO, "tools", "train.py")
+
+    def run(args, expect_rc=0):
+        proc = subprocess.run([sys.executable, train] + args,
+                              cwd=str(tmp_path), env=env,
+                              capture_output=True, text=True,
+                              timeout=900)
+        if expect_rc == 0:
+            assert proc.returncode == 0, (proc.stdout[-2000:],
+                                          proc.stderr[-2000:])
+        else:
+            assert proc.returncode != 0
+        return proc.stdout + proc.stderr
+
+    run(["--config", "tiny", "--epochs", "1", "--train-h5", corpus,
+         "--checkpoint-dir", "tckpt", "--print-freq", "1",
+         "--workers", "0"])
+    out = run(["--config", "tiny_student", "--epochs", "1",
+               "--train-h5", corpus, "--checkpoint-dir", "sckpt",
+               "--print-freq", "1", "--workers", "0",
+               "--distill-from", "tckpt/epoch_0",
+               "--teacher-config", "tiny", "--distill-alpha", "0.6"])
+    assert "distilling from" in out
+    assert any("epoch" in c
+               for c in os.listdir(str(tmp_path / "sckpt")))
+    # remaining refusal matrix (each exits before any device work)
+    out = run(["--config", "tiny_student", "--teacher-config", "tiny"],
+              expect_rc=1)
+    assert "require --distill-from" in out
+    out = run(["--config", "tiny_student", "--distill-from", "x",
+               "--teacher-config", "tiny", "--swa"], expect_rc=1)
+    assert "SWA" in out
+    out = run(["--config", "tiny_student", "--distill-from", "x",
+               "--teacher-config", "canonical"], expect_rc=1)
+    assert "different skeleton" in out

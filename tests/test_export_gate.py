@@ -1,0 +1,114 @@
+"""tools/export_model.py as a deploy gate: the exported program's
+compiled graftaudit fingerprint is stamped into the artifact manifest
+and diffed against the blessed PROGRAM_AUDIT.json — a divergent program
+refuses to export (ROADMAP item 3's audit-as-deploy-gate direction)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _golden():
+    with open(os.path.join(REPO, "PROGRAM_AUDIT.json")) as f:
+        return json.load(f)
+
+
+def _same_jax_version():
+    import jax
+
+    return _golden().get("jax_version") == jax.__version__
+
+
+def _run(args, timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "export_model.py")]
+        + args, capture_output=True, text=True, timeout=timeout, env=env)
+
+
+pytestmark_slow = pytest.mark.slow  # compile-bearing export tests
+
+
+@pytest.fixture(scope="module")
+def student_export(tmp_path_factory):
+    """One gated bf16 student fused-decode export, shared by the
+    assertions below (the compile is the expensive part)."""
+    out = str(tmp_path_factory.mktemp("export") / "student.jaxexport")
+    proc = _run(["--config", "tiny_student", "--dtype", "bf16",
+                 "--program", "decode", "--size", "128",
+                 "--audit-program", "student_serve_decode_b1",
+                 "--out", out])
+    return proc, out
+
+
+@pytestmark_slow
+def test_gated_export_passes_and_stamps_manifest(student_export):
+    proc, out = student_export
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert os.path.exists(out) and os.path.getsize(out) > 0
+    with open(out + ".manifest.json") as f:
+        manifest = json.load(f)
+    assert manifest["program"] == "decode"
+    assert manifest["params_dtype"] == "bf16"
+    assert manifest["audit_gate"]["program"] == "student_serve_decode_b1"
+    fp = manifest["graftaudit"]["compiled_fingerprint"]
+    # the fingerprint is the audit tier's own shape: cost + structure
+    for key in ("flops", "hlo_instruction_count", "aliased_params"):
+        assert key in fp
+    if _same_jax_version():
+        assert manifest["audit_gate"]["status"] == "passed"
+        golden_fp = _golden()["programs"]["student_serve_decode_b1"][
+            "fingerprint"]["compiled"]
+        # the manifest stamps EXACTLY the program the registry blessed
+        assert fp["hlo_instruction_count"] == \
+            golden_fp["hlo_instruction_count"]
+        assert fp["flops"] == golden_fp["flops"]
+
+
+@pytestmark_slow
+@pytest.mark.skipif(not _same_jax_version(),
+                    reason="cross-jax-version goldens gate as warnings "
+                           "by design (fingerprints are version-exact)")
+def test_divergent_program_refuses_export(tmp_path):
+    """Exporting the STUDENT program against the TEACHER's blessed
+    entry is a structural divergence: the export must refuse, exit
+    non-zero and write NO artifact."""
+    out = str(tmp_path / "wrong.jaxexport")
+    proc = _run(["--config", "tiny_student", "--dtype", "bf16",
+                 "--program", "decode", "--size", "128",
+                 "--audit-program", "serve_decode_b1", "--out", out])
+    assert proc.returncode != 0
+    assert "REFUSED" in proc.stdout + proc.stderr
+    assert not os.path.exists(out)
+
+
+def test_unregistered_audit_program_refuses_fast(tmp_path):
+    """Tier-1's gate probe: an unblessed program name refuses BEFORE
+    the compile is paid (the fail-fast half of the gate; the
+    fingerprint-diff halves are slow-tier, compile-bearing)."""
+    out = str(tmp_path / "x.jaxexport")
+    proc = _run(["--config", "tiny_student", "--program", "decode",
+                 "--size", "128", "--audit-program", "no_such_program",
+                 "--out", out], timeout=120)
+    assert proc.returncode != 0
+    assert "not in the blessed" in proc.stdout + proc.stderr
+    assert not os.path.exists(out)
+
+
+@pytestmark_slow
+def test_ungated_export_still_stamps_fingerprint(tmp_path):
+    """Without --audit-program the manifest still carries the compiled
+    fingerprint (auditable after the fact), marked not-gated."""
+    out = str(tmp_path / "fwd.jaxexport")
+    proc = _run(["--config", "tiny_student", "--program", "forward",
+                 "--size", "128", "--out", out])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out + ".manifest.json") as f:
+        manifest = json.load(f)
+    assert manifest["audit_gate"]["program"] is None
+    assert "not-gated" in manifest["audit_gate"]["status"]
+    assert manifest["graftaudit"]["compiled_fingerprint"]["flops"] > 0

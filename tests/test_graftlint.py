@@ -986,6 +986,50 @@ def test_scope_covers_fault_tolerant_serving_modules():
         lint(leak, path="improved_body_parts_tpu/serve/pool.py"))
 
 
+def test_scope_covers_cascade_module():
+    """ISSUE 13 satellite: the cascade layer (serve/cascade.py) lives in
+    the JGL002 hot-path scope — its routing callbacks run on the
+    engines' completion threads per request — and JGL005 sees any
+    thread/executor lifecycle it might grow.  Locked on the file's
+    actual path so a future move out of serve/ can't silently drop it
+    from the sweep."""
+    hot = """
+        import jax.numpy as jnp
+
+        def escalate_loop(frames):
+            for f in frames:
+                score = jnp.max(f)
+                route(float(score))
+    """
+    assert "JGL002" in rules_of(
+        lint(hot, path="improved_body_parts_tpu/serve/cascade.py"))
+    leak = """
+        import threading
+
+        def escalate(engine):
+            t = threading.Thread(target=engine.submit)
+            t.start()
+    """
+    assert "JGL005" in rules_of(
+        lint(leak, path="improved_body_parts_tpu/serve/cascade.py"))
+
+
+def test_donation_tracks_distill_factory():
+    """The distill step factory is in the donating-factories config:
+    JGL001 must flag a read of the state after it flowed into a
+    make_distill_train_step-built step, exactly like make_train_step."""
+    bad = """
+        from improved_body_parts_tpu.train import make_distill_train_step
+
+        def run(model, teacher, cfg, opt, state, tvars, batch):
+            step = make_distill_train_step(model, teacher, cfg, opt)
+            new_state, loss = step(state, tvars, *batch)
+            return state.params  # read after donation
+    """
+    assert "JGL001" in rules_of(
+        lint(bad, path="improved_body_parts_tpu/train/x.py"))
+
+
 def test_scope_covers_partition_module():
     """ISSUE 12 satellite: the GSPMD partition module (and the rest of
     parallel/) lives in the JGL002 hot-path scope — its

@@ -169,3 +169,60 @@ class TestServeMetricsConcurrency:
         snap = m.snapshot()
         assert snap["queue_depth"] == m.depth
         assert snap["latency_ms"]["count"] == m.completed
+
+    def test_eight_thread_hammer_conserves_counts_per_model(self):
+        """ISSUE 13: the cascade registers one ServeMetrics per tier
+        (``model="student"/"teacher"``) into ONE registry.  8 threads
+        hammer BOTH tiers concurrently; conservation must hold PER
+        MODEL, the two tiers' totals must partition the traffic
+        exactly, and every exported sample must carry its tier's
+        ``{model=...}`` label."""
+        from improved_body_parts_tpu.obs import Registry
+        from improved_body_parts_tpu.serve.metrics import ServeMetrics
+
+        reg = Registry()
+        student = ServeMetrics(model="student").register_into(reg)
+        teacher = ServeMetrics(model="teacher").register_into(reg)
+        threads_n, ops = 8, 240
+        barrier = threading.Barrier(threads_n)
+
+        def hammer(tid):
+            barrier.wait()
+            for i in range(ops):
+                # deterministic 2:1 student:teacher split per thread
+                m = student if (tid + i) % 3 else teacher
+                m.on_submit()
+                m.on_dispatch(i % 4 + 1)
+                if i % 5 == 0:
+                    m.on_fail()
+                else:
+                    m.on_complete(0.001 * (i % 3))
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(threads_n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        for m in (student, teacher):
+            assert m.submitted == m.completed + m.failed + m.depth
+            assert m.depth == 0
+            assert m.latency.count == m.completed
+        # the two tiers partition the hammered traffic exactly
+        assert student.submitted + teacher.submitted == threads_n * ops
+        # every sample of a labeled tier carries its model label
+        for m, name in ((student, "student"), (teacher, "teacher")):
+            for _, labels, _, _ in m.collect():
+                assert labels.get("model") == name
+            assert m.snapshot()["model"] == name
+        # one registry, both tiers separable in the exposition
+        text = reg.prometheus()
+        assert 'serve_submitted_total{model="student"} ' \
+               f'{float(student.submitted)}' in text
+        assert 'serve_submitted_total{model="teacher"} ' \
+               f'{float(teacher.submitted)}' in text
+        # an unlabeled ServeMetrics still exports bare names (the
+        # single-model deployments' exposition is unchanged)
+        assert all("model" not in labels
+                   for _, labels, _, _ in ServeMetrics().collect())

@@ -412,12 +412,22 @@ def test_registry_has_the_shipped_entry_points(registry_sweep):
     assert len(names) >= 6
     for required in ("train_step", "train_step_health", "eval_step",
                      "serve_compact_b1", "flip_tta_peaks", "swa_update",
-                     "train_step_partitioned"):
+                     "train_step_partitioned", "student_forward",
+                     "student_serve_decode_b1", "distill_train_step"):
         assert required in names
     part = next(s for s in program_registry()
                 if s.name == "train_step_partitioned")
     assert part.meshed and part.expect_sharded_params, \
         "the partitioned step must gate under PRG006's param facet"
+    # the fast tier's serve program declares its assembly while, like
+    # the teacher's
+    student_decode = next(s for s in program_registry()
+                          if s.name == "student_serve_decode_b1")
+    assert student_decode.allow_while
+    distill = next(s for s in program_registry()
+                   if s.name == "distill_train_step")
+    assert distill.donate_argnums == (0,), \
+        "the distill step donates the student state ONLY"
 
 
 def test_fused_decode_programs_registered_with_declared_while():
@@ -446,6 +456,40 @@ def test_fused_decode_programs_registered_with_declared_while():
                               expect_bf16=True)
         assert "PRG005" in rules_of(
             audit_program(undeclared, level="trace"))
+
+
+def test_distill_step_aliases_student_state_only():
+    """ISSUE 13 acceptance: the distill step's donation is REALIZED
+    (compiled input_output_aliases exist and cover the full student
+    state bytes) and every alias points into the donated state's flat
+    parameter range — the teacher variables, the very next argument,
+    contribute ZERO aliases.  A donation leak into the frozen teacher
+    would delete the weights every later step reads."""
+    import jax
+    import numpy as np
+
+    from improved_body_parts_tpu.analysis.program.compiled import (
+        compile_program,
+    )
+    from improved_body_parts_tpu.analysis.program.registry import (
+        get_program,
+    )
+
+    spec = get_program("distill_train_step")
+    built = spec.build()
+    info, _ = compile_program(built)
+    state_leaves = jax.tree.leaves(built.args[0])
+    n_state = len(state_leaves)
+    assert info.aliases, "the distill step's donation vanished"
+    assert all(p < n_state for p in info.aliases.values()), (
+        "an input_output_alias points past the student state's flat "
+        "parameter range — the teacher variables were donated")
+    state_bytes = sum(
+        int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        for leaf in state_leaves)
+    assert info.alias_bytes == state_bytes, (
+        f"aliased {info.alias_bytes} of {state_bytes} student-state "
+        "bytes — donation only partially realized")
 
 
 def test_registry_sweep_is_clean(registry_sweep):

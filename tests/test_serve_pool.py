@@ -581,12 +581,18 @@ class TestEnginePoolLogic:
         assert m.expired == 1
         assert m.submitted == m.completed + m.failed + m.depth
 
+    @pytest.mark.slow
     def test_restart_during_fence_drain_is_serialized(self, warm_pred,
                                                       person_maps):
         """Review regression: restart() racing the fence's background
         drain must wait out the drain's tail (engine start/stop share a
         lock) instead of having the old drain tear down the fresh
-        pipeline — and the replica re-enters routing able to serve."""
+        pipeline — and the replica re-enters routing able to serve.
+
+        Slow tier (~40 s of wedge_timeout wall-clock): the race corner
+        of the wedge->fence->restart machinery whose end-to-end
+        acceptance (`test_pool_wedge_fence_failover_end_to_end`) stays
+        in tier-1."""
         from improved_body_parts_tpu.serve import DynamicBatcher
 
         img = np.zeros((*SIZE_A, 3), np.uint8)

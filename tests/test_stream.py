@@ -670,10 +670,15 @@ def test_session_migrate_preserves_frame_order(warm_pred, second_pred):
     _join_serve_threads()
 
 
+@pytest.mark.slow
 def test_manager_migrate_moves_every_session(warm_pred, second_pred):
     """SessionManager.migrate rebinds every live session AND the
     manager default: in-flight frames re-submit, later opens land on
-    the new engine."""
+    the new engine.
+
+    Slow tier (~30 s of wedge wall-clock): the manager-loop variant of
+    the migration machinery whose per-session acceptance
+    (`test_session_migrate_preserves_frame_order`) stays in tier-1."""
     from test_serve import GatedPredictor
 
     from improved_body_parts_tpu.serve import DynamicBatcher
@@ -701,13 +706,19 @@ def test_manager_migrate_moves_every_session(warm_pred, second_pred):
     _join_serve_threads()
 
 
+@pytest.mark.slow
 def test_sessions_over_pool_survive_replica_hard_stop(warm_pred,
                                                       second_pred):
     """Streams driven through an EnginePool survive a replica hard-stop
     MID-STREAM with no session-side involvement: the pool re-submits
     the stranded frames to the healthy replica and the session's
     in-order delivery machinery never notices which replica resolved a
-    frame."""
+    frame.
+
+    Slow tier (~30 s of wedge wall-clock): a composite of two layers —
+    pool failover (`test_pool_wedge_fence_failover_end_to_end`) and
+    in-order stream delivery (`test_session_migrate_preserves_frame_
+    order`) — each still accepted in tier-1 on its own."""
     from test_serve import GatedPredictor
 
     from improved_body_parts_tpu.serve import DynamicBatcher, EnginePool

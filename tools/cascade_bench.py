@@ -1,0 +1,449 @@
+#!/usr/bin/env python
+"""Cascade-serving benchmark: student-first two-tier serving vs
+teacher-only, on a mixed easy/hard synthetic stream.
+
+The claim under test (ROADMAP open item 2): a cheap student lane that
+answers easy traffic and escalates hard frames off the fused decode
+payload's free signals multiplies served QPS without new hardware and
+without giving up the teacher's quality on the frames that need it.
+
+Protocol (the standing interleaved-round discipline of
+serve_bench/ckpt_bench — the only perf protocol this host trusts):
+
+- Both tiers run REAL forwards (student = the narrow 1-stack
+  ``--student-config``, teacher = ``--teacher-config``) wrapped in a
+  flip-aware planted-maps shim (the e2e_bench ``PlantedModel`` idea,
+  extended): the input image's brightness selects, PER LANE and on
+  device, between an easy planted crowd (``--easy-people``) and a hard
+  one (``--hard-people``).  Hard frames therefore decode to a person
+  count above the committed ``--max-people`` threshold and the cascade
+  escalates exactly them — the escalation decision exercises the real
+  signal path end to end, while the decode workload stays
+  trained-model-like.
+- K closed-loop clients drive a mixed stream (``--hard-frac`` bright
+  frames); rounds alternate a cascade slice and a teacher-only slice,
+  and the verdict is the MEDIAN per-round QPS ratio (host drift hits
+  both arms of a round equally).
+- Quality gate: every unique image is decoded once by each arm and
+  scored with the OKS AP machinery (``infer.oks.evaluate_oks``) against
+  the planted ground truth; the cascade's synthetic AP must be within
+  ``--ap-tol`` relative of teacher-only.  Both arms see identical
+  planted maps, so this isolates the SERVING layer's claim — escalation
+  routing loses nobody; the student-vs-teacher model-quality trade is
+  the distillation trainer's domain (tests/test_distill.py), not this
+  bench's.
+- Warmup precompiles BOTH tiers through the shared predictor-set path;
+  the committed artifact asserts 0 post-warmup recompiles across the
+  whole sweep (CPU-host caveat: both tiers share the same few cores, so
+  the throughput ratio here UNDERSTATES the on-chip win, where the
+  student's smaller program frees real accelerator time).
+
+    python tools/cascade_bench.py --out CASCADE_BENCH.json
+"""
+import argparse
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
+# the stick-figure joint layout shared with e2e_bench.planted_maps
+# (relative (dx, dy) offsets of each part from the figure anchor)
+_LAYOUT = [("nose", 0, 0.12), ("neck", 0, 0.21), ("Rsho", -0.09, 0.22),
+           ("Lsho", 0.09, 0.22), ("Relb", -0.13, 0.33),
+           ("Lelb", 0.13, 0.33), ("Rwri", -0.14, 0.43),
+           ("Lwri", 0.14, 0.43), ("Rhip", -0.05, 0.45),
+           ("Lhip", 0.05, 0.45), ("Rkne", -0.06, 0.59),
+           ("Lkne", 0.06, 0.59), ("Rank", -0.06, 0.72),
+           ("Lank", 0.06, 0.72), ("Reye", -0.02, 0.10),
+           ("Leye", 0.02, 0.10), ("Rear", -0.04, 0.11),
+           ("Lear", 0.04, 0.11)]
+
+
+def plant_people(skeleton, n_people, rng, canvas):
+    """Stride-grid maps for N planted stick people PLUS their COCO-order
+    ground truth (the quality gate's GT side).  Coordinates are canvas
+    pixels — the bench pins ``boxsize == size == canvas`` so decoded
+    detections come back in the same space 1:1."""
+    import dataclasses
+
+    import numpy as np
+
+    from improved_body_parts_tpu.data.heatmapper import Heatmapper
+
+    sk = dataclasses.replace(skeleton, width=canvas, height=canvas)
+    joints = np.zeros((n_people, sk.num_parts, 3), np.float32)
+    joints[:, :, 2] = 2
+    region = canvas * 0.86
+    xs = np.linspace(0.18, 0.82, n_people) * region
+    for p in range(n_people):
+        cx = xs[p] + rng.uniform(-4, 4)
+        scale = rng.uniform(0.42, 0.52) * region
+        y0 = rng.uniform(0.02, 0.12) * region
+        for name, dx, dy in _LAYOUT:
+            joints[p, sk.parts_dict[name]] = [cx + dx * scale,
+                                              y0 + dy * scale, 1]
+    maps = Heatmapper(sk).create_heatmaps(
+        joints, np.ones(sk.grid_shape, np.float32)).astype(np.float32)
+
+    mapping = skeleton.dt_gt_mapping
+    gts = []
+    for p in range(n_people):
+        kp = np.zeros((17, 3), np.float64)
+        for di, gi in mapping.items():
+            if gi is None:
+                continue
+            kp[gi] = [joints[p, di, 0], joints[p, di, 1], 2.0]
+        xs_v, ys_v = kp[kp[:, 2] > 0, 0], kp[kp[:, 2] > 0, 1]
+        area = float((xs_v.max() - xs_v.min()) * (ys_v.max() - ys_v.min()))
+        gts.append({"keypoints": kp, "area": max(area, 1.0)})
+    return maps, gts
+
+
+class TieredPlantedModel:
+    """Flip-aware planted-maps shim with a PER-LANE difficulty select:
+    output = (easy | hard planted maps, chosen by the lane's input-image
+    brightness ON DEVICE) + 1e-3 x the real last-stack output — the full
+    forward still runs (honest device time for the wrapped tier's real
+    architecture), the maps contain decodable people, and hard frames
+    carry a crowd the escalation policy fires on.
+
+    Mirror lanes (the second half, in both the 2-lane single and 2N-lane
+    batch programs) get the width-flipped, channel-permuted maps so the
+    flip-ensemble merge reconstructs the planted people exactly (no
+    ghosts, no halving) — the PlantedModel discipline."""
+
+    def __init__(self, model, easy_maps, hard_maps, skeleton,
+                 bright_thresh: float = 0.5):
+        self.model = model
+        self.easy = easy_maps
+        self.hard = hard_maps
+        self.skeleton = skeleton
+        self.bright_thresh = bright_thresh
+
+    def apply(self, variables, imgs, train=False):
+        import jax.numpy as jnp
+
+        sk = self.skeleton
+        preds = self.model.apply(variables, imgs, train=train)
+        out = preds[-1][0]
+        gh, gw = out.shape[1], out.shape[2]
+
+        def straight_mirror(maps):
+            assert maps.shape[0] >= gh and maps.shape[1] >= gw, (
+                "planted canvas smaller than the model grid")
+            m = jnp.asarray(maps[:gh, :gw])
+            mm = jnp.concatenate(
+                [m[..., :sk.paf_layers][..., jnp.asarray(sk.flip_paf_ord)],
+                 m[..., sk.heat_start:sk.num_layers]
+                 [..., jnp.asarray(sk.flip_heat_ord)]], axis=-1)[:, ::-1]
+            return m, mm
+
+        e, em = straight_mirror(self.easy)
+        h, hm = straight_mirror(self.hard)
+        # brightness is flip-invariant, so lane i and its mirror N+i
+        # always agree on the difficulty select
+        bright = imgs.mean(axis=(1, 2, 3)) > self.bright_thresh
+        n = out.shape[0] // 2
+        sel = bright[:, None, None, None]
+        straight = jnp.where(sel[:n], h[None], e[None]) + 1e-3 * out[:n]
+        mirror = jnp.where(sel[n:], hm[None], em[None]) + 1e-3 * out[n:]
+        return [[jnp.concatenate([straight, mirror], axis=0)]]
+
+
+def make_images(size, n_each, rng):
+    """(easy_images, hard_images): dark vs bright BGR uint8 frames —
+    the stream's difficulty carrier."""
+    import numpy as np
+
+    easy = [rng.integers(0, 50, (size, size, 3)).astype(np.uint8)
+            for _ in range(n_each)]
+    hard = [rng.integers(205, 255, (size, size, 3)).astype(np.uint8)
+            for _ in range(n_each)]
+    return easy, hard
+
+
+def run_clients(n_clients, requests, work_fn):
+    latencies = [[] for _ in range(n_clients)]
+    errors = []
+
+    def client(cid):
+        try:
+            for i in range(requests):
+                t0 = time.perf_counter()
+                work_fn(cid, i)
+                latencies[cid].append(time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 — surfaced after join
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return wall, [v for lat in latencies for v in lat]
+
+
+def run_slice(submit, stream, n_clients, requests):
+    """One closed-loop slice: ``submit(image) -> Future``; sheds retry
+    through the shared policy helper and are reported, not failed."""
+    from improved_body_parts_tpu.serve import submit_with_retry
+
+    retries = [0]
+    lock = threading.Lock()
+
+    def work(cid, i):
+        img = stream[(cid + i * n_clients) % len(stream)]
+        fut, n = submit_with_retry(submit, img, base_s=0.002, max_s=0.05)
+        if n:
+            with lock:
+                retries[0] += n
+        fut.result()
+
+    wall, lats = run_clients(n_clients, requests, work)
+    total = n_clients * requests
+    lats.sort()
+    return {"imgs_per_sec": round(total / wall, 3),
+            "p95_ms": round(lats[int(0.95 * (len(lats) - 1))] * 1e3, 2),
+            "shed_retries": retries[0]}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--student-config", default="synth_deep_student",
+                    help="fast-tier architecture (the production-shape "
+                         "default pairs the 2-stack quarter-width "
+                         "student with the 4-stack synth_deep teacher; "
+                         "tiny_student/tiny is the seconds-scale smoke "
+                         "pair)")
+    ap.add_argument("--teacher-config", default="synth_deep")
+    ap.add_argument("--size", type=int, default=256,
+                    help="frame H=W; also the planted canvas and the "
+                         "boxsize, so GT and detections share one "
+                         "coordinate space")
+    ap.add_argument("--hard-frac", type=float, default=0.25,
+                    help="fraction of the stream that is hard (bright "
+                         "-> crowd above the escalation threshold)")
+    ap.add_argument("--easy-people", type=int, default=2)
+    ap.add_argument("--hard-people", type=int, default=6)
+    ap.add_argument("--max-people", type=int, default=4,
+                    help="EscalationPolicy.max_people — the committed "
+                         "threshold between the planted easy and hard "
+                         "crowds")
+    ap.add_argument("--score-floor", type=float, default=0.0)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=8,
+                    help="closed-loop requests per client per slice")
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--max-wait-ms", type=float, default=30.0)
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--ap-tol", type=float, default=0.03,
+                    help="max relative synthetic-AP deficit of the "
+                         "cascade vs teacher-only")
+    ap.add_argument("--target-ratio", type=float, default=1.3,
+                    help="the QPS claim: median cascade/teacher-only "
+                         "round ratio the artifact gates on")
+    ap.add_argument("--out", default="CASCADE_BENCH.json")
+    args = ap.parse_args()
+
+    from improved_body_parts_tpu.utils import (
+        apply_platform_env, devices_with_timeout)
+    apply_platform_env()
+
+    import jax
+    import numpy as np
+
+    platform = devices_with_timeout(900)[0].platform
+    print(f"platform={platform}", flush=True)
+
+    import jax.numpy as jnp
+
+    from improved_body_parts_tpu.config import (
+        InferenceModelParams, get_config)
+    from improved_body_parts_tpu.infer import Predictor, evaluate_oks
+    from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.obs import Registry, RunTelemetry
+    from improved_body_parts_tpu.serve import CascadeEngine, \
+        DynamicBatcher, EscalationPolicy, ServeMetrics
+
+    s_cfg = get_config(args.student_config)
+    t_cfg = get_config(args.teacher_config)
+    assert s_cfg.skeleton == t_cfg.skeleton, \
+        "cascade tiers must share the skeleton"
+    sk = s_cfg.skeleton
+    rng = np.random.default_rng(0)
+    size = args.size
+
+    easy_maps, easy_gt = plant_people(sk, args.easy_people, rng, size)
+    hard_maps, hard_gt = plant_people(sk, args.hard_people, rng, size)
+
+    def tiered_predictor(cfg):
+        model = build_model(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, size, size, 3)), train=False)
+        planted = TieredPlantedModel(model, easy_maps, hard_maps, sk)
+        return Predictor(planted, variables, sk,
+                         model_params=InferenceModelParams(
+                             boxsize=size, max_downsample=64),
+                         bucket=64)
+
+    student = tiered_predictor(s_cfg)
+    teacher = tiered_predictor(t_cfg)
+
+    easy_imgs, hard_imgs = make_images(size, 4, rng)
+    # the mixed stream: hard frames spread evenly at --hard-frac
+    # (Bresenham interleave, so every client's closed loop sees the mix)
+    n_stream = 16
+    n_hard = max(1, round(args.hard_frac * n_stream))
+    stream, e_i, h_i = [], 0, 0
+    for i in range(n_stream):
+        if (i + 1) * n_hard // n_stream > i * n_hard // n_stream:
+            stream.append(hard_imgs[h_i % len(hard_imgs)])
+            h_i += 1
+        else:
+            stream.append(easy_imgs[e_i % len(easy_imgs)])
+            e_i += 1
+    hard_in_stream = h_i
+
+    telemetry = RunTelemetry(
+        None, registry=Registry(),
+        run_meta={"tool": "cascade_bench", "platform": platform})
+    policy = EscalationPolicy(max_people=args.max_people,
+                              score_floor=args.score_floor)
+    batcher_kw = dict(max_batch=args.max_batch,
+                      max_wait_ms=args.max_wait_ms,
+                      max_queue=args.max_queue)
+    sizes = [(size, size)]
+
+    report = {
+        "platform": platform,
+        "student_config": args.student_config,
+        "teacher_config": args.teacher_config,
+        "size": size, "hard_frac_requested": args.hard_frac,
+        "hard_frac_stream": round(hard_in_stream / n_stream, 3),
+        "easy_people": args.easy_people, "hard_people": args.hard_people,
+        "policy": {"max_people": args.max_people,
+                   "score_floor": args.score_floor,
+                   "escalate_on_overflow": True},
+        "clients": args.clients, "requests_per_slice":
+            args.clients * args.requests, "rounds": args.rounds,
+        "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
+        "note": "Interleaved rounds, median per-round ratio (the "
+                "standing bench protocol). Both tiers run their real "
+                "forwards behind a flip-aware planted-maps shim whose "
+                "per-lane brightness select makes hard frames decode "
+                "to a crowd above the committed max_people threshold; "
+                "both tiers plant IDENTICAL maps, so the AP gate "
+                "isolates the serving layer (escalation loses nobody) "
+                "while the student-vs-teacher model quality trade "
+                "belongs to the distillation trainer. CPU-host caveat: "
+                "both tiers share the same cores, so the ratio "
+                "UNDERSTATES the on-chip win.",
+    }
+
+    def flush():
+        with open(args.out, "w") as f:
+            strict_dump(report, f, indent=2)
+
+    cascade = CascadeEngine.build(student, teacher, policy=policy,
+                                  registry=telemetry.registry,
+                                  **batcher_kw)
+    teacher_only = DynamicBatcher(teacher,
+                                  metrics=ServeMetrics(
+                                      model="teacher_only"),
+                                  registry=telemetry.registry,
+                                  device_decode=True, **batcher_kw)
+    with cascade, teacher_only:
+        warm = cascade.warmup(sizes)
+        warm_t = teacher_only.warmup(sizes)
+        telemetry.mark_warm("cascade + teacher-only warmup precompile")
+        report["warmup"] = {
+            "student_newly_compiled": warm["student"]["newly_compiled"],
+            "teacher_newly_compiled": warm["teacher"]["newly_compiled"],
+            "teacher_only_newly_compiled": warm_t["newly_compiled"]}
+
+        # --- interleaved throughput rounds ---------------------------
+        cas_rounds, tea_rounds = [], []
+        for r in range(args.rounds):
+            cas = run_slice(cascade.submit, stream, args.clients,
+                            args.requests)
+            tea = run_slice(teacher_only.submit, stream, args.clients,
+                            args.requests)
+            cas_rounds.append(cas)
+            tea_rounds.append(tea)
+            print(f"round {r}: cascade {cas['imgs_per_sec']} vs "
+                  f"teacher-only {tea['imgs_per_sec']} imgs/s",
+                  flush=True)
+        # routing snapshot BEFORE the quality pass: the committed
+        # escalation rate describes the serving stream, not the
+        # half-easy/half-hard unique-image set the AP gate decodes
+        snap = cascade.metrics.snapshot()
+
+        # --- quality gate: per-image decode, both arms, OKS AP -------
+        gts, det_cascade, det_teacher = {}, {}, {}
+        uniq = [(i, im, im.mean() > 127) for i, im in
+                enumerate(easy_imgs + hard_imgs)]
+        for img_id, im, is_hard in uniq:
+            gts[img_id] = hard_gt if is_hard else easy_gt
+            det_cascade[img_id] = cascade.submit(im).result(timeout=120)
+            det_teacher[img_id] = teacher_only.submit(im).result(
+                timeout=120)
+        ap_c = evaluate_oks(gts, det_cascade)["AP"]
+        ap_t = evaluate_oks(gts, det_teacher)["AP"]
+        rel = abs(ap_c - ap_t) / max(ap_t, 1e-9)
+        report["quality"] = {
+            "cascade_synthetic_ap": round(ap_c, 4),
+            "teacher_only_synthetic_ap": round(ap_t, 4),
+            "rel_diff": round(rel, 4), "tolerance": args.ap_tol,
+            "within_tolerance": bool(rel <= args.ap_tol)}
+        flush()
+        print(f"quality: cascade AP {ap_c:.4f} vs teacher-only "
+              f"{ap_t:.4f} (rel {rel:.4f})", flush=True)
+
+    ratios = sorted(c["imgs_per_sec"] / t["imgs_per_sec"]
+                    for c, t in zip(cas_rounds, tea_rounds))
+    median_ratio = ratios[len(ratios) // 2]
+    report.update({
+        "cascade_imgs_per_sec": [r["imgs_per_sec"] for r in cas_rounds],
+        "teacher_only_imgs_per_sec": [r["imgs_per_sec"]
+                                      for r in tea_rounds],
+        "cascade_p95_ms": cas_rounds[-1]["p95_ms"],
+        "teacher_only_p95_ms": tea_rounds[-1]["p95_ms"],
+        "shed_retries_total": sum(r["shed_retries"]
+                                  for r in cas_rounds + tea_rounds),
+        "per_round_ratio": [round(r, 3) for r in ratios],
+        "median_round_ratio": round(median_ratio, 3),
+        "target_ratio": args.target_ratio,
+        "cascade_beats_target": bool(median_ratio >= args.target_ratio),
+        "cascade_routing": snap,
+        "escalation_rate": snap["escalation_rate"],
+        "recompiles_post_warmup": int(
+            telemetry.compile_watch.recompiles.value),
+    })
+    telemetry.close()
+    flush()
+    print(strict_dumps({
+        "median_round_ratio": report["median_round_ratio"],
+        "cascade_beats_target": report["cascade_beats_target"],
+        "escalation_rate": report["escalation_rate"],
+        "ap_within_tolerance":
+            report["quality"]["within_tolerance"],
+        "recompiles_post_warmup": report["recompiles_post_warmup"]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,41 +1,165 @@
 #!/usr/bin/env python
-"""Export the jitted forward as a serialized jax.export artifact.
+"""Export a serving program as a serialized jax.export artifact, with a
+graftaudit-fingerprinted manifest and an export GATE against the blessed
+PROGRAM_AUDIT.json.
 
-The artifact contains the StableHLO program + calling convention; a server
-reloads it with ``jax.export.deserialize(blob).call(variables, images)``
-without importing this package's model code.
+The artifact contains the StableHLO program + calling convention; a
+server reloads it with ``jax.export.deserialize(blob).call(...)``
+without importing this package's model code.  Three program families:
+
+- ``--program forward``: the bare last-stack forward (the legacy
+  artifact) — call ``(variables, images (N,H,W,3))``;
+- ``--program compact``: the compact serve program for one padded
+  bucket shape — call ``(variables, img, valid_h, valid_w)``;
+- ``--program decode``: the FUSED end-to-end decode serve program
+  (forward + compact extraction + greedy assembly — the cascade tiers'
+  actual serving program); same calling convention as compact.
+
+For compact/decode, ``--size`` is the PADDED bucket/lane shape (the
+``serve.warmup`` precompile unit), rounded up to the predictor's bucket
+multiple; ``--batch N`` exports the N-lane pow2-chunk program instead of
+the singleton flush.  ``--dtype bf16`` casts the checkpoint's fp32
+params to bf16 storage first — the quantized student artifact.
+
+Every export writes ``<out>.manifest.json`` stamping the compiled
+graftaudit fingerprint (flops, bytes, aliases, HLO instruction count —
+``analysis.program.fingerprint``) of the EXACT program serialized.  With
+``--audit-program <registry name>`` the export is GATED: the fingerprint
+is diffed against that program's entry in the committed
+PROGRAM_AUDIT.json and the export REFUSES on divergence — the audit
+golden becomes a deploy gate, so an artifact whose compiled program
+drifted from what was reviewed (a new transfer, a lost donation alias, a
+cost jump) can never ship silently.  A golden recorded under a different
+jax version gates as a warning (structural fingerprints are
+version-exact), mirroring ``tools/program_audit.py``.
 
     python tools/export_model.py --config canonical \
         --checkpoint checkpoints/epoch_99 --out posenet.jaxexport
+    python tools/export_model.py --config tiny_student --dtype bf16 \
+        --program decode --size 128 \
+        --audit-program student_serve_decode_b1 --out student.jaxexport
 """
 import argparse
+import hashlib
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from improved_body_parts_tpu.obs.events import strict_dump  # noqa: E402
+
+
+def _load_golden_fingerprint(name: str):
+    """Resolve the blessed entry for ``name`` — called BEFORE the
+    expensive compile, so a missing/unblessed program refuses in
+    seconds.  Returns (golden dict, its compiled fingerprint)."""
+    import json
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    golden_path = os.path.join(root, "PROGRAM_AUDIT.json")
+    if not os.path.exists(golden_path):
+        raise SystemExit(f"--audit-program: no blessed golden at "
+                         f"{golden_path} — run tools/program_audit.py "
+                         "--bless first")
+    with open(golden_path) as f:
+        golden = json.load(f)
+    entry = golden.get("programs", {}).get(name)
+    if entry is None:
+        raise SystemExit(
+            f"--audit-program {name}: not in the blessed "
+            "PROGRAM_AUDIT.json — register the program "
+            "(analysis.program.registry) and bless it before exporting")
+    golden_fp = entry.get("fingerprint", {}).get("compiled")
+    if not golden_fp:
+        raise SystemExit(
+            f"--audit-program {name}: the golden entry has no "
+            "compiled-level fingerprint — re-bless with "
+            "tools/program_audit.py --bless (full compile sweep)")
+    return golden, golden_fp
+
+
+def _audit_gate(name: str, golden, golden_fp, fingerprint: dict,
+                jax_version: str):
+    """Diff ``fingerprint`` against the blessed golden entry; returns
+    the gate-status string or raises SystemExit on divergence."""
+    from improved_body_parts_tpu.analysis.program.config import (
+        load_audit_config)
+    from improved_body_parts_tpu.analysis.program.fingerprint import (
+        compare_compiled)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = load_audit_config(root)
+    drift = compare_compiled(golden_fp, fingerprint,
+                             cfg.cost_tolerance_pct)
+    if not drift:
+        return "passed"
+    fields = ", ".join(
+        f"{d['field']} {d['golden']!r}->{d['current']!r}"
+        + (f" ({d['drift_pct']}%)" if d.get("drift_pct") else "")
+        for d in drift)
+    if golden.get("jax_version") != jax_version:
+        # structural fingerprints are version-exact; a cross-version
+        # golden still gates, but as a warning (the program_audit rule)
+        print(f"WARNING: fingerprint differs from the golden (recorded "
+              f"under jax {golden.get('jax_version')}, running "
+              f"{jax_version}): {fields}", file=sys.stderr)
+        return "version-mismatch-warning"
+    raise SystemExit(
+        f"export REFUSED: compiled fingerprint of the exported program "
+        f"diverges from the blessed '{name}' entry — {fields}. If the "
+        "change is intentional, re-bless with tools/program_audit.py "
+        "--bless and re-export.")
+
 
 def main():
-    ap = argparse.ArgumentParser(description="serialize the jitted forward")
+    ap = argparse.ArgumentParser(
+        description="serialize a serving program (jax.export) with a "
+                    "graftaudit-fingerprinted, gateable manifest")
     ap.add_argument("--config", default="canonical")
     ap.add_argument("--checkpoint", default=None,
                     help="orbax checkpoint dir (omit: fresh init — useful "
                          "for shape/ABI checks)")
     ap.add_argument("--size", type=int, default=None,
-                    help="input H=W (default: the config's)")
+                    help="forward: input H=W (default: the config's); "
+                         "compact/decode: the padded bucket shape, "
+                         "rounded up to the predictor's bucket multiple")
+    ap.add_argument("--program", default="forward",
+                    choices=("forward", "compact", "decode"),
+                    help="program family to export (decode = the fused "
+                         "serve program the cascade tiers dispatch)")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="compact/decode: export the N-lane pow2-chunk "
+                         "batch program (default: the singleton-flush "
+                         "program)")
+    ap.add_argument("--dtype", default="fp32",
+                    choices=("fp32", "bf16"),
+                    help="parameter storage dtype of the artifact "
+                         "(bf16 = the quantized fast-tier artifact; "
+                         "compute dtype follows the config regardless)")
+    ap.add_argument("--audit-program", default=None, metavar="NAME",
+                    help="GATE the export on this registry program's "
+                         "blessed PROGRAM_AUDIT.json entry: refuse when "
+                         "the exported program's compiled fingerprint "
+                         "diverges from the golden")
     ap.add_argument("--out", required=True)
     args = ap.parse_args()
 
     import jax
 
-    from improved_body_parts_tpu.utils import (
-        apply_platform_env, export_serialized)
+    from improved_body_parts_tpu.utils import apply_platform_env
     apply_platform_env()
 
     import jax.numpy as jnp
+    import numpy as np
 
     from improved_body_parts_tpu.config import get_config
     from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.utils.precision import resolve_params_dtype
+
+    golden = golden_fp = None
+    if args.audit_program:
+        # fail fast on an unblessed program BEFORE paying the compile
+        golden, golden_fp = _load_golden_fingerprint(args.audit_program)
 
     cfg = get_config(args.config)
     size = args.size or cfg.skeleton.height
@@ -50,9 +174,90 @@ def main():
                      "batch_stats": payload["batch_stats"]}
     else:
         variables = model.init(jax.random.PRNGKey(0), imgs, train=False)
-    path = export_serialized(model, variables, imgs, args.out)
-    print(f"exported {args.config} @{size}px -> {path} "
-          f"({os.path.getsize(path):,} bytes)")
+    variables = resolve_params_dtype(args.dtype, variables)
+
+    from jax import export as jexport
+
+    if args.program == "forward":
+        if args.batch is not None:
+            raise SystemExit("--batch applies to the compact/decode "
+                             "serve programs; the forward artifact is "
+                             "batch-polymorphic by shape")
+
+        def forward(variables, imgs):
+            return model.apply(variables, imgs, train=False)[-1][0]
+
+        fn = jax.jit(forward)
+        call_args = (variables, imgs)
+    else:
+        from improved_body_parts_tpu.infer.predict import Predictor
+
+        pred = Predictor(model, variables, cfg.skeleton)
+        b = pred.bucket
+        h = w = size + (-size) % b  # the padded bucket/lane shape
+        program = (pred.decode_program if args.program == "decode"
+                   else pred.compact_program)
+        fn = program((h, w), batch=args.batch)
+        if args.batch is None:
+            call_args = (variables,
+                         jnp.zeros((h, w, 3), jnp.float32),
+                         np.int32(h), np.int32(w))
+        else:
+            n = int(args.batch)
+            call_args = (variables,
+                         jnp.zeros((n, h, w, 3), jnp.float32),
+                         np.full((n,), h, np.int32),
+                         np.full((n,), w, np.int32))
+        size = h
+
+    # the compiled graftaudit fingerprint of the EXACT program being
+    # serialized — what the manifest stamps and the gate diffs
+    from improved_body_parts_tpu.analysis.program.audit import (
+        GRAFTAUDIT_VERSION, audit_ruleset_hash)
+    from improved_body_parts_tpu.analysis.program.compiled import (
+        compile_program)
+    from improved_body_parts_tpu.analysis.program.fingerprint import (
+        compiled_fingerprint)
+    from improved_body_parts_tpu.analysis.program.registry import (
+        BuiltProgram)
+
+    info, _ = compile_program(BuiltProgram(fn=fn, args=call_args))
+    fingerprint = compiled_fingerprint(info)
+
+    gate_status = "not-gated (no --audit-program)"
+    if args.audit_program:
+        gate_status = _audit_gate(args.audit_program, golden, golden_fp,
+                                  fingerprint, jax.__version__)
+
+    exported = jexport.export(fn, platforms=["cpu", "tpu"])(*call_args)
+    with open(args.out, "wb") as f:
+        f.write(exported.serialize())
+
+    with open(args.out, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()
+    manifest = {
+        "artifact": os.path.basename(args.out),
+        "bytes": os.path.getsize(args.out),
+        "sha256": digest,
+        "config": args.config,
+        "program": args.program,
+        "size": size,
+        "batch": args.batch,
+        "params_dtype": args.dtype,
+        "jax_version": jax.__version__,
+        "graftaudit": {"version": GRAFTAUDIT_VERSION,
+                       "ruleset": audit_ruleset_hash(),
+                       "compiled_fingerprint": fingerprint},
+        "audit_gate": {"program": args.audit_program,
+                       "status": gate_status},
+    }
+    manifest_path = args.out + ".manifest.json"
+    with open(manifest_path, "w") as f:
+        strict_dump(manifest, f, indent=2)
+    print(f"exported {args.config}/{args.program} @{size}px "
+          f"dtype={args.dtype} -> {args.out} "
+          f"({os.path.getsize(args.out):,} bytes); manifest "
+          f"{manifest_path} (audit gate: {gate_status})")
 
 
 if __name__ == "__main__":

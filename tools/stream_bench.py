@@ -294,17 +294,10 @@ def main():
                                  smoothing=smoothing,
                                  max_in_flight=args.max_in_flight,
                                  policy=args.policy)
-        # non-pow2 occupancies flush as pow2 chunks joined by an
-        # on-device row-concat program — a shape the (bucket x pow2)
-        # precompile cannot reach; dispatch each one once, untimed, so
-        # the timed rounds can never pay its first compile
-        warm_img = videos[0].frame(0)
-        for n in range(3, args.max_batch + 1):
-            if n & (n - 1):
-                pred.predict_decoded_batch_async(
-                    [warm_img] * n, thre1=pred.params.thre1,
-                    params=pred.params)()
-        # one untimed traffic slice on top (the sessions' own paths)
+        # non-pow2 chunk-join occupancies are warmed by server.warmup
+        # itself now (the shared serve.warmup path absorbed this
+        # bench's PR 10 finding); one untimed traffic slice on top
+        # (the sessions' own paths)
         run_streams(manager, videos, max(4, args.max_batch), args.policy)
         telemetry.mark_warm("stream warmup precompile + warm slice")
         rounds = []

@@ -149,6 +149,31 @@ def main():
                          "the new device count, reported loudly), "
                          "'refuse' errors out. Default: refuse for plain "
                          "resumes, adjust under --supervised")
+    # heatmap distillation (train.distill; TRAINING.md "Distillation +
+    # cascade")
+    ap.add_argument("--distill-from", default=None, metavar="CKPT",
+                    help="train THIS config as a distilled student: "
+                         "load the teacher's checkpoint (an orbax epoch "
+                         "dir) and blend the supervised focal-L2 with a "
+                         "focal-L2 against the teacher's heatmaps, "
+                         "alpha*gt + (1-alpha)*teacher, teacher forward "
+                         "folded into the jitted step (frozen, "
+                         "non-donated). Requires --teacher-config")
+    ap.add_argument("--teacher-config", default=None,
+                    help="config name of the TEACHER architecture the "
+                         "--distill-from checkpoint was trained with "
+                         "(the student is --config); skeletons must "
+                         "match — only width/stacks may differ")
+    ap.add_argument("--distill-alpha", type=float, default=None,
+                    help="GT blend weight (default: the config's "
+                         "distill_alpha, normally 0.5; 1.0 = plain "
+                         "supervised training)")
+    ap.add_argument("--distill-alpha-warmup", type=int, default=None,
+                    metavar="STEPS",
+                    help="ramp alpha linearly from 1.0 (pure GT) to "
+                         "--distill-alpha over the first N steps "
+                         "(default: the config's "
+                         "distill_alpha_warmup_steps; 0 = constant)")
     # GSPMD partitioned training (parallel.partition; TRAINING.md §1d)
     ap.add_argument("--partition", action="store_true",
                     help="run the fully GSPMD-partitioned train step: "
@@ -225,7 +250,9 @@ def main():
             or args.sync_checkpoint or args.keep_last_n is not None
             or args.milestone_every is not None or args.partition
             or args.partition_rules or args.mesh_model is not None
-            or args.lr_batch_ref is not None):
+            or args.lr_batch_ref is not None
+            or args.distill_alpha is not None
+            or args.distill_alpha_warmup is not None):
         import dataclasses
 
         overrides = {}
@@ -240,6 +267,13 @@ def main():
             overrides["mesh_model_axis"] = args.mesh_model
         if args.lr_batch_ref is not None:
             overrides["lr_batch_ref"] = args.lr_batch_ref
+        # the alpha schedule folds into the config: the jitted distill
+        # step reads it at trace time (same rule as on_divergence)
+        if args.distill_alpha is not None:
+            overrides["distill_alpha"] = args.distill_alpha
+        if args.distill_alpha_warmup is not None:
+            overrides["distill_alpha_warmup_steps"] = \
+                args.distill_alpha_warmup
         if args.checkpoint_dir:
             overrides["checkpoint_dir"] = args.checkpoint_dir
         if args.lr:
@@ -269,6 +303,39 @@ def main():
             overrides["milestone_every"] = args.milestone_every
         cfg = cfg.replace(train=dataclasses.replace(cfg.train, **overrides))
 
+    if args.distill_from:
+        # the distillation step composes with the replicated training
+        # stack (supervisor/checkpoint/telemetry unchanged); the modes
+        # that change the step's pytree or signature are excluded
+        # loudly rather than silently ignored
+        if not args.teacher_config:
+            raise SystemExit("--distill-from requires --teacher-config "
+                             "(the teacher checkpoint's architecture; "
+                             "the student is --config)")
+        if args.swa:
+            raise SystemExit("--distill-from covers the main fit; run "
+                             "the SWA stage on the distilled student "
+                             "without it")
+        if cfg.train.partition:
+            raise SystemExit("--distill-from runs the replicated step "
+                             "(the student is small — that is the "
+                             "point); drop --partition")
+        if args.device_gt:
+            raise SystemExit("--distill-from needs host GT label maps "
+                             "(the blend's supervised term); drop "
+                             "--device-gt")
+        # checked HERE, before any dataset/device work: the skeletons
+        # must match channel for channel or the blend is meaningless
+        if get_config(args.teacher_config).skeleton != cfg.skeleton:
+            raise SystemExit(
+                f"teacher config '{args.teacher_config}' has a "
+                f"different skeleton than student '{args.config}' — "
+                "distillation blends heatmaps channel for channel, the "
+                "skeletons must match (only width/stacks may differ)")
+    elif args.teacher_config or args.distill_alpha is not None \
+            or args.distill_alpha_warmup is not None:
+        raise SystemExit("--teacher-config/--distill-alpha/"
+                         "--distill-alpha-warmup require --distill-from")
     if not cfg.train.partition and (args.mesh_model is not None
                                     or args.partition_rules):
         # these flags only take effect on the partitioned path — an
@@ -545,14 +612,40 @@ def main():
     # `telemetry_wanted` is process-symmetric, so all hosts compile the
     # same step program; read back only at window readbacks
     with_health = telemetry_wanted
-    # SWA freezes BatchNorm (train_distributed_SWA.py:219-221)
-    train_step = make_train_step(model, cfg, optimizer, use_focal=use_focal,
-                                 freeze_bn=args.swa,
-                                 device_gt=args.device_gt > 0,
-                                 health=with_health,
-                                 mesh=mesh if partitioned else None,
-                                 rules=rules,
-                                 state_shardings=state_shardings)
+    if args.distill_from:
+        # student distillation: the frozen teacher's forward folds into
+        # the jitted step; its variables ride as a real (non-donated)
+        # program argument bound outside the jit, so the loop still
+        # sees the standard (state, *batch) contract and the
+        # supervisor/checkpoint/telemetry stack is untouched
+        from improved_body_parts_tpu.train import (
+            bind_teacher, make_distill_train_step)
+
+        teacher_cfg = get_config(args.teacher_config)
+        teacher_model = build_model(teacher_cfg)
+        payload = restore_checkpoint(args.distill_from)
+        teacher_vars = jax.device_put(
+            {"params": payload["params"],
+             "batch_stats": payload["batch_stats"]}, replicated(mesh))
+        print(f"distilling from {args.distill_from} "
+              f"(teacher {args.teacher_config}, "
+              f"alpha {cfg.train.distill_alpha}, "
+              f"warmup {cfg.train.distill_alpha_warmup_steps} steps)")
+        train_step = bind_teacher(
+            make_distill_train_step(model, teacher_model, cfg, optimizer,
+                                    use_focal=use_focal,
+                                    health=with_health),
+            teacher_vars)
+    else:
+        # SWA freezes BatchNorm (train_distributed_SWA.py:219-221)
+        train_step = make_train_step(
+            model, cfg, optimizer, use_focal=use_focal,
+            freeze_bn=args.swa,
+            device_gt=args.device_gt > 0,
+            health=with_health,
+            mesh=mesh if partitioned else None,
+            rules=rules,
+            state_shardings=state_shardings)
     eval_step = make_eval_step(model, cfg, use_focal=use_focal)
     is_lead = args.process_id == 0
 
