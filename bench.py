@@ -682,6 +682,55 @@ def _cascade_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _slo_summary(fallback, budget_s):
+    """Run tools/latency_audit.py --quick (the request-tracing + SLO
+    layer's proof sweep: per-hop conservation, causal completeness
+    under failover/hedge churn, reqtrace overhead, recompile check) and
+    return the gates, or an {"error"/"skipped"} marker — the
+    "serve"/"decode" key contract.  Subprocess so an audit failure can
+    never take down the primary metric; the committed
+    LATENCY_AUDIT.json carries the full protocol run.
+    ``IBP_BENCH_SLO=0`` skips it unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_SLO") == "0":
+        return {"skipped": "IBP_BENCH_SLO=0"}
+    if budget_s < 240:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (LATENCY_AUDIT.json has the full "
+                           "run)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="slo_"),
+                       "LATENCY_AUDIT.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"  # CPU protocol — never claims the chip
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "latency_audit.py"),
+             "--quick", "--out", out],
+            capture_output=True, timeout=min(600, budget_s), check=True,
+            env=env)
+        with open(out) as f:
+            r = json.load(f)
+        return {
+            "gates": r["gates"],
+            "plain_conservation":
+                r["plain_serve"]["registry_conservation_frac"],
+            "chain_coverage_p50":
+                r["plain_serve"]["chain_coverage_p50"],
+            "failover_edges": r["chaos"]["failover_edges"],
+            "hedge_edges": r["chaos"]["hedge_edges"],
+            "reqtrace_overhead_pct":
+                r["reqtrace_overhead"]["overhead_pct"],
+            "recompiles_post_warmup": r["recompiles_post_warmup"],
+            "slo_status": r["slo"]["status"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def _lint_summary(budget_s):
     """Run tools/lint.py (the graftlint static-analysis gate) and return
     finding counts by severity, or an {"error"/"skipped"} marker — the
@@ -809,6 +858,10 @@ def main():
     # same discipline
     cascade = _cascade_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # request-path tracing + SLO layer (hop conservation, causal
+    # completeness, reqtrace overhead), same discipline
+    slo = _slo_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     # static-analysis gate (graftlint), same discipline
     lint = _lint_summary(
         TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
@@ -834,6 +887,7 @@ def main():
         "servechaos": servechaos,
         "scaling": scaling,
         "cascade": cascade,
+        "slo": slo,
         "lint": lint,
         "audit": audit,
         "provenance": _provenance(),

@@ -52,7 +52,14 @@ class HiddenHostSync(Rule):
              # the parallel tree: device_prefetch's producer thread runs
              # per batch, and the ISSUE 12 partition module's
              # sharding/resharding helpers sit on the train entry path
-             "improved_body_parts_tpu/parallel")
+             "improved_body_parts_tpu/parallel",
+             # the ISSUE 15 per-request observability layer: reqtrace
+             # nodes are opened/finished and SLO outcomes recorded ON
+             # the serve threads for every request — the same hot-path
+             # discipline as the engines themselves (the rest of obs/
+             # is scrape-time/export code and stays out of scope)
+             "improved_body_parts_tpu/obs/reqtrace.py",
+             "improved_body_parts_tpu/obs/slo.py")
 
     def check(self, ctx: ModuleContext) -> None:
         if not ctx.under(*self.SCOPE):

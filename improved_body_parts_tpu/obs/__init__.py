@@ -21,6 +21,13 @@ package replaces that with one process-wide pipeline every layer shares:
 - :mod:`health`   — loss/grad-norm divergence sentinel with a
   configurable ``warn|halt|skip_step`` policy (``HealthSentinel``,
   ``DivergenceError``);
+- :mod:`reqtrace` — request-scoped causal tracing across the multi-hop
+  serve stack: per-request trees with reason-annotated hop edges and
+  per-hop waterfalls (``ReqTrace``, process-default ``set_reqtrace``;
+  reporter: ``tools/request_report.py``);
+- :mod:`slo`      — declarative per-QoS-class latency/availability
+  objectives, multi-window burn rate, error budgets and alarm events
+  (``SLOTracker``, ``Objective``; served at ``/slo``);
 - :mod:`run`      — the per-run bundle (``RunTelemetry``).
 
 ``tools/telemetry_report.py`` folds a run's JSONL stream into a
@@ -49,7 +56,14 @@ from .registry import (
     StepPhases,
     get_registry,
 )
+from .reqtrace import (
+    NullReqTrace,
+    ReqTrace,
+    get_reqtrace,
+    set_reqtrace,
+)
 from .run import RunTelemetry, resolve_sink_path
+from .slo import Objective, SLOTracker, default_objectives
 from .trace import (
     NullTraceRecorder,
     TraceRecorder,
@@ -64,5 +78,6 @@ __all__ = [
     "get_registry", "RunTelemetry", "resolve_sink_path",
     "POLICIES", "DivergenceError", "HealthSentinel", "DeviceMemory",
     "NullTraceRecorder", "TraceRecorder", "get_tracer", "set_tracer",
-    "INPUT_BOUND_FRAC",
+    "INPUT_BOUND_FRAC", "NullReqTrace", "ReqTrace", "get_reqtrace",
+    "set_reqtrace", "Objective", "SLOTracker", "default_objectives",
 ]

@@ -1,4 +1,5 @@
-"""Background metrics endpoint: ``/metrics`` + ``/snapshot`` + ``/healthz``.
+"""Background metrics endpoint: ``/metrics`` + ``/snapshot`` +
+``/healthz`` + ``/slo``.
 
 A daemon-threaded ``ThreadingHTTPServer`` over one :class:`Registry`:
 
@@ -11,7 +12,13 @@ A daemon-threaded ``ThreadingHTTPServer`` over one :class:`Registry`:
   with ``{"status": "ok", ...}`` while healthy, 503 once the latest
   window diverged — the contract a stock load-balancer / liveness probe
   expects.  Without a health source the route answers 200/"ok" (the
-  endpoint being up is the only health there is).
+  endpoint being up is the only health there is);
+- ``GET /slo``      → the SLO/error-budget document from the
+  caller-supplied ``slo`` callable (``obs.slo.SLOTracker.state``):
+  per-class burn rates, budget remaining and alarm level — what the
+  autoscaler / deploy gate polls.  HTTP 200 while every class is
+  within budget, 503 while any alarm fires (so a dumb threshold-less
+  consumer can gate on status alone); 404 when no tracker was wired.
 
 ``HEAD`` is answered for every route with the same status and headers
 and no body — LB probes default to HEAD, and an unanswered method must
@@ -39,12 +46,14 @@ class MetricsServer:
     def __init__(self, registry: Registry, port: int = 0,
                  host: str = "127.0.0.1",
                  extra: Optional[Callable[[], dict]] = None,
-                 health: Optional[Callable[[], dict]] = None):
+                 health: Optional[Callable[[], dict]] = None,
+                 slo: Optional[Callable[[], dict]] = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         reg = registry
         extra_fn = extra
         health_fn = health
+        slo_fn = slo
 
         class Handler(BaseHTTPRequestHandler):
             def _handle(self):
@@ -73,10 +82,23 @@ class MetricsServer:
                         body = json.dumps(_definan(state), indent=2,
                                           default=str).encode()
                         ctype = "application/json"
+                    elif path == "/slo":
+                        if slo_fn is None:
+                            self.send_error(
+                                404, "no SLO tracker wired on this "
+                                     "endpoint")
+                            return
+                        state = dict(slo_fn())
+                        code = 200 if state.get("status", "ok") != \
+                            "alarm" else 503
+                        body = json.dumps(_definan(state), indent=2,
+                                          default=str).encode()
+                        ctype = "application/json"
                     else:
                         # send_error handles HEAD itself (headers, no body)
                         self.send_error(
-                            404, "use /metrics, /snapshot or /healthz")
+                            404, "use /metrics, /snapshot, /healthz or "
+                                 "/slo")
                         return
                 except Exception as e:  # noqa: BLE001 — a scrape bug
                     # must 500, not kill the handler thread silently

@@ -32,6 +32,7 @@ from .http import MetricsServer
 from .memory import DeviceMemory
 from .recompile import CompileWatch
 from .registry import Registry, StepPhases, get_registry
+from .reqtrace import NullReqTrace, ReqTrace, set_reqtrace
 from .trace import NullTraceRecorder, TraceRecorder, set_tracer
 
 
@@ -47,7 +48,9 @@ class RunTelemetry:
                  trace: Optional[bool] = None,
                  trace_capacity: int = 65536,
                  on_divergence: str = "warn",
-                 grad_norm_limit: float = 0.0):
+                 grad_norm_limit: float = 0.0,
+                 reqtrace_sample: Optional[int] = None,
+                 slo=None):
         self.registry = registry if registry is not None else get_registry()
         self.sink = (EventSink(sink_path, run_meta=run_meta)
                      if sink_path else NullSink())
@@ -74,6 +77,28 @@ class RunTelemetry:
         if self.trace.enabled:
             self._prev_tracer = set_tracer(self.trace)
             self._installed_tracer = True
+            # satellite: a lossy ring must be visible on /metrics, not
+            # only as a stamp buried in the export
+            self.trace.attach_registry(self.registry)
+        # request-scoped causal tracing (obs.reqtrace): on whenever the
+        # sink is (records emit through it), like the span trace; 0
+        # forces it off, N samples every Nth request
+        if reqtrace_sample is None:
+            reqtrace_sample = 1 if self.sink.enabled else 0
+        self.reqtrace = (ReqTrace(sample=reqtrace_sample,
+                                  t0=self.sink.t0)
+                         if reqtrace_sample >= 1 else NullReqTrace())
+        self._prev_reqtrace = None
+        self._installed_reqtrace = False
+        if self.reqtrace.enabled:
+            self._prev_reqtrace = set_reqtrace(self.reqtrace)
+            self._installed_reqtrace = True
+            self.reqtrace.attach_registry(self.registry)
+        # optional SLO tracker (obs.slo): registered for exposition and
+        # served at /slo when the endpoint is up
+        self.slo = slo
+        if slo is not None:
+            slo.register_into(self.registry)
         # device-memory accounting (graceful no-op on statless backends)
         self.memory = DeviceMemory(self.registry, self.sink)
         # run-health sentinel; its state backs the endpoint's /healthz
@@ -86,7 +111,9 @@ class RunTelemetry:
         self.step_sample = max(1, int(step_sample))
         self.server = (MetricsServer(self.registry, port=http_port,
                                      extra=self._server_extra,
-                                     health=self.health.state)
+                                     health=self.health.state,
+                                     slo=(slo.state if slo is not None
+                                          else None))
                        if http_port is not None and http_port >= 0 else None)
         self._phases: Dict[str, StepPhases] = {}
         self._closed = False
@@ -125,6 +152,8 @@ class RunTelemetry:
             path = self.trace.save(self.trace_path)
             self.sink.emit("trace_export", path=path, events=n,
                            dropped=self.trace.dropped)
+        if self._installed_reqtrace:
+            set_reqtrace(self._prev_reqtrace)
         if self._installed_tracer:
             set_tracer(self._prev_tracer)
         if self._installed_sink:

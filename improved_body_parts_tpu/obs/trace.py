@@ -87,10 +87,16 @@ class NullTraceRecorder:
     def async_end(self, name, id, cat="async", args=None) -> None:
         pass
 
-    def flow_start(self, name, id, track=None, ts=None) -> None:
+    def flow_start(self, name, id, track=None, ts=None, cat="flow") -> None:
         pass
 
-    def flow_finish(self, name, id, track=None, ts=None) -> None:
+    def flow_step(self, name, id, track=None, ts=None, cat="flow") -> None:
+        pass
+
+    def flow_finish(self, name, id, track=None, ts=None, cat="flow") -> None:
+        pass
+
+    def attach_registry(self, registry) -> None:
         pass
 
     def events(self) -> List[dict]:
@@ -207,16 +213,50 @@ class TraceRecorder:
                    int(id), args))
 
     def flow_start(self, name: str, id: int, track: Optional[str] = None,
-                   ts: Optional[float] = None) -> None:
+                   ts: Optional[float] = None, cat: str = "flow") -> None:
         """Start a flow arrow (binds to the slice enclosing ``ts`` on the
-        recording track)."""
-        self._put(("s", "flow", name, self._tid(track),
+        recording track).  ``cat`` namespaces the id: flows bind by
+        (cat, id), so independent id counters (the batcher's rids, the
+        reqtrace request ids) must not share one category."""
+        self._put(("s", cat, name, self._tid(track),
+                   self.now() if ts is None else ts, None, int(id), None))
+
+    def flow_step(self, name: str, id: int, track: Optional[str] = None,
+                  ts: Optional[float] = None, cat: str = "flow") -> None:
+        """Intermediate flow point ("t" phase): the arrow threads
+        through the slice enclosing ``ts`` — what makes a multi-hop
+        request ONE followable arc across engine tracks."""
+        self._put(("t", cat, name, self._tid(track),
                    self.now() if ts is None else ts, None, int(id), None))
 
     def flow_finish(self, name: str, id: int, track: Optional[str] = None,
-                    ts: Optional[float] = None) -> None:
-        self._put(("f", "flow", name, self._tid(track),
+                    ts: Optional[float] = None, cat: str = "flow") -> None:
+        self._put(("f", cat, name, self._tid(track),
                    self.now() if ts is None else ts, None, int(id), None))
+
+    def attach_registry(self, registry) -> None:
+        """Expose the ring's drop accounting on a shared
+        ``obs.Registry`` (weakref collector): a lossy trace previously
+        only stamped its drops into the export's ``otherData`` — a
+        consumer watching ``/metrics`` could mistake a truncated
+        timeline for a complete one.  ``trace_spans_dropped_total``
+        growing during a run is the live signal to raise ``capacity``
+        (or accept the loss knowingly)."""
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def _collect():
+            t = ref()
+            if t is None:
+                return []
+            return [
+                ("trace_spans_dropped_total", {}, "counter",
+                 float(t.dropped)),
+                ("trace_spans_recorded", {}, "gauge", float(t.recorded)),
+            ]
+
+        registry.register_collector(_collect)
 
     # ----------------------------------------------------------- export
     @property

@@ -54,8 +54,9 @@ import numpy as np
 
 from ..config import InferenceParams, SkeletonConfig
 from ..infer.pipeline import compact_decode_fn
+from ..obs.reqtrace import NULL_NODE, get_reqtrace
 from ..obs.trace import get_tracer
-from .metrics import ServeMetrics
+from .metrics import HOPS, ServeMetrics
 from .warmup import precompile
 
 _STOP = object()
@@ -83,7 +84,8 @@ class DeadlineExceeded(RuntimeError):
 
 class _Request:
     __slots__ = ("image", "future", "t_submit", "deadline", "finished",
-                 "rid")
+                 "rid", "ctx", "t_bucket", "t_dispatch", "t_exec",
+                 "t_decode", "replica")
 
     def __init__(self, image: np.ndarray,
                  deadline_s: Optional[float] = None):
@@ -97,6 +99,15 @@ class _Request:
                          else self.t_submit + deadline_s)
         self.finished = False  # server-side once-flag (see _finish)
         self.rid = next(_RID)  # trace flow/async-span key
+        self.ctx = NULL_NODE   # reqtrace node (obs.reqtrace)
+        # hop-waterfall boundary stamps (perf_counter): each stage
+        # stamps its exit, so consecutive differences PARTITION the
+        # submit→finish window — see serve.metrics.HOPS
+        self.t_bucket: Optional[float] = None
+        self.t_dispatch: Optional[float] = None
+        self.t_exec: Optional[float] = None
+        self.t_decode: Optional[float] = None
+        self.replica = 0
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
@@ -132,7 +143,8 @@ class DynamicBatcher:
                  eager_idle_flush: bool = True,
                  metrics: Optional[ServeMetrics] = None,
                  registry=None, device_decode: bool = True,
-                 emit_signals: bool = False):
+                 emit_signals: bool = False, slo=None,
+                 qos_class: str = "interactive"):
         from ..infer.predict import trivial_grid
 
         self.predictor = predictor
@@ -156,6 +168,12 @@ class DynamicBatcher:
         # flush behavior deterministic for tests.
         self.eager_idle_flush = eager_idle_flush
         self.metrics = metrics or ServeMetrics()
+        # optional SLO wiring (obs.slo.SLOTracker): every finished
+        # request recorded under this engine's QoS class.  Attach at
+        # ONE layer per deployment — a pool/policy above an slo-wired
+        # batcher would double-count the same request.
+        self._slo = slo
+        self._qos_class = qos_class
         if registry is not None:
             # one exposition path for serve + train: the batcher's
             # counters/reservoirs surface on the shared /metrics endpoint
@@ -403,6 +421,15 @@ class DynamicBatcher:
                 f"{self.max_queue} requests in flight (max_queue); "
                 "retry with backoff")
         req = _Request(image_bgr, deadline_s)
+        rt = get_reqtrace()
+        if rt.enabled:
+            # root when the caller is a bare client; child of the
+            # submitting layer's node (pool route, policy attempt,
+            # cascade lane, stream frame) when this submit runs inside
+            # its child_scope — the cross-hop causal link
+            req.ctx = rt.begin(
+                "batcher", **({"model": self.metrics.model}
+                              if self.metrics.model else {}))
         with self._finish_lock:
             self._inflight_reqs.add(req)
         trace = get_tracer()
@@ -535,6 +562,7 @@ class DynamicBatcher:
                     # image must fail ITS future, never the dispatcher
                     self._finish(item, error=e)
                     continue
+                item.t_bucket = time.perf_counter()  # queue hop ends
                 bucket = pending.setdefault(key, [])
                 bucket.append(item)
                 if len(bucket) >= self.max_batch:
@@ -586,6 +614,10 @@ class DynamicBatcher:
         with self._in_flight_lock:
             idx = min(range(len(self._replicas)),
                       key=self._in_flight.__getitem__)
+        t_dispatch = time.perf_counter()  # batch_formation hop ends
+        for r in reqs:
+            r.t_dispatch = t_dispatch
+            r.replica = idx
         replica = self._replicas[idx]
         if self.device_decode:
             dispatch_one = replica.predict_decoded_async
@@ -650,6 +682,9 @@ class DynamicBatcher:
                 for r in reqs:
                     self._finish(r, error=e)
                 continue
+            t_fetched = time.perf_counter()  # device hop ends
+            for r in reqs:
+                r.t_exec = t_fetched
             if trace.enabled:
                 trace.add_span_rel("execute", t_exec,
                                    trace.now() - t_exec,
@@ -713,6 +748,7 @@ class DynamicBatcher:
             with get_tracer().span("decode", args={"rid": req.rid,
                                                    "lane": "device"}):
                 result = decode_device(res, self.skeleton)
+            req.t_decode = time.perf_counter()  # decode hop ends
             if self.emit_signals:
                 result = (result, signals)
             self._finish(req, result=result)
@@ -725,6 +761,7 @@ class DynamicBatcher:
             with get_tracer().span("decode", args={"rid": req.rid,
                                                    "lane": "host"}):
                 result = self._decode_one(res, req.image)
+            req.t_decode = time.perf_counter()  # decode hop ends
             if self.emit_signals:
                 result = (result, signals)
             self._finish(req, result=result)
@@ -743,18 +780,57 @@ class DynamicBatcher:
                 return
             req.finished = True
             self._inflight_reqs.discard(req)
+        # ONE end-of-life stamp shared by the hop waterfall, the e2e
+        # reservoir and the SLO record: measuring them at different
+        # instants would charge this function's own record-assembly
+        # work to the request and break the exact hop↔e2e conservation
+        t_fin = time.perf_counter()
+        if error is None and req.t_decode is not None:
+            # the hop waterfall: consecutive boundary stamps partition
+            # submit→here, so the five segments sum to the measured e2e
+            # by construction (the conservation discipline); fed for
+            # EVERY completed request — reqtrace sampling only thins
+            # the per-request records, never these reservoirs
+            durs = (req.t_bucket - req.t_submit,
+                    req.t_dispatch - req.t_bucket,
+                    req.t_exec - req.t_dispatch,
+                    req.t_decode - req.t_exec,
+                    t_fin - req.t_decode)
+            if req.ctx.sampled:
+                # finish BEFORE the reservoir updates: the node's end
+                # stamp must sit next to t_fin, not after ten meter
+                # updates — on sub-ms requests that gap alone would
+                # break the per-request conservation readout
+                req.ctx.finish("ok", hops=list(zip(HOPS, durs)),
+                               replica=req.replica)
+            self.metrics.on_hops(req.replica, durs)
+        elif req.ctx.sampled:
+            # error path: record what the request got through before it
+            # died (partial waterfall, stamps that exist)
+            stamps = [("queue", req.t_submit, req.t_bucket),
+                      ("batch_formation", req.t_bucket, req.t_dispatch),
+                      ("device", req.t_dispatch, req.t_exec),
+                      ("decode", req.t_exec, req.t_decode)]
+            hops = [(name, t1 - t0) for name, t0, t1 in stamps
+                    if t0 is not None and t1 is not None]
+            req.ctx.finish(
+                "ok" if error is None
+                else f"error:{type(error).__name__}",
+                hops=hops, replica=req.replica)
         trace = get_tracer()
         if trace.enabled:
             trace.async_end("request", req.rid, cat="serve",
                             args={"error": error is not None})
+        if self._slo is not None:
+            self._slo.record(self._qos_class, t_fin - req.t_submit,
+                             error=error is not None)
         try:
             if error is not None:
                 self.metrics.on_fail(
                     expired=isinstance(error, DeadlineExceeded))
                 req.future.set_exception(error)
             else:
-                self.metrics.on_complete(time.perf_counter()
-                                         - req.t_submit)
+                self.metrics.on_complete(t_fin - req.t_submit)
                 req.future.set_result(result)
         except Exception:  # noqa: BLE001 — future cancelled by caller;
             # the server-side work still completed and is accounted

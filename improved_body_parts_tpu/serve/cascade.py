@@ -42,6 +42,7 @@ from dataclasses import dataclass
 from concurrent.futures import Future
 from typing import Dict, Optional, Sequence, Tuple
 
+from ..obs.reqtrace import NULL_NODE, get_reqtrace
 from .batcher import DeadlineExceeded, DynamicBatcher, ServerOverloaded
 from .metrics import ServeMetrics
 
@@ -193,13 +194,25 @@ class CascadeMetrics:
 class _CascadeRequest:
     """Per-request routing state: the caller-facing future plus the
     absolute deadline the teacher leg inherits."""
-    __slots__ = ("image", "future", "deadline")
+    __slots__ = ("image", "future", "deadline", "ctx", "t0",
+                 "student_node", "teacher_node", "t_s_submit",
+                 "t_s_done", "t_t_submit", "t_t_done")
 
     def __init__(self, image, deadline_s: Optional[float]):
         self.image = image
         self.future: Future = Future()
+        self.t0 = time.perf_counter()
         self.deadline = (None if deadline_s is None
-                         else time.perf_counter() + deadline_s)
+                         else self.t0 + deadline_s)
+        self.ctx = NULL_NODE          # reqtrace node (obs.reqtrace)
+        self.student_node = None
+        self.teacher_node = None
+        # hop boundary stamps: route / student_lane / escalate /
+        # deliver bookends around the tiers' own spans
+        self.t_s_submit: Optional[float] = None
+        self.t_s_done: Optional[float] = None
+        self.t_t_submit: Optional[float] = None
+        self.t_t_done: Optional[float] = None
 
 
 class CascadeEngine:
@@ -339,8 +352,20 @@ class CascadeEngine:
                 "cascade is draining (shutdown in progress); retry "
                 "against a live instance")
         req = _CascadeRequest(image_bgr, deadline_s)
+        rt = get_reqtrace()
+        if rt.enabled:
+            req.ctx = rt.begin("cascade")
         # student admission FIRST: a shed must not count as submitted
-        sfut = self.student.submit(image_bgr, deadline_s=deadline_s)
+        try:
+            with req.ctx.child_scope("submit") as scope:
+                sfut = self.student.submit(image_bgr,
+                                           deadline_s=deadline_s)
+        except BaseException as e:  # noqa: BLE001 — re-raised: a shed
+            # opened no request; close the cascade node it did open
+            req.ctx.finish(f"error:{type(e).__name__}")
+            raise
+        req.student_node = scope.node
+        req.t_s_submit = time.perf_counter()
         self.metrics.on_submit()
         sfut.add_done_callback(lambda f: self._student_done(f, req))
         return req.future
@@ -349,47 +374,87 @@ class CascadeEngine:
     def _student_done(self, sfut: Future, req: _CascadeRequest) -> None:
         """Runs on the student engine's completion threads: route the
         answer or escalate."""
+        req.t_s_done = time.perf_counter()
         try:
             skeletons, signals = sfut.result()
         except BaseException as e:  # noqa: BLE001 — delivered on the future
-            self._finish(req, error=e)
+            self._finish(req, error=e, node=req.student_node)
             return
         reason = self.policy.reason(signals)
         if reason is None:
-            self._finish(req, result=skeletons, lane="student")
+            self._finish(req, result=skeletons, lane="student",
+                         node=req.student_node)
             return
         self.metrics.on_escalate(reason)
         remaining = (None if req.deadline is None
                      else req.deadline - time.perf_counter())
         try:
-            tfut = self.teacher.submit(req.image, deadline_s=remaining)
+            # the ESCALATE edge, annotated with WHY the fast tier's
+            # answer was not authoritative (people/overflow/score)
+            with req.ctx.child_scope("escalate", reason) as scope:
+                tfut = self.teacher.submit(req.image,
+                                           deadline_s=remaining)
         except DeadlineExceeded as e:
             # the caller's global deadline passed — delivering anything
             # now is pointless, and a retry elsewhere equally so
-            self._finish(req, error=e)
+            self._finish(req, error=e, node=req.student_node)
             return
         except Exception:  # noqa: BLE001 — teacher shed/stopped: degrade
-            self._finish(req, result=skeletons, lane="degraded")
+            self._finish(req, result=skeletons, lane="degraded",
+                         node=req.student_node)
             return
+        req.teacher_node = scope.node
+        req.t_t_submit = time.perf_counter()
         tfut.add_done_callback(
             lambda f: self._teacher_done(f, req, skeletons))
 
     def _teacher_done(self, tfut: Future, req: _CascadeRequest,
                       student_skeletons) -> None:
+        req.t_t_done = time.perf_counter()
         try:
             result = tfut.result()
         except DeadlineExceeded as e:
-            self._finish(req, error=e)
+            self._finish(req, error=e, node=req.teacher_node)
             return
         except BaseException:  # noqa: BLE001 — teacher died mid-flight:
             # the student's answer exists; a deliberate quality degrade
             # beats failing a request the fast tier already served
-            self._finish(req, result=student_skeletons, lane="degraded")
+            self._finish(req, result=student_skeletons, lane="degraded",
+                         node=req.student_node)
             return
-        self._finish(req, result=result, lane="teacher")
+        self._finish(req, result=result, lane="teacher",
+                     node=req.teacher_node)
 
     def _finish(self, req: _CascadeRequest, result=None, error=None,
-                lane: Optional[str] = None) -> None:
+                lane: Optional[str] = None, node=None) -> None:
+        if req.ctx.sampled:
+            # cascade-node hops around the delivering tier's span.
+            # Escalated requests carry the student_lane GAP hop — the
+            # fast tier's full window is real request latency even
+            # though the teacher subtree delivered — so the chain's sum
+            # stays conservative (≥95% of e2e) on escalations too.
+            t_fin = time.perf_counter()
+            hops = []
+            if req.t_s_submit is not None:
+                hops.append(("route", req.t_s_submit - req.t0))
+            if node is req.teacher_node and node is not None:
+                if req.t_s_done is not None and \
+                        req.t_s_submit is not None:
+                    hops.append(("student_lane",
+                                 req.t_s_done - req.t_s_submit))
+                if req.t_t_submit is not None and \
+                        req.t_s_done is not None:
+                    hops.append(("escalate",
+                                 req.t_t_submit - req.t_s_done))
+                if req.t_t_done is not None:
+                    hops.append(("deliver", t_fin - req.t_t_done))
+            elif req.t_s_done is not None:
+                hops.append(("deliver", t_fin - req.t_s_done))
+            req.ctx.finish(
+                "ok" if error is None
+                else f"error:{type(error).__name__}",
+                hops=hops, won_by=node,
+                **({"lane": lane} if lane else {}))
         if error is not None:
             self.metrics.on_fail()
         else:

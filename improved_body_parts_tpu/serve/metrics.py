@@ -13,6 +13,23 @@ from typing import Dict, Optional
 
 from ..utils.meters import PercentileMeter
 
+#: the batcher's per-request hop waterfall, in pipeline order.  The
+#: five segments PARTITION the submit→finish window with shared
+#: boundary stamps, so their sum equals the measured e2e latency by
+#: construction — the conservation discipline (hop sums must account
+#: for ≥95% of e2e) holds exactly at this layer and the cross-hop
+#: layers above it only lose callback-handoff microseconds.
+#:
+#: - ``queue``: submit → the dispatcher buckets the request;
+#: - ``batch_formation``: bucketed → the bucket flushes to a device;
+#: - ``device``: dispatch → the batch's single fetch lands (forward +
+#:   compact extraction + on-device assembly on the fused lane);
+#: - ``decode``: fetch → skeletons (inline O(people) finish on the
+#:   fused lane; the decode pool's queue+work on the host-pool lane
+#:   and for overflow fallbacks);
+#: - ``deliver``: decoded → the future resolves.
+HOPS = ("queue", "batch_formation", "device", "decode", "deliver")
+
 
 class ServeMetrics:
     """Counters and histograms for one :class:`serve.DynamicBatcher`.
@@ -61,6 +78,13 @@ class ServeMetrics:
         self.depth = 0              # in-flight requests (admitted, not done)
         self.depth_peak = 0
         self.occupancy: Dict[int, int] = {}
+        # per-hop latency reservoirs: aggregate (the snapshot/bench
+        # block) + per-replica (the {model=,replica=,hop=} labeled
+        # exposition) — both fed once per COMPLETED request
+        self.hops: Dict[str, PercentileMeter] = {
+            h: PercentileMeter(latency_reservoir) for h in HOPS}
+        self._hops_by_replica: Dict[int, Dict[str, PercentileMeter]] = {}
+        self._hop_reservoir = latency_reservoir
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
         self._t_busy: Optional[float] = None  # last idle->busy instant
@@ -101,6 +125,23 @@ class ServeMetrics:
                 self.decode_fused += 1
             else:
                 self.decode_host_fallback += 1
+
+    def on_hops(self, replica: int, durations) -> None:
+        """One completed request's hop waterfall: ``durations`` aligned
+        with :data:`HOPS` (seconds).  Fed alongside ``on_complete`` so
+        hop sums and the e2e reservoir describe the same request set —
+        what makes the conservation check (Σ hop sums ≥ 95% of
+        Σ e2e) well-defined."""
+        with self._lock:
+            per = self._hops_by_replica.get(replica)
+            if per is None:
+                per = self._hops_by_replica[replica] = {
+                    h: PercentileMeter(self._hop_reservoir)
+                    for h in HOPS}
+            for hop, d in zip(HOPS, durations):
+                d = max(float(d), 0.0)
+                self.hops[hop].update(d)
+                per[hop].update(d)
 
     def on_complete(self, latency_s: float) -> None:
         with self._lock:
@@ -181,6 +222,10 @@ class ServeMetrics:
             occupancy = dict(self.occupancy)
             lat = self.latency.summary()   # seconds
             lat_sum = self.latency.sum
+            hop_samples = [
+                (str(replica), hop, m.summary(), m.sum)
+                for replica, per in sorted(self._hops_by_replica.items())
+                for hop, m in per.items()]
         # the per-tier label dimension: one dict merged into EVERY
         # sample's labels, so a shared registry separates student vs
         # teacher traffic without a second registry or prefix fork
@@ -208,6 +253,23 @@ class ServeMetrics:
             (f"{prefix}_imgs_per_sec", dict(base), "gauge",
              self.throughput()),
         ]
+        # the per-hop attribution families: {model=,replica=,hop=}
+        # labeled quantiles + _sum/_count, one series set per hop per
+        # replica — the registry-level half of the request waterfall
+        # (the per-request half is obs.reqtrace)
+        for replica, hop, s, hop_sum in hop_samples:
+            labels = {**base, "replica": replica, "hop": hop}
+            for q, key in (("0.5", "p50"), ("0.95", "p95"),
+                           ("0.99", "p99")):
+                samples.append((f"{prefix}_hop_latency_seconds",
+                                {**labels, "quantile": q}, "gauge",
+                                s[key]))
+            samples += [
+                (f"{prefix}_hop_latency_seconds_sum", labels, "counter",
+                 hop_sum),
+                (f"{prefix}_hop_latency_seconds_count", labels,
+                 "counter", float(s["count"])),
+            ]
         return samples
 
     # ----------------------------------------------------------- readout
@@ -245,6 +307,18 @@ class ServeMetrics:
                 "occupancy_histogram": {str(k): v
                                         for k, v in occupancy.items()},
                 "latency_ms": self.latency.summary(scale=1e3),
+                # the per-hop decomposition block (ms): p50/p95/p99 +
+                # exact mean/count/sum per hop, aggregated over
+                # replicas — what the bench artifacts commit alongside
+                # their e2e numbers
+                "hops_ms": {
+                    h: {**m.summary(scale=1e3),
+                        "sum": round(m.sum * 1e3, 3)}
+                    for h, m in self.hops.items()},
+                "hop_conservation_frac": (
+                    round(sum(m.sum for m in self.hops.values())
+                          / self.latency.sum, 4)
+                    if self.latency.sum > 0 else None),
             }
         out["mean_batch_occupancy"] = round(self.mean_occupancy(), 3)
         out["imgs_per_sec"] = round(self.throughput(), 3)

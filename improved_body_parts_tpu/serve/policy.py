@@ -32,6 +32,7 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Optional, Tuple
 
+from ..obs.reqtrace import NULL_NODE, get_reqtrace
 from .batcher import DeadlineExceeded, ServerOverloaded
 
 
@@ -129,7 +130,8 @@ class _Flight:
     engine attempts still outstanding, and the timers armed for it."""
 
     __slots__ = ("future", "lock", "outstanding", "last_error", "timers",
-                 "won_by")
+                 "won_by", "ctx", "t0", "t_admitted", "t_hedge",
+                 "last_node")
 
     def __init__(self):
         self.future: Future = Future()
@@ -138,6 +140,11 @@ class _Flight:
         self.last_error: Optional[BaseException] = None
         self.timers: list = []
         self.won_by: Optional[str] = None
+        self.ctx = NULL_NODE            # reqtrace node (obs.reqtrace)
+        self.t0 = time.perf_counter()
+        self.t_admitted: Optional[float] = None
+        self.t_hedge: Optional[float] = None  # hedge admission instant
+        self.last_node = None           # last attempt's child node
 
 
 class PolicyClient:
@@ -169,7 +176,8 @@ class PolicyClient:
                  max_attempts: int = 4, backoff_base_s: float = 0.002,
                  backoff_max_s: float = 0.25, jitter: float = 0.5,
                  hedge_after_s: Optional[float] = None, seed: int = 0,
-                 stats: Optional[PolicyStats] = None):
+                 stats: Optional[PolicyStats] = None, slo=None,
+                 qos_class: str = "interactive"):
         if max_attempts < 1:
             raise ValueError(f"max_attempts={max_attempts} must be >= 1")
         if hedge_after_s is not None and hedge_after_s <= 0:
@@ -182,6 +190,12 @@ class PolicyClient:
         self.jitter = jitter
         self.hedge_after_s = hedge_after_s
         self.stats = stats or PolicyStats()
+        # optional SLO wiring: the policy client is the outermost layer
+        # — what it resolves is the caller's experienced outcome, the
+        # deadline/hedge machinery included (attach at ONE layer — see
+        # DynamicBatcher)
+        self._slo = slo
+        self._qos_class = qos_class
         self._locked_rng = self._LockedRng(random.Random(seed),
                                            threading.Lock())
 
@@ -202,12 +216,23 @@ class PolicyClient:
         deadline = (None if budget is None
                     else time.perf_counter() + budget)
         flight = _Flight()
-        fut = self._admit(image, deadline)   # raises if never admitted
+        rt = get_reqtrace()
+        if rt.enabled:
+            flight.ctx = rt.begin("policy")
+        try:
+            # raises if never admitted
+            fut, node = self._admit(flight, image, deadline)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            flight.ctx.finish(f"error:{type(e).__name__}",
+                              hops=[("admit", time.perf_counter()
+                                     - flight.t0)])
+            raise
+        flight.t_admitted = time.perf_counter()
         self.stats.add(submitted=1)
         with flight.lock:
             flight.outstanding += 1
         fut.add_done_callback(
-            lambda f: self._on_attempt_done(flight, f, "primary"))
+            lambda f: self._on_attempt_done(flight, f, "primary", node))
         if deadline is not None:
             self._arm(flight, max(0.0, deadline - time.perf_counter()),
                       lambda: self._on_deadline(flight))
@@ -232,9 +257,13 @@ class PolicyClient:
             with self._lock:
                 return self._rng.random()
 
-    def _admit(self, image, deadline: Optional[float]) -> Future:
+    def _admit(self, flight: _Flight, image,
+               deadline: Optional[float]) -> Tuple[Future, object]:
         """Engine admission with bounded jittered retry; the caller's
-        thread sleeps the backoff (a closed-loop client by design)."""
+        thread sleeps the backoff (a closed-loop client by design).
+        Returns ``(engine_future, reqtrace_child_node)`` — a retried
+        admission lands as a reason-annotated ``retry`` edge naming how
+        many sheds preceded it."""
         attempt = 0
         while True:
             if deadline is not None:
@@ -246,7 +275,12 @@ class PolicyClient:
             else:
                 remaining = None
             try:
-                return self.engine.submit(image, deadline_s=remaining)
+                with flight.ctx.child_scope(
+                        "submit" if attempt == 0 else "retry",
+                        None if attempt == 0
+                        else f"sheds={attempt}") as scope:
+                    fut = self.engine.submit(image, deadline_s=remaining)
+                return fut, scope.node
             except ServerOverloaded:
                 attempt += 1
                 if attempt >= self.max_attempts or \
@@ -280,12 +314,38 @@ class PolicyClient:
         flight.timers.clear()
 
     def _resolve(self, flight: _Flight, kind: str, result=None,
-                 error: Optional[BaseException] = None) -> bool:
+                 error: Optional[BaseException] = None, node=None,
+                 t_done: Optional[float] = None) -> bool:
         with flight.lock:
             if flight.future.done():
                 return False
             self._cancel_timers(flight)
             flight.won_by = kind
+            if flight.ctx.sampled:
+                # policy-node hop bookends: admit (admission incl. shed
+                # backoff), hedge_wait (the gap hop — time spent
+                # waiting on the primary before the winning hedge was
+                # even dispatched), deliver (attempt resolution → this
+                # future).  The winning attempt's span covers the rest.
+                now = time.perf_counter()
+                hops = []
+                if flight.t_admitted is not None:
+                    hops.append(("admit",
+                                 flight.t_admitted - flight.t0))
+                if kind == "hedge" and flight.t_hedge is not None \
+                        and flight.t_admitted is not None:
+                    hops.append(("hedge_wait",
+                                 flight.t_hedge - flight.t_admitted))
+                if t_done is not None:
+                    hops.append(("deliver", now - t_done))
+                flight.ctx.finish(
+                    "ok" if error is None
+                    else f"error:{type(error).__name__}",
+                    hops=hops, won_by=node, won_kind=kind)
+            if self._slo is not None:
+                self._slo.record(self._qos_class,
+                                 time.perf_counter() - flight.t0,
+                                 error=error is not None)
             try:
                 if error is not None:
                     flight.future.set_exception(error)
@@ -297,24 +357,28 @@ class PolicyClient:
         return True
 
     def _on_attempt_done(self, flight: _Flight, fut: Future,
-                         kind: str) -> None:
+                         kind: str, node=None) -> None:
+        t_done = time.perf_counter()
         try:
             result = fut.result()
             error = None
         except BaseException as e:  # noqa: BLE001 — delivered or held
             result, error = None, e
         if error is None:
-            if self._resolve(flight, kind, result=result) \
-                    and kind == "hedge":
+            if self._resolve(flight, kind, result=result, node=node,
+                             t_done=t_done) and kind == "hedge":
                 self.stats.add(hedge_wins=1)
             return
         with flight.lock:
             flight.outstanding -= 1
             flight.last_error = error
+            flight.last_node = node if node is not None \
+                else flight.last_node
             deliver = flight.outstanding <= 0
         if deliver:
             # every outstanding attempt failed: surface the last error
-            self._resolve(flight, kind, error=error)
+            self._resolve(flight, kind, error=error, node=node,
+                          t_done=t_done)
 
     def _on_deadline(self, flight: _Flight) -> None:
         if self._resolve(flight, "deadline", error=DeadlineExceeded(
@@ -337,20 +401,29 @@ class PolicyClient:
             # error while a winnable attempt is seconds from flight
             flight.outstanding += 1
         try:
-            fut = self.engine.submit(image, deadline_s=remaining)
+            with flight.ctx.child_scope(
+                    "hedge",
+                    f"hedge_after_s={self.hedge_after_s}") as scope:
+                fut = self.engine.submit(image, deadline_s=remaining)
         except Exception:  # noqa: BLE001 — a shed/draining hedge is
             # simply not taken; release the reservation, and if the
             # primary already failed while waiting on us, deliver now
             self._attempt_abandoned(flight)
             return
+        flight.t_hedge = time.perf_counter()
         self.stats.add(hedges=1)
         fut.add_done_callback(
-            lambda f: self._on_attempt_done(flight, f, "hedge"))
+            lambda f, nd=scope.node:
+            self._on_attempt_done(flight, f, "hedge", nd))
 
     def _attempt_abandoned(self, flight: _Flight) -> None:
         with flight.lock:
             flight.outstanding -= 1
             error = flight.last_error
+            # the failed attempt whose error we are delivering: the
+            # chain must end at ITS leaf, not dangle at the policy
+            # root (an interior chain end is a completeness violation)
+            node = flight.last_node
             deliver = flight.outstanding <= 0 and error is not None
         if deliver:
-            self._resolve(flight, "primary", error=error)
+            self._resolve(flight, "primary", error=error, node=node)

@@ -43,6 +43,7 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..obs.reqtrace import NULL_NODE, get_reqtrace
 from .batcher import DeadlineExceeded, DynamicBatcher, ServerOverloaded
 from .breaker import CircuitBreaker
 from .metrics import ServeMetrics
@@ -55,7 +56,8 @@ REPLICA_STATE_CODES = {"live": 0.0, "fenced": 1.0, "restarting": 2.0}
 
 class _PoolRequest:
     __slots__ = ("image", "future", "t_submit", "deadline", "attempts",
-                 "tried", "finished", "rid")
+                 "tried", "finished", "rid", "ctx", "attempt_log",
+                 "last_error_type")
 
     def __init__(self, image, deadline_s: Optional[float]):
         self.image = image
@@ -67,6 +69,12 @@ class _PoolRequest:
         self.tried: set = set()    # replica indices that failed it
         self.finished = False
         self.rid = next(_PRID)
+        self.ctx = NULL_NODE       # reqtrace node (obs.reqtrace)
+        # (child_node, t_admitted) per engine attempt, in order — what
+        # lets the finish hop account name the time burned on attempts
+        # that failed over before the winner's
+        self.attempt_log: list = []
+        self.last_error_type: Optional[str] = None
 
     def remaining(self) -> Optional[float]:
         if self.deadline is None:
@@ -125,7 +133,8 @@ class EnginePool:
                  restart_after_s: Optional[float] = None,
                  on_fence: Optional[Callable[[int, str], None]] = None,
                  metrics: Optional[ServeMetrics] = None,
-                 registry=None):
+                 registry=None, slo=None,
+                 qos_class: str = "interactive"):
         if not engines:
             raise ValueError("EnginePool needs at least one engine")
         kw = dict(breaker_kw or {})
@@ -145,6 +154,15 @@ class EnginePool:
         # (one pool request is ONE submit no matter how many replicas
         # it visited)
         self.metrics = metrics or ServeMetrics()
+        # optional SLO wiring: pool-level outcomes are what the caller
+        # experiences (failover absorbed), so this is the natural SLO
+        # attachment point for a replicated deployment WITHOUT a
+        # hedging PolicyClient above — every hedge is a SECOND pool
+        # submit, so under hedging the pool records attempts, not
+        # caller requests: attach to the PolicyClient there instead
+        # (attach at ONE layer — see DynamicBatcher)
+        self._slo = slo
+        self._qos_class = qos_class
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {
             "failovers": 0,      # replica attempts that failed over
@@ -285,7 +303,14 @@ class EnginePool:
             raise DeadlineExceeded(
                 f"deadline_s={deadline_s} already expired at submit")
         preq = _PoolRequest(image, deadline_s)
+        rt = get_reqtrace()
+        if rt.enabled:
+            preq.ctx = rt.begin("pool")
         if not self._route(preq, first=True):
+            # the node opened above MUST close on this raise path too:
+            # an unfinished node wedges its request's tree forever (the
+            # record never emits, the recorder's live entry leaks)
+            preq.ctx.finish("error:ServerOverloaded")
             self.metrics.on_reject()
             raise ServerOverloaded(
                 "no healthy replica admitted the request (all fenced, "
@@ -309,28 +334,36 @@ class EnginePool:
         spot); False when every candidate refused — the caller decides
         whether that is a submit-time ``ServerOverloaded`` (first
         placement) or a failover give-up."""
+        # the causal hop edge this placement creates: a first placement
+        # is a plain submit; a re-placement after a replica failure is
+        # a FAILOVER edge annotated with the error that forced it
+        kind = "submit" if first else "failover"
+        reason = None if first else preq.last_error_type
         for idx in self._candidates(preq.tried):
             r = self._replicas[idx]
             if not r.breaker.allow():
                 continue
-            try:
-                fut = r.engine.submit(preq.image,
-                                      deadline_s=preq.remaining())
-            except ServerOverloaded:
-                # shed is backpressure, not a fault: no breaker outcome
-                # — but give back the half-open probe slot it consumed
-                r.breaker.release_probe()
-                continue
-            except DeadlineExceeded as e:
-                # the GLOBAL deadline lapsed while routing: resolve now
-                r.breaker.release_probe()
-                self._finish(preq, error=e, first=first)
-                return True
-            except RuntimeError:
-                # replica stopped between the health read and submit;
-                # the probe loop will fence it — move on
-                r.breaker.release_probe()
-                continue
+            with preq.ctx.child_scope(kind, reason) as scope:
+                try:
+                    fut = r.engine.submit(preq.image,
+                                          deadline_s=preq.remaining())
+                except ServerOverloaded:
+                    # shed is backpressure, not a fault: no breaker
+                    # outcome — but give back the half-open probe slot
+                    # it consumed
+                    r.breaker.release_probe()
+                    continue
+                except DeadlineExceeded as e:
+                    # the GLOBAL deadline lapsed while routing: resolve
+                    r.breaker.release_probe()
+                    self._finish(preq, error=e, first=first)
+                    return True
+                except RuntimeError:
+                    # replica stopped between the health read and
+                    # submit; the probe loop will fence it — move on
+                    r.breaker.release_probe()
+                    continue
+            preq.attempt_log.append((scope.node, time.perf_counter()))
             if first:
                 self.metrics.on_submit()
             else:
@@ -339,14 +372,18 @@ class EnginePool:
             # attach AFTER the pool-level on_submit so completion
             # accounting can never run ahead of submission accounting
             fut.add_done_callback(
-                lambda f, i=idx: self._on_replica_done(preq, i, f))
+                lambda f, i=idx, nd=scope.node:
+                self._on_replica_done(preq, i, f, nd))
             return True
         return False
 
     def _on_replica_done(self, preq: _PoolRequest, idx: int,
-                         fut: Future) -> None:
+                         fut: Future, node=None) -> None:
         """One replica attempt resolved (runs on that replica's
-        completion threads): deliver, or fail over."""
+        completion threads): deliver, or fail over.  ``node`` is the
+        attempt's reqtrace child — the ``won_by`` chain link when this
+        attempt's outcome is the one delivered."""
+        t_done = time.perf_counter()
         try:
             result = fut.result()
             error = None
@@ -355,7 +392,7 @@ class EnginePool:
         r = self._replicas[idx]
         if error is None:
             r.breaker.record_success()
-            self._finish(preq, result=result)
+            self._finish(preq, result=result, node=node, t_done=t_done)
             return
         if isinstance(error, DeadlineExceeded):
             # the deadline is global to the request: another replica
@@ -365,33 +402,35 @@ class EnginePool:
             # back (no outcome will ever be recorded for it), or
             # enough expiring probes would wedge the breaker half-open
             r.breaker.release_probe()
-            self._finish(preq, error=error)
+            self._finish(preq, error=error, node=node, t_done=t_done)
             return
         r.breaker.record_failure()
         if self.fence_on_breaker and r.breaker.state == "open":
             self.fence(idx, "breaker_open")
         preq.tried.add(idx)
         preq.attempts += 1
+        preq.last_error_type = type(error).__name__
         with self._lock:
             self._counters["failovers"] += 1
         if self._draining or preq.attempts > self.max_failovers or \
                 (preq.deadline is not None and preq.remaining() <= 0):
-            self._finish(preq, error=error)
+            self._finish(preq, error=error, node=node, t_done=t_done)
             return
         try:
             placed = self._route(preq, first=False)
         except Exception as e:  # noqa: BLE001 — a routing bug must fail
             # THIS request, never strand it or kill a fetch thread
-            self._finish(preq, error=e)
+            self._finish(preq, error=e, node=node, t_done=t_done)
             return
         if not placed:
             # nowhere healthy left: the caller gets the replica error
             # (typed), not a hang
-            self._finish(preq, error=error)
+            self._finish(preq, error=error, node=node, t_done=t_done)
 
     def _finish(self, preq: _PoolRequest, result=None,
                 error: Optional[BaseException] = None,
-                first: bool = False) -> None:
+                first: bool = False, node=None,
+                t_done: Optional[float] = None) -> None:
         """Resolve one pool request exactly once (the `_finish`
         discipline one level up: callbacks from a drained replica and a
         successful failover may race here)."""
@@ -399,11 +438,39 @@ class EnginePool:
             if preq.finished:
                 return
             preq.finished = True
+        if preq.ctx.sampled:
+            # the pool node's hop bookends around its children's
+            # windows: route (candidate selection + admission before
+            # the first placement), prior_attempts (the gap hop — time
+            # burned on attempts that failed over before the winning
+            # one was even submitted), deliver (winner's resolution →
+            # pool future).  The winner's own span covers the middle.
+            t_fin = time.perf_counter()
+            hops = []
+            log = preq.attempt_log
+            if log:
+                hops.append(("route", log[0][1] - preq.t_submit))
+                if node is not None:
+                    widx = next((i for i, (nd, _) in enumerate(log)
+                                 if nd is node), None)
+                    if widx:
+                        hops.append(("prior_attempts",
+                                     log[widx][1] - log[0][1]))
+            if t_done is not None:
+                hops.append(("deliver", t_fin - t_done))
+            preq.ctx.finish(
+                "ok" if error is None
+                else f"error:{type(error).__name__}",
+                hops=hops, won_by=node, failovers=preq.attempts)
         if first:
             # resolved during its own submit() call, before the pool
             # counted it submitted: count both sides so conservation
             # (submitted == completed + failed + depth) stays exact
             self.metrics.on_submit()
+        if self._slo is not None:
+            self._slo.record(self._qos_class,
+                             time.perf_counter() - preq.t_submit,
+                             error=error is not None)
         try:
             if error is not None:
                 self.metrics.on_fail(

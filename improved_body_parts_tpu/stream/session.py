@@ -33,6 +33,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..obs.reqtrace import NULL_NODE, get_reqtrace
 from ..obs.trace import get_tracer
 from ..serve.batcher import ServerOverloaded
 from ..serve.policy import jittered_backoff
@@ -50,7 +51,8 @@ class FrameDropped(RuntimeError):
 
 class _Frame:
     __slots__ = ("seq", "future", "t_submit", "tr0", "ready", "dropped",
-                 "result", "error", "image", "epoch", "engine_submitted")
+                 "result", "error", "image", "epoch", "engine_submitted",
+                 "ctx", "attempt_nodes", "won_node", "t_ready", "t_admit")
 
     def __init__(self, seq: int, t_submit: float, tr0: float, image):
         self.seq = seq
@@ -61,6 +63,11 @@ class _Frame:
         self.dropped = False        # future already failed FrameDropped
         self.result = None
         self.error: Optional[BaseException] = None
+        self.ctx = NULL_NODE        # reqtrace node (obs.reqtrace)
+        self.attempt_nodes: Dict[int, object] = {}  # epoch -> child
+        self.won_node = None        # the attempt whose outcome landed
+        self.t_ready: Optional[float] = None
+        self.t_admit: Optional[float] = None
         # retained until the frame resolves so a migration off a fenced
         # replica can RE-SUBMIT it (bounded by max_in_flight frames per
         # stream); freed the moment ready/dropped lands
@@ -250,6 +257,10 @@ class StreamSession:
             self._pending.append(frame)
             self._unresolved += 1
         self.metrics.on_submit()
+        rt = get_reqtrace()
+        if rt.enabled:
+            frame.ctx = rt.begin("stream", stream=self.stream_id,
+                                 seq=frame.seq)
         self._submit_to_engine(frame, image_bgr)
         return frame.future
 
@@ -266,6 +277,7 @@ class StreamSession:
             return
         victim.dropped = True
         victim.image = None
+        victim.ctx.finish("error:FrameDropped")
         self.metrics.on_drop()
         if trace.enabled:
             trace.instant("frame_dropped", track=self._track,
@@ -293,7 +305,18 @@ class StreamSession:
             # this producer is parked in backoff
             engine = self.batcher
             try:
-                bf = engine.submit(image_bgr)
+                # epoch 0 is the frame's first engine attempt; a bumped
+                # epoch is a MIGRATE edge — the session re-submitted the
+                # frame after its replica was fenced (or the admission
+                # raced a migrate)
+                with frame.ctx.child_scope(
+                        "submit" if epoch == 0 else "migrate",
+                        f"sheds={attempt}" if attempt else
+                        (f"epoch={epoch}" if epoch else None)) as scope:
+                    bf = engine.submit(image_bgr)
+                frame.attempt_nodes[epoch] = scope.node
+                if epoch == 0:
+                    frame.t_admit = time.perf_counter()
                 break
             except ServerOverloaded as e:
                 draining = getattr(self.batcher, "draining", False)
@@ -386,6 +409,11 @@ class StreamSession:
                 frame.result = result
                 frame.error = error
                 frame.ready = True
+                frame.t_ready = time.perf_counter()
+                # the accepted attempt owns the frame's outcome — the
+                # won_by chain link (a stale attempt's error was
+                # discarded above and never becomes the delivering one)
+                frame.won_node = frame.attempt_nodes.get(epoch)
                 frame.image = None  # no further re-submission possible
         self._advance()
 
@@ -431,6 +459,18 @@ class StreamSession:
             self._unresolved -= 1
             self._cond.notify_all()
 
+    def _frame_hops(self, frame: _Frame, t_fin: float):
+        """The frame node's hop bookends: ``admit`` (first engine
+        admission, incl. shed backoff) and ``deliver`` (engine outcome
+        → in-order delivery: head-of-line wait + tracker/smoother
+        update).  The engine attempt's own span covers the middle."""
+        hops = []
+        if frame.t_admit is not None:
+            hops.append(("admit", frame.t_admit - frame.t_submit))
+        if frame.t_ready is not None:
+            hops.append(("deliver", t_fin - frame.t_ready))
+        return hops
+
     def _deliver(self, frame: _Frame) -> None:
         trace = get_tracer()
         if frame.error is not None:
@@ -439,6 +479,10 @@ class StreamSession:
                 trace.instant("frame_failed", track=self._track,
                               args={"stream": self.stream_id,
                                     "seq": frame.seq})
+            frame.ctx.finish(
+                f"error:{type(frame.error).__name__}",
+                hops=self._frame_hops(frame, time.perf_counter()),
+                won_by=frame.won_node)
             self._fail_future(frame, frame.error)
             self._frame_resolved()
             return
@@ -466,9 +510,16 @@ class StreamSession:
         except Exception as e:  # noqa: BLE001 — a tracker bug fails ITS
             # frame, never the delivery loop or later frames
             self.metrics.on_fail()
+            frame.ctx.finish(
+                f"error:{type(e).__name__}",
+                hops=self._frame_hops(frame, time.perf_counter()),
+                won_by=frame.won_node)
             self._fail_future(frame, e)
             self._frame_resolved()
             return
+        t_fin = time.perf_counter()
+        frame.ctx.finish("ok", hops=self._frame_hops(frame, t_fin),
+                         won_by=frame.won_node)
         self.metrics.on_deliver(time.perf_counter() - frame.t_submit)
         try:
             frame.future.set_result(tracked)

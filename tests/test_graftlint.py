@@ -1014,6 +1014,39 @@ def test_scope_covers_cascade_module():
         lint(leak, path="improved_body_parts_tpu/serve/cascade.py"))
 
 
+def test_scope_covers_reqtrace_and_slo_modules():
+    """ISSUE 15 satellite: the per-request observability layer
+    (obs/reqtrace.py, obs/slo.py) runs ON the serve threads for every
+    request — node open/finish and SLO recording are hot-path code and
+    live in the JGL002 scope (the rest of obs/ is scrape-time/export
+    code and stays out), with JGL005 covering any thread lifecycle they
+    might grow.  Locked on the files' actual paths so a future move
+    can't silently drop them from the sweep."""
+    hot = """
+        import jax.numpy as jnp
+
+        def record_loop(outcomes):
+            for o in outcomes:
+                v = jnp.max(o)
+                track(float(v))
+    """
+    for path in ("improved_body_parts_tpu/obs/reqtrace.py",
+                 "improved_body_parts_tpu/obs/slo.py"):
+        assert "JGL002" in rules_of(lint(hot, path=path)), path
+    # the rest of obs/ stays out of the hot-path scope
+    assert "JGL002" not in rules_of(
+        lint(hot, path="improved_body_parts_tpu/obs/registry.py"))
+    leak = """
+        import threading
+
+        def emit(record):
+            t = threading.Thread(target=record.flush)
+            t.start()
+    """
+    assert "JGL005" in rules_of(
+        lint(leak, path="improved_body_parts_tpu/obs/slo.py"))
+
+
 def test_donation_tracks_distill_factory():
     """The distill step factory is in the donating-factories config:
     JGL001 must flag a read of the state after it flowed into a

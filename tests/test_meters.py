@@ -226,3 +226,69 @@ class TestServeMetricsConcurrency:
         # single-model deployments' exposition is unchanged)
         assert all("model" not in labels
                    for _, labels, _, _ in ServeMetrics().collect())
+
+    def test_eight_thread_hammer_conserves_hop_counts(self):
+        """ISSUE 15: the per-hop waterfall reservoirs
+        (``{model=,replica=,hop=}`` families) are fed once per COMPLETED
+        request from the batcher's completion threads.  8 threads hammer
+        completions with hops across 2 replicas; afterwards every hop's
+        aggregate count must equal completed EXACTLY (the conservation
+        check divides hop sums by the e2e sum — a lost hop update would
+        silently skew it), the per-replica counts must partition the
+        traffic, and every exported hop sample must carry all three
+        labels."""
+        from improved_body_parts_tpu.obs import Registry
+        from improved_body_parts_tpu.serve.metrics import (
+            HOPS,
+            ServeMetrics,
+        )
+
+        reg = Registry()
+        m = ServeMetrics(model="student").register_into(reg)
+        threads_n, ops = 8, 240
+        barrier = threading.Barrier(threads_n)
+
+        def hammer(tid):
+            barrier.wait()
+            for i in range(ops):
+                m.on_submit()
+                m.on_dispatch(i % 4 + 1)
+                if i % 5 == 0:
+                    m.on_fail()       # failures record NO hops
+                else:
+                    durs = [0.001 * (h + 1) for h in range(len(HOPS))]
+                    m.on_hops((tid + i) % 2, durs)
+                    m.on_complete(sum(durs))
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(threads_n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        assert m.submitted == m.completed + m.failed + m.depth
+        for hop in HOPS:
+            assert m.hops[hop].count == m.completed
+        per_replica = m._hops_by_replica
+        assert set(per_replica) == {0, 1}
+        for hop in HOPS:
+            assert sum(per_replica[r][hop].count
+                       for r in per_replica) == m.completed
+        # the conservation readout is exact on this synthetic stream
+        snap = m.snapshot()
+        assert snap["hop_conservation_frac"] == pytest.approx(1.0)
+        assert set(snap["hops_ms"]) == set(HOPS)
+        # exported hop samples carry {model=,replica=,hop=} exactly
+        hop_samples = [(name, labels) for name, labels, kind, v
+                       in m.collect()
+                       if name.startswith("serve_hop_latency_seconds")]
+        assert hop_samples
+        for name, labels in hop_samples:
+            assert labels.get("model") == "student"
+            assert labels.get("replica") in {"0", "1"}
+            assert labels.get("hop") in HOPS
+        counts = {(lb["replica"], lb["hop"]): v
+                  for name, lb, kind, v in m.collect()
+                  if name == "serve_hop_latency_seconds_count"}
+        assert sum(counts.values()) == m.completed * len(HOPS)

@@ -562,7 +562,12 @@ class TestRunnerCli:
         assert proc.returncode == 2
         assert "unknown program" in proc.stderr
 
+    @pytest.mark.slow
     def test_empty_programs_list_is_usage_error_not_clean(self):
+        # slow tier since ISSUE 15's budget re-fit: pure argv-refusal
+        # semantics, but each subprocess pays the full jax import
+        # (~10s on this host).  The CLI stays smoke-covered in tier-1
+        # by test_rules_table / test_unknown_program_is_usage_error.
         # `--programs` with zero names must not sweep nothing and exit
         # 0 — and `--bless --programs` must not write an empty golden
         proc = self.run("--programs")
@@ -571,12 +576,16 @@ class TestRunnerCli:
         proc = self.run("--bless", "--programs")
         assert proc.returncode == 2
 
+    @pytest.mark.slow
     def test_bless_refuses_partial_sweep(self):
+        # slow tier since ISSUE 15's budget re-fit (see above)
         proc = self.run("--bless", "--programs", "train_step")
         assert proc.returncode == 2
         assert "FULL sweep" in proc.stderr
 
+    @pytest.mark.slow
     def test_bless_refuses_trace_level(self):
+        # slow tier since ISSUE 15's budget re-fit (see above)
         proc = self.run("--bless", "--level", "trace")
         assert proc.returncode == 2
         assert "--level compile" in proc.stderr

@@ -1,0 +1,657 @@
+"""Request-scoped causal tracing (obs.reqtrace) + the per-hop
+waterfall: unit semantics of the node/scope machinery, the batcher's
+hop conservation on a real pipeline, cross-hop trees under failover /
+hedge / escalation / streaming, and the request_report completeness
+verifier both ways."""
+import os
+import sys
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+# the request_report verifier lives in tools/ (shared with the
+# LATENCY_AUDIT harness)
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from improved_body_parts_tpu.config import (
+    default_inference_params,
+    get_config,
+)
+from improved_body_parts_tpu.obs import Registry
+from improved_body_parts_tpu.obs.reqtrace import (
+    NULL_NODE,
+    NullReqTrace,
+    ReqTrace,
+    get_reqtrace,
+    set_reqtrace,
+)
+from improved_body_parts_tpu.serve import (
+    DynamicBatcher,
+    EnginePool,
+    PolicyClient,
+)
+from improved_body_parts_tpu.serve.metrics import HOPS
+
+
+def _fake_predictor(batch_sleep_s=0.002):
+    """The test_obs fake: a duck-typed predictor with no jax — the
+    batcher pipeline (dispatcher, fetchers, decode pool, hops) is real,
+    only the device program is stubbed."""
+    params, _ = default_inference_params()
+
+    class FakePredictor:
+        pass
+
+    FakePredictor.params = params
+    FakePredictor.skeleton = get_config("tiny").skeleton
+    FakePredictor.compact_lane_shape = lambda self, img, prm: (256, 256)
+
+    def _single(self, img, **kw):
+        def resolve():
+            time.sleep(batch_sleep_s)
+            return "one"
+
+        return resolve
+
+    FakePredictor.predict_compact_async = _single
+
+    def _batch(self, imgs, **kw):
+        n = len(imgs)
+
+        def resolve():
+            time.sleep(batch_sleep_s)
+            return ["res"] * n
+
+        return resolve
+
+    FakePredictor.predict_compact_batch_async = _batch
+    FakePredictor.device_replica = lambda self, d: self
+    return FakePredictor()
+
+
+def _make_batcher(pred=None, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 5)
+    kw.setdefault("device_decode", False)
+    b = DynamicBatcher(pred or _fake_predictor(), **kw)
+    b._decode_one = lambda res, img: [res]
+    return b
+
+
+@pytest.fixture
+def reqtrace():
+    rt = ReqTrace(sample=1)
+    prev = set_reqtrace(rt)
+    try:
+        yield rt
+    finally:
+        set_reqtrace(prev)
+
+
+def _drain(rt, n, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        recs = rt.records()
+        if len(recs) >= n and rt.live == 0:
+            return recs
+        time.sleep(0.01)
+    return rt.records()
+
+
+IMG = np.zeros((64, 64, 3), np.uint8)
+
+
+class StubTracker:
+    """The fake batcher resolves frames to strings, not skeletons —
+    a no-op tracker keeps delivery on the happy path."""
+
+    births = deaths = active = 0
+
+    def update(self, skeletons):
+        return []
+
+    def live_ids(self):
+        return []
+
+    def snapshot(self):
+        return {}
+
+
+# ------------------------------------------------------------------ unit
+class TestReqTraceUnit:
+    def test_root_child_chain_and_coverage(self):
+        rt = ReqTrace(sample=1)
+        root = rt.begin("pool")
+        with root.child_scope("failover", "RuntimeError") as scope:
+            child = rt.begin("batcher", model="student")
+            assert scope.node is child
+        child.finish("ok", hops=[("device", 0.01)])
+        root.finish("ok", hops=[("route", 0.001)], won_by=child)
+        recs = rt.records()
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["status"] == "ok"
+        assert rec["chain"] == [root.node_id, child.node_id]
+        nodes = {n["node"]: n for n in rec["nodes"]}
+        assert nodes[child.node_id]["kind"] == "failover"
+        assert nodes[child.node_id]["reason"] == "RuntimeError"
+        assert nodes[child.node_id]["model"] == "student"
+        assert nodes[child.node_id]["parent"] == root.node_id
+        assert rec["chain_hops_ms"] == pytest.approx(11.0, abs=0.5)
+
+    def test_record_waits_for_losing_attempt(self):
+        """A hedge loser finishing AFTER the root must still land in
+        the record — emission happens at the LAST node, not at root
+        resolution."""
+        rt = ReqTrace(sample=1)
+        root = rt.begin("policy")
+        with root.child_scope("submit") as s1:
+            a = rt.begin("batcher")
+            assert s1.node is a
+        with root.child_scope("hedge") as s2:
+            b = rt.begin("batcher")
+        a.finish("ok")
+        root.finish("ok", won_by=a)
+        assert rt.records() == []      # loser still open
+        b.finish("ok")
+        recs = rt.records()
+        assert len(recs) == 1
+        assert len(recs[0]["nodes"]) == 3
+        assert recs[0]["chain"][-1] == a.node_id
+        assert s2.node is b
+
+    def test_sampling_thins_roots_and_children_inherit(self):
+        rt = ReqTrace(sample=3)
+        kept = 0
+        for _ in range(9):
+            root = rt.begin("batcher")
+            if root.sampled:
+                kept += 1
+                root.finish("ok")
+            else:
+                assert root is NULL_NODE
+                with root.child_scope("submit") as scope:
+                    child = rt.begin("batcher")
+                assert child is NULL_NODE and scope.node is NULL_NODE
+        assert kept == 3
+        assert len(rt.records()) == 3
+
+    def test_double_finish_is_once(self):
+        rt = ReqTrace(sample=1)
+        root = rt.begin("batcher")
+        root.finish("ok")
+        root.finish("error:RuntimeError")   # late loser: ignored
+        recs = rt.records()
+        assert len(recs) == 1 and recs[0]["status"] == "ok"
+
+    def test_abandoned_trees_evicted_bounded(self):
+        rt = ReqTrace(sample=1, max_live=2)
+        roots = [rt.begin("batcher") for _ in range(4)]
+        assert rt.live == 2            # oldest two evicted
+        assert rt.dropped == 2
+        # finishing an evicted root is a harmless no-op
+        roots[0].finish("ok")
+        assert len(rt.records()) == 0
+
+    def test_null_recorder_and_null_node_are_inert(self):
+        rt = NullReqTrace()
+        node = rt.begin("batcher")
+        assert node is NULL_NODE and not node.sampled
+        with node.child_scope("submit") as scope:
+            pass
+        node.finish("ok", hops=[("x", 1.0)])
+        assert rt.records() == [] and scope.node is None
+
+    def test_registry_collector_names(self):
+        rt = ReqTrace(sample=1)
+        reg = Registry()
+        rt.attach_registry(reg)
+        rt.begin("batcher").finish("ok")
+        import re
+
+        name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+        names = set()
+        for name, labels, kind, value, help in reg._flat():
+            names.add(name)
+            assert name_re.match(name), name
+            if kind == "counter":
+                assert name.endswith(("_total", "_sum", "_count")), name
+        assert {"reqtrace_requests_total", "reqtrace_dropped_total",
+                "reqtrace_live_requests"} <= names
+
+    def test_records_emit_through_sink(self, tmp_path):
+        from improved_body_parts_tpu.obs import EventSink, read_events, set_sink
+
+        path = str(tmp_path / "ev.jsonl")
+        sink = EventSink(path)
+        prev = set_sink(sink)
+        try:
+            rt = ReqTrace(sample=1, t0=sink.t0)
+            rt.begin("batcher").finish("ok", hops=[("device", 0.001)])
+        finally:
+            set_sink(prev)
+            sink.close()
+        evs = [e for e in read_events(path) if e["event"] == "request"]
+        assert len(evs) == 1
+        assert evs[0]["nodes"][0]["comp"] == "batcher"
+
+
+# ------------------------------------------------- batcher waterfall
+class TestBatcherWaterfall:
+    def test_hops_partition_e2e_and_records(self, reqtrace):
+        # ~20ms device stage: the partition is exact by construction,
+        # but on sub-ms requests a scheduling hiccup between two stamp
+        # reads could cost >5% — give the clock real spans to measure
+        with _make_batcher(_fake_predictor(batch_sleep_s=0.02)) as b:
+            futs = [b.submit(IMG) for _ in range(8)]
+            for f in futs:
+                assert f.result(timeout=30) in (["res"], ["one"])
+        recs = _drain(reqtrace, 8)
+        assert len(recs) == 8
+        for rec in recs:
+            node = rec["nodes"][0]
+            assert list(node["hops_ms"]) == list(HOPS)
+            # the five segments partition submit->finish: per-request
+            # conservation is exact up to stamp-readback microseconds
+            assert rec["hop_coverage"] >= 0.95, rec
+            assert rec["chain"] == [node["node"]]
+        snap = b.metrics.snapshot()
+        assert snap["hop_conservation_frac"] >= 0.95
+        assert snap["hops_ms"]["device"]["count"] == 8
+
+    def test_error_request_records_status(self, reqtrace):
+        pred = _fake_predictor()
+
+        def bad_shape(self, img, prm):
+            raise ValueError("malformed image")
+
+        type(pred).compact_lane_shape = bad_shape
+        with _make_batcher(pred) as b:
+            fut = b.submit(IMG)
+            with pytest.raises(ValueError):
+                fut.result(timeout=30)
+        recs = _drain(reqtrace, 1)
+        assert recs[0]["status"] == "error:ValueError"
+
+    def test_hop_reservoirs_skip_sampling(self):
+        """Hop histograms see EVERY completed request even when the
+        recorder samples (or is absent entirely)."""
+        with _make_batcher() as b:
+            futs = [b.submit(IMG) for _ in range(5)]
+            for f in futs:
+                f.result(timeout=30)
+        assert b.metrics.snapshot()["hops_ms"]["queue"]["count"] == 5
+        assert isinstance(get_reqtrace(), NullReqTrace)
+
+
+# ----------------------------------------------------- cross-hop trees
+class TestCrossHopTrees:
+    def test_failover_yields_one_complete_tree(self, reqtrace):
+        """ISSUE satellite: a failed-over request yields exactly one
+        complete causal tree — the poisoned replica's attempt in the
+        record as a failed branch, the FAILOVER edge reason-annotated,
+        the delivering leaf unique."""
+        from request_report import verify
+
+        poisoned = _fake_predictor()
+
+        def boom(self, imgs, **kw):
+            def resolve():
+                raise RuntimeError("poisoned program")
+
+            return resolve
+
+        type(poisoned).predict_compact_batch_async = boom
+        type(poisoned).predict_compact_async = boom
+        # a ~50ms healthy replica: cross-thread handoff gaps must be
+        # small next to the spans they sit between, or the
+        # conservation readout tests the clock, not the waterfall.
+        # The floor here is 0.9, not the audited 0.95: a suite-wide
+        # scheduling hiccup can cost a few ms on a request this small
+        # — the ≥95% acceptance is gated in LATENCY_AUDIT.json on
+        # realistically-sized requests; THIS test pins the causal
+        # structure exactly
+        engines = [_make_batcher(poisoned),
+                   _make_batcher(_fake_predictor(batch_sleep_s=0.05))]
+        with EnginePool(engines, probe_interval_s=30.0,
+                        fence_on_breaker=False) as pool:
+            assert pool.submit(IMG).result(timeout=30) in (["res"],
+                                                           ["one"])
+        recs = _drain(reqtrace, 1)
+        assert len(recs) == 1
+        rec = recs[0]
+        summary = verify([rec], min_coverage=0.9)
+        assert summary["complete"], summary["violations"]
+        assert summary["orphan_nodes"] == 0
+        assert summary["duplicate_nodes"] == 0
+        kinds = {n["kind"]: n for n in rec["nodes"]}
+        assert kinds["failover"]["reason"] == "RuntimeError"
+        assert kinds["failover"]["status"] == "ok"
+        assert kinds["submit"]["status"].startswith("error:")
+        # the delivering chain routes pool -> failover attempt
+        assert rec["chain"] == [rec["nodes"][0]["node"],
+                                kinds["failover"]["node"]]
+        # the pool node names the time burned on the failed attempt
+        pool_hops = rec["nodes"][0]["hops_ms"]
+        assert "prior_attempts" in pool_hops
+
+    def test_hedged_request_yields_one_complete_tree(self, reqtrace):
+        """ISSUE satellite: a hedged request — two engine attempts, one
+        winner — is ONE complete tree with one delivering leaf; the
+        loser's node is present but off the chain."""
+        from request_report import verify
+
+        with _make_batcher(_fake_predictor(batch_sleep_s=0.05)) as b:
+            client = PolicyClient(b, hedge_after_s=0.01)
+            assert client.submit(IMG).result(timeout=30) in (["res"],
+                                                             ["one"])
+        recs = _drain(reqtrace, 1)
+        assert len(recs) == 1
+        rec = recs[0]
+        # 0.9 floor, same reasoning as the failover test above
+        summary = verify([rec], min_coverage=0.9)
+        assert summary["complete"], summary["violations"]
+        kinds = [n["kind"] for n in rec["nodes"]]
+        assert "hedge" in kinds
+        assert len(rec["nodes"]) == 3   # policy + primary + hedge
+        # exactly one delivering leaf: the chain ends at ONE of the two
+        # attempts; the other is recorded but not delivering
+        leaf = rec["chain"][-1]
+        attempts = [n["node"] for n in rec["nodes"]
+                    if n["parent"] is not None]
+        assert leaf in attempts and len(attempts) == 2
+        root = rec["nodes"][0]
+        if root.get("won_kind") == "hedge":
+            assert "hedge_wait" in root["hops_ms"]
+
+    def test_cascade_escalation_tree(self, reqtrace):
+        """The ESCALATE edge carries its reason, and the chain keeps
+        conservation through the student_lane gap hop."""
+        from improved_body_parts_tpu.serve.cascade import (
+            CascadeEngine,
+            EscalationPolicy,
+        )
+
+        Sig = namedtuple("Sig", ["n_people", "peak_overflow",
+                                 "cand_overflow", "person_overflow",
+                                 "min_mean_score"])
+
+        class TracedEngine:
+            """submit-contract fake that follows the batcher's reqtrace
+            discipline: begin inside submit (picks up the caller's
+            scope), finish on resolution."""
+
+            emit_signals = False
+
+            def __init__(self, result, hold_s=0.03, model="m"):
+                self.result = result
+                self.hold_s = hold_s
+                self.model = model
+                self.draining = False
+
+            def start(self):
+                return self
+
+            def stop(self, drain_timeout_s=None):
+                pass
+
+            def submit(self, image, *, deadline_s=None):
+                node = get_reqtrace().begin("batcher", model=self.model)
+                f = Future()
+
+                def run():
+                    time.sleep(self.hold_s)
+                    node.finish("ok", hops=[("device", self.hold_s)])
+                    f.set_result(self.result)
+
+                threading.Thread(target=run, daemon=True).start()
+                return f
+
+        crowd = Sig(n_people=9, peak_overflow=False, cand_overflow=False,
+                    person_overflow=False, min_mean_score=1.0)
+        student = TracedEngine(("student_skels", crowd), model="student")
+        student.emit_signals = True
+        teacher = TracedEngine("teacher_skels", model="teacher")
+        cascade = CascadeEngine(student, teacher,
+                                policy=EscalationPolicy(max_people=4))
+        with cascade:
+            assert cascade.submit(IMG).result(timeout=30) == \
+                "teacher_skels"
+        recs = _drain(reqtrace, 1)
+        rec = recs[0]
+        esc = [n for n in rec["nodes"] if n["kind"] == "escalate"]
+        assert len(esc) == 1 and esc[0]["reason"] == "people"
+        assert esc[0]["model"] == "teacher"
+        root = rec["nodes"][0]
+        assert root["comp"] == "cascade" and root.get("lane") == "teacher"
+        assert "student_lane" in root["hops_ms"]
+        # chain: cascade -> teacher attempt; conservation holds even
+        # though the student's window is a side branch
+        assert rec["chain"] == [root["node"], esc[0]["node"]]
+        assert rec["hop_coverage"] >= 0.9
+
+    def test_pool_shed_at_submit_closes_its_node(self, reqtrace):
+        """Regression (review finding): EnginePool.submit opens a pool
+        node before routing; when every replica sheds it raises
+        ServerOverloaded — the node must CLOSE on that path or the
+        request's tree wedges forever (record never emits, the
+        recorder's live entry leaks)."""
+        from improved_body_parts_tpu.serve import ServerOverloaded
+
+        pred = _fake_predictor()
+        engines = [_make_batcher(pred, max_queue=1)]
+        with EnginePool(engines, probe_interval_s=30.0) as pool:
+            # saturate the single admission slot via a gated predictor?
+            # simpler: shed deterministically by draining the engine
+            engines[0].stop()
+            with pytest.raises(ServerOverloaded):
+                pool.submit(IMG)
+        recs = _drain(reqtrace, 1)
+        assert reqtrace.live == 0          # nothing wedged
+        assert len(recs) == 1
+        assert recs[0]["status"] == "error:ServerOverloaded"
+        assert recs[0]["nodes"][0]["comp"] == "pool"
+
+    def test_abandoned_hedge_chain_ends_at_failed_leaf(self, reqtrace):
+        """Regression (review finding): primary fails while the hedge
+        is being shed — `_attempt_abandoned` delivers the primary's
+        error and the chain must end at the FAILED ATTEMPT'S LEAF, not
+        dangle at the policy root (an interior chain end without a
+        deadline is a completeness violation)."""
+        from request_report import verify
+
+        from improved_body_parts_tpu.serve import ServerOverloaded
+
+        class OnceEngine:
+            """First submit: a node-tracked future that fails after a
+            delay.  Every later submit (the hedge) sheds."""
+
+            draining = False
+
+            def __init__(self):
+                self.calls = 0
+
+            def submit(self, image, *, deadline_s=None):
+                self.calls += 1
+                if self.calls > 1:
+                    # hold the hedge in its admission window PAST the
+                    # primary's failure, then shed: delivery must come
+                    # from _attempt_abandoned (the reviewed path), not
+                    # from _on_attempt_done
+                    time.sleep(0.1)
+                    raise ServerOverloaded("hedge shed")
+                node = get_reqtrace().begin("batcher")
+                f = Future()
+
+                def run():
+                    time.sleep(0.05)
+                    node.finish("error:RuntimeError")
+                    f.set_exception(RuntimeError("primary died"))
+
+                threading.Thread(target=run, daemon=True).start()
+                return f
+
+        client = PolicyClient(OnceEngine(), hedge_after_s=0.01,
+                              max_attempts=1)
+        with pytest.raises(RuntimeError, match="primary died"):
+            client.submit(IMG).result(timeout=30)
+        recs = _drain(reqtrace, 1)
+        assert len(recs) == 1
+        rec = recs[0]
+        summary = verify([rec], min_coverage=0.0)
+        assert summary["delivering_leaf_violations"] == 0, \
+            summary["violations"]
+        # chain: policy root -> the failed primary attempt
+        assert len(rec["chain"]) == 2
+        leaf = rec["nodes"][1]
+        assert leaf["status"] == "error:RuntimeError"
+
+    def test_stream_frame_tree_and_drop(self, reqtrace):
+        from improved_body_parts_tpu.stream import StreamSession
+
+        with _make_batcher(_fake_predictor(batch_sleep_s=0.02)) as b:
+            session = StreamSession("cam0", b, max_in_flight=4,
+                                    tracker=StubTracker())
+            futs = [session.submit_frame(IMG) for _ in range(3)]
+            for f in futs:
+                f.result(timeout=30)
+            session.close()
+        recs = _drain(reqtrace, 3)
+        assert len(recs) == 3
+        for rec in recs:
+            root = rec["nodes"][0]
+            assert root["comp"] == "stream"
+            assert root["stream"] == "cam0"
+            assert {"admit", "deliver"} <= set(root["hops_ms"])
+            # chain: frame -> its engine attempt
+            assert len(rec["chain"]) == 2
+            assert rec["hop_coverage"] >= 0.9, rec
+
+    def test_dropped_frame_records_frame_dropped(self, reqtrace):
+        from improved_body_parts_tpu.stream import StreamSession
+
+        gate = threading.Event()
+        pred = _fake_predictor()
+
+        def gated(self, imgs, **kw):
+            n = len(imgs)
+
+            def resolve():
+                gate.wait(10)
+                return ["res"] * n
+
+            return resolve
+
+        type(pred).predict_compact_batch_async = gated
+        type(pred).predict_compact_async = \
+            lambda self, img, **kw: gated(self, [img])
+        with _make_batcher(pred) as b:
+            session = StreamSession("cam1", b, max_in_flight=1,
+                                    policy="drop_oldest",
+                                    tracker=StubTracker())
+            f0 = session.submit_frame(IMG)
+            session.submit_frame(IMG)       # drops f0
+            gate.set()
+            from improved_body_parts_tpu.stream import FrameDropped
+
+            with pytest.raises(FrameDropped):
+                f0.result(timeout=30)
+            session.close()
+        recs = _drain(reqtrace, 2)
+        statuses = sorted(r["status"] for r in recs)
+        assert statuses == ["error:FrameDropped", "ok"]
+
+
+# --------------------------------------------- request_report verifier
+class TestRequestReportVerify:
+    def _good(self):
+        return {
+            "req": 1, "e2e_ms": 10.0, "status": "ok",
+            "chain": [1, 2], "hop_coverage": 1.0,
+            "nodes": [
+                {"node": 1, "parent": None, "comp": "pool",
+                 "kind": "submit", "status": "ok", "won_by": 2,
+                 "hops_ms": {"route": 1.0}},
+                {"node": 2, "parent": 1, "comp": "batcher",
+                 "kind": "submit", "status": "ok",
+                 "hops_ms": {"device": 9.0}},
+            ],
+        }
+
+    def test_good_record_passes(self):
+        from request_report import verify
+
+        s = verify([self._good()])
+        assert s["complete"] and s["chain_coverage"]["min"] == 1.0
+
+    def test_orphan_flagged(self):
+        from request_report import verify
+
+        rec = self._good()
+        rec["nodes"][1]["parent"] = 99
+        s = verify([rec])
+        assert not s["complete"] and s["orphan_nodes"] == 1
+
+    def test_duplicate_node_and_request_flagged(self):
+        from request_report import verify
+
+        rec = self._good()
+        rec["nodes"][1]["node"] = 1     # id collision
+        s = verify([rec, self._good()])
+        assert s["duplicate_nodes"] == 1
+        assert s["duplicate_requests"] == 1
+        assert not s["complete"]
+
+    def test_interior_chain_end_without_deadline_flagged(self):
+        from request_report import verify
+
+        rec = self._good()
+        rec["nodes"][0].pop("won_by")   # pool delivered with no child?
+        s = verify([rec])
+        assert s["delivering_leaf_violations"] == 1
+
+    def test_interior_deadline_end_allowed(self):
+        from request_report import verify
+
+        rec = self._good()
+        rec["nodes"][0].pop("won_by")
+        rec["nodes"][0]["status"] = "error:DeadlineExceeded"
+        # coverage shrinks to the root's own hops: relax the floor —
+        # this test pins the LEAF rule, not conservation
+        s = verify([rec], min_coverage=0.0)
+        assert s["delivering_leaf_violations"] == 0
+
+    def test_low_coverage_flagged(self):
+        from request_report import verify
+
+        rec = self._good()
+        rec["nodes"][1]["hops_ms"] = {"device": 1.0}
+        s = verify([rec])
+        assert s["coverage_violations"] == 1 and not s["complete"]
+
+    def test_cli_renders_and_verifies(self, tmp_path):
+        import subprocess
+        import sys
+
+        from improved_body_parts_tpu.obs.events import strict_dumps
+
+        path = tmp_path / "ev.jsonl"
+        rec = dict(self._good(), event="request", t=0.0)
+        path.write_text(strict_dumps(rec) + "\n")
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        r = subprocess.run(
+            [sys.executable, os.path.join(root, "tools",
+                                          "request_report.py"),
+             str(path), "--strict"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr[-500:]
+        assert "complete=True" in r.stdout
+        assert "pool/submit" in r.stdout

@@ -60,7 +60,14 @@ class TestCrossMeshEquivalence:
         first = jax.tree.leaves(new_state.params)[0]
         return float(loss), np.asarray(first)
 
+    @pytest.mark.slow
     def test_loss_and_update_identical_across_mesh_shapes(self, setup):
+        # slow tier since ISSUE 15's budget re-fit (60s: five mesh
+        # shapes × compiled steps on a degraded 2-core host).  Tier-1
+        # twins retained: test_training's 8-device SPMD step,
+        # test_partition's partitioned-vs-single equivalence, and this
+        # class's compiled-all-reduce check; bench.py's "scaling" key
+        # and the slow tier still run the full cross-shape sweep.
         """Scaling out is semantically invisible: 1x1, 2x1, 4x1, 8x1 and
         4x2 meshes all produce the same loss and the same updated params
         for one global batch (the all-reduced gradient is exact)."""

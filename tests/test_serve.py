@@ -349,6 +349,37 @@ def test_device_decode_is_the_default_lane(warm_pred):
     assert snap["completed"] == 4
 
 
+def test_hop_waterfall_conserves_e2e_on_warm_batcher(warm_pred):
+    """ISSUE 15 satellite: on a REAL warm batcher (jitted fused-decode
+    programs, warmed buckets) the five-hop waterfall
+    (queue/batch_formation/device/decode/deliver) must account for
+    >=95% of the measured end-to-end latency — the conservation
+    discipline that makes 'which hop ate the budget' a trustworthy
+    question.  The partition is exact by construction (shared boundary
+    stamps); this pins that the plumbing actually stamps every stage on
+    both the batch and singleton-flush paths."""
+    from improved_body_parts_tpu.serve import DynamicBatcher
+    from improved_body_parts_tpu.serve.metrics import HOPS
+
+    img = np.zeros((*SIZE_A, 3), np.uint8)
+    with DynamicBatcher(warm_pred, max_batch=2, max_wait_ms=20,
+                        use_native=False) as server:
+        server.warmup([SIZE_A], batch_sizes=(1, 2))
+        futs = [server.submit(img) for _ in range(6)]
+        for f in futs:
+            f.result(timeout=120)
+        snap = server.metrics.snapshot()
+    assert snap["completed"] == 6
+    for hop in HOPS:
+        assert snap["hops_ms"][hop]["count"] == 6
+    assert snap["hop_conservation_frac"] >= 0.95
+    # sums, not estimates: hop sums vs the exact e2e reservoir sum
+    hop_total = sum(snap["hops_ms"][h]["sum"] for h in HOPS)
+    e2e_total = (snap["latency_ms"]["mean"]
+                 * snap["latency_ms"]["count"])
+    assert hop_total == pytest.approx(e2e_total, rel=0.05)
+
+
 def test_host_pool_lane_still_serves(warm_pred):
     """device_decode=False keeps the pre-fusion decode-pool lane alive
     (the A/B + parity arm): same people, everything counted as
@@ -425,6 +456,12 @@ def test_serve_bench_cli(tmp_path):
     assert serve["mean_batch_occupancy"] >= 1
     assert r["sequential"]["imgs_per_sec"] > 0
     assert isinstance(r["batched_beats_sequential"], bool)
+    # ISSUE 15 satellite: the per-hop decomposition block rides the
+    # artifact next to the e2e numbers
+    for k in ("queue", "batch_formation", "device", "decode",
+              "deliver"):
+        assert serve["hops_ms"][k]["count"] > 0
+    assert serve["hop_conservation_frac"] >= 0.95
 
 
 def test_metrics_endpoint_serves_batcher_under_load(warm_pred):

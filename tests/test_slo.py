@@ -1,0 +1,270 @@
+"""obs.slo: declarative objectives, multi-window burn rate, error
+budgets, alarm transitions, the /slo route (HEAD parity) and the
+engine-layer wiring."""
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from improved_body_parts_tpu.obs import (
+    MetricsServer,
+    Objective,
+    Registry,
+    SLOTracker,
+    default_objectives,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_tracker(target=0.9, latency_ms=100.0, windows=(10.0, 100.0),
+                 burn_alarm=2.0, min_requests=5, **kw):
+    clock = FakeClock()
+    tracker = SLOTracker(
+        [Objective("interactive", latency_ms=latency_ms, target=target,
+                   windows_s=windows, burn_alarm=burn_alarm,
+                   min_requests=min_requests)],
+        clock=clock, **kw)
+    return tracker, clock
+
+
+class TestObjective:
+    def test_declarative_round_trip(self):
+        spec = {"latency_ms": 250.0, "target": 0.99,
+                "windows_s": [60.0, 600.0], "burn_alarm": 2.0,
+                "min_requests": 10}
+        obj = Objective.from_dict("interactive", spec)
+        assert obj.to_dict() == spec
+
+    def test_unknown_keys_loud(self):
+        with pytest.raises(ValueError, match="unknown keys"):
+            Objective.from_dict("x", {"latency_ms": 1, "latencyms": 2})
+
+    def test_degenerate_targets_refused(self):
+        with pytest.raises(ValueError):
+            Objective("x", latency_ms=10, target=1.0)
+        with pytest.raises(ValueError):
+            Objective("x", latency_ms=0)
+        with pytest.raises(ValueError):
+            Objective("x", latency_ms=10, windows_s=())
+
+    def test_tracker_from_declarative_dict(self):
+        t = SLOTracker({"interactive": {"latency_ms": 50.0},
+                        "batch": {"latency_ms": 1000.0,
+                                  "target": 0.999}})
+        assert set(t.state()["classes"]) == {"interactive", "batch"}
+
+    def test_default_objectives_build(self):
+        t = SLOTracker(default_objectives())
+        assert t.state()["status"] == "ok"
+
+
+class TestBurnRate:
+    def test_good_traffic_burns_nothing(self):
+        tracker, clock = make_tracker()
+        for _ in range(20):
+            tracker.record("interactive", 0.01)
+            clock.advance(0.1)
+        cls = tracker.state()["classes"]["interactive"]
+        assert cls["error_budget_remaining"] == 1.0
+        for win in cls["windows"].values():
+            assert win["burn_rate"] == 0.0 and win["availability"] == 1.0
+        assert not cls["alarm"]
+
+    def test_slow_success_is_bad(self):
+        """The latency SLO shares the good count: a success over the
+        latency bound spends budget exactly like an error."""
+        tracker, clock = make_tracker(target=0.9, latency_ms=100.0)
+        tracker.record("interactive", 0.5)          # slow success
+        cls = tracker.state()["classes"]["interactive"]
+        assert cls["good_total"] == 0
+
+    def test_burn_rate_math(self):
+        # target 0.9 -> budget 0.1; 2 bad of 10 -> bad_frac 0.2 ->
+        # burn 2.0 on every window containing them
+        tracker, clock = make_tracker(target=0.9)
+        for i in range(10):
+            tracker.record("interactive", 0.01, error=(i < 2))
+            clock.advance(0.1)
+        cls = tracker.state()["classes"]["interactive"]
+        for win in cls["windows"].values():
+            assert win["burn_rate"] == pytest.approx(2.0)
+        # cumulative budget: 2 bad / (10 * 0.1) = 2.0 spent -> clamped 0
+        assert cls["error_budget_remaining"] == 0.0
+
+    def test_windows_forget_at_different_rates(self):
+        tracker, clock = make_tracker(target=0.9,
+                                      windows=(10.0, 100.0))
+        for _ in range(5):
+            tracker.record("interactive", 0.01, error=True)
+            clock.advance(0.1)
+        # move past the fast window but stay inside the slow one; new
+        # good traffic dominates the fast window
+        clock.advance(15.0)
+        for _ in range(20):
+            tracker.record("interactive", 0.01)
+            clock.advance(0.1)
+        wins = tracker.state()["classes"]["interactive"]["windows"]
+        assert wins["10s"]["burn_rate"] == 0.0
+        assert wins["100s"]["burn_rate"] > 0.0
+
+    def test_alarm_needs_every_window_and_volume(self):
+        tracker, clock = make_tracker(target=0.9, burn_alarm=2.0,
+                                      min_requests=5)
+        # 3 bad requests: burn is huge but under the volume floor
+        for _ in range(3):
+            tracker.record("interactive", 0.01, error=True)
+        assert not tracker.state()["classes"]["interactive"]["alarm"]
+        for _ in range(4):
+            tracker.record("interactive", 0.01, error=True)
+        assert tracker.state()["classes"]["interactive"]["alarm"]
+
+    def test_alarm_transitions_emit_sink_events(self, tmp_path):
+        from improved_body_parts_tpu.obs import (
+            EventSink,
+            read_events,
+            set_sink,
+        )
+
+        path = str(tmp_path / "ev.jsonl")
+        sink = EventSink(path)
+        prev = set_sink(sink)
+        try:
+            tracker, clock = make_tracker(target=0.9, min_requests=5,
+                                          windows=(10.0, 20.0))
+            for _ in range(8):
+                tracker.record("interactive", 0.01, error=True)
+                clock.advance(0.1)
+            assert tracker.state()["classes"]["interactive"]["alarm"]
+            # resolve: the bad burst ages out of both windows and good
+            # traffic takes over
+            clock.advance(30.0)
+            for _ in range(20):
+                tracker.record("interactive", 0.01)
+                clock.advance(0.1)
+            assert not tracker.state()["classes"]["interactive"]["alarm"]
+        finally:
+            set_sink(prev)
+            sink.close()
+        alarms = [e for e in read_events(path)
+                  if e["event"] == "slo_alarm"]
+        assert [a["state"] for a in alarms] == ["firing", "resolved"]
+        assert alarms[0]["qos_class"] == "interactive"
+        assert "burn_rates" in alarms[0]
+        cls = tracker.state()["classes"]["interactive"]
+        assert cls["alarm_transitions"] == 1   # firings, not levels
+
+    def test_unclassified_counted_or_defaulted(self):
+        tracker, _ = make_tracker()
+        tracker.record("typo_class", 0.01)
+        assert tracker.unclassified == 1
+        assert tracker.state()["unclassified_requests"] == 1
+        tracker2, _ = make_tracker(default_class="interactive")
+        tracker2.record("typo_class", 0.01)
+        cls = tracker2.state()["classes"]["interactive"]
+        assert cls["requests_total"] == 1
+        with pytest.raises(ValueError):
+            make_tracker(default_class="nope")
+
+
+class TestExposition:
+    NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+    def test_collector_names_are_prometheus_legal(self):
+        tracker, _ = make_tracker()
+        tracker.record("interactive", 0.01)
+        reg = Registry()
+        tracker.register_into(reg)
+        names = set()
+        for name, labels, kind, value, help in reg._flat():
+            names.add(name)
+            assert self.NAME_RE.match(name), name
+            for k in labels:
+                assert self.NAME_RE.match(str(k)), (name, k)
+            if kind == "counter":
+                assert name.endswith(("_total", "_sum", "_count")), name
+        assert {"slo_requests_total", "slo_good_total",
+                "slo_error_budget_remaining", "slo_alarm",
+                "slo_burn_rate"} <= names
+
+    def test_slo_route_ok_alarm_head_and_404(self):
+        tracker, clock = make_tracker(target=0.9, min_requests=5,
+                                      windows=(10.0, 20.0))
+        reg = Registry()
+        with MetricsServer(reg, port=0, slo=tracker.state) as srv:
+            tracker.record("interactive", 0.01)
+            resp = urllib.request.urlopen(srv.url + "/slo", timeout=10)
+            body = json.loads(resp.read())
+            assert resp.status == 200 and body["status"] == "ok"
+            assert body["classes"]["interactive"]["requests_total"] == 1
+            # HEAD parity: same status, no body
+            req = urllib.request.Request(srv.url + "/slo",
+                                         method="HEAD")
+            head = urllib.request.urlopen(req, timeout=10)
+            assert head.status == 200 and head.read() == b""
+            # alarm -> 503 so a status-only consumer can gate
+            for _ in range(8):
+                tracker.record("interactive", 0.01, error=True)
+                clock.advance(0.1)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/slo", timeout=10)
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["status"] == "alarm"
+        with MetricsServer(Registry(), port=0) as srv:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/slo", timeout=10)
+            assert ei.value.code == 404
+
+
+class TestEngineWiring:
+    def test_batcher_records_outcomes(self):
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_reqtrace import IMG, _make_batcher
+
+        tracker, _ = make_tracker(latency_ms=60000.0)
+        with _make_batcher(slo=tracker, qos_class="interactive") as b:
+            for _ in range(4):
+                b.submit(IMG).result(timeout=30)
+        cls = tracker.state()["classes"]["interactive"]
+        assert cls["requests_total"] == 4
+        assert cls["good_total"] == 4
+
+    def test_policy_records_failures(self):
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_reqtrace import IMG, _fake_predictor, _make_batcher
+
+        from improved_body_parts_tpu.serve import PolicyClient
+
+        pred = _fake_predictor()
+
+        def boom(self, imgs, **kw):
+            def resolve():
+                raise RuntimeError("dead program")
+
+            return resolve
+
+        type(pred).predict_compact_batch_async = boom
+        type(pred).predict_compact_async = boom
+        tracker, _ = make_tracker()
+        with _make_batcher(pred) as b:
+            client = PolicyClient(b, slo=tracker,
+                                  qos_class="interactive")
+            with pytest.raises(RuntimeError):
+                client.submit(IMG).result(timeout=30)
+        cls = tracker.state()["classes"]["interactive"]
+        assert cls["requests_total"] == 1 and cls["good_total"] == 0

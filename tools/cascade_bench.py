@@ -392,6 +392,18 @@ def main():
         # escalation rate describes the serving stream, not the
         # half-easy/half-hard unique-image set the AP gate decodes
         snap = cascade.metrics.snapshot()
+        # per-hop p50/p95/p99 decomposition per tier (queue/
+        # batch_formation/device/decode/deliver, serve.metrics.HOPS)
+        # alongside the e2e numbers — same interleaved-round protocol
+        report["hops_ms"] = {
+            "student": cascade.student.metrics.snapshot()["hops_ms"],
+            "teacher": cascade.teacher.metrics.snapshot()["hops_ms"],
+            "teacher_only": teacher_only.metrics.snapshot()["hops_ms"]}
+        report["hop_conservation_frac"] = {
+            "student": cascade.student.metrics.snapshot()[
+                "hop_conservation_frac"],
+            "teacher_only": teacher_only.metrics.snapshot()[
+                "hop_conservation_frac"]}
 
         # --- quality gate: per-image decode, both arms, OKS AP -------
         gts, det_cascade, det_teacher = {}, {}, {}

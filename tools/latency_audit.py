@@ -1,0 +1,410 @@
+#!/usr/bin/env python
+"""End-to-end request-path latency audit → LATENCY_AUDIT.json.
+
+The proof artifact for the request-tracing + SLO layer: every claim the
+obs/reqtrace waterfall makes about where a request's budget goes is
+checked against ground it can't fake, on REAL warm serve pipelines
+(jitted programs, the standard warmup path, a compile watch over the
+whole sweep).  Four arms:
+
+1. **plain serve** — closed-loop clients against one warm
+   ``DynamicBatcher``; gates that the five-hop decomposition
+   (queue / batch_formation / device / decode / deliver,
+   ``serve.metrics.HOPS``) sums to ≥95% of measured e2e latency — both
+   at the registry level (hop reservoir sums vs the latency reservoir
+   sum) and per request (the delivering chain's hop coverage).  An
+   ``obs.slo.SLOTracker`` rides this arm and its state lands in the
+   artifact (the ``/slo`` consumable).
+2. **cascade** — the same gates across a student→teacher
+   ``CascadeEngine`` on a mixed easy/hard stream (the tiered planted
+   shim from ``tools/cascade_bench.py``): escalated requests must keep
+   chain conservation through the ESCALATE hop edge (the
+   ``student_lane`` gap hop is what makes that possible).
+3. **chaos** — a 2-replica ``EnginePool`` behind a hedging
+   ``PolicyClient``; mid-traffic one replica is hard-stopped out from
+   under the pool (the SERVE_CHAOS injection class).  Gates causal
+   completeness where it is hardest: every record a complete tree with
+   exactly one delivering leaf, zero orphan/duplicate records, and the
+   sweep must actually have exercised ``failover`` and ``hedge`` edges
+   (a chaos arm that injected nothing proves nothing).
+4. **overhead** — the serve-path reqtrace A/B
+   (``tools/telemetry_overhead.serve_overhead_ab``, the
+   TELEMETRY_OVERHEAD estimator): the full tracing stack must cost <2%.
+
+Plus: slowest-10 request trees (via ``tools/request_report``), and 0
+post-warmup recompiles across every arm — tracing must add no jitted
+programs.
+
+    python tools/latency_audit.py --out LATENCY_AUDIT.json
+    python tools/latency_audit.py --quick     # bench.py's "slo" smoke
+"""
+import argparse
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    strict_dump,
+    strict_dumps,
+)
+
+CONSERVATION_FLOOR = 0.95
+
+
+def run_clients(n_clients, requests, work_fn):
+    errors = []
+
+    def client(cid):
+        try:
+            for i in range(requests):
+                work_fn(cid, i)
+        except Exception as e:  # noqa: BLE001 — surfaced after join
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+    return wall
+
+
+def drain_records(reqtrace, expected, timeout_s=30.0):
+    """Request records assemble when the LAST node of each tree
+    finishes — a losing hedge/failover attempt can land after the
+    caller's future resolved.  Wait for the in-flight table to drain."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        recs = reqtrace.records()
+        if len(recs) >= expected and reqtrace.live == 0:
+            return recs
+        time.sleep(0.02)
+    return reqtrace.records()
+
+
+def arm_summary(records, snapshot, verify):
+    """The per-arm artifact block: registry-level hop decomposition +
+    per-request chain conservation + causal completeness."""
+    hops = snapshot["hops_ms"]
+    summary = verify(records)
+    covs = sorted(r["hop_coverage"] for r in records)
+    return {
+        "requests": len(records),
+        "e2e_ms": snapshot["latency_ms"],
+        "hops_ms": hops,
+        "registry_conservation_frac": snapshot["hop_conservation_frac"],
+        "chain_coverage_p50": (covs[len(covs) // 2] if covs else None),
+        "chain_coverage_min": (covs[0] if covs else None),
+        "causal": {k: summary[k] for k in
+                   ("complete", "orphan_nodes", "duplicate_nodes",
+                    "duplicate_requests", "delivering_leaf_violations",
+                    "coverage_violations", "edge_kinds")},
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--size", type=int, default=192,
+                    help="square frame size (also boxsize: one bucket)")
+    ap.add_argument("--clients", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=10,
+                    help="closed-loop requests per client per arm")
+    ap.add_argument("--overhead-rounds", type=int, default=6)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--max-wait-ms", type=float, default=15.0)
+    ap.add_argument("--quick", action="store_true",
+                    help="bench.py smoke shape: fewer clients/requests/"
+                         "rounds, smaller frames")
+    ap.add_argument("--out", default="LATENCY_AUDIT.json")
+    args = ap.parse_args()
+    if args.quick:
+        args.size = min(args.size, 128)
+        args.clients = 2
+        args.requests = 6
+        args.overhead_rounds = 4
+
+    from improved_body_parts_tpu.utils import (
+        apply_platform_env, devices_with_timeout)
+    apply_platform_env()
+
+    import jax
+    import numpy as np
+
+    platform = devices_with_timeout(900)[0].platform
+    print(f"platform={platform}", flush=True)
+
+    import jax.numpy as jnp
+
+    from cascade_bench import TieredPlantedModel, make_images, plant_people
+    from chaos_serve import ChaosBox, ChaosPredictor
+    from e2e_bench import PlantedModel, planted_maps, synth_images
+    from request_report import slowest, verify
+    from telemetry_overhead import serve_overhead_ab
+
+    from improved_body_parts_tpu.config import (
+        InferenceModelParams, get_config)
+    from improved_body_parts_tpu.infer.predict import Predictor
+    from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.obs import (
+        Objective, Registry, RunTelemetry, SLOTracker)
+    from improved_body_parts_tpu.serve import (
+        CascadeEngine, DynamicBatcher, EnginePool, EscalationPolicy,
+        PolicyClient, ServeMetrics, submit_with_retry)
+
+    size = args.size
+    rng = np.random.default_rng(0)
+    sizes = [(size, size)]
+    batcher_kw = dict(max_batch=args.max_batch,
+                      max_wait_ms=args.max_wait_ms, max_queue=64)
+
+    def make_pred(cfg_name, model_wrap):
+        cfg = get_config(cfg_name)
+        model = build_model(cfg)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, size, size, 3)),
+                               train=False)
+        return Predictor(model_wrap(model, cfg), variables,
+                         cfg.skeleton,
+                         model_params=InferenceModelParams(
+                             boxsize=size, max_downsample=64),
+                         bucket=64)
+
+    tiny_sk = get_config("tiny").skeleton
+    plain_pred = make_pred(
+        "tiny", lambda m, cfg: PlantedModel(
+            m, planted_maps(cfg.skeleton, 2, rng,
+                            canvas=max(size * 2, 256)), cfg.skeleton))
+    images = synth_images(4, size, rng)
+
+    # the SLO layer rides the plain arm; its state lands in the
+    # artifact as the /slo consumable
+    slo = SLOTracker([Objective("interactive", latency_ms=5000.0,
+                                target=0.99, windows_s=(30.0, 120.0))])
+    sink_path = os.path.splitext(args.out)[0] + "_events.jsonl"
+    if os.path.exists(sink_path):
+        os.unlink(sink_path)
+    telemetry = RunTelemetry(
+        sink_path, registry=Registry(), reqtrace_sample=1, slo=slo,
+        run_meta={"tool": "latency_audit", "platform": platform})
+    rt = telemetry.reqtrace
+
+    report = {
+        "platform": platform,
+        "size": size, "clients": args.clients,
+        "requests_per_client": args.requests,
+        "conservation_floor": CONSERVATION_FLOOR,
+        "telemetry_events": sink_path,
+        "note": "All arms run real jitted serve programs behind "
+                "planted-map shims (the standing bench discipline); "
+                "per-hop sums are checked against the e2e reservoir "
+                "at the registry level AND per request along the "
+                "delivering chain. CPU-host absolute numbers are not "
+                "the claim — conservation, causal completeness and "
+                "the overhead ratio are.",
+    }
+
+    def closed_loop(submit):
+        def work(cid, i):
+            img = images[(cid + i * args.clients) % len(images)]
+            fut, _ = submit_with_retry(submit, img, base_s=0.002,
+                                       max_s=0.05)
+            fut.result(timeout=300)
+        return run_clients(args.clients, args.requests, work)
+
+    # per-arm recompile accounting: each arm fences AFTER its own
+    # warmup and reads the process compile counter's delta over its
+    # traffic — a multi-arm audit cannot use one global mark_warm (each
+    # later arm's legitimate warmup would count against the earlier
+    # fence)
+    def compiles():
+        return int(telemetry.compile_watch.compiles.value)
+
+    arm_recompiles = {}
+
+    # --- arm 1: plain serve ------------------------------------------
+    n_arm = args.clients * args.requests
+    server = DynamicBatcher(plain_pred, registry=telemetry.registry,
+                            slo=slo, qos_class="interactive",
+                            **batcher_kw)
+    with server:
+        server.warmup(sizes)
+        c0 = compiles()
+        closed_loop(server.submit)
+        arm_recompiles["plain_serve"] = compiles() - c0
+        recs = drain_records(rt, n_arm)
+        report["plain_serve"] = arm_summary(
+            recs, server.metrics.snapshot(), verify)
+    report["slo"] = slo.state()
+    print(f"plain serve: conservation "
+          f"{report['plain_serve']['registry_conservation_frac']} "
+          f"chain p50 {report['plain_serve']['chain_coverage_p50']}",
+          flush=True)
+
+    # --- arm 2: cascade ----------------------------------------------
+    easy_maps, _ = plant_people(tiny_sk, 2, rng, size)
+    hard_maps, _ = plant_people(tiny_sk, 6, rng, size)
+    student = make_pred("tiny_student", lambda m, cfg: TieredPlantedModel(
+        m, easy_maps, hard_maps, cfg.skeleton))
+    teacher = make_pred("tiny", lambda m, cfg: TieredPlantedModel(
+        m, easy_maps, hard_maps, cfg.skeleton))
+    easy_imgs, hard_imgs = make_images(size, 3, rng)
+    # every 4th frame hard: the escalate edge must appear in records
+    mixed = [hard_imgs[i // 4 % len(hard_imgs)] if i % 4 == 3
+             else easy_imgs[i % len(easy_imgs)] for i in range(8)]
+    base = len(rt.records())
+    cascade = CascadeEngine.build(
+        student, teacher, policy=EscalationPolicy(max_people=4),
+        registry=telemetry.registry, **batcher_kw)
+    with cascade:
+        cascade.warmup(sizes)
+        c0 = compiles()
+        images_save, images[:] = images[:], mixed
+        try:
+            closed_loop(cascade.submit)
+        finally:
+            images[:] = images_save
+        arm_recompiles["cascade"] = compiles() - c0
+        recs = drain_records(rt, base + n_arm)[base:]
+        report["cascade"] = arm_summary(
+            recs, cascade.student.metrics.snapshot(), verify)
+        report["cascade"]["routing"] = cascade.metrics.snapshot()
+    esc_edges = report["cascade"]["causal"]["edge_kinds"].get(
+        "escalate", 0)
+    print(f"cascade: chain p50 "
+          f"{report['cascade']['chain_coverage_p50']} "
+          f"escalate edges {esc_edges}", flush=True)
+
+    # --- arm 3: chaos (failover + hedge) -----------------------------
+    # the SERVE_CHAOS injection machinery: shared-nothing replicas
+    # (one Predictor per engine — never two dispatchers on one program
+    # cache), replica 0 wrapped in a ChaosBox whose POISON makes its
+    # next N resolves raise mid-execute — a deterministic failover
+    # source (every poisoned batch's requests fail over to replica 1)
+    boxes = [ChaosBox(), ChaosBox()]
+    chaos_preds = [
+        ChaosPredictor(make_pred(
+            "tiny", lambda m, cfg: PlantedModel(
+                m, planted_maps(cfg.skeleton, 2, rng,
+                                canvas=max(size * 2, 256)),
+                cfg.skeleton)), boxes[i])
+        for i in range(2)]
+    engines = [DynamicBatcher(chaos_preds[i], metrics=ServeMetrics(),
+                              **batcher_kw) for i in range(2)]
+    base = len(rt.records())
+    pool = EnginePool(engines, probe_interval_s=0.05,
+                      wedge_timeout_s=30.0, drain_timeout_s=5.0,
+                      fence_on_breaker=False,
+                      registry=telemetry.registry)
+    with pool:
+        pool.warmup(sizes)
+        # hedge fires at ~half a typical request's latency: most
+        # requests dispatch a covering attempt, some hedges win
+        warm_t0 = time.perf_counter()
+        pool.submit(images[0]).result(timeout=300)
+        typical = time.perf_counter() - warm_t0
+        c0 = compiles()
+        client = PolicyClient(pool, hedge_after_s=max(typical * 0.5,
+                                                      0.005),
+                              max_attempts=8)
+        n_poison = max(2, n_arm // 4)
+        boxes[0].poison_left = n_poison
+
+        def chaos_work(cid, i):
+            img = images[(cid + i * args.clients) % len(images)]
+            client.submit(img).result(timeout=300)
+
+        run_clients(args.clients, args.requests, chaos_work)
+        arm_recompiles["chaos"] = compiles() - c0
+        recs = drain_records(rt, base + n_arm + 1)[base:]
+    chaos_verify = verify(recs)
+    kinds = chaos_verify["edge_kinds"]
+    report["chaos"] = {
+        "requests": len(recs),
+        "injection": f"replica 0 poisoned for {n_poison} resolves "
+                     f"(mid-execute raise -> failover) + hedging "
+                     f"policy client",
+        "policy": client.stats.snapshot(),
+        "pool_counters": pool.counters(),
+        "causal": {k: chaos_verify[k] for k in
+                   ("complete", "orphan_nodes", "duplicate_nodes",
+                    "duplicate_requests", "delivering_leaf_violations",
+                    "coverage_violations", "edge_kinds")},
+        "failover_edges": kinds.get("failover", 0),
+        "hedge_edges": kinds.get("hedge", 0),
+    }
+    print(f"chaos: {len(recs)} records, failover edges "
+          f"{report['chaos']['failover_edges']}, hedge edges "
+          f"{report['chaos']['hedge_edges']}, complete "
+          f"{chaos_verify['complete']}", flush=True)
+
+    # --- slowest request trees (across every arm's records) ----------
+    report["slowest_requests"] = slowest(rt.records(), 10)
+    # the committed events stream must survive the standalone verifier
+    # (`request_report --strict`) — every record of every arm
+    all_verify = verify(rt.records())
+    report["all_records"] = {
+        "requests": all_verify["requests"],
+        "complete": all_verify["complete"],
+        "violations": len(all_verify["violations"]),
+    }
+
+    # --- arm 4: serve-path overhead A/B ------------------------------
+    oh_c0 = [None]
+    report["reqtrace_overhead"] = serve_overhead_ab(
+        plain_pred, sizes, images, 2, max(4, args.requests // 2),
+        args.overhead_rounds, batcher_kw=batcher_kw,
+        on_warm=lambda: oh_c0.__setitem__(0, compiles()))
+    arm_recompiles["overhead_ab"] = compiles() - oh_c0[0]
+    print(f"overhead: {report['reqtrace_overhead']['overhead_pct']}% "
+          f"(budget {report['reqtrace_overhead']['budget_pct']}%)",
+          flush=True)
+
+    report["recompiles_by_arm"] = arm_recompiles
+    report["recompiles_post_warmup"] = int(sum(arm_recompiles.values()))
+    slow_verify = verify(report["slowest_requests"])
+    report["gates"] = {
+        "plain_conservation_ge_95": bool(
+            report["plain_serve"]["registry_conservation_frac"]
+            >= CONSERVATION_FLOOR
+            and report["plain_serve"]["chain_coverage_p50"]
+            >= CONSERVATION_FLOOR),
+        "cascade_conservation_ge_95": bool(
+            report["cascade"]["chain_coverage_p50"]
+            >= CONSERVATION_FLOOR),
+        "slowest_trees_complete": bool(slow_verify["complete"]),
+        "chaos_zero_orphans_dupes": bool(
+            chaos_verify["orphan_nodes"] == 0
+            and chaos_verify["duplicate_nodes"] == 0
+            and chaos_verify["duplicate_requests"] == 0),
+        "chaos_trees_complete": bool(chaos_verify["complete"]),
+        "chaos_exercised_failover_and_hedge": bool(
+            report["chaos"]["failover_edges"] > 0
+            and report["chaos"]["hedge_edges"] > 0),
+        "overhead_within_budget": bool(
+            report["reqtrace_overhead"]["within_budget"]),
+        "zero_post_warmup_recompiles": bool(
+            report["recompiles_post_warmup"] == 0),
+        "all_records_complete": bool(report["all_records"]["complete"]),
+    }
+    report["gates"]["all"] = all(report["gates"].values())
+
+    telemetry.close()
+    with open(args.out, "w") as f:
+        strict_dump(report, f, indent=2)
+    print(strict_dumps({"gates": report["gates"]}))
+    if not report["gates"]["all"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

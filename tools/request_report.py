@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Reconstruct per-request causal trees and verify causal completeness.
+
+Input is a JSONL event stream containing ``request`` records (what
+``obs.reqtrace.ReqTrace`` emits through the run's event sink — the
+benches' ``*_events.jsonl`` files, or the stream a serving process
+writes), or a JSON file with a top-level ``"records"`` list (the
+``LATENCY_AUDIT.json`` shape).  For each request the record carries the
+whole causal tree: one node per component that handled it (batcher,
+pool, policy, cascade, stream), the edge kind that created each node
+(submit / retry / hedge / failover / escalate / migrate) with its
+reason annotation, and each node's hop waterfall.
+
+The tool answers the two questions the raw records exist for:
+
+- **Where did the slow requests' budgets go?**  ``--top N`` renders the
+  N slowest requests as indented trees with their per-hop waterfalls —
+  which hop ate the time is readable without a UI.
+- **Is the tracing itself trustworthy?**  Causal completeness is
+  verified over EVERY record: exactly one delivering leaf per request
+  (the ``won_by`` chain from the root must exist, terminate, and end at
+  a leaf — or at the interior node itself only when a client-side
+  deadline resolved it), zero orphan nodes (every ``parent`` resolves
+  inside the tree), zero duplicate node ids, zero duplicate request
+  ids, and chain-hop conservation (the delivering chain's hop sum
+  covers ``--min-coverage`` of the end-to-end span).  A tracing layer
+  that drops or duplicates records under failover/hedge churn would
+  read as a healthy system lying about its tail — these checks are what
+  ``LATENCY_AUDIT.json`` gates on, including under the chaos arm's
+  injected failovers.
+
+    python tools/request_report.py SERVE_BENCH_events.jsonl --top 10
+    python tools/request_report.py LATENCY_AUDIT.json --strict
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    read_events,
+    strict_dump,
+)
+
+#: chain-hop conservation floor: the delivering chain's hop sum must
+#: cover this fraction of the request's end-to-end span (the StepPhases
+#: discipline applied per request)
+MIN_COVERAGE = 0.95
+
+
+def load_records(path):
+    """``request`` records from a JSONL event stream or a JSON file
+    with a top-level ``records`` list."""
+    if path.endswith(".jsonl"):
+        return [e for e in read_events(path)
+                if e.get("event") == "request"]
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        recs = data.get("records")
+        if recs is None:
+            raise SystemExit(
+                f"{path}: no 'records' list — pass a JSONL event "
+                "stream or a JSON file with a records list")
+        return recs
+    return data
+
+
+def verify(records, min_coverage=MIN_COVERAGE):
+    """Causal-completeness verdict over every record; returns the
+    summary dict (``violations`` lists each failing request with the
+    rule it broke)."""
+    seen_req = set()
+    out = {
+        "requests": len(records),
+        "duplicate_requests": 0,
+        "duplicate_nodes": 0,
+        "orphan_nodes": 0,
+        "delivering_leaf_violations": 0,
+        "coverage_violations": 0,
+        "edge_kinds": {},
+        "violations": [],
+    }
+    coverages = []
+    for rec in records:
+        req = rec.get("req")
+        problems = []
+        if req in seen_req:
+            out["duplicate_requests"] += 1
+            problems.append("duplicate request id")
+        seen_req.add(req)
+        nodes = rec.get("nodes", [])
+        ids = [n.get("node") for n in nodes]
+        by_id = {}
+        for n in nodes:
+            if n.get("node") in by_id:
+                out["duplicate_nodes"] += 1
+                problems.append(f"duplicate node id {n.get('node')}")
+            by_id[n.get("node")] = n
+            kind = n.get("kind", "?")
+            out["edge_kinds"][kind] = out["edge_kinds"].get(kind, 0) + 1
+        roots = [n for n in nodes if n.get("parent") is None]
+        for n in nodes:
+            if n.get("parent") is not None and \
+                    n["parent"] not in by_id:
+                out["orphan_nodes"] += 1
+                problems.append(
+                    f"orphan node {n.get('node')} (parent "
+                    f"{n['parent']} missing)")
+        # the delivering chain: follow won_by from the root
+        children = {}
+        for n in nodes:
+            if n.get("parent") is not None:
+                children.setdefault(n["parent"], []).append(n)
+        chain_ok = len(roots) == 1
+        if chain_ok:
+            cur, hops_sum, steps = roots[0], 0.0, 0
+            while True:
+                hops_sum += sum(cur.get("hops_ms", {}).values())
+                nxt = by_id.get(cur.get("won_by"))
+                if cur.get("won_by") is not None and nxt is None:
+                    chain_ok = False
+                    problems.append(
+                        f"won_by {cur['won_by']} not in tree")
+                    break
+                if nxt is None:
+                    # chain terminus: must be a LEAF — exactly one
+                    # delivering leaf — unless a client-side deadline
+                    # resolved the request at an interior node (the
+                    # only layer that can legally deliver without a
+                    # child outcome)
+                    is_leaf = not children.get(cur.get("node"))
+                    deadline = "DeadlineExceeded" in str(
+                        cur.get("status", ""))
+                    if not is_leaf and not deadline:
+                        chain_ok = False
+                        problems.append(
+                            f"chain ends at interior node "
+                            f"{cur.get('node')} without a deadline")
+                    break
+                cur = nxt
+                steps += 1
+                if steps > len(nodes):
+                    chain_ok = False
+                    problems.append("won_by cycle")
+                    break
+            e2e = rec.get("e2e_ms", 0.0)
+            if chain_ok and e2e > 0:
+                cov = hops_sum / e2e
+                coverages.append(cov)
+                if cov < min_coverage:
+                    out["coverage_violations"] += 1
+                    problems.append(
+                        f"chain hops cover {cov:.1%} of e2e "
+                        f"(< {min_coverage:.0%})")
+        else:
+            problems.append(f"{len(roots)} roots (need exactly 1)")
+        if not chain_ok:
+            out["delivering_leaf_violations"] += 1
+        if problems:
+            out["violations"].append({"req": req, "problems": problems})
+    if coverages:
+        coverages.sort()
+        out["chain_coverage"] = {
+            "mean": round(sum(coverages) / len(coverages), 4),
+            "p50": round(coverages[len(coverages) // 2], 4),
+            "min": round(coverages[0], 4),
+        }
+    out["complete"] = not out["violations"]
+    return out
+
+
+def render_tree(rec):
+    """One request as an indented causal tree with hop waterfalls."""
+    nodes = rec.get("nodes", [])
+    children = {}
+    roots = []
+    for n in nodes:
+        if n.get("parent") is None:
+            roots.append(n)
+        else:
+            children.setdefault(n["parent"], []).append(n)
+    chain = set(rec.get("chain", []))
+    lines = [f"req {rec.get('req')}  e2e {rec.get('e2e_ms')} ms  "
+             f"status {rec.get('status')}  chain covers "
+             f"{rec.get('hop_coverage', 0):.1%}"]
+
+    def walk(n, depth):
+        extras = {k: v for k, v in n.items()
+                  if k not in ("node", "parent", "comp", "kind",
+                               "t0_ms", "dur_ms", "status", "won_by",
+                               "hops_ms")}
+        hops = "  ".join(f"{h}={v}" for h, v in
+                         n.get("hops_ms", {}).items())
+        star = "*" if n.get("node") in chain else " "
+        extra = ("  [" + " ".join(f"{k}={v}"
+                                  for k, v in extras.items()) + "]"
+                 if extras else "")
+        lines.append(
+            f"  {'  ' * depth}{star}{n.get('comp')}/{n.get('kind')}"
+            f"  {n.get('dur_ms')} ms  ({n.get('status')}){extra}"
+            + (f"\n  {'  ' * depth}   hops: {hops}" if hops else ""))
+        for c in sorted(children.get(n.get("node"), []),
+                        key=lambda x: x.get("t0_ms", 0)):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    return "\n".join(lines)
+
+
+def slowest(records, top):
+    return sorted(records, key=lambda r: -r.get("e2e_ms", 0.0))[:top]
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("events", help="JSONL event stream with `request` "
+                    "records, or a JSON file with a `records` list")
+    ap.add_argument("--top", type=int, default=10,
+                    help="render the N slowest request trees")
+    ap.add_argument("--min-coverage", type=float, default=MIN_COVERAGE,
+                    help="chain-hop conservation floor (fraction of "
+                         "e2e the delivering chain must account for)")
+    ap.add_argument("--json", default=None,
+                    help="also write the verification summary + "
+                         "slowest trees to this path")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero on any completeness violation")
+    args = ap.parse_args()
+
+    records = load_records(args.events)
+    if not records:
+        raise SystemExit(f"{args.events}: 0 request records — nothing "
+                         "to report (was reqtrace enabled?)")
+    summary = verify(records, args.min_coverage)
+    slow = slowest(records, args.top)
+
+    print(f"{summary['requests']} request records; "
+          f"complete={summary['complete']} "
+          f"(orphans={summary['orphan_nodes']}, "
+          f"dup_nodes={summary['duplicate_nodes']}, "
+          f"dup_reqs={summary['duplicate_requests']}, "
+          f"leaf_violations={summary['delivering_leaf_violations']}, "
+          f"coverage_violations={summary['coverage_violations']})")
+    print("edge kinds: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(summary["edge_kinds"].items())))
+    if "chain_coverage" in summary:
+        cc = summary["chain_coverage"]
+        print(f"chain coverage: mean {cc['mean']:.1%}  p50 "
+              f"{cc['p50']:.1%}  min {cc['min']:.1%}")
+    print(f"\nslowest {len(slow)} requests:")
+    for rec in slow:
+        print(render_tree(rec))
+    for v in summary["violations"][:10]:
+        print(f"VIOLATION req {v['req']}: {'; '.join(v['problems'])}",
+              file=sys.stderr)
+    if args.json:
+        with open(args.json, "w") as f:
+            strict_dump({"summary": summary, "slowest": slow}, f,
+                        indent=2)
+    if args.strict and not summary["complete"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

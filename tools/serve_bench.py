@@ -223,6 +223,12 @@ def bench_serve(pred, params, images, sizes, n_clients, requests, args,
         "mean_batch_occupancy": snap["mean_batch_occupancy"],
         "occupancy_histogram": snap["occupancy_histogram"],
         "queue_depth_peak": snap["queue_depth_peak"],
+        # the per-hop decomposition (queue/batch_formation/device/
+        # decode/deliver) alongside the e2e numbers, plus the
+        # conservation readout (hop sums / e2e sums — exact partition
+        # by construction, see serve.metrics.HOPS)
+        "hops_ms": snap["hops_ms"],
+        "hop_conservation_frac": snap["hop_conservation_frac"],
         "warmup": {"bucket_shapes": [list(s) for s
                                      in warm["bucket_shapes"]],
                    "batch_sizes": list(warm["batch_sizes"]),
@@ -504,7 +510,11 @@ def main():
                                   for r in serve_rounds),
         "mean_batch_occupancy": verdict_snap["mean_batch_occupancy"],
         "occupancy_histogram": verdict_snap["occupancy_histogram"],
-        "queue_depth_peak": verdict_snap["queue_depth_peak"]}
+        "queue_depth_peak": verdict_snap["queue_depth_peak"],
+        # per-hop p50/p95/p99 over the interleaved verdict rounds
+        "hops_ms": verdict_snap["hops_ms"],
+        "hop_conservation_frac":
+            verdict_snap["hop_conservation_frac"]}
     report["batched_beats_sequential"] = bool(serve_fps > seq_fps)
     report["speedup_at_peak_load"] = round(serve_fps / seq_fps, 3)
     strongest = max(seq_fps,

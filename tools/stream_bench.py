@@ -352,6 +352,12 @@ def main():
     report["occupancy_histogram"] = serve_snap["occupancy_histogram"]
     report["decode_fused"] = serve_snap["decode_fused"]
     report["decode_host_fallback"] = serve_snap["decode_host_fallback"]
+    # the engine-side per-hop decomposition (queue/batch_formation/
+    # device/decode/deliver) behind the streams' e2e numbers, with the
+    # conservation readout (serve.metrics.HOPS)
+    report["engine_hops_ms"] = serve_snap["hops_ms"]
+    report["engine_hop_conservation_frac"] = \
+        serve_snap["hop_conservation_frac"]
     report["recompiles_post_warmup"] = int(
         telemetry.compile_watch.recompiles.value)
     # the sustained verdict: every stream of every multi round delivered

@@ -39,6 +39,7 @@ import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from improved_body_parts_tpu.obs.events import (  # noqa: E402
     strict_dump,
@@ -46,6 +47,155 @@ from improved_body_parts_tpu.obs.events import (  # noqa: E402
 )
 
 OVERHEAD_BUDGET_PCT = 2.0
+
+
+def paired_median_overhead(off_fps, on_fps):
+    """Overhead %% = median of paired per-round off/on throughput
+    ratios — the TELEMETRY_OVERHEAD estimator on the serve path.
+
+    Estimator notes (empirical, same discipline as the train-path
+    selection): on a cpu-shares host under EXTERNAL load a single
+    round's paired ratio swings ±70%% in both directions and no
+    round-level estimator is sound — the adaptive retry (double the
+    pairs, re-estimate over all of them) is the defense, and the
+    committed artifact runs on an otherwise-idle host where 24 pairs
+    sit within ~±8%% and the median stabilizes to ~1-2%%.  Selecting a
+    "quiet" SUBSET of pairs by their own throughput was tried and
+    rejected: it preferentially keeps rounds where the off arm drew
+    high, biasing the median upward by >2×."""
+    ratios = sorted(o / n for o, n in zip(off_fps, on_fps))
+    return (ratios[len(ratios) // 2] - 1.0) * 100.0
+
+
+def serve_overhead_ab(predictor, sizes, images, n_clients, requests,
+                      rounds, batcher_kw=None, tmpdir=None,
+                      budget_pct=OVERHEAD_BUDGET_PCT, on_warm=None):
+    """Serve-path reqtrace A/B: closed-loop slices against ONE warm
+    batcher, alternating the full request-tracing stack OFF and ON
+    (``obs.reqtrace.ReqTrace`` sample=1 + JSONL sink + span tracer —
+    what a traced serving process actually pays per request), ABBA
+    round order, verdict = median of paired per-round throughput
+    ratios.  The same TELEMETRY_OVERHEAD estimator discipline as the
+    train-path A/B: pairing cancels host-load drift, the median
+    discards burst-inflated rounds, and one adaptive retry doubles the
+    evidence before concluding the budget is blown.  The per-hop
+    boundary stamps (``serve.metrics.HOPS``) run in BOTH arms — they
+    are part of the serve path now, five perf_counter reads per
+    request; this A/B prices the *recorder* (tree assembly + JSONL
+    emission), which is the part sampling can thin.
+
+    Importable: ``tools/latency_audit.py`` embeds this verdict in
+    LATENCY_AUDIT.json.
+    """
+    from improved_body_parts_tpu.obs import (
+        EventSink, ReqTrace, TraceRecorder, set_reqtrace, set_sink,
+        set_tracer)
+    from improved_body_parts_tpu.serve import (
+        DynamicBatcher, submit_with_retry)
+
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="reqtrace_oh_")
+    events_path = os.path.join(tmpdir, "serve_events.jsonl")
+
+    def run_slice(server):
+        import threading
+
+        errors = []
+
+        def client(cid):
+            try:
+                for i in range(requests):
+                    img = images[(cid + i * n_clients) % len(images)]
+                    fut, _ = submit_with_retry(server.submit, img,
+                                               base_s=0.002, max_s=0.05)
+                    fut.result()
+            except Exception as e:  # noqa: BLE001 — surfaced after join
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(c,),
+                                    daemon=True)
+                   for c in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        return n_clients * requests / wall
+
+    def measure(n_rounds):
+        off_fps, on_fps = [], []
+        for i in range(n_rounds):
+            order = [("off", off_fps), ("on", on_fps)]
+            if i % 2:
+                order.reverse()
+            for arm, acc in order:
+                if arm == "on":
+                    sink = EventSink(events_path,
+                                     run_meta={"tool": "serve_ab"})
+                    installs = (set_sink(sink),
+                                set_reqtrace(ReqTrace(sample=1,
+                                                      t0=sink.t0)),
+                                set_tracer(TraceRecorder(t0=sink.t0)))
+                else:
+                    # the OFF arm must install the NULL stack
+                    # explicitly — when the CALLER runs under a live
+                    # RunTelemetry (latency_audit does), inheriting its
+                    # recorder/sink/tracer would silently turn this
+                    # A/B into an A/A (and leak the off rounds'
+                    # records into the caller's stream)
+                    sink = None
+                    installs = (set_sink(None), set_reqtrace(None),
+                                set_tracer(None))
+                try:
+                    acc.append(run_slice(server))
+                finally:
+                    prev_sink, prev_rt, prev_tr = installs
+                    set_tracer(prev_tr)
+                    set_reqtrace(prev_rt)
+                    set_sink(prev_sink)
+                    if sink is not None:
+                        sink.close()
+        return paired_median_overhead(off_fps, on_fps), off_fps, on_fps
+
+    kw = dict(batcher_kw or {})
+    # ONE warm server for both arms: identical compiled programs and
+    # thread pools, so the only difference a round sees is the
+    # installed recorder stack
+    server = DynamicBatcher(predictor, **kw)
+    with server:
+        server.warmup(sizes)
+        if on_warm is not None:
+            # the caller's warm fence (latency_audit anchors its
+            # per-arm recompile delta here)
+            on_warm()
+        overhead_pct, off_fps, on_fps = measure(max(1, rounds))
+        retried = False
+        if overhead_pct >= budget_pct:
+            # noise shrinks with samples, real overhead would not:
+            # double the evidence once and re-estimate over ALL pairs
+            retried = True
+            _, off2, on2 = measure(max(1, rounds) * 2)
+            off_fps += off2
+            on_fps += on2
+            overhead_pct = paired_median_overhead(off_fps, on_fps)
+    n_events = sum(1 for line in open(events_path))
+    return {
+        "estimator": "median of paired per-round off/on throughput "
+                     "ratios, ABBA order, adaptive retry pooling all "
+                     "pairs (see paired_median_overhead)",
+        "clients": n_clients,
+        "requests_per_round": n_clients * requests,
+        "overhead_pct": round(overhead_pct, 3),
+        "budget_pct": budget_pct,
+        "within_budget": bool(overhead_pct < budget_pct),
+        "retried": retried,
+        "off_imgs_per_sec": [round(v, 3) for v in off_fps],
+        "on_imgs_per_sec": [round(v, 3) for v in on_fps],
+        "on_events_emitted": n_events,
+        "events": events_path,
+    }
 
 
 def main():
@@ -63,6 +213,20 @@ def main():
                          "shared-core host's spread on identical code "
                          "can be several times the true overhead)")
     ap.add_argument("--print-freq", type=int, default=5)
+    ap.add_argument("--serve-path", action="store_true",
+                    help="also run the serve-path reqtrace A/B (closed-"
+                         "loop clients against one warm batcher, "
+                         "request tracing off vs on) and report it as "
+                         "the serve_path block")
+    ap.add_argument("--serve-rounds", type=int, default=6,
+                    help="serve-path A/B rounds (ABBA paired)")
+    ap.add_argument("--serve-clients", type=int, default=2)
+    ap.add_argument("--serve-requests", type=int, default=6,
+                    help="closed-loop requests per client per round")
+    ap.add_argument("--serve-size", type=int, default=128,
+                    help="square frame size for the serve-path arm "
+                         "(small = fast rounds AND a conservatively "
+                         "LARGE relative overhead)")
     ap.add_argument("--out", default="TELEMETRY_OVERHEAD.json")
     ap.add_argument("--strict", action="store_true",
                     help="exit nonzero when the overhead budget is blown")
@@ -205,6 +369,37 @@ def main():
     hold = sum(e["compute_s"] for e in records)
     split_cover = (wait + hold) / on_wall if on_wall else 0.0
 
+    serve_path = None
+    if args.serve_path:
+        from e2e_bench import PlantedModel, planted_maps, synth_images
+
+        from improved_body_parts_tpu.config import (
+            InferenceModelParams, get_config)
+        from improved_body_parts_tpu.infer.predict import Predictor
+
+        s_cfg = get_config("tiny")
+        s_model = build_model(s_cfg)
+        sz = args.serve_size
+        import jax.numpy as jnp
+
+        s_vars = s_model.init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, sz, sz, 3)), train=False)
+        s_rng = np.random.default_rng(0)
+        planted = PlantedModel(
+            s_model, planted_maps(s_cfg.skeleton, 2, s_rng,
+                                  canvas=max(sz * 2, 256)),
+            s_cfg.skeleton)
+        s_pred = Predictor(planted, s_vars, s_cfg.skeleton,
+                           model_params=InferenceModelParams(
+                               boxsize=sz, max_downsample=64),
+                           bucket=64)
+        serve_path = serve_overhead_ab(
+            s_pred, [(sz, sz)], synth_images(4, sz, s_rng),
+            args.serve_clients, args.serve_requests, args.serve_rounds,
+            batcher_kw=dict(max_batch=4, max_wait_ms=10.0))
+        print(strict_dumps({"serve_path_overhead_pct":
+                            serve_path["overhead_pct"]}))
+
     report = {
         "config": args.config,
         "steps": args.steps,
@@ -240,11 +435,15 @@ def main():
         "split_covers_wall_frac": round(split_cover, 4),
         "recompiles_post_warmup": sum(
             1 for e in events if e.get("event") == "recompile"),
+        **({"serve_path": serve_path} if serve_path is not None else {}),
     }
     with open(args.out, "w") as f:
         strict_dump(report, f, indent=2)
     print(strict_dumps(report))
     if args.strict and not report["within_budget"]:
+        sys.exit(1)
+    if args.strict and serve_path is not None \
+            and not serve_path["within_budget"]:
         sys.exit(1)
 
 

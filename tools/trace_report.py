@@ -142,6 +142,10 @@ def summarize(events, other):
         "events": len(events),
         "spans": len(spans),
         "dropped_events": int(other.get("dropped_events", 0)),
+        # a lossy ring means every aggregate below UNDERCOUNTS — the
+        # same signal rides the registry as trace_spans_dropped_total
+        # so a live scrape sees it too (obs.trace.attach_registry)
+        "lossy": bool(int(other.get("dropped_events", 0))),
         "tracks": sorted(_track_names(events).values()),
         "by_name": names,
         "step_windows": {
@@ -158,9 +162,13 @@ def summarize(events, other):
 
 def render_text(summary):
     lines = [f"trace: {summary['spans']} spans / {summary['events']} "
-             f"events on {len(summary['tracks'])} tracks"
-             + (f" ({summary['dropped_events']} dropped by the ring)"
-                if summary["dropped_events"] else "")]
+             f"events on {len(summary['tracks'])} tracks"]
+    if summary["lossy"]:
+        lines.append(
+            f"WARNING: LOSSY TRACE — the ring dropped "
+            f"{summary['dropped_events']} events past capacity; every "
+            f"total below undercounts.  Raise TraceRecorder(capacity=) "
+            f"(and watch trace_spans_dropped_total on /metrics).")
     sw = summary["step_windows"]
     if sw["count"]:
         lines.append(
