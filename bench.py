@@ -536,6 +536,53 @@ def _servechaos_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _procpool_summary(fallback, budget_s):
+    """Run tools/serve_bench.py --proc-only (the thread-pool vs
+    process-pool A/B over the shared-memory wire plus the SIGKILL
+    chaos arm) and return a compact summary, or an {"error"/"skipped"}
+    marker — the "chaos" key contract.  Subprocess so a worker-process
+    failure can never take down the primary metric; bounded by the
+    REMAINING driver budget.  ``IBP_BENCH_PROCPOOL=0`` skips it
+    unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_PROCPOOL") == "0":
+        return {"skipped": "IBP_BENCH_PROCPOOL=0"}
+    if budget_s < 240:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (PROC_BENCH.json has the full "
+                           "A/B)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="proc_bench_"),
+                       "PROC_BENCH.json")
+    # smoke A/B: fewer rounds/requests than the committed artifact —
+    # the verdict machinery, wire and chaos arm are what's exercised
+    argv = ["--proc-only", "--proc-rounds", "3", "--requests", "8",
+            "--telemetry-sink", "none"]
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "serve_bench.py"),
+             "--out", out] + argv,
+            capture_output=True, timeout=min(900, budget_s), check=True,
+            env=dict(os.environ))
+        with open(out) as f:
+            r = json.load(f)
+        ab, chaos = r["proc_ab"], r["proc_chaos"]
+        return {
+            "verdict_ok": ab["verdict_ok"],
+            "multi_core_host": ab["multi_core_host"],
+            "median_round_ratio": ab["median_round_ratio"],
+            "workers": ab["workers"],
+            "recompiles_post_warmup": r["recompiles_post_warmup"],
+            "chaos_all_futures_resolved": chaos["all_futures_resolved"],
+            "chaos_respawned": chaos["respawned"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def _audit_summary(budget_s):
     """Run tools/program_audit.py (the graftaudit compiled-program tier:
     jaxpr checks + fingerprint gating over the program registry, at
@@ -850,6 +897,10 @@ def main():
     # same discipline
     servechaos = _servechaos_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # thread-pool vs process-pool A/B + worker-SIGKILL arm, same
+    # discipline
+    procpool = _procpool_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     # GSPMD weak-scaling smoke (partitioned step, virtual meshes), same
     # discipline
     scaling = _scaling_summary(
@@ -885,6 +936,7 @@ def main():
         "ckpt": ckpt,
         "chaos": chaos,
         "servechaos": servechaos,
+        "procpool": procpool,
         "scaling": scaling,
         "cascade": cascade,
         "slo": slo,

@@ -18,6 +18,11 @@ user requests to the chip).
   breaking, fencing and transparent failover of in-flight work.
 - ``breaker``  — :class:`CircuitBreaker`: sliding-window failure-rate
   breaker with half-open probing.
+- ``router`` / ``worker`` — :class:`ProcessRouter` +
+  :class:`ProcessWorkerEngine`: the pool's replicas promoted to real
+  worker PROCESSES over the PR 2 shared-memory wire (images in,
+  fixed-shape person tables out, no pickling) — true multi-core QPS,
+  SIGKILL-survivable, same engine contract end to end.
 - ``policy``   — :class:`PolicyClient` + :func:`submit_with_retry`:
   client-side deadlines, jittered retry on ``ServerOverloaded``, hedged
   dispatch for tail latency.
@@ -31,10 +36,12 @@ from .cascade import CascadeEngine, CascadeMetrics, EscalationPolicy
 from .metrics import ServeMetrics
 from .policy import PolicyClient, PolicyStats, jittered_backoff, submit_with_retry
 from .pool import EnginePool
+from .router import ProcessRouter, ProcessWorkerEngine
 from .warmup import pow2_batch_sizes, precompile
 
 __all__ = ["CascadeEngine", "CascadeMetrics", "CircuitBreaker",
            "DeadlineExceeded", "DynamicBatcher", "EnginePool",
            "EscalationPolicy", "PolicyClient", "PolicyStats",
+           "ProcessRouter", "ProcessWorkerEngine",
            "ServeMetrics", "ServerOverloaded", "jittered_backoff",
            "pow2_batch_sizes", "precompile", "submit_with_retry"]

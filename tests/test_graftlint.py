@@ -1014,6 +1014,36 @@ def test_scope_covers_cascade_module():
         lint(leak, path="improved_body_parts_tpu/serve/cascade.py"))
 
 
+def test_scope_covers_process_serving_modules():
+    """ISSUE 16 satellite: the process-serving layer (serve/worker.py,
+    serve/router.py) lives in the JGL002 hot-path scope (the worker
+    serve loop and the router's submit/fetch paths run per request)
+    and JGL005 sees its process/thread/pipe lifecycles — locked on the
+    files' actual paths so a future move out of serve/ can't silently
+    drop them from the sweep."""
+    hot = """
+        import jax.numpy as jnp
+
+        def serve_loop(slots):
+            for s in slots:
+                out = jnp.sum(s)
+                respond(float(out))
+    """
+    for path in ("improved_body_parts_tpu/serve/worker.py",
+                 "improved_body_parts_tpu/serve/router.py"):
+        assert "JGL002" in rules_of(lint(hot, path=path)), path
+    leak = """
+        import threading
+
+        def spawn_fetcher(engine):
+            t = threading.Thread(target=engine.fetch)
+            t.start()
+    """
+    for path in ("improved_body_parts_tpu/serve/worker.py",
+                 "improved_body_parts_tpu/serve/router.py"):
+        assert "JGL005" in rules_of(lint(leak, path=path)), path
+
+
 def test_scope_covers_reqtrace_and_slo_modules():
     """ISSUE 15 satellite: the per-request observability layer
     (obs/reqtrace.py, obs/slo.py) runs ON the serve threads for every

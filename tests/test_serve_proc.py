@@ -1,0 +1,358 @@
+"""Process-isolated serving tests: ``serve.worker`` + ``serve.router``
+(ISSUE 16).
+
+Three tiers, none of which pays an XLA compile:
+
+- **Wire units** — encode/decode round-trips of the fixed-shape person
+  table, bit-exactly, without spawning anything.
+- **Engine contract on one worker process** — submit/health/drain/
+  deadline/error delivery through the shared-memory transport, plus
+  the respawn discipline (backoff counters, crash budget) driven by a
+  real SIGKILL.
+- **Fleet semantics** — a ``ProcessRouter``'s pool carries the PR 11
+  fence/failover/breaker logic across the process boundary: bit
+  identity against an in-process thread arm on the SAME fake
+  predictor, kill-mid-flight failover with zero lost futures, drain
+  discipline (every future resolves on ``stop()``), and reqtrace
+  causal completeness over a process-pool run.
+"""
+import os
+import signal
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.infer.decode import EscalationSignals
+from improved_body_parts_tpu.serve import (
+    DeadlineExceeded,
+    EnginePool,
+    ProcessRouter,
+    ProcessWorkerEngine,
+    ServeMetrics,
+    ServerOverloaded,
+)
+from improved_body_parts_tpu.serve.worker import (
+    constant_predictor,
+    decode_people,
+    encode_people,
+    wire_format,
+)
+
+SPEC = "improved_body_parts_tpu.serve.worker:constant_predictor"
+NUM_PARTS = 6
+
+ENGINE_KW = dict(max_image_hw=(64, 64), num_parts=NUM_PARTS,
+                 max_people=8, slots=8)
+
+
+def _img(value: int, hw=(32, 32)) -> np.ndarray:
+    return np.full((*hw, 3), value, np.uint8)
+
+
+def _conserved(m: ServeMetrics) -> bool:
+    return m.submitted == m.completed + m.failed + m.depth
+
+
+# --------------------------------------------------------------------- #
+# wire units                                                             #
+# --------------------------------------------------------------------- #
+class TestWire:
+    def _views(self, max_people=8, num_parts=NUM_PARTS):
+        _, shapes, dtypes = wire_format((64, 64), num_parts, max_people)
+        kps = np.zeros(shapes[2], np.float64)
+        scores = np.zeros(shapes[3], np.float64)
+        sig = np.zeros(shapes[4], np.float64)
+        meta_out = np.zeros(shapes[5], np.float64)
+        return kps, scores, sig, meta_out
+
+    def test_roundtrip_bit_identity(self):
+        people = [
+            ([(1.5, 2.25), None, (-3.0, 4.125), (0.1, 0.2), None,
+              (7.0, 8.0)], 3.375),
+            ([None, (10.5, 11.0), (12.0, 13.5), None, (1e-9, 2e9),
+              (0.0625, 0.03125)], -1.5),
+        ]
+        signals = EscalationSignals(2, False, True, False,
+                                    float("inf"), True)
+        kps, scores, sig, meta_out = self._views()
+        encode_people(people, signals, kps, scores, sig, meta_out)
+        out, out_sig = decode_people(kps, scores, sig)
+        assert out == people            # exact float equality
+        assert out_sig == signals
+        assert meta_out[6] == 0.0       # nothing truncated
+
+    def test_roundtrip_no_signals_and_empty(self):
+        kps, scores, sig, meta_out = self._views()
+        encode_people([], None, kps, scores, sig, meta_out)
+        out, out_sig = decode_people(kps, scores, sig)
+        assert out == [] and out_sig is None
+
+    def test_truncation_counted(self):
+        people = [([(float(p), 1.0)] + [None] * (NUM_PARTS - 1), 1.0)
+                  for p in range(1, 12)]
+        kps, scores, sig, meta_out = self._views(max_people=8)
+        encode_people(people, None, kps, scores, sig, meta_out)
+        out, _ = decode_people(kps, scores, sig)
+        assert len(out) == 8 and out == people[:8]
+        assert meta_out[6] == 3.0
+
+
+# --------------------------------------------------------------------- #
+# one worker process behind the engine contract                         #
+# --------------------------------------------------------------------- #
+class TestProcessWorkerEngine:
+    def test_serve_and_contract_refusals(self):
+        with ProcessWorkerEngine(SPEC, {"num_parts": NUM_PARTS},
+                                 **ENGINE_KW) as eng:
+            with pytest.raises(DeadlineExceeded):
+                eng.submit(_img(1), deadline_s=0.0)
+            people, signals = eng.submit(
+                _img(3), deadline_s=30.0).result(timeout=30)
+            assert len(people) == 2 and signals.fused
+            # deterministic content: base = img[0, 0, 0]
+            assert people[0][0][0] == (3.0, 32.0)
+            h = eng.health()
+            assert h["running"] and h["dispatcher_alive"]
+            assert h["fetchers_alive"] == h["fetchers_expected"] == 1
+            assert _conserved(eng.metrics)
+        with pytest.raises(RuntimeError, match="not running"):
+            eng.submit(_img(1))
+
+    def test_overload_sheds(self):
+        kw = dict(ENGINE_KW, slots=2)
+        with ProcessWorkerEngine(SPEC, {"num_parts": NUM_PARTS,
+                                        "delay_s": 0.5}, **kw) as eng:
+            futs = [eng.submit(_img(1)) for _ in range(2)]
+            with pytest.raises(ServerOverloaded, match="in flight"):
+                eng.submit(_img(1))
+            assert eng.metrics.rejected == 1
+            for f in futs:
+                f.result(timeout=30)
+            assert _conserved(eng.metrics)
+
+    def test_worker_error_delivered_and_engine_survives(self):
+        with ProcessWorkerEngine(SPEC, {"num_parts": NUM_PARTS,
+                                        "fail_every": 2},
+                                 **ENGINE_KW) as eng:
+            eng.submit(_img(1)).result(timeout=30)        # call 1 ok
+            with pytest.raises(RuntimeError,
+                               match="injected predictor failure"):
+                eng.submit(_img(1)).result(timeout=30)    # call 2 fails
+            eng.submit(_img(1)).result(timeout=30)        # call 3 ok
+            assert eng.metrics.failed == 1
+            assert _conserved(eng.metrics)
+
+    def test_deadline_expired_at_worker(self):
+        with ProcessWorkerEngine(SPEC, {"num_parts": NUM_PARTS,
+                                        "delay_s": 0.3},
+                                 **ENGINE_KW) as eng:
+            # first request holds the worker; the second's deadline
+            # lapses while it waits in the task queue
+            slow = eng.submit(_img(1), deadline_s=30.0)
+            doa = eng.submit(_img(2), deadline_s=0.05)
+            with pytest.raises(DeadlineExceeded):
+                doa.result(timeout=30)
+            slow.result(timeout=30)
+            assert eng.metrics.expired == 1
+
+    def test_sigkill_fails_inflight_and_respawn_serves(self):
+        kw = dict(ENGINE_KW)
+        with ProcessWorkerEngine(SPEC, {"num_parts": NUM_PARTS,
+                                        "delay_s": 0.4}, **kw) as eng:
+            fut = eng.submit(_img(1), deadline_s=30.0)
+            time.sleep(0.05)
+            os.kill(eng.worker_stats()["pid"], signal.SIGKILL)
+            with pytest.raises(RuntimeError):   # WorkerDied
+                fut.result(timeout=30)
+            assert not eng.health()["running"]
+            assert eng.consecutive_failures == 1
+            # the pool's restart path: start() respawns with backoff
+            eng.start()
+            assert eng.health()["running"]
+            eng.submit(_img(4)).result(timeout=30)
+            assert eng.consecutive_failures == 0   # progress resets
+            assert eng.restarts == 2
+            assert _conserved(eng.metrics)
+
+    def test_crash_budget_stops_the_respawn_loop(self):
+        eng = ProcessWorkerEngine(SPEC, {"num_parts": NUM_PARTS},
+                                  crash_budget=2, backoff_base_s=0.0,
+                                  **ENGINE_KW)
+        eng.consecutive_failures = 2       # deterministic crash loop
+        eng.start()
+        assert eng.gave_up and not eng.health()["running"]
+        eng.stop()
+
+
+# --------------------------------------------------------------------- #
+# thread arm for the bit-identity check                                 #
+# --------------------------------------------------------------------- #
+class InlineEngine:
+    """The SAME fake predictor served in-process on threads — the
+    thread-pool arm of the bit-identity contract."""
+
+    def __init__(self, **pred_kw):
+        self.pred = constant_predictor(**pred_kw)
+        self.metrics = ServeMetrics()
+        self._running = False
+        self._draining = False
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def start(self):
+        self._running = True
+        return self
+
+    def stop(self, drain_timeout_s=None):
+        self._running = False
+
+    def warmup(self, image_sizes, batch_sizes=None):
+        return {}
+
+    def submit(self, image, *, deadline_s=None):
+        if not self._running:
+            raise RuntimeError("not running")
+        if deadline_s is not None and deadline_s <= 0:
+            self.metrics.on_expire_rejected()
+            raise DeadlineExceeded("expired at submit")
+        self.metrics.on_submit()
+        f = Future()
+        try:
+            f.set_result(self.pred.serve_one(image))
+            self.metrics.on_complete(0.001)
+        except Exception as e:  # noqa: BLE001 — delivered per request
+            self.metrics.on_fail()
+            f.set_exception(e)
+        return f
+
+    def health(self):
+        return {"running": self._running, "draining": self._draining,
+                "dispatcher_alive": self._running, "fetchers_alive": 1,
+                "fetchers_expected": 1,
+                "queue_depth": self.metrics.depth,
+                "batches_in_flight": 0,
+                "stall_age_s": self.metrics.stall_age_s()}
+
+
+# --------------------------------------------------------------------- #
+# fleet semantics                                                        #
+# --------------------------------------------------------------------- #
+class TestProcessRouter:
+    def test_bit_identity_thread_vs_process_pool(self):
+        """The process wire adds nothing and loses nothing: the same
+        fake predictor behind a thread pool and behind worker processes
+        yields bit-identical person tables and signals."""
+        pred_kw = {"num_parts": NUM_PARTS, "n_people": 3}
+        images = [_img(v, (32, 48)) for v in (0, 7, 19, 255)]
+        with EnginePool([InlineEngine(**pred_kw),
+                         InlineEngine(**pred_kw)]) as tpool:
+            thread_res = [tpool.submit(im).result(timeout=10)
+                          for im in images]
+        with ProcessRouter(SPEC, num_workers=2, spec_kwargs=pred_kw,
+                           **ENGINE_KW) as router:
+            proc_res = [router.submit(im).result(timeout=30)
+                        for im in images]
+        assert thread_res == proc_res   # exact: floats, Nones, signals
+
+    def test_kill_mid_flight_fails_over_and_respawns(self):
+        with ProcessRouter(SPEC, num_workers=2,
+                           spec_kwargs={"num_parts": NUM_PARTS,
+                                        "delay_s": 0.25},
+                           restart_after_s=0.3, probe_interval_s=0.05,
+                           **ENGINE_KW) as router:
+            futs = [router.submit(_img(v), deadline_s=60.0)
+                    for v in range(6)]
+            time.sleep(0.05)
+            os.kill(router.workers[0].worker_stats()["pid"],
+                    signal.SIGKILL)
+            # zero lost futures: every one resolves WITH A RESULT (the
+            # survivor absorbs the failovers)
+            for f in futs:
+                people, _ = f.result(timeout=60)
+                assert len(people) == 2
+            c = router.counters()
+            assert c["fenced"] >= 1 and c["failovers"] >= 1
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                if router.counters()["restarts"] >= 1 and \
+                        router.workers[0].health()["running"]:
+                    break
+                time.sleep(0.05)
+            assert router.counters()["restarts"] >= 1
+            # the respawned fleet serves new traffic
+            router.submit(_img(9)).result(timeout=30)
+            m = router.metrics
+            assert _conserved(m) and m.failed == 0
+
+    def test_drain_resolves_every_future(self):
+        router = ProcessRouter(SPEC, num_workers=2,
+                               spec_kwargs={"num_parts": NUM_PARTS,
+                                            "delay_s": 0.15},
+                               **ENGINE_KW).start()
+        futs = [router.submit(_img(v), deadline_s=60.0)
+                for v in range(8)]
+        router.stop(drain_timeout_s=30.0)
+        resolved = 0
+        for f in futs:
+            assert f.done()
+            try:
+                f.result(timeout=0)
+                resolved += 1
+            except Exception:  # noqa: BLE001 — typed error still counts
+                resolved += 1
+        assert resolved == len(futs)
+        assert _conserved(router.metrics)
+
+    def test_reqtrace_completeness_over_process_run(self, tmp_path):
+        import sys
+
+        from improved_body_parts_tpu.obs.events import (
+            EventSink,
+            NullSink,
+            set_sink,
+        )
+        from improved_body_parts_tpu.obs.reqtrace import (
+            ReqTrace,
+            set_reqtrace,
+        )
+
+        path = str(tmp_path / "proc_events.jsonl")
+        sink = EventSink(path)
+        set_sink(sink)
+        set_reqtrace(ReqTrace(sample=1.0))
+        try:
+            with ProcessRouter(SPEC, num_workers=2,
+                               spec_kwargs={"num_parts": NUM_PARTS},
+                               **ENGINE_KW) as router:
+                futs = [router.submit(_img(v), deadline_s=30.0)
+                        for v in range(10)]
+                [f.result(timeout=30) for f in futs]
+        finally:
+            set_reqtrace(ReqTrace(sample=0.0))
+            set_sink(NullSink())
+            sink.close()
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import request_report
+
+        records = request_report.load_records(path)
+        summary = request_report.verify(records)
+        assert summary["requests"] == 10
+        assert summary["complete"], summary["violations"][:3]
+        # the PR 3 multi-process sink rule: each worker wrote its own
+        # `.pN` shard with its lifecycle events
+        shards = sorted(p for p in os.listdir(tmp_path)
+                        if p.startswith("proc_events.jsonl.p"))
+        assert shards == ["proc_events.jsonl.p1",
+                          "proc_events.jsonl.p2"]
+        from improved_body_parts_tpu.obs.events import read_events
+
+        for shard in shards:
+            events = [e["event"] for e in
+                      read_events(str(tmp_path / shard))]
+            assert events[0] == "run_start"
+            assert "worker_start" in events

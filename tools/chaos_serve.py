@@ -22,7 +22,12 @@ injected INTO the serving machinery:
   the stranded frames and every stream must deliver ALL frames strictly
   in order (the tracker's age stamp is the proof);
 - **latency spike**: a replica turns slow — the policy layer's hedged
-  second dispatch must bound the tail (hedges fire and win).
+  second dispatch must bound the tail (hedges fire and win);
+- **worker SIGKILL**: the process lane — a ``ProcessRouter`` worker
+  PROCESS is kill -9'd mid-batch.  The shm-wire engine fails its
+  in-flight futures with ``WorkerDied``, the pool fences the replica
+  and fails the work over (zero lost futures), and the supervisor
+  respawns a FRESH process (new pid) that serves again.
 
 Asserted end to end, the ISSUE 11 acceptance: **zero lost futures**
 (every submit() of any kind resolves with a result or a typed error),
@@ -254,8 +259,10 @@ def main():
             "in-process EnginePool over shared-nothing DynamicBatcher "
             "replicas serving real traffic (submits, hedged policy "
             "submits, stream sessions) while deterministic faults are "
-            "injected mid-execute; every future tracked; zero-lost/"
-            "bounded-failover/frame-order/leak-scan asserted"),
+            "injected mid-execute, plus a process-lane phase (worker "
+            "SIGKILL through a ProcessRouter); every future tracked; "
+            "zero-lost/bounded-failover/frame-order/leak-scan "
+            "asserted"),
         "platform": platform, "config": args.config,
         "size": args.size, "replicas": n_rep,
         "requests_per_phase": args.requests,
@@ -521,6 +528,76 @@ def main():
               "latency: a hedge beat the slow replica")
         return rec
 
+    # ------------------------------------------------------ 6: worker SIGKILL
+    def inject_worker_sigkill():
+        """kill -9 across the PROCESS boundary: the one fault class the
+        in-process phases above cannot model (a thread cannot survive
+        its own interpreter dying).  Self-contained router — the
+        injection must not share fate with the in-process pool."""
+        import signal
+
+        from improved_body_parts_tpu.serve.router import ProcessRouter
+
+        t0 = time.perf_counter()
+        small = np.zeros((48, 48, 3), np.uint8)
+        router = ProcessRouter(
+            "improved_body_parts_tpu.serve.worker:constant_predictor",
+            num_workers=2,
+            spec_kwargs={"num_parts": 18, "n_people": 2,
+                         "delay_s": 0.2},
+            slots=16, max_image_hw=(64, 64), num_parts=18,
+            max_people=8, restart_after_s=0.3, probe_interval_s=0.05)
+        with router:
+            # path probe, and the proof the target worker is serving
+            ledger.track(router.submit(small),
+                         "worker_sigkill_probe").result(timeout=120)
+            pid0 = router.workers[0].worker_stats()["pid"]
+            futs = [ledger.track(router.submit(small), "worker_sigkill")
+                    for _ in range(args.requests)]
+            time.sleep(0.05)             # land the kill MID-batch
+            os.kill(pid0, signal.SIGKILL)
+            ok = err = 0
+            for f in futs:
+                try:
+                    f.result(timeout=120)
+                    ok += 1
+                except Exception:  # noqa: BLE001 — typed = resolved
+                    err += 1
+            recovered_s = time.perf_counter() - t0
+            respawned = wait_until(
+                lambda: router.workers[0].restarts >= 2, timeout_s=30)
+            pid1 = router.workers[0].worker_stats()["pid"]
+            post = ledger.track(router.submit(small),
+                                "worker_sigkill_post")
+            # the process engine returns (people, signals) when the
+            # escalation signal vector rides the response
+            res = post.result(timeout=120)
+            people = res[0] if isinstance(res, tuple) else res
+            post_ok = isinstance(people, list) and len(people) > 0
+            counters = router.counters()
+        rec = {
+            "kind": "worker_sigkill",
+            "in_flight_at_kill": len(futs),
+            "resolved_ok": ok, "resolved_error": err,
+            "killed_pid": pid0, "respawned_pid": pid1,
+            "respawned": bool(respawned and pid1 != pid0),
+            "worker_respawns": counters["worker_respawns"],
+            "fenced": counters["fenced"],
+            "failovers": counters["failovers"],
+            "post_respawn_answered": post_ok,
+            "recovery_s": round(recovered_s, 3),
+        }
+        check(ok + err == len(futs),
+              "sigkill: every mid-batch future resolved")
+        check(counters["fenced"] >= 1 and counters["failovers"] >= 1,
+              "sigkill: pool fenced the dead worker and failed over")
+        check(rec["respawned"],
+              "sigkill: supervisor respawned a fresh process (new pid)")
+        check(post_ok, "sigkill: respawned worker serves again")
+        check(recovered_s < args.failover_bound,
+              f"sigkill: recovery bounded ({recovered_s:.2f}s)")
+        return rec
+
     def ensure_all_live(after_kind):
         """Between-injection hygiene: only the TARGETED replica may
         have been fenced (and each phase restarts it); a healthy
@@ -538,7 +615,7 @@ def main():
 
     for inject in (inject_wedged_fetcher, inject_poisoned_program,
                    inject_killed_decode_pool, inject_hard_stop_mid_stream,
-                   inject_latency_spike):
+                   inject_latency_spike, inject_worker_sigkill):
         rec = inject()
         report["injections"].append(rec)
         ensure_all_live(rec["kind"])
@@ -579,8 +656,22 @@ def main():
     report["leaked_threads"] = leaked()
     check(not report["leaked_threads"],
           f"no leaked threads ({report['leaked_threads']})")
-    report["leaked_children"] = sorted(
-        set(proc_children()) - children_before)
+    def stdlib_singleton(pid):
+        # multiprocessing's resource_tracker is a deliberate
+        # process-wide singleton the stdlib keeps alive after the last
+        # SharedMemory is unlinked — not a leak
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                return b"resource_tracker" in f.read()
+        except OSError:
+            return True      # reaped between the scan and the read
+
+    def leaked_children():
+        return sorted(pid for pid in set(proc_children())
+                      - children_before if not stdlib_singleton(pid))
+
+    wait_until(lambda: not leaked_children(), timeout_s=30)
+    report["leaked_children"] = leaked_children()
     check(not report["leaked_children"], "no leaked descendants")
 
     report["pool_final"] = pool.snapshot()
