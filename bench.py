@@ -302,6 +302,74 @@ def _stream_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _fastpath_summary(fallback, budget_s):
+    """Run tools/stream_bench.py --fastpath (temporal-coherence fast
+    path: tracker tier + width-only ROI re-inference vs full-frame
+    every frame, interleaved A/B rounds + equal-quality protocol) and
+    return a compact summary, or an {"error"/"skipped"} marker — the
+    "serve"/"decode" key contract.  Subprocess so a fast-path failure
+    can never take down the primary metric; bounded by the REMAINING
+    driver budget.  ``IBP_BENCH_FASTPATH=0`` skips it
+    unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_FASTPATH") == "0":
+        return {"skipped": "IBP_BENCH_FASTPATH=0"}
+    if budget_s < 300:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (STREAM_FASTPATH.json has the full "
+                           "run)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="stream_fastpath_"),
+                       "STREAM_FASTPATH.json")
+    # planted-canvas == size hugs the planted crowd into the frame's
+    # top-left so the width-only ROI window anchors at x0=0 and the
+    # crop decode EXACTLY equals the full-frame decode (people_delta=0
+    # A/B with no content artifacts); the committed STREAM_FASTPATH.json
+    # carries the full protocol run
+    if fallback:
+        argv = ["--config", "tiny", "--size", "256", "--boxsize", "256",
+                "--streams", "2", "--frames", "12",
+                "--video-frames", "8", "--rounds", "1",
+                "--planted", "2", "--planted-canvas", "256",
+                "--max-batch", "2", "--fastpath",
+                "--fp-roi-width", "128", "--fp-roi-margin", "16",
+                "--fp-quality-frames", "12"]
+        timeout = min(720, budget_s)
+    else:
+        argv = ["--config", "canonical", "--size", "512",
+                "--streams", "4", "--frames", "16",
+                "--video-frames", "8", "--rounds", "2",
+                "--planted", "2", "--planted-canvas", "512",
+                "--max-batch", "4", "--fastpath",
+                "--fp-roi-width", "256", "--fp-roi-margin", "32",
+                "--fp-quality-frames", "16"]
+        timeout = min(900, budget_s)
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "stream_bench.py"),
+             "--out", out] + argv,
+            capture_output=True, timeout=timeout, check=True,
+            env=dict(os.environ))
+        with open(out) as f:
+            r = json.load(f)
+        return {
+            "median_fastpath_speedup": r["median_fastpath_speedup"],
+            "fastpath_speedup_sustained":
+                r["fastpath_speedup_sustained"],
+            "skip_rate": r["fastpath_skip_rate"],
+            "roi_rate": r["fastpath_roi_rate"],
+            "conservation_exact":
+                r["fastpath_conservation"]["exact"],
+            "quality_equal_all_scenes": r["quality_equal_all_scenes"],
+            "recompiles_post_warmup": r["recompiles_post_warmup"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def _feed_rate_summary(fallback, budget_s):
     """Run tools/feed_rate.py (sync vs shm-worker input feed rate) and
     return a compact summary for the bench line, or an {"error"/"skipped"}
@@ -880,6 +948,10 @@ def main():
     # discipline
     stream = _stream_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # temporal-coherence fast path (tracker tier + ROI re-inference vs
+    # full-frame every frame), same discipline
+    fastpath = _fastpath_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     # input feed rate (sync vs shm workers), same budget discipline
     feed = _feed_rate_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
@@ -931,6 +1003,7 @@ def main():
         "serve": serve,
         "decode": decode,
         "stream": stream,
+        "fastpath": fastpath,
         "feed": feed,
         "telemetry": telemetry,
         "ckpt": ckpt,

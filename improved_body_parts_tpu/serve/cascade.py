@@ -176,6 +176,27 @@ class CascadeMetrics:
                         self.escalation_rate()))
         return samples
 
+    def conservation(self) -> dict:
+        """The per-tier conservation block bench artifacts record
+        (``tools/cascade_bench.py``, mirrored by the stream fast path's
+        ``FastPathMetrics.conservation``): every counter of the
+        invariant plus ``exact`` — True iff it holds at this instant."""
+        with self._lock:
+            out = {
+                "submitted": self.submitted,
+                "answered_student": self.answered_student,
+                "escalated_teacher": self.escalated_teacher,
+                "degraded_student_answer": self.degraded_student_answer,
+                "failed": self.failed,
+                "depth": self.depth,
+            }
+        out["exact"] = (out["submitted"]
+                        == out["answered_student"]
+                        + out["escalated_teacher"]
+                        + out["degraded_student_answer"]
+                        + out["failed"] + out["depth"])
+        return out
+
     def snapshot(self) -> dict:
         with self._lock:
             out = {

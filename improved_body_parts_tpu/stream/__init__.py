@@ -5,13 +5,23 @@ Every workload below this package is an independent single image; real
 pose traffic is video.  This package adds the stateful layer (ROADMAP
 open item 4): per-stream ordered sessions over ``serve.DynamicBatcher``
 (``session``), temporal track identity via frame-to-frame OKS matching
-(``track``), optional confidence-gated temporal smoothing (``smooth``)
-and a deterministic synthetic video generator (``synth``) that makes
-tracker correctness a gateable number instead of an eyeballed demo.
+(``track``), optional confidence-gated temporal smoothing (``smooth``),
+a deterministic synthetic video generator (``synth``) that makes
+tracker correctness a gateable number instead of an eyeballed demo, and
+the temporal-coherence fast path (``fastpath``): tracker-predicted
+frame skipping + ROI re-inference under exact three-tier conservation.
 """
+from .fastpath import (
+    FastPath,
+    FastPathConfig,
+    FastPathMetrics,
+    TierDecision,
+    paste_back,
+    signals_from_people,
+)
 from .session import FrameDropped, SessionManager, StreamMetrics, StreamSession
 from .smooth import KeypointSmoother, jitter_rms, keypoint_sequence_jitter
-from .synth import SyntheticVideo
+from .synth import DetectionEngine, SyntheticVideo, read_stamp
 from .track import (
     IdentitySwitchCounter,
     Track,
@@ -21,6 +31,10 @@ from .track import (
 )
 
 __all__ = [
+    "DetectionEngine",
+    "FastPath",
+    "FastPathConfig",
+    "FastPathMetrics",
     "FrameDropped",
     "IdentitySwitchCounter",
     "KeypointSmoother",
@@ -28,10 +42,14 @@ __all__ = [
     "StreamMetrics",
     "StreamSession",
     "SyntheticVideo",
+    "TierDecision",
     "Track",
     "TrackedPerson",
     "Tracker",
     "jitter_rms",
     "keypoint_sequence_jitter",
     "keypoint_similarity",
+    "paste_back",
+    "read_stamp",
+    "signals_from_people",
 ]

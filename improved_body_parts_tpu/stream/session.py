@@ -38,6 +38,8 @@ from ..obs.trace import get_tracer
 from ..serve.batcher import ServerOverloaded
 from ..serve.policy import jittered_backoff
 from ..utils.meters import PercentileMeter
+from .fastpath import (FASTPATH_REASONS, TIERS, FastPath, FastPathConfig,
+                       paste_back, signals_from_people, split_result)
 from .smooth import KeypointSmoother
 from .track import Tracker
 
@@ -52,7 +54,8 @@ class FrameDropped(RuntimeError):
 class _Frame:
     __slots__ = ("seq", "future", "t_submit", "tr0", "ready", "dropped",
                  "result", "error", "image", "epoch", "engine_submitted",
-                 "ctx", "attempt_nodes", "won_node", "t_ready", "t_admit")
+                 "ctx", "attempt_nodes", "won_node", "t_ready", "t_admit",
+                 "tier", "roi_off")
 
     def __init__(self, seq: int, t_submit: float, tr0: float, image):
         self.seq = seq
@@ -78,6 +81,10 @@ class _Frame:
         # A RESULT from any epoch wins (real work is never thrown away).
         self.epoch = 0
         self.engine_submitted = False   # an engine future is wired
+        # fast-path routing (stream.fastpath): which tier answers this
+        # frame, and — ROI tier — the crop's (x, y) full-frame offset
+        self.tier: Optional[str] = None
+        self.roi_off: Optional[tuple] = None
 
 
 class StreamMetrics:
@@ -184,6 +191,7 @@ class StreamSession:
                  max_in_flight: int = 4, policy: str = "block",
                  metrics: Optional[StreamMetrics] = None,
                  overload_timeout_s: float = 30.0,
+                 fastpath: Optional[FastPathConfig] = None,
                  on_close: Optional[Callable[["StreamSession"], None]]
                  = None):
         if policy not in ("block", "drop_oldest"):
@@ -195,6 +203,11 @@ class StreamSession:
         self.batcher = batcher
         self.tracker = tracker if tracker is not None else Tracker()
         self.smoother = smoother
+        # temporal-coherence fast path (stream.fastpath): per-session
+        # policy state — tier decisions ride the submit ordering below,
+        # outcome observations ride the deliver lock
+        self.fastpath = (FastPath(fastpath) if fastpath is not None
+                         else None)
         self.max_in_flight = int(max_in_flight)
         self.policy = policy
         self.metrics = metrics or StreamMetrics()
@@ -253,14 +266,45 @@ class StreamSession:
             frame = _Frame(self._seq, time.perf_counter(),
                            trace.now() if trace.enabled else 0.0,
                            image_bgr)
+            if self.fastpath is not None:
+                # decided UNDER _cond so decisions are strictly in
+                # submit (= delivery) order
+                decision = self.fastpath.decide(image_bgr.shape[0],
+                                                image_bgr.shape[1])
+                frame.tier = decision.tier
             self._seq += 1
             self._pending.append(frame)
             self._unresolved += 1
         self.metrics.on_submit()
+        if self.fastpath is not None:
+            self.fastpath.metrics.on_submit(decision.tier,
+                                            decision.reason)
         rt = get_reqtrace()
         if rt.enabled:
+            extra = {} if frame.tier is None else {"tier": frame.tier}
             frame.ctx = rt.begin("stream", stream=self.stream_id,
-                                 seq=frame.seq)
+                                 seq=frame.seq, **extra)
+        if frame.tier == "tracker":
+            # the tracker tier never touches the engine: the frame is
+            # ready NOW; _advance delivers it in order and the tracker's
+            # constant-velocity prediction answers it at delivery time
+            self._ready_with(frame)
+            return frame.future
+        if frame.tier == "roi":
+            # width-only crop (see stream.fastpath: the scale protocol
+            # renormalizes height, so width is the one cheap dimension),
+            # anchored so the fixed window is fully image-backed.  The
+            # CROP becomes the frame's retained image — a migration
+            # re-submits the crop, keeping paste-back exact.
+            x0 = decision.roi_x0
+            crop = np.ascontiguousarray(
+                image_bgr[:, x0:x0 + self.fastpath.config.roi_width])
+            frame.roi_off = (x0, 0)
+            with self._cond:
+                if not frame.dropped:
+                    frame.image = crop
+            self._submit_to_engine(frame, crop)
+            return frame.future
         self._submit_to_engine(frame, image_bgr)
         return frame.future
 
@@ -279,6 +323,8 @@ class StreamSession:
         victim.image = None
         victim.ctx.finish("error:FrameDropped")
         self.metrics.on_drop()
+        if self.fastpath is not None and victim.tier is not None:
+            self.fastpath.metrics.on_drop(victim.tier)
         if trace.enabled:
             trace.instant("frame_dropped", track=self._track,
                           args={"stream": self.stream_id,
@@ -475,6 +521,9 @@ class StreamSession:
         trace = get_tracer()
         if frame.error is not None:
             self.metrics.on_fail()
+            if self.fastpath is not None and frame.tier is not None:
+                self.fastpath.metrics.on_fail(frame.tier)
+                self.fastpath.on_failed(frame.tier)
             if trace.enabled:
                 trace.instant("frame_failed", track=self._track,
                               args={"stream": self.stream_id,
@@ -488,7 +537,21 @@ class StreamSession:
             return
         try:
             t_track = trace.now() if trace.enabled else 0.0
-            tracked = self.tracker.update(frame.result)
+            if frame.tier == "tracker":
+                # skipped frame: the tracker's constant-velocity state
+                # answers — no engine result exists
+                tracked = self.tracker.predict_frame()
+                self.fastpath.on_delivered("tracker", None, self.tracker)
+            else:
+                skeletons, signals = split_result(frame.result)
+                if frame.roi_off is not None:
+                    skeletons = paste_back(skeletons, frame.roi_off)
+                tracked = self.tracker.update(skeletons)
+                if self.fastpath is not None:
+                    if signals is None:
+                        signals = signals_from_people(skeletons)
+                    self.fastpath.on_delivered(frame.tier or "full",
+                                               signals, self.tracker)
             if self.smoother is not None:
                 tracked = [
                     p._replace(keypoints=self.smoother.apply(
@@ -510,6 +573,9 @@ class StreamSession:
         except Exception as e:  # noqa: BLE001 — a tracker bug fails ITS
             # frame, never the delivery loop or later frames
             self.metrics.on_fail()
+            if self.fastpath is not None and frame.tier is not None:
+                self.fastpath.metrics.on_fail(frame.tier)
+                self.fastpath.on_failed(frame.tier)
             frame.ctx.finish(
                 f"error:{type(e).__name__}",
                 hops=self._frame_hops(frame, time.perf_counter()),
@@ -521,6 +587,9 @@ class StreamSession:
         frame.ctx.finish("ok", hops=self._frame_hops(frame, t_fin),
                          won_by=frame.won_node)
         self.metrics.on_deliver(time.perf_counter() - frame.t_submit)
+        if self.fastpath is not None and frame.tier is not None:
+            self.fastpath.metrics.on_answer(frame.tier,
+                                            t_fin - frame.t_submit)
         try:
             frame.future.set_result(tracked)
         except Exception:  # noqa: BLE001 — caller cancelled the future;
@@ -576,6 +645,8 @@ class StreamSession:
         out["in_flight"] = self.in_flight
         out["closed"] = self._closed
         out["tracker"] = self.tracker.snapshot()
+        if self.fastpath is not None:
+            out["fastpath"] = self.fastpath.snapshot()
         return out
 
 
@@ -605,7 +676,8 @@ class SessionManager:
                  smoothing: Optional[str] = None,
                  smoother_kw: Optional[dict] = None,
                  max_in_flight: int = 4, policy: str = "block",
-                 overload_timeout_s: float = 30.0):
+                 overload_timeout_s: float = 30.0,
+                 fastpath: Optional[FastPathConfig] = None):
         self.batcher = batcher
         self._tracker_factory = tracker_factory or Tracker
         self._smoothing = smoothing
@@ -617,6 +689,10 @@ class SessionManager:
         self.max_in_flight = max_in_flight
         self.policy = policy
         self.overload_timeout_s = overload_timeout_s
+        #: the temporal-coherence fast path every opened session runs
+        #: (None = every frame is a full forward, the pre-fast-path
+        #: behavior); per-session FastPath STATE is built per open()
+        self.fastpath = fastpath
         self._lock = threading.Lock()
         self._sessions: Dict[str, StreamSession] = {}
         self._auto_id = 0
@@ -628,7 +704,13 @@ class SessionManager:
         self._retired = {"frames_submitted": 0, "frames_delivered": 0,
                          "frames_dropped": 0, "frames_failed": 0,
                          "engine_shed_retries": 0,
-                         "track_births": 0, "track_deaths": 0}
+                         "track_births": 0, "track_deaths": 0,
+                         "fastpath_submitted": 0,
+                         "fastpath_answered_tracker": 0,
+                         "fastpath_answered_roi": 0,
+                         "fastpath_escalated_full": 0,
+                         "fastpath_failed": 0, "fastpath_dropped": 0}
+        self._retired_esc = {r: 0 for r in FASTPATH_REASONS}
         if registry is not None:
             import weakref
 
@@ -645,7 +727,8 @@ class SessionManager:
              max_in_flight: Optional[int] = None,
              policy: Optional[str] = None,
              tracker: Optional[Tracker] = None,
-             smoother: Optional[KeypointSmoother] = None
+             smoother: Optional[KeypointSmoother] = None,
+             fastpath: Optional[FastPathConfig] = None
              ) -> StreamSession:
         """Open one stream session (auto-named ``stream-N`` when no id
         is given); per-stream overrides win over manager defaults."""
@@ -669,6 +752,8 @@ class SessionManager:
                                else self.max_in_flight),
                 policy=policy if policy is not None else self.policy,
                 overload_timeout_s=self.overload_timeout_s,
+                fastpath=(fastpath if fastpath is not None
+                          else self.fastpath),
                 on_close=self._forget)
             self._sessions[stream_id] = session
             self._opened += 1
@@ -678,6 +763,9 @@ class SessionManager:
         m = session.metrics
         counts, _, _ = m.sample()
         tr = session.tracker
+        fp_counts, fp_esc = (), {}
+        if session.fastpath is not None:
+            fp_counts, fp_esc, _, _ = session.fastpath.metrics.sample()
         with self._lock:
             cur = self._sessions.get(session.stream_id)
             if cur is session:
@@ -687,6 +775,11 @@ class SessionManager:
                     self._retired[name] += v
                 self._retired["track_births"] += tr.births
                 self._retired["track_deaths"] += tr.deaths
+                for name, v in fp_counts:
+                    self._retired[name] += v
+                for reason, v in fp_esc.items():
+                    self._retired_esc[reason] = (
+                        self._retired_esc.get(reason, 0) + v)
 
     def get(self, stream_id: str) -> Optional[StreamSession]:
         with self._lock:
@@ -746,6 +839,7 @@ class SessionManager:
             # counts into _retired after we snapshotted it, and the
             # monotone stream_all_* totals would step backwards
             retired = dict(self._retired)
+            retired_esc = dict(self._retired_esc)
             opened, closed = self._opened, self._closed
             live = list(self._sessions.values())
         samples = [
@@ -755,15 +849,25 @@ class SessionManager:
              float(closed)),
         ]
         totals = dict(retired)
+        esc_totals = dict(retired_esc)
         for session in live:
             counts, _, _ = session.metrics.sample()
             for name, v in counts:
                 totals[name] += v
             totals["track_births"] += session.tracker.births
             totals["track_deaths"] += session.tracker.deaths
+            if session.fastpath is not None:
+                fp_counts, fp_esc, _, _ = session.fastpath.metrics.sample()
+                for name, v in fp_counts:
+                    totals[name] += v
+                for reason, v in fp_esc.items():
+                    esc_totals[reason] = esc_totals.get(reason, 0) + v
         for name, v in totals.items():
             samples.append((f"{prefix}_all_{name}_total", {}, "counter",
                             float(v)))
+        for reason, v in sorted(esc_totals.items()):
+            samples.append((f"{prefix}_all_fastpath_escalations_total",
+                            {"reason": reason}, "counter", float(v)))
         for session in live:
             labels = {"stream": session.stream_id}
             m = session.metrics
@@ -771,6 +875,35 @@ class SessionManager:
             for name, v in counts:
                 samples.append((f"{prefix}_{name}_total", labels,
                                 "counter", float(v)))
+            if session.fastpath is not None:
+                fp_counts, fp_esc, fp_lat, fp_depth = (
+                    session.fastpath.metrics.sample())
+                for name, v in fp_counts:
+                    samples.append((f"{prefix}_{name}_total", labels,
+                                    "counter", float(v)))
+                for reason, v in sorted(fp_esc.items()):
+                    samples.append(
+                        (f"{prefix}_fastpath_escalations_total",
+                         {**labels, "reason": reason}, "counter",
+                         float(v)))
+                samples.append((f"{prefix}_fastpath_depth", labels,
+                                "gauge", float(fp_depth)))
+                # the PR 15 per-hop latency block, one entry per TIER
+                for tier in TIERS:
+                    tl, tl_sum = fp_lat[tier]
+                    tlabels = {**labels, "tier": tier}
+                    for q, key in (("0.5", "p50"), ("0.95", "p95"),
+                                   ("0.99", "p99")):
+                        samples.append(
+                            (f"{prefix}_fastpath_tier_latency_seconds",
+                             {**tlabels, "quantile": q}, "gauge",
+                             tl[key]))
+                    samples += [
+                        (f"{prefix}_fastpath_tier_latency_seconds_sum",
+                         tlabels, "counter", tl_sum),
+                        (f"{prefix}_fastpath_tier_latency_seconds_count",
+                         tlabels, "counter", float(tl["count"])),
+                    ]
             tr = session.tracker
             samples += [
                 (f"{prefix}_track_births_total", labels, "counter",

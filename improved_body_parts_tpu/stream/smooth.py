@@ -110,7 +110,13 @@ class KeypointSmoother:
                 continue
             freq = self.fps / gap
             if self.mode == "ema":
-                st.x = self.ema_alpha * x + (1.0 - self.ema_alpha) * st.x
+                # a gap of g frames smooths like g EMA steps toward the
+                # same sample: the retained weight of the old state is
+                # (1 - alpha)^g (gap == 1 is exactly ema_alpha) — the
+                # non-contiguous-frame-index contract the One-Euro
+                # branch gets from its freq scaling below
+                w = 1.0 - (1.0 - self.ema_alpha) ** gap
+                st.x = w * x + (1.0 - w) * st.x
             else:
                 dx = (x - st.x) * freq
                 a_d = _smoothing_alpha(self.d_cutoff, freq)

@@ -49,12 +49,20 @@ class SyntheticVideo:
                  size: Tuple[int, int] = (240, 320), num_frames: int = 60,
                  crossing: bool = False, image_size: int = 512,
                  speed: float = 3.0, appear_at: Optional[Dict[int, int]]
-                 = None, leave_at: Optional[Dict[int, int]] = None):
+                 = None, leave_at: Optional[Dict[int, int]] = None,
+                 scene: str = "default"):
         from ..data.fixture import synthetic_person
 
         if crossing and num_people != 2:
             raise ValueError("crossing protocol is defined for exactly "
                              f"2 people, got {num_people}")
+        if scene not in ("default", "static", "slow_pan"):
+            raise ValueError(f"scene={scene!r} must be 'default', "
+                             "'static' or 'slow_pan'")
+        if crossing and scene != "default":
+            raise ValueError("crossing defines its own motion; "
+                             f"scene={scene!r} conflicts")
+        self.scene = scene
         self.seed = int(seed)
         self.num_people = int(num_people)
         self.h, self.w = size
@@ -103,6 +111,21 @@ class SyntheticVideo:
                 direction = 1.0 if rng.uniform() < 0.5 else -1.0
                 v = np.array([direction * self.speed
                               * float(rng.uniform(0.7, 1.3)), 0.0])
+            # scene protocols (fast-path gates): same seeded PLACEMENT
+            # as the default churn — only the velocities change, AFTER
+            # the rng draws, so a given seed puts people in the same
+            # spots under every scene
+            if scene == "static":
+                # nothing moves: every frame's GT equals frame 0's —
+                # the scene where skipping should approach max_skip_run
+                v = np.zeros(2)
+            elif scene == "slow_pan":
+                # one SHARED slow velocity (a camera pan): constant-
+                # velocity prediction is exact until a figure's
+                # triangle-wave edge bounce (per-person extents make
+                # bounces de-phase on long streams — bench lengths stay
+                # inside the first leg)
+                v = np.array([self.speed / 3.0, 0.0])
             self._base.append(base)
             self._start.append(np.array([cx, cy]))
             self._vel.append(v)
@@ -188,3 +211,94 @@ class SyntheticVideo:
             order = rng.permutation(len(people))
             people = [people[i] for i in order]
         return people
+
+    def stamped_frame(self, t: int) -> np.ndarray:
+        """A cheap stand-in frame that encodes ``t`` in every pixel and
+        each pixel's COLUMN coordinate alongside (:func:`read_stamp`) —
+        so any width-crop of it is self-describing: the fast path's ROI
+        tier can run over a :class:`DetectionEngine` exactly like a full
+        frame, and the engine sees which window of the scene it was
+        handed.  Rendering stick figures is pointless for an engine that
+        answers from ground truth; this keeps the deterministic quality
+        protocols allocation-cheap at full frame geometry."""
+        if self.w >= 4096:
+            raise ValueError("stamped frames encode columns in 12 bits "
+                             f"(width {self.w} >= 4096)")
+        img = np.empty((self.h, self.w, 3), dtype=np.uint8)
+        img[..., 0] = np.uint8(t & 0xFF)
+        xs = np.arange(self.w, dtype=np.uint16)
+        img[..., 1] = (xs & 0xFF).astype(np.uint8)[None, :]
+        img[..., 2] = (0xA0 | (xs >> 8)).astype(np.uint8)[None, :]
+        return img
+
+
+def read_stamp(image_bgr: np.ndarray) -> Tuple[int, int]:
+    """``(t, x0)`` from a :meth:`SyntheticVideo.stamped_frame` or any
+    width-crop of one — ``x0`` is the crop's left edge in full-frame
+    coordinates (0 for the full frame).  ``t`` wraps at 256 (the
+    generators are pure in ``t``, so benches index frames modulo the
+    clip length anyway)."""
+    px = image_bgr[0, 0]
+    if (int(px[2]) & 0xF0) != 0xA0:
+        raise ValueError("image is not a stamped synthetic frame")
+    return int(px[0]), int(px[1]) | ((int(px[2]) & 0x0F) << 8)
+
+
+class DetectionEngine:
+    """Engine-contract fake: resolves stamped frames straight to the
+    video's seeded :meth:`SyntheticVideo.detections` — the deterministic
+    quality half of the fast-path A/B (``tools/stream_bench.py
+    --fastpath``) and the session/fast-path protocol tests, running in
+    microseconds without a model or device.
+
+    Implements the duck-typed engine surface ``StreamSession`` uses
+    (``submit(image) -> Future``, ``draining``); with ``emit_signals``
+    the future resolves to ``(detections, EscalationSignals)`` like a
+    fused-decode ``DynamicBatcher`` — the signals derived from the
+    detections themselves (``stream.fastpath.signals_from_people``).
+    A CROPPED stamped frame is answered like a real model would answer
+    a crop: only joints inside the window, in crop-relative coordinates
+    (people entirely outside are invisible — the person-count signal
+    honestly reflects what the crop can see).  Futures resolve inline
+    on the submitting thread; ``calls`` counts real forwards (what the
+    fast path is supposed to be saving).
+    """
+
+    def __init__(self, video: SyntheticVideo, *, noise: float = 0.0,
+                 drop_joint_p: float = 0.0, emit_signals: bool = True):
+        self.video = video
+        self.noise = float(noise)
+        self.drop_joint_p = float(drop_joint_p)
+        self.emit_signals = bool(emit_signals)
+        self.draining = False
+        self.calls = 0
+
+    def submit(self, image_bgr: np.ndarray, *, deadline_s=None):
+        from concurrent.futures import Future
+
+        t, x0 = read_stamp(image_bgr)
+        t %= max(self.video.num_frames, 1)
+        dets = self.video.detections(t, noise=self.noise,
+                                     drop_joint_p=self.drop_joint_p)
+        w = image_bgr.shape[1]
+        if x0 or w < self.video.w:      # the crop's limited view
+            windowed = []
+            for kps, score in dets:
+                shifted: Keypoints = []
+                for c in kps:
+                    if c is None or not x0 <= c[0] < x0 + w:
+                        shifted.append(None)
+                    else:
+                        shifted.append((c[0] - x0, c[1]))
+                if any(c is not None for c in shifted):
+                    windowed.append((shifted, score))
+            dets = windowed
+        self.calls += 1
+        fut: Future = Future()
+        if self.emit_signals:
+            from .fastpath import signals_from_people
+
+            fut.set_result((dets, signals_from_people(dets)))
+        else:
+            fut.set_result(dets)
+        return fut

@@ -118,6 +118,17 @@ class Track:
     misses: int = 0                # consecutive unmatched frames
     born_at: int = 0               # tracker frame index at birth
     last_seen: int = 0             # tracker frame index of last match
+    #: (17, 2) px/frame constant-velocity estimate from the last two
+    #: observations (None until the second match) — what the stream
+    #: fast path extrapolates skipped frames from
+    vel: Optional[np.ndarray] = None
+
+    def predicted_xy(self, at_frame: int) -> np.ndarray:
+        """Constant-velocity position at ``at_frame`` (>= last_seen):
+        the last observation advanced by the velocity estimate."""
+        if self.vel is None:
+            return self.xy
+        return self.xy + self.vel * max(at_frame - self.last_seen, 0)
 
 
 class Tracker:
@@ -179,6 +190,17 @@ class Tracker:
             tr = self.tracks[ti]
             xy, valid = dets[di]
             coords, score = people[di]
+            # constant-velocity estimate from the last two OBSERVATIONS
+            # of this track, per joint, over the real frame gap (a track
+            # re-found after coasting/skipping divides by the full gap).
+            # Joints not visible in both frames keep their previous
+            # estimate (an occluded joint keeps moving with the person).
+            gap = max(self.frame_index - tr.last_seen, 1)
+            both = tr.valid & valid
+            vel = (tr.vel.copy() if tr.vel is not None
+                   else np.zeros_like(xy))
+            vel[both] = (xy[both] - tr.xy[both]) / gap
+            tr.vel = vel
             tr.xy, tr.valid = xy, valid
             tr.keypoints, tr.score = list(coords), float(score)
             tr.hits += 1
@@ -213,6 +235,59 @@ class Tracker:
                for di in range(len(dets))]
         self.frame_index += 1
         return out
+
+    @property
+    def confirmed(self) -> int:
+        """Live tracks the most recent real frame actually matched
+        (``misses == 0``) — the population :meth:`predict_frame` answers
+        with; coasting tracks are excluded (their person was already
+        missing from the last observation)."""
+        return sum(1 for tr in self.tracks if tr.misses == 0)
+
+    def predict_frame(self) -> List[TrackedPerson]:
+        """Advance ONE frame without detections: every confirmed track
+        answers with its constant-velocity extrapolation — the stream
+        fast path's tracker tier (``stream.fastpath``).
+
+        Consumes a frame slot exactly like :meth:`update` (ages and
+        later velocity gaps stay in real-frame units) but mutates no
+        track state: the next real frame's match still compares against
+        the last OBSERVED pose extrapolated over the full gap
+        (:meth:`Track.predicted_xy`), so repeated skips extrapolate
+        linearly instead of compounding prediction error.
+        """
+        out: List[TrackedPerson] = []
+        for tr in self.tracks:
+            if tr.misses:
+                continue
+            xy = tr.predicted_xy(self.frame_index)
+            kps: Keypoints = [
+                (float(xy[j, 0]), float(xy[j, 1])) if tr.valid[j] else None
+                for j in range(len(tr.valid))]
+            out.append(TrackedPerson(tr.track_id, kps, tr.score,
+                                     self.frame_index - tr.born_at))
+        self.frame_index += 1
+        return out
+
+    def union_box(self) -> Optional[Tuple[float, float, float, float]]:
+        """Tight (x0, y0, x1, y1) over every live track's valid joints
+        at their constant-velocity position for the CURRENT frame index
+        (coasting tracks included — their person may only have missed a
+        detection), or ``None`` with no live tracks.  The stream fast
+        path crops ROI re-inference to this box."""
+        lo = np.array([np.inf, np.inf])
+        hi = np.array([-np.inf, -np.inf])
+        any_joint = False
+        for tr in self.tracks:
+            if not tr.valid.any():
+                continue
+            xy = tr.predicted_xy(self.frame_index)[tr.valid]
+            lo = np.minimum(lo, xy.min(axis=0))
+            hi = np.maximum(hi, xy.max(axis=0))
+            any_joint = True
+        if not any_joint:
+            return None
+        return (float(lo[0]), float(lo[1]), float(hi[0]), float(hi[1]))
 
     def live_ids(self) -> List[int]:
         return [tr.track_id for tr in self.tracks]

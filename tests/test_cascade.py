@@ -342,3 +342,36 @@ def test_cascade_metrics_exposition_names():
     assert 'cascade_escalations_total{reason="people"} 1.0' in text
     assert "cascade_escalated_teacher_total 1.0" in text
     assert "cascade_escalation_rate 1.0" in text
+
+
+@pytest.mark.slow
+def test_cascade_bench_cli(tmp_path):
+    """tools/cascade_bench.py end-to-end on the synthetic tier pair:
+    the artifact records the routing snapshot AND the exact two-tier
+    conservation ledger (submitted == answered_student +
+    escalated_teacher + failed + depth) with zero post-warmup
+    recompiles -- the same ledger discipline the stream fast path
+    extends to three tiers."""
+    import json
+    import subprocess
+
+    out = tmp_path / "CASCADE_BENCH.json"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run(
+        [sys.executable, os.path.join(repo, "tools",
+                                      "cascade_bench.py"),
+         "--size", "128", "--clients", "2", "--requests", "4",
+         "--rounds", "1", "--max-batch", "2", "--out", str(out)],
+        check=True, timeout=1500, env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    r = json.loads(out.read_text())
+    cons = r["cascade_conservation"]
+    assert cons["exact"] is True
+    assert cons["submitted"] == (cons["answered_student"]
+                                 + cons["escalated_teacher"]
+                                 + cons["degraded_student_answer"]
+                                 + cons["failed"] + cons["depth"])
+    assert cons["submitted"] > 0 and cons["depth"] == 0
+    assert r["recompiles_post_warmup"] == 0
+    assert "cascade_routing" in r

@@ -1,0 +1,752 @@
+"""Temporal-coherence fast path tests (``stream.fastpath``).
+
+Three tiers of coverage, mirroring the layer boundaries:
+
+- **Policy/accounting units** (pure NumPy): the tier decision state
+  machine against a scripted tracker, the three-tier conservation
+  invariant, ROI window anchoring, paste-back, signal derivation, and
+  the tracker's constant-velocity prediction + smoother frame-gap
+  contracts the tracker tier leans on.
+- **Session protocol** over the deterministic :class:`DetectionEngine`
+  (stamped frames answer crops faithfully): tier mix, EXACT three-tier
+  conservation through drop_oldest / migration / engine errors, and the
+  quality gate — on the ``static`` and ``slow_pan`` scene protocols the
+  fast path's delivered keypoints equal ground truth to float precision
+  with 0 identity switches, at a fraction of the engine calls.
+- **Real predictor ROI** over a ``DynamicBatcher`` + stub-model
+  predictor: the width-only crop lands in the ONE precompiled extra
+  bucket (0 post-warmup recompiles, ``obs.recompile.CompileWatch``),
+  and ROI delivery equals the engine's own answer for that crop pasted
+  back by the decision's anchor.
+"""
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.stream import (
+    DetectionEngine,
+    FastPath,
+    FastPathConfig,
+    FastPathMetrics,
+    IdentitySwitchCounter,
+    KeypointSmoother,
+    SessionManager,
+    SyntheticVideo,
+    Tracker,
+    paste_back,
+    read_stamp,
+    signals_from_people,
+)
+from improved_body_parts_tpu.stream.fastpath import (
+    FASTPATH_REASONS,
+    TIERS,
+    _Signals,
+    split_result,
+)
+
+# --------------------------------------------------------------------- #
+# config + helper units                                                 #
+# --------------------------------------------------------------------- #
+
+
+def test_fastpath_config_validation():
+    for bad in (dict(max_skip_run=0), dict(min_stable=0),
+                dict(roi_width=-1), dict(roi_margin=-1),
+                dict(full_refresh_every=-1), dict(people_delta=-1),
+                dict(score_floor=-0.1)):
+        (key,) = bad
+        with pytest.raises(ValueError, match=key):
+            FastPathConfig(**bad)
+    # defaults are valid and frozen
+    cfg = FastPathConfig()
+    with pytest.raises(Exception):
+        cfg.max_skip_run = 5
+
+
+def test_signals_from_people_and_split_result():
+    sig = signals_from_people([])
+    assert sig.n_people == 0 and sig.min_mean_score == float("inf")
+    assert not sig.fused
+    people = [([(1.0, 2.0)] + [None] * 16, 0.9),
+              ([(3.0, 4.0)] + [None] * 16, 0.4)]
+    sig = signals_from_people(people)
+    assert sig.n_people == 2
+    assert sig.min_mean_score == pytest.approx(0.4)
+    assert not (sig.peak_overflow or sig.cand_overflow
+                or sig.person_overflow)
+    # split: a fused payload comes apart, anything else is bare people
+    got, s = split_result((people, sig))
+    assert got is people and s is sig
+    got, s = split_result(people)
+    assert got is people and s is None
+
+
+def test_paste_back_translates_and_preserves_none():
+    people = [([(10.0, 20.0), None, (0.0, 0.0)], 0.7)]
+    same = paste_back(people, (0, 0))
+    assert same == people
+    moved = paste_back(people, (100, 0))
+    assert moved[0][0][0] == (110.0, 20.0)
+    assert moved[0][0][1] is None
+    assert moved[0][0][2] == (100.0, 0.0)
+    assert moved[0][1] == 0.7
+
+
+# --------------------------------------------------------------------- #
+# decision state machine (scripted tracker, no engine)                  #
+# --------------------------------------------------------------------- #
+
+
+class _ScriptedTracker:
+    """union_box / confirmed stand-in the policy observes."""
+
+    def __init__(self, box=None, confirmed=1):
+        self.box = box
+        self._confirmed = confirmed
+
+    @property
+    def confirmed(self):
+        return self._confirmed
+
+    def union_box(self):
+        return self.box
+
+
+def _calm(n_people=1, score=0.9):
+    return _Signals(n_people, False, False, False, score, True)
+
+
+def _deliver(fp, tier, signals=None, tracker=None):
+    fp.on_delivered(tier, signals if signals is not None else _calm(),
+                    tracker if tracker is not None
+                    else _ScriptedTracker(box=(50.0, 10.0, 90.0, 100.0)))
+
+
+def test_policy_cold_start_then_skip_run():
+    fp = FastPath(FastPathConfig(max_skip_run=3, min_stable=2))
+    # cold until min_stable calm REAL deliveries with confirmed tracks
+    d = fp.decide(120, 480)
+    assert (d.tier, d.reason) == ("full", "cold")
+    _deliver(fp, "full")
+    assert fp.decide(120, 480).reason == "cold"     # stable 1 < 2
+    _deliver(fp, "full")
+    for _ in range(3):                              # the skip run
+        d = fp.decide(120, 480)
+        assert (d.tier, d.reason) == ("tracker", None)
+        _deliver(fp, "tracker")
+    # roi_width=0: the owed real forward is a full "interval"
+    d = fp.decide(120, 480)
+    assert (d.tier, d.reason) == ("full", "interval")
+
+
+def test_policy_cold_when_no_confirmed_tracks():
+    fp = FastPath(FastPathConfig(min_stable=1))
+    _deliver(fp, "full", tracker=_ScriptedTracker(box=None, confirmed=0))
+    # calm but nothing to predict from: skipping would answer frames
+    # with an empty scene forever
+    assert fp.decide(120, 480).reason == "cold"
+
+
+def test_policy_roi_anchor_refresh_and_unfit():
+    cfg = FastPathConfig(max_skip_run=1, min_stable=1, roi_width=200,
+                         roi_margin=10, full_refresh_every=3)
+    fp = FastPath(cfg)
+    _deliver(fp, "full")
+
+    def next_real(tracker=None):
+        d = fp.decide(120, 480)
+        if d.tier == "tracker":
+            _deliver(fp, "tracker", tracker=tracker)
+            d = fp.decide(120, 480)
+        return d
+
+    # box (50..90): x0 = floor(50)-10 = 40, fits 200 easily
+    d = next_real()
+    assert (d.tier, d.reason, d.roi_x0) == ("roi", "interval", 40)
+    _deliver(fp, "roi")
+    # near the right edge the fixed window clamps fully inside the frame
+    edge = _ScriptedTracker(box=(460.0, 0.0, 475.0, 50.0))
+    _deliver(fp, "roi", tracker=edge)
+    d = next_real(tracker=edge)
+    assert (d.tier, d.roi_x0) == ("roi", 480 - 200)
+    _deliver(fp, "roi", tracker=edge)
+    # third real since the last full: periodic refresh goes full-frame
+    d = next_real(tracker=edge)
+    assert (d.tier, d.reason) == ("full", "refresh")
+    _deliver(fp, "full")
+    # a box wider than the window is honest about not fitting
+    wide = _ScriptedTracker(box=(10.0, 0.0, 400.0, 50.0))
+    _deliver(fp, "full", tracker=wide)
+    d = next_real(tracker=wide)
+    assert (d.tier, d.reason) == ("full", "roi_unfit")
+
+
+def test_policy_signal_escalations_pend_until_calm_full():
+    cfg = FastPathConfig(max_skip_run=2, min_stable=1, roi_width=200,
+                         roi_margin=10, full_refresh_every=0,
+                         people_delta=0, score_floor=0.3)
+    fp = FastPath(cfg)
+    _deliver(fp, "full", _calm(n_people=2))
+    assert fp.decide(120, 480).tier == "tracker"
+    _deliver(fp, "tracker")
+    # person count changed on the next real: a full forward is owed and
+    # KEEPS being owed until a calm full delivery clears it
+    d = fp.decide(120, 480)
+    assert d.tier == "tracker"
+    _deliver(fp, "tracker")
+    d = fp.decide(120, 480)
+    assert (d.tier, d.reason) == ("roi", "interval")
+    _deliver(fp, "roi", _calm(n_people=3))          # the delta lands
+    d = fp.decide(120, 480)
+    assert (d.tier, d.reason) == ("full", "people")
+    # an ROI delivery cannot clear the pending full (limited view)
+    _deliver(fp, "full", _calm(n_people=3))
+    # cleared + stable resets through cold before skipping resumes
+    d = fp.decide(120, 480)
+    assert d.tier == "tracker"
+    # score under the floor escalates; AT the floor stays cheap
+    _deliver(fp, "tracker")
+    fp.on_delivered("roi", _calm(n_people=3, score=0.3),
+                    _ScriptedTracker(box=(50.0, 10.0, 90.0, 100.0)))
+    fp.on_delivered("full", _calm(n_people=3, score=0.29),
+                    _ScriptedTracker(box=(50.0, 10.0, 90.0, 100.0)))
+    d = fp.decide(120, 480)
+    assert (d.tier, d.reason) == ("full", "score")
+
+
+def test_policy_overflow_and_error_reasons():
+    fp = FastPath(FastPathConfig(min_stable=1))
+    over = _Signals(1, True, False, False, 0.9, True)
+    fp.on_delivered("full", over,
+                    _ScriptedTracker(box=(0.0, 0.0, 10.0, 10.0)))
+    assert fp.decide(120, 480).reason == "overflow"
+    fp2 = FastPath(FastPathConfig(min_stable=1))
+    _deliver(fp2, "full")
+    fp2.on_failed("full")
+    assert fp2.decide(120, 480).reason == "error"
+    # overflow tolerated when the knob is off
+    fp3 = FastPath(FastPathConfig(min_stable=1,
+                                  escalate_on_overflow=False))
+    fp3.on_delivered("full", over,
+                     _ScriptedTracker(box=(0.0, 0.0, 10.0, 10.0)))
+    assert fp3.decide(120, 480).tier == "tracker"
+
+
+def test_fastpath_metrics_conservation_exact():
+    m = FastPathMetrics()
+    m.on_submit("full", "cold")
+    m.on_submit("tracker", None)
+    m.on_submit("roi", "interval")
+    m.on_submit("full", "people")
+    m.on_submit("full", "cold")
+    c = m.conservation()
+    assert c["depth"] == 5 and c["exact"]
+    m.on_answer("full", 0.01)
+    m.on_answer("tracker", 0.0001)
+    m.on_answer("roi", 0.005)
+    m.on_fail("full")
+    m.on_drop("full")
+    c = m.conservation()
+    assert c == {"submitted": 5, "answered_tracker": 1,
+                 "answered_roi": 1, "escalated_full": 1, "failed": 1,
+                 "dropped": 1, "depth": 0, "exact": True}
+    snap = m.snapshot()
+    assert snap["escalations"]["cold"] == 2
+    assert snap["escalations"]["people"] == 1
+    assert set(snap["tier_latency_ms"]) == set(TIERS)
+    assert snap["tier_latency_ms"]["roi"]["count"] == 1
+    # the invariant actually bites: an unbalanced ledger reads inexact
+    m.submitted += 1
+    assert not m.conservation()["exact"]
+
+
+# --------------------------------------------------------------------- #
+# tracker velocity / smoother frame-gap contracts                       #
+# --------------------------------------------------------------------- #
+
+
+def _moving_person(t, v=(3.0, 0.0)):
+    kps = [(40.0 + v[0] * t + 2.0 * j, 50.0 + v[1] * t + 3.0 * j)
+           for j in range(17)]
+    return [(kps, 0.9)]
+
+
+def test_tracker_velocity_and_linear_prediction():
+    tr = Tracker()
+    tr.update(_moving_person(0))
+    tr.update(_moving_person(1))
+    t0 = tr.tracks[0]
+    assert np.allclose(t0.vel, [3.0, 0.0])
+    # predictions extrapolate LINEARLY from the last observation — a
+    # second skip does not compound on the first prediction
+    p1 = tr.predict_frame()[0]
+    p2 = tr.predict_frame()[0]
+    want1 = np.asarray(_moving_person(2)[0][0])
+    want2 = np.asarray(_moving_person(3)[0][0])
+    assert np.allclose(np.asarray(p1.keypoints), want1)
+    assert np.allclose(np.asarray(p2.keypoints), want2)
+    assert p1.track_id == p2.track_id == t0.track_id
+    # predict_frame mutated no observation state
+    assert t0.last_seen == 1 and np.allclose(t0.vel, [3.0, 0.0])
+    # the re-match after the skip gap divides by the REAL gap
+    tr.update(_moving_person(4))
+    assert np.allclose(tr.tracks[0].vel, [3.0, 0.0])
+    assert tr.tracks[0].last_seen == 4
+
+
+def test_tracker_velocity_occluded_joint_keeps_estimate():
+    tr = Tracker()
+    tr.update(_moving_person(0))
+    second = _moving_person(1)
+    kps = list(second[0][0])
+    kps[3] = None                         # joint 3 occluded this frame
+    tr.update([(kps, 0.9)])
+    t0 = tr.tracks[0]
+    assert np.allclose(t0.vel[0], [3.0, 0.0])   # observed joints move
+    assert np.allclose(t0.vel[3], [0.0, 0.0])   # unobserved: unchanged
+    # the occluded joint is invalid, so the prediction omits it
+    pred = tr.predict_frame()[0]
+    assert pred.keypoints[3] is None
+    assert pred.keypoints[0] is not None
+
+
+def test_tracker_confirmed_and_union_box():
+    tr = Tracker(max_age=5)
+    tr.update(_moving_person(0))
+    assert tr.confirmed == 1
+    tr.update([])                         # coasting: not confirmed
+    assert tr.active == 1 and tr.confirmed == 0
+    box = tr.union_box()
+    kps = np.asarray(_moving_person(0)[0][0])
+    assert box[0] == pytest.approx(kps[:, 0].min())
+    assert box[3] == pytest.approx(kps[:, 1].max())
+    assert Tracker().union_box() is None
+
+
+def test_ema_gap_equals_consecutive_steps():
+    """The satellite-2 contract: a gap of g frames must smooth exactly
+    like g consecutive EMA steps toward the same sample — retained old
+    weight (1 - alpha)^g, not one alpha step per CALL."""
+    a = KeypointSmoother(mode="ema", ema_alpha=0.4, reset_after=5)
+    b = KeypointSmoother(mode="ema", ema_alpha=0.4, reset_after=5)
+    start = [(10.0, 20.0)] + [None] * 16
+    target = [(50.0, 60.0)] + [None] * 16
+    a.apply(1, start, 0)
+    b.apply(1, start, 0)
+    a.apply(1, target, 1)
+    got_a = a.apply(1, target, 2)[0]
+    got_b = b.apply(1, target, 2)[0]      # frame 1 skipped: gap 2
+    assert got_b[0] == pytest.approx(got_a[0])
+    assert got_b[1] == pytest.approx(got_a[1])
+    # closed form: (1 - (1-a)^2) x + (1-a)^2 s
+    w = 1.0 - 0.6 ** 2
+    assert got_b[0] == pytest.approx(w * 50.0 + (1 - w) * 10.0)
+
+
+def test_one_euro_gap_scales_by_real_frame_rate():
+    """Non-contiguous frame indices at fps F must filter exactly like
+    contiguous indices at fps F/gap (freq = fps/gap is the one knob the
+    filter sees)."""
+    hi = KeypointSmoother(mode="one_euro", fps=30.0, reset_after=5)
+    lo = KeypointSmoother(mode="one_euro", fps=15.0, reset_after=5)
+    rng = np.random.default_rng(0)
+    pts = [(float(10 + 3 * i + rng.normal(0, 0.5)),
+            float(20 + rng.normal(0, 0.5))) for i in range(6)]
+    for i, p in enumerate(pts):
+        kp = [p] + [None] * 16
+        got_hi = hi.apply(1, kp, 2 * i)       # frames 0,2,4,... @30fps
+        got_lo = lo.apply(1, kp, i)           # frames 0,1,2,... @15fps
+    assert got_hi[0][0] == pytest.approx(got_lo[0][0])
+    assert got_hi[0][1] == pytest.approx(got_lo[0][1])
+
+
+# --------------------------------------------------------------------- #
+# synthetic scene protocols + stamped frames + DetectionEngine          #
+# --------------------------------------------------------------------- #
+
+
+def test_scene_protocols_deterministic_motion():
+    static = SyntheticVideo(seed=7, num_people=2, scene="static")
+    assert static.gt(25) == static.gt(0)      # nothing ever moves
+    # scene overrides ride AFTER the rng draws: same seed, same spots
+    default = SyntheticVideo(seed=7, num_people=2)
+    assert static.gt(0) == default.gt(0)
+    pan = SyntheticVideo(seed=3, num_people=2, size=(120, 480),
+                         scene="slow_pan", speed=3.0)
+    for t in range(4):
+        for (pa, ka), (pb, kb) in zip(pan.gt(t), pan.gt(t + 1)):
+            assert pa == pb
+            d = np.asarray(kb) - np.asarray(ka)
+            assert np.allclose(d, [1.0, 0.0])  # one shared pan velocity
+    with pytest.raises(ValueError, match="scene"):
+        SyntheticVideo(scene="chaos")
+    with pytest.raises(ValueError, match="crossing"):
+        SyntheticVideo(num_people=2, crossing=True, scene="static")
+
+
+def test_stamped_frame_roundtrip_and_crops():
+    vid = SyntheticVideo(seed=0, num_people=1, size=(64, 300))
+    img = vid.stamped_frame(9)
+    assert read_stamp(img) == (9, 0)
+    assert read_stamp(img[:, 120:250]) == (9, 120)
+    assert read_stamp(np.ascontiguousarray(img[:, 299:])) == (9, 299)
+    with pytest.raises(ValueError, match="stamped"):
+        read_stamp(np.zeros((4, 4, 3), np.uint8))
+    wide = SyntheticVideo(seed=0, num_people=1, size=(8, 4096))
+    with pytest.raises(ValueError, match="4096"):
+        wide.stamped_frame(0)
+
+
+def test_detection_engine_answers_crops_like_a_model_would():
+    # seed 0 static: person 0 spans x ~[266, 285], person 1 ~[139, 157]
+    vid = SyntheticVideo(seed=0, num_people=2, size=(240, 320),
+                         scene="static")
+    eng = DetectionEngine(vid)
+    full, sig = eng.submit(vid.stamped_frame(5)).result()
+    assert sig.n_people == 2 and len(full) == 2
+    assert eng.calls == 1
+    # a window over person 1 only: person 0 is invisible to the crop
+    # and the coordinates come back crop-relative
+    crop = np.ascontiguousarray(vid.stamped_frame(5)[:, 130:230])
+    dets, sig = eng.submit(crop).result()
+    assert sig.n_people == 1 and len(dets) == 1
+    want = next(kps for kps, _ in full
+                if all(c is None or c[0] < 230 for c in kps))
+    for got_c, want_c in zip(dets[0][0], want):
+        assert got_c == (want_c[0] - 130, want_c[1])
+    # pasted back, the crop's answer is the full frame's answer
+    assert paste_back(dets, (130, 0))[0][0] == want
+    # bare-skeleton mode: no signals payload
+    bare = DetectionEngine(vid, emit_signals=False)
+    out = bare.submit(vid.stamped_frame(0)).result()
+    assert isinstance(out, list) and len(out) == 2
+
+
+# --------------------------------------------------------------------- #
+# session integration: the three tiers over DetectionEngine             #
+# --------------------------------------------------------------------- #
+
+_PAN_CFG = FastPathConfig(max_skip_run=3, min_stable=2, roi_width=384,
+                          roi_margin=24, full_refresh_every=3)
+
+
+def _run_scene(scene, cfg, frames=40, seed=3):
+    vid = SyntheticVideo(seed=seed, num_people=2, size=(120, 480),
+                         num_frames=frames, scene=scene, speed=3.0)
+    eng = DetectionEngine(vid)
+    mgr = SessionManager(eng, fastpath=cfg)
+    session = mgr.open("cam0")
+    counter = IdentitySwitchCounter()
+    worst = 0.0
+    futs = [session.submit_frame(vid.stamped_frame(t))
+            for t in range(frames)]
+    for t, fut in enumerate(futs):
+        tracked = fut.result(timeout=30)
+        counter.update(vid.gt(t), tracked)
+        gt = {tuple(np.round(np.asarray(k)[0], 4)): k
+              for _, k in vid.gt(t)}
+        assert len(tracked) == len(gt)
+        for person in tracked:
+            got = np.asarray(person.keypoints, dtype=np.float64)
+            best = min(
+                float(np.abs(got - np.asarray(k)).max())
+                for k in gt.values())
+            worst = max(worst, best)
+    assert session.close(timeout_s=30)
+    return session, eng, counter, worst
+
+
+def test_fastpath_three_tiers_exact_on_slow_pan():
+    """THE fast-path quality gate, slow-pan scene: all three tiers
+    engage, conservation is exact, identity never switches, delivered
+    keypoints equal ground truth to float precision (constant-velocity
+    prediction is exact under a constant pan), and the engine runs a
+    fraction of the frames."""
+    session, eng, counter, worst = _run_scene("slow_pan", _PAN_CFG)
+    snap = session.fastpath.snapshot()
+    assert snap["exact"]
+    assert snap["submitted"] == 40
+    assert snap["answered_tracker"] > 0
+    assert snap["answered_roi"] > 0
+    assert snap["escalated_full"] > 0
+    assert snap["failed"] == 0 and snap["dropped"] == 0
+    assert counter.switches == 0
+    assert worst < 1e-6
+    # the whole point: >= (max_skip_run+1)x fewer real forwards
+    assert eng.calls <= 2 + (40 - 2) // (_PAN_CFG.max_skip_run + 1) + 1
+    assert sum(snap["escalations"].values()) == eng.calls
+    assert set(snap["escalations"]) <= set(FASTPATH_REASONS)
+
+
+def test_fastpath_static_scene_maxes_skip_rate():
+    """Static scene, ROI disabled: after the cold start every real
+    forward is an interval full, the skip rate saturates at
+    max_skip_run/(max_skip_run+1), and predictions are exact (zero
+    velocity)."""
+    cfg = FastPathConfig(max_skip_run=3, min_stable=2)
+    session, eng, counter, worst = _run_scene("static", cfg, seed=0)
+    snap = session.fastpath.snapshot()
+    assert snap["exact"] and counter.switches == 0 and worst < 1e-9
+    assert snap["answered_roi"] == 0
+    assert snap["answered_tracker"] == 40 - eng.calls
+    # 2 cold fulls, then period-4 cycles of 3 skips + 1 interval full
+    assert eng.calls == 2 + 38 // 4
+    assert set(k for k, v in snap["escalations"].items() if v) == {
+        "cold", "interval"}
+
+
+class _GatedEngine:
+    """Holds every submitted future until released — deterministic
+    in-flight depth for the drop/migration conservation tests."""
+
+    def __init__(self, video, **kw):
+        self._inner = DetectionEngine(video, **kw)
+        self.pending = []
+        self.draining = False
+
+    def submit(self, image_bgr, *, deadline_s=None):
+        fut = Future()
+        self.pending.append((fut, image_bgr))
+        return fut
+
+    def release_all(self):
+        held, self.pending = self.pending, []
+        for fut, img in held:
+            fut.set_result(self._inner.submit(img).result())
+
+
+def test_fastpath_drop_oldest_keeps_conservation_exact():
+    vid = SyntheticVideo(seed=0, num_people=2, size=(120, 480),
+                         scene="static")
+    eng = _GatedEngine(vid)
+    mgr = SessionManager(eng, fastpath=FastPathConfig(),
+                         max_in_flight=2, policy="drop_oldest")
+    session = mgr.open("live")
+    futs = [session.submit_frame(vid.stamped_frame(t)) for t in range(5)]
+    eng.release_all()
+    delivered = dropped = 0
+    from improved_body_parts_tpu.stream import FrameDropped
+
+    for fut in futs:
+        try:
+            fut.result(timeout=30)
+            delivered += 1
+        except FrameDropped:
+            dropped += 1
+    assert (delivered, dropped) == (2, 3)
+    assert session.close(timeout_s=30)
+    c = session.fastpath.metrics.conservation()
+    assert c["exact"]
+    assert c == {"submitted": 5, "answered_tracker": 0,
+                 "answered_roi": 0, "escalated_full": 2, "failed": 0,
+                 "dropped": 3, "depth": 0, "exact": True}
+
+
+def test_fastpath_migration_keeps_conservation_exact():
+    """Frames parked on a wedged engine re-submit through migrate();
+    every future resolves and the three-tier ledger stays exact."""
+    vid = SyntheticVideo(seed=0, num_people=2, size=(120, 480),
+                         scene="static")
+    wedged = _GatedEngine(vid)
+    healthy = DetectionEngine(vid)
+    mgr = SessionManager(wedged, fastpath=FastPathConfig(),
+                         max_in_flight=4)
+    session = mgr.open("cam0")
+    futs = [session.submit_frame(vid.stamped_frame(t)) for t in range(3)]
+    assert not any(f.done() for f in futs)
+    moved = session.migrate(healthy)
+    assert moved == 3
+    for fut in futs:
+        assert len(fut.result(timeout=30)) == 2
+    assert session.close(timeout_s=30)
+    c = session.fastpath.metrics.conservation()
+    assert c["exact"] and c["failed"] == 0 and c["dropped"] == 0
+    assert c["escalated_full"] == 3
+    assert healthy.calls == 3
+
+
+class _FlakyEngine:
+    """Fails the first N submissions (future-borne errors), then
+    delegates — the error-reason re-proving path."""
+
+    def __init__(self, video, fail_first=2):
+        self._inner = DetectionEngine(video)
+        self.fail_left = fail_first
+        self.draining = False
+
+    def submit(self, image_bgr, *, deadline_s=None):
+        if self.fail_left > 0:
+            self.fail_left -= 1
+            fut = Future()
+            fut.set_exception(RuntimeError("transient replica error"))
+            return fut
+        return self._inner.submit(image_bgr)
+
+
+def test_fastpath_engine_errors_reprove_before_skipping():
+    vid = SyntheticVideo(seed=0, num_people=2, size=(120, 480),
+                         num_frames=12, scene="static")
+    eng = _FlakyEngine(vid, fail_first=2)
+    mgr = SessionManager(eng, fastpath=FastPathConfig(min_stable=2))
+    session = mgr.open("cam0")
+    outcomes = []
+    for t in range(12):
+        fut = session.submit_frame(vid.stamped_frame(t))
+        try:
+            fut.result(timeout=30)
+            outcomes.append("ok")
+        except RuntimeError:
+            outcomes.append("err")
+    assert outcomes[:2] == ["err", "err"]
+    assert all(o == "ok" for o in outcomes[2:])
+    assert session.close(timeout_s=30)
+    snap = session.fastpath.snapshot()
+    assert snap["exact"] and snap["failed"] == 2
+    # the failures forced full-frame re-proving before skipping resumed
+    assert snap["escalations"]["error"] >= 1
+    assert snap["answered_tracker"] > 0
+
+
+def test_fastpath_metric_families_and_retired_fold():
+    from improved_body_parts_tpu.obs import Registry
+
+    vid = SyntheticVideo(seed=3, num_people=2, size=(120, 480),
+                         num_frames=20, scene="slow_pan", speed=3.0)
+    reg = Registry()
+    mgr = SessionManager(DetectionEngine(vid), registry=reg,
+                         fastpath=_PAN_CFG)
+    session = mgr.open("cam0")
+    for t in range(20):
+        session.submit_frame(vid.stamped_frame(t)).result(timeout=30)
+    text = reg.prometheus()
+    assert 'stream_fastpath_submitted_total{stream="cam0"} 20.0' in text
+    assert 'stream_fastpath_answered_tracker_total{stream="cam0"}' in text
+    assert ('stream_fastpath_escalations_total{reason="cold",'
+            'stream="cam0"}') in text
+    assert ('stream_fastpath_tier_latency_seconds{quantile="0.5",'
+            'stream="cam0",tier="tracker"}') in text
+    assert 'stream_all_fastpath_escalations_total{reason="cold"}' in text
+    snap_before = session.fastpath.metrics.conservation()
+    assert session.close(timeout_s=30)
+    # the closed session's fast-path counts fold into monotone totals
+    totals = {name: v for name, labels, _, v in mgr.collect()
+              if not labels}
+    assert totals["stream_all_fastpath_submitted_total"] == 20.0
+    assert (totals["stream_all_fastpath_answered_tracker_total"]
+            == float(snap_before["answered_tracker"]))
+    esc = {labels["reason"]: v for name, labels, _, v in mgr.collect()
+           if name == "stream_all_fastpath_escalations_total"}
+    assert esc["cold"] == float(
+        session.fastpath.metrics.escalations["cold"])
+
+
+def test_fastpath_off_changes_nothing():
+    """Sessions without the knob keep the pre-fast-path contract: no
+    fastpath block, every frame a real forward."""
+    vid = SyntheticVideo(seed=0, num_people=2, size=(120, 480),
+                         scene="static")
+    eng = DetectionEngine(vid)
+    mgr = SessionManager(eng)
+    session = mgr.open("cam0")
+    for t in range(5):
+        session.submit_frame(vid.stamped_frame(t)).result(timeout=30)
+    assert eng.calls == 5
+    assert session.fastpath is None
+    assert "fastpath" not in session.snapshot()
+    assert session.close(timeout_s=30)
+
+
+# --------------------------------------------------------------------- #
+# real predictor: ROI bucket warmup + paste-back, 0 recompiles          #
+# --------------------------------------------------------------------- #
+
+SIZE = (256, 256)
+# the planted people span x ~[0, 174]: +margins they fit a 192-wide
+# window (a genuinely narrower lane than the 256 full frame)
+ROI_W = 192
+
+
+@pytest.fixture(scope="module")
+def roi_pred():
+    """Stub-model predictor warmed for BOTH buckets the fast path
+    drives: the full frame and the ONE extra width-cropped lane."""
+    from test_serve import _make_pred, _person_maps
+
+    pred = _make_pred(_person_maps())
+    pred.precompile_compact(
+        [pred.compact_lane_shape(np.zeros((*SIZE, 3), np.uint8),
+                                 pred.params),
+         pred.compact_lane_shape(np.zeros((SIZE[0], ROI_W, 3), np.uint8),
+                                 pred.params)],
+        batch_sizes=(1, 2), decode=True)
+    return pred
+
+
+def test_roi_real_predictor_paste_back_and_zero_recompiles(roi_pred):
+    """ROI frames over a real DynamicBatcher: the crop lands in the
+    precompiled (H, ROI_W) bucket — zero post-warmup XLA compiles — and
+    delivery equals the engine's own answer for that crop pasted back
+    by the decision's anchor.
+
+    The stub model is content-blind, so its answer for the narrower
+    lane decodes a DIFFERENT person count than the full frame — which
+    exercises the escalation half too: the people-delta signal forces
+    full-frame re-proving right after the ROI round, then skipping
+    resumes.  The whole 8-frame tier sequence is deterministic."""
+    from test_serve import _reference
+
+    from improved_body_parts_tpu.obs import Registry
+    from improved_body_parts_tpu.obs.recompile import CompileWatch
+    from improved_body_parts_tpu.serve import DynamicBatcher
+
+    watch = CompileWatch(Registry()).install()
+    try:
+        cfg = FastPathConfig(max_skip_run=2, min_stable=1,
+                             roi_width=ROI_W, roi_margin=8,
+                             full_refresh_every=0)
+        img = np.zeros((*SIZE, 3), np.uint8)
+        with DynamicBatcher(roi_pred, max_batch=2, max_wait_ms=20,
+                            use_native=False) as server:
+            with SessionManager(server, fastpath=cfg) as mgr:
+                # max_age=0: the content-blind stub answers the crop
+                # with shifted people, so the pre-shift track must die
+                # instead of coasting into the union box
+                session = mgr.open("cam0",
+                                   tracker=Tracker(max_age=0))
+                watch.mark_warm("both buckets precompiled")
+                # sequential submit→deliver: full(cold), 2×tracker,
+                # roi(interval) — whose 5-person crop answer then owes
+                # 2×full(people) until the count re-proves — 2×tracker
+                results = [session.submit_frame(img).result(timeout=120)
+                           for _ in range(8)]
+        snap = session.fastpath.snapshot()
+        assert snap["exact"] and snap["failed"] == 0
+        assert snap["answered_tracker"] == 4
+        assert snap["answered_roi"] == 1
+        assert snap["escalated_full"] == 3
+        assert {k: v for k, v in snap["escalations"].items() if v} == {
+            "cold": 1, "interval": 1, "people": 2}
+        assert watch.recompiles.value == 0.0, watch.timeline
+        # frame 0 (full tier) pins the reference people; the ROI frame
+        # must deliver the crop's own decode + the anchor offset
+        base = [(p.keypoints, p.score) for p in results[0]]
+        xs = [c[0] for kps, _ in base for c in kps if c is not None]
+        x0 = min(max(int(np.floor(min(xs))) - cfg.roi_margin, 0),
+                 SIZE[1] - ROI_W)
+        crop_ref = _reference(roi_pred,
+                              np.zeros((SIZE[0], ROI_W, 3), np.uint8))
+        want = paste_back(crop_ref, (x0, 0))
+        got = [(p.keypoints, p.score) for p in results[3]]   # first roi
+        assert len(got) == len(want) >= 1
+        for (gk, gs), (wk, ws) in zip(
+                sorted(got, key=lambda r: -r[1]),
+                sorted(want, key=lambda r: -r[1])):
+            assert gs == pytest.approx(ws, abs=1e-3)
+            for pg, pw in zip(gk, wk):
+                assert (pg is None) == (pw is None)
+                if pg is not None:
+                    assert pg[0] == pytest.approx(pw[0], abs=0.05)
+                    assert pg[1] == pytest.approx(pw[1], abs=0.05)
+    finally:
+        watch.uninstall()

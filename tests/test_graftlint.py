@@ -390,6 +390,23 @@ class TestHiddenHostSync:
         assert "JGL002" in rules_of(
             lint(src, path="improved_body_parts_tpu/infer/x.py"))
 
+    def test_fastpath_per_frame_code_is_scope_locked(self):
+        """The stream fast path's decision/delivery code runs on the
+        serve completion threads once per frame — a hidden device sync
+        there stalls every stream behind one session.  Lock
+        ``stream/fastpath.py`` into the JGL002 scope so a scope
+        refactor cannot silently drop the per-frame tier machinery."""
+        src = """
+            import jax.numpy as jnp
+
+            def on_delivered(frames, reasons):
+                for f in frames:
+                    score = jnp.min(f)
+                    reasons.append(float(score))
+        """
+        assert "JGL002" in rules_of(
+            lint(src, path="improved_body_parts_tpu/stream/fastpath.py"))
+
     def test_untainted_host_values_pass(self):
         findings = lint("""
             import numpy as np

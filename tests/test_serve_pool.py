@@ -872,8 +872,22 @@ def test_chaos_serve_cli(tmp_path):
     assert r["ok"] is True
     assert [i["kind"] for i in r["injections"]] == [
         "wedged_fetcher", "poisoned_program", "killed_decode_pool",
-        "replica_hard_stop_mid_stream", "latency_spike"]
+        "replica_hard_stop_mid_stream", "latency_spike",
+        "worker_sigkill", "fastpath_mid_skip_run"]
     assert r["futures"]["lost"] == 0
+    fp = next(i for i in r["injections"]
+              if i["kind"] == "fastpath_mid_skip_run")
+    # three-tier conservation exact through shed + migration +
+    # hard-stop, the skip run survived the faults, and the stranded
+    # real forward is the ONLY failure
+    assert fp["migrate_stream"]["exact"] is True
+    assert fp["shed_stream"]["exact"] is True
+    assert fp["migrate_stream"]["failed"] == 1
+    assert fp["shed_stream"]["dropped"] >= 1
+    assert fp["frames_migrated"] >= 1
+    assert fp["migrate_stream_escalations"]["error"] >= 1
+    assert fp["migrate_stream"]["answered_tracker"] > \
+        fp["skipped_before_faults"]
     assert r["recompiles_post_warmup"] == 0
     assert r["leaked_threads"] == []
     assert r["checks_failed"] == 0

@@ -604,6 +604,73 @@ def test_stream_bench_cli(tmp_path):
     assert r["track_ids_stable_all_rounds"] is True
 
 
+@pytest.mark.slow
+def test_stream_bench_fastpath_cli(tmp_path):
+    """tools/stream_bench.py --fastpath end-to-end: interleaved
+    fastpath-on/off A/B rounds, the three-tier conservation ledger,
+    per-tier latency percentiles, the width-only ROI warmup bucket,
+    per-arm recompile deltas and the equal-quality (synthetic-AP +
+    IDSW) protocol all land in the artifact."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    out = tmp_path / "STREAM_FASTPATH.json"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "stream_bench.py"),
+         "--config", "tiny", "--size", "256", "--boxsize", "256",
+         "--streams", "2", "--frames", "12", "--video-frames", "8",
+         "--rounds", "1", "--planted", "2", "--planted-canvas", "256",
+         "--max-batch", "2", "--fastpath", "--fp-roi-width", "128",
+         "--fp-roi-margin", "16", "--fp-quality-frames", "12",
+         "--out", str(out)],
+        check=True, timeout=1500, env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    r = json.loads(out.read_text())
+    assert r["fastpath_mode"] is True
+    # exactly ONE extra warmup bucket: the width-only ROI shape
+    shapes = [tuple(s) for s in r["warmup"]["bucket_shapes"]]
+    assert (256, 128) in shapes and (256, 256) in shapes
+    # interleaved A/B rounds with per-arm compile accounting
+    assert len(r["per_round_fastpath_speedup"]) == 1
+    assert r["median_fastpath_speedup"] > 0
+    assert r["fastpath_arm_recompile_delta_total"] == 0
+    assert r["baseline_arm_recompile_delta_total"] == 0
+    assert r["recompiles_post_warmup"] == 0
+    # three-tier conservation, exact, with the tracker tier engaged
+    cons = r["fastpath_conservation"]
+    assert cons["exact"] is True
+    assert cons["submitted"] == (cons["answered_tracker"]
+                                 + cons["answered_roi"]
+                                 + cons["escalated_full"]
+                                 + cons["failed"] + cons["dropped"]
+                                 + cons["depth"])
+    assert cons["answered_tracker"] > 0
+    assert r["fastpath_skip_rate"] > 0
+    # per-tier latency percentiles for every engaged tier
+    for tier, block in r["fastpath_tier_latency_ms"].items():
+        assert block["count"] > 0
+        assert block["p50"] <= block["p95"] <= block["p99"]
+    assert "tracker" in r["fastpath_tier_latency_ms"]
+    # escalation reasons are the closed vocabulary
+    assert set(r["fastpath_escalations"]) <= {
+        "overflow", "people", "score", "error", "cold", "refresh",
+        "roi_unfit", "interval"}
+    # equal-quality protocol: same synthetic-AP and IDSW per scene,
+    # with real forwards saved
+    assert r["quality_equal_all_scenes"] is True
+    for scene in ("static", "slow_pan"):
+        q = r["quality"][scene]
+        assert q["ap_equal"] is True
+        assert q["idsw_equal"] is True
+        assert q["forwards_saved_frac"] > 0
+    assert r["frames_failed_total"] == 0
+    assert r["track_ids_stable_all_rounds"] is True
+
+
 # --------------------------------------------------------------------- #
 # session migration off a fenced replica (ISSUE 11)                     #
 # --------------------------------------------------------------------- #

@@ -442,6 +442,11 @@ def main():
         "target_ratio": args.target_ratio,
         "cascade_beats_target": bool(median_ratio >= args.target_ratio),
         "cascade_routing": snap,
+        # the exact two-tier ledger (CascadeMetrics.conservation):
+        # submitted == answered_student + escalated_teacher + failed
+        # + depth, checked at this instant — the same conservation
+        # discipline the stream fast path extends to three tiers
+        "cascade_conservation": cascade.metrics.conservation(),
         "escalation_rate": snap["escalation_rate"],
         "recompiles_post_warmup": int(
             telemetry.compile_watch.recompiles.value),
@@ -454,6 +459,8 @@ def main():
         "escalation_rate": report["escalation_rate"],
         "ap_within_tolerance":
             report["quality"]["within_tolerance"],
+        "cascade_conservation_exact":
+            report["cascade_conservation"]["exact"],
         "recompiles_post_warmup": report["recompiles_post_warmup"]}))
 
 

@@ -27,7 +27,16 @@ injected INTO the serving machinery:
   PROCESS is kill -9'd mid-batch.  The shm-wire engine fails its
   in-flight futures with ``WorkerDied``, the pool fences the replica
   and fails the work over (zero lost futures), and the supervisor
-  respawns a FRESH process (new pid) that serves again.
+  respawns a FRESH process (new pid) that serves again;
+- **fastpath mid-skip-run**: streams running the temporal-coherence
+  fast path (``stream.fastpath``: tracker-tier frame skipping) hit the
+  full fault menu MID-SKIP-RUN — a shed under drop_oldest backpressure,
+  a live migration of parked real forwards to a healthy replica, and a
+  replica hard-stop that strands a real forward (the ``error``
+  escalation re-proves the scene before skipping resumes).  The
+  three-tier conservation ledger (``submitted == answered_tracker +
+  answered_roi + escalated_full + failed + dropped + depth``) must
+  balance EXACTLY through all of it.
 
 Asserted end to end, the ISSUE 11 acceptance: **zero lost futures**
 (every submit() of any kind resolves with a result or a typed error),
@@ -212,7 +221,8 @@ def main():
     from improved_body_parts_tpu.obs import Registry, RunTelemetry
     from improved_body_parts_tpu.serve import (
         DynamicBatcher, EnginePool, PolicyClient)
-    from improved_body_parts_tpu.stream import SessionManager
+    from improved_body_parts_tpu.stream import (
+        FastPathConfig, SessionManager)
 
     import jax.numpy as jnp
 
@@ -223,10 +233,14 @@ def main():
                            jnp.zeros((1, args.size, args.size, 3)),
                            train=False)
     if args.planted > 0:
-        canvas = max(int(args.size / 0.6) + 64, 640)
+        # canvas == frame size hugs the planted crowd into the frame's
+        # top-left, so it actually DECODES at chaos smoke sizes — the
+        # hard-stop order proof (tracker age stamps) and the fastpath
+        # phase (a tracker needs confirmed tracks to skip) both need
+        # real people, not an empty decode
         model = PlantedModel(model, planted_maps(cfg.skeleton,
                                                  args.planted, rng,
-                                                 canvas=canvas),
+                                                 canvas=args.size),
                              cfg.skeleton)
     model_params = (InferenceModelParams(boxsize=args.boxsize)
                     if args.boxsize else None)
@@ -598,6 +612,136 @@ def main():
               f"sigkill: recovery bounded ({recovered_s:.2f}s)")
         return rec
 
+    # --------------------------------------- 7: fastpath mid-skip-run
+    def inject_fastpath_mid_skip_run():
+        """The temporal-coherence fast path under the fault menu: a
+        skipping stream is shed (drop_oldest), migrated mid-skip-run
+        (parked real forwards re-submitted to a healthy replica, zero
+        failures), and hard-stopped (the stranded real forward FAILS,
+        the ``error`` escalation re-proves the scene, skipping
+        resumes).  The three-tier conservation ledger must balance
+        exactly through all of it."""
+        t0 = time.perf_counter()
+        fp = FastPathConfig(max_skip_run=2, min_stable=1)
+        mgr = SessionManager(engines[0], fastpath=fp)
+        sess_m = mgr.open("fp_migrate", max_in_flight=4, policy="block")
+        sess_s = mgr.open("fp_shed", max_in_flight=2,
+                          policy="drop_oldest")
+
+        def drive(sess, n, wait=True, catch=False):
+            futs = [ledger.track(sess.submit_frame(img),
+                                 "fastpath_frame") for _ in range(n)]
+            outcomes = []
+            if wait:
+                for f in futs:
+                    try:
+                        f.result(timeout=300)
+                        outcomes.append("ok")
+                    except Exception as e:  # noqa: BLE001 — typed
+                        if not catch:
+                            raise
+                        outcomes.append(type(e).__name__)
+            return futs, outcomes
+
+        # phase a — prove skipping: sequential calm frames; with
+        # max_skip_run=2 every 3rd frame is a real forward
+        for _ in range(5):
+            drive(sess_m, 1)
+        skipped_before = sess_m.fastpath.metrics.answered_tracker
+        # phase b — migration mid-skip-run: slow replica 0 so the next
+        # owed real forward PARKS (any 3 consecutive frames contain
+        # exactly one real), then rebind the stream to replica 1 — the
+        # parked forward is re-submitted, nothing fails
+        boxes[0].delay_s = 0.25
+        futs_b, _ = drive(sess_m, 3, wait=False)
+        time.sleep(0.05)      # let admissions land; the real forward
+        moved = sess_m.migrate(engines[1])  # is parked in the delay
+        for f in futs_b:
+            f.result(timeout=300)
+        boxes[0].delay_s = 0.0
+        failed_after_migration = sess_m.fastpath.metrics.failed
+        # phase c — hard-stop mid-skip-run: park the next real forward
+        # on replica 1, then stop the replica with a drain too short to
+        # finish it: the stranded frame fails with a typed error, the
+        # fast path owes an ``error`` full forward
+        boxes[1].delay_s = 0.3
+        futs_c, _ = drive(sess_m, 3, wait=False)
+        time.sleep(0.05)                  # land the stop mid-execute
+        engines[1].stop(drain_timeout_s=0.05)
+        outcomes_c = []
+        for f in futs_c:
+            try:
+                f.result(timeout=300)
+                outcomes_c.append("ok")
+            except Exception as e:  # noqa: BLE001 — typed resolution
+                outcomes_c.append(type(e).__name__)
+        boxes[1].delay_s = 0.0
+        # phase d — recovery: back on the live replica, the owed
+        # ``error`` full forward re-proves the scene and skipping
+        # resumes
+        sess_m.migrate(engines[0])
+        for _ in range(5):
+            drive(sess_m, 1)
+        snap_m = sess_m.fastpath.snapshot()
+        # phase e — shed mid-skip-run on the second stream: establish
+        # skipping, then burst a slowed replica at depth 2 under
+        # drop_oldest — oldest frames shed as FrameDropped (a typed
+        # resolution), accounted in the dropped bucket
+        for _ in range(5):
+            drive(sess_s, 1)
+        boxes[0].delay_s = 0.25
+        futs_e, outcomes_e = drive(sess_s, 9, catch=True)
+        boxes[0].delay_s = 0.0
+        snap_s = sess_s.fastpath.snapshot()
+        mgr.close_all(timeout_s=60)
+        recovered_s = time.perf_counter() - t0
+        # replica 1 was hard-stopped: the pool fences it; restart it
+        # (phase hygiene, same dance as the hard-stop phase)
+        fenced = wait_until(
+            lambda: pool.replica_states()[1]["state"] == "fenced",
+            timeout_s=30)
+        restarted = pool.restart(1)
+        cons_m = {k: snap_m[k] for k in
+                  ("submitted", "answered_tracker", "answered_roi",
+                   "escalated_full", "failed", "dropped", "depth",
+                   "exact")}
+        cons_s = {k: snap_s[k] for k in cons_m}
+        rec = {
+            "kind": "fastpath_mid_skip_run",
+            "migrate_stream": cons_m,
+            "migrate_stream_escalations": snap_m["escalations"],
+            "shed_stream": cons_s,
+            "shed_stream_escalations": snap_s["escalations"],
+            "skipped_before_faults": skipped_before,
+            "frames_migrated": moved,
+            "stop_outcomes": outcomes_c,
+            "shed_outcomes": outcomes_e,
+            "fenced": fenced, "restarted": restarted,
+            "recovery_s": round(recovered_s, 3),
+        }
+        check(skipped_before >= 3,
+              "fastpath: tracker tier engaged before the faults")
+        check(moved >= 1,
+              "fastpath: mid-skip-run migration re-submitted parked "
+              "real forwards")
+        check(failed_after_migration == 0,
+              "fastpath: migration was invisible (zero failures)")
+        check(snap_m["failed"] == 1,
+              "fastpath: hard-stop stranded exactly the parked real "
+              "forward")
+        check(snap_m["escalations"]["error"] >= 1,
+              "fastpath: the error escalation re-proved the scene")
+        check(snap_m["answered_tracker"] > skipped_before,
+              "fastpath: skipping resumed after recovery")
+        check(snap_s["dropped"] >= 1,
+              "fastpath: backpressure shed frames into the dropped "
+              "bucket")
+        check(cons_m["exact"] and cons_s["exact"],
+              "fastpath: three-tier conservation exact through "
+              "shed + migration + hard-stop")
+        check(restarted, "fastpath: replica restarted into routing")
+        return rec
+
     def ensure_all_live(after_kind):
         """Between-injection hygiene: only the TARGETED replica may
         have been fenced (and each phase restarts it); a healthy
@@ -615,7 +759,8 @@ def main():
 
     for inject in (inject_wedged_fetcher, inject_poisoned_program,
                    inject_killed_decode_pool, inject_hard_stop_mid_stream,
-                   inject_latency_spike, inject_worker_sigkill):
+                   inject_latency_spike, inject_worker_sigkill,
+                   inject_fastpath_mid_skip_run):
         rec = inject()
         report["injections"].append(rec)
         ensure_all_live(rec["kind"])
