@@ -651,6 +651,50 @@ def _procpool_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _fleetobs_summary(fallback, budget_s):
+    """Run tools/fleet_audit.py --quick (the fleet observability plane:
+    obs-on/off A/B over a 2-worker ProcessRouter, cross-boundary
+    conservation, merged-scrape check, trace stitching, SIGKILL
+    postmortem) and return a compact summary, or an {"error"/"skipped"}
+    marker — the "chaos" key contract.  Subprocess so a worker-process
+    failure can never take down the primary metric; bounded by the
+    REMAINING driver budget.  ``IBP_BENCH_FLEETOBS=0`` skips it
+    unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_FLEETOBS") == "0":
+        return {"skipped": "IBP_BENCH_FLEETOBS=0"}
+    if budget_s < 240:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (FLEET_OBS.json has the full "
+                           "audit)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="fleet_obs_"),
+                       "FLEET_OBS.json")
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "fleet_audit.py"),
+             "--quick", "--out", out],
+            capture_output=True, timeout=min(900, budget_s), check=True,
+            env=dict(os.environ))
+        with open(out) as f:
+            r = json.load(f)
+        return {
+            "ok": r["ok"],
+            "overhead_median_pct":
+                r["overhead"]["paired_median_overhead_pct"],
+            "conservation_frac": r["conservation"]["frac"],
+            "compiles_ok": r["compiles"]["ok"],
+            "scrape_ok": r["scrape"]["ok"],
+            "stitch_ok": r["trace_stitch"]["ok"],
+            "postmortem_ok": r["chaos"]["postmortem_ok"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def _audit_summary(budget_s):
     """Run tools/program_audit.py (the graftaudit compiled-program tier:
     jaxpr checks + fingerprint gating over the program registry, at
@@ -973,6 +1017,10 @@ def main():
     # discipline
     procpool = _procpool_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # fleet observability plane (obs-on/off A/B, conservation, scrape,
+    # stitch, postmortem), same discipline
+    fleetobs = _fleetobs_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     # GSPMD weak-scaling smoke (partitioned step, virtual meshes), same
     # discipline
     scaling = _scaling_summary(
@@ -1010,6 +1058,7 @@ def main():
         "chaos": chaos,
         "servechaos": servechaos,
         "procpool": procpool,
+        "fleetobs": fleetobs,
         "scaling": scaling,
         "cascade": cascade,
         "slo": slo,

@@ -59,7 +59,11 @@ class HiddenHostSync(Rule):
              # discipline as the engines themselves (the rest of obs/
              # is scrape-time/export code and stays out of scope)
              "improved_body_parts_tpu/obs/reqtrace.py",
-             "improved_body_parts_tpu/obs/slo.py")
+             "improved_body_parts_tpu/obs/slo.py",
+             # worker-side telemetry publishes into the shm block and
+             # records flight-ring milestones ON the serve loop between
+             # batches — same hot-path discipline
+             "improved_body_parts_tpu/obs/fleet.py")
 
     def check(self, ctx: ModuleContext) -> None:
         if not ctx.under(*self.SCOPE):

@@ -28,6 +28,11 @@ package replaces that with one process-wide pipeline every layer shares:
 - :mod:`slo`      — declarative per-QoS-class latency/availability
   objectives, multi-window burn rate, error budgets and alarm events
   (``SLOTracker``, ``Objective``; served at ``/slo``);
+- :mod:`fleet`    — cross-process fleet observability over the shm
+  wire: worker-side telemetry publisher (``WorkerTelemetry``), the
+  parent-side merge registry (``FleetRegistry``; served at ``/fleet``)
+  and the crash flight recorder (``build_postmortem``,
+  ``verify_postmortem``);
 - :mod:`run`      — the per-run bundle (``RunTelemetry``).
 
 ``tools/telemetry_report.py`` folds a run's JSONL stream into a
@@ -42,6 +47,17 @@ from .events import (
     get_sink,
     read_events,
     set_sink,
+)
+from .fleet import (
+    TELEM_VERSION,
+    FleetRegistry,
+    WorkerTelemetry,
+    build_postmortem,
+    decode_telem,
+    flow_id,
+    read_block,
+    read_flight_records,
+    verify_postmortem,
 )
 from .health import POLICIES, DivergenceError, HealthSentinel
 from .http import MetricsServer
@@ -80,4 +96,7 @@ __all__ = [
     "NullTraceRecorder", "TraceRecorder", "get_tracer", "set_tracer",
     "INPUT_BOUND_FRAC", "NullReqTrace", "ReqTrace", "get_reqtrace",
     "set_reqtrace", "Objective", "SLOTracker", "default_objectives",
+    "TELEM_VERSION", "FleetRegistry", "WorkerTelemetry",
+    "build_postmortem", "decode_telem", "flow_id", "read_block",
+    "read_flight_records", "verify_postmortem",
 ]

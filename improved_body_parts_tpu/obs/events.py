@@ -126,9 +126,13 @@ class EventSink:
         # shares it so span ts and event t are the same axis
         self.t0 = time.monotonic()
         self._closed = False
+        # public: what the header carried — the serve router reads
+        # run_id off the live sink to stamp worker shards with the SAME
+        # run identity (the report tools' shard-mismatch guard)
+        self.run_meta = dict(run_meta or {})
         header = {"event": "run_start", "schema": SCHEMA_VERSION, "t": 0.0,
                   "time_unix": round(time.time(), 3), "pid": os.getpid()}
-        header.update(run_meta or {})
+        header.update(self.run_meta)
         self._write(header)
 
     def _write(self, rec: dict) -> None:

@@ -164,7 +164,15 @@ class HealthSentinel:
             }
         for name, fn in self._extra.items():
             try:
-                out[name] = fn()
+                v = fn()
             except Exception as e:  # noqa: BLE001 — a probe body must
-                out[name] = f"error: {type(e).__name__}"  # never 500
+                v = f"error: {type(e).__name__}"          # never 500
+            out[name] = v
+            # an extra source can escalate the probe: a dict carrying
+            # its own non-ok "status" (the fleet block once a worker
+            # exhausts its crash budget) flips the top-level status —
+            # and with it /healthz to 503 — without owning the route
+            if (isinstance(v, dict) and out["status"] == "ok"
+                    and v.get("status", "ok") != "ok"):
+                out["status"] = str(v["status"])
         return out

@@ -18,7 +18,12 @@ A daemon-threaded ``ThreadingHTTPServer`` over one :class:`Registry`:
   per-class burn rates, budget remaining and alarm level — what the
   autoscaler / deploy gate polls.  HTTP 200 while every class is
   within budget, 503 while any alarm fires (so a dumb threshold-less
-  consumer can gate on status alone); 404 when no tracker was wired.
+  consumer can gate on status alone); 404 when no tracker was wired;
+- ``GET /fleet``    → the per-worker fleet document from the
+  caller-supplied ``fleet`` callable
+  (``obs.fleet.FleetRegistry.fleet_state``): per-worker liveness,
+  respawn/crash-budget counters, telemetry staleness age and the
+  cross-process conservation block.  404 when no fleet was wired.
 
 ``HEAD`` is answered for every route with the same status and headers
 and no body — LB probes default to HEAD, and an unanswered method must
@@ -47,13 +52,15 @@ class MetricsServer:
                  host: str = "127.0.0.1",
                  extra: Optional[Callable[[], dict]] = None,
                  health: Optional[Callable[[], dict]] = None,
-                 slo: Optional[Callable[[], dict]] = None):
+                 slo: Optional[Callable[[], dict]] = None,
+                 fleet: Optional[Callable[[], dict]] = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         reg = registry
         extra_fn = extra
         health_fn = health
         slo_fn = slo
+        fleet_fn = fleet
 
         class Handler(BaseHTTPRequestHandler):
             def _handle(self):
@@ -94,11 +101,21 @@ class MetricsServer:
                         body = json.dumps(_definan(state), indent=2,
                                           default=str).encode()
                         ctype = "application/json"
+                    elif path == "/fleet":
+                        if fleet_fn is None:
+                            self.send_error(
+                                404, "no fleet source wired on this "
+                                     "endpoint")
+                            return
+                        code = 200
+                        body = json.dumps(_definan(dict(fleet_fn())),
+                                          indent=2, default=str).encode()
+                        ctype = "application/json"
                     else:
                         # send_error handles HEAD itself (headers, no body)
                         self.send_error(
-                            404, "use /metrics, /snapshot, /healthz or "
-                                 "/slo")
+                            404, "use /metrics, /snapshot, /healthz, "
+                                 "/slo or /fleet")
                         return
                 except Exception as e:  # noqa: BLE001 — a scrape bug
                     # must 500, not kill the handler thread silently

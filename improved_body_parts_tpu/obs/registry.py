@@ -242,9 +242,15 @@ class Registry:
                 yield (m.name, dict(m.labels), m.kind, m.value, m.help)
         for fn in collectors:
             try:
-                for name, labels, kind, value in fn():
+                for tup in fn():
+                    # collectors yield (name, labels, kind, value) or,
+                    # with help text, (name, labels, kind, value, help)
+                    # — the fleet merge uses the 5-tuple form so worker
+                    # families render HELP like first-class metrics
+                    name, labels, kind, value = tup[:4]
+                    help = tup[4] if len(tup) > 4 else ""
                     yield (_sanitize(name), dict(labels or {}), kind,
-                           float(value), "")
+                           float(value), help)
             except Exception:  # noqa: BLE001 — one dead collector must
                 continue       # not take down the whole exposition
 
@@ -283,7 +289,8 @@ class Registry:
                 out[key] = m.value
         for fn in collectors:
             try:
-                for name, labels, kind, value in fn():
+                for tup in fn():
+                    name, labels, _kind, value = tup[:4]
                     out[_sanitize(name) + _render_labels(labels or {})] = \
                         float(value)
             except Exception:  # noqa: BLE001

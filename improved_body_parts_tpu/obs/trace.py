@@ -303,8 +303,13 @@ class TraceRecorder:
         return {
             "traceEvents": meta + self.events(),
             "displayTimeUnit": "ms",
+            # t0_mono: the absolute CLOCK_MONOTONIC anchor — what lets
+            # tools/trace_report.py rebase a worker process's export
+            # onto the parent's axis ((t0_shard - t0_parent) µs shift)
+            # and stitch the fleet into ONE timeline
             "otherData": {"dropped_events": self.dropped,
-                          "capacity": self.capacity},
+                          "capacity": self.capacity,
+                          "t0_mono": self._t0},
         }
 
     def save(self, path: str) -> str:

@@ -48,7 +48,15 @@ import numpy as np
 
 from ..data.shm_ring import WorkerDied, _quiet_close, _slot_views
 from ..obs.events import get_sink
+from ..obs.fleet import (
+    FleetRegistry,
+    build_postmortem,
+    flow_id,
+    read_block,
+    read_flight_records,
+)
 from ..obs.reqtrace import NULL_NODE, get_reqtrace
+from ..obs.trace import get_tracer
 from .batcher import DeadlineExceeded, ServerOverloaded
 from .metrics import HOPS, ServeMetrics
 from .pool import EnginePool
@@ -58,7 +66,9 @@ from .worker import (
     STATUS_OK,
     decode_people,
     hb_view,
+    rec_view,
     region_size,
+    telem_view,
     wire_format,
     worker_main,
 )
@@ -105,7 +115,9 @@ class ProcessWorkerEngine:
                  crash_budget: int = 5,
                  warmup_timeout_s: float = 300.0,
                  metrics: Optional[ServeMetrics] = None,
-                 registry=None):
+                 registry=None,
+                 telemetry: bool = True,
+                 trace_path: Optional[str] = None):
         if slots < 1:
             raise ValueError(f"slots={slots} must be >= 1")
         self.spec = spec
@@ -120,6 +132,8 @@ class ProcessWorkerEngine:
         self.max_batch = max_batch
         self.worker_idx = worker_idx
         self.sink_path = sink_path
+        self.telemetry = bool(telemetry)
+        self.trace_path = trace_path
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
@@ -143,6 +157,12 @@ class ProcessWorkerEngine:
         self._header = None
         self._views = None
         self._hb = None
+        self._telem = None      # worker telemetry block (read-only)
+        self._rec = None        # flight-recorder ring (read-only)
+        #: the last exhumed ``worker_postmortem`` record (None until a
+        #: worker death is detected with the ring attached)
+        self.last_postmortem: Optional[dict] = None
+        self._backing_off = False
         self._task_tx = None    # parent write end of the task pipe
         self._done_rx = None    # parent read end of the done pipe
         # multiple client threads write the task channel; pipe sends
@@ -178,9 +198,13 @@ class ProcessWorkerEngine:
             self._gen += 1
             gen = self._gen
         if self.consecutive_failures > 0:
-            time.sleep(min(self.backoff_base_s
-                           * 2 ** (self.consecutive_failures - 1),
-                           self.backoff_max_s))
+            self._backing_off = True
+            try:
+                time.sleep(min(self.backoff_base_s
+                               * 2 ** (self.consecutive_failures - 1),
+                               self.backoff_max_s))
+            finally:
+                self._backing_off = False
         self._teardown_transport()
         from multiprocessing import shared_memory
 
@@ -192,12 +216,18 @@ class ProcessWorkerEngine:
         # reads done-tokens from done_r; no feeder threads anywhere
         task_r, task_w = self._ctx.Pipe(duplex=False)
         done_r, done_w = self._ctx.Pipe(duplex=False)
+        # the parent run's identity rides into the worker shard header
+        # so the report tools can match shards to THIS run (and skip a
+        # stray shard from another one loudly)
+        run_id = (getattr(get_sink(), "run_meta", None)
+                  or {}).get("run_id")
         proc = self._ctx.Process(
             target=worker_main,
             args=(self.worker_idx, shm.name, self.slots, self.shapes,
                   self.dtypes, self.spec, self.spec_kwargs_json,
                   task_r, done_w, os.getpid(), self.sink_path,
-                  self.max_batch),
+                  self.max_batch, self.telemetry, self.trace_path,
+                  run_id),
             name=f"serve-worker-{self.worker_idx}", daemon=True)
         proc.start()
         # drop the parent's copies of the child-side ends so a dead
@@ -210,6 +240,10 @@ class ProcessWorkerEngine:
             self._shm, self._header, self._views = shm, header, views
             self._hb = hb_view(shm.buf, self.slots, self.shapes,
                                self.dtypes, writeable=False)
+            self._telem = telem_view(shm.buf, self.slots, self.shapes,
+                                     self.dtypes, writeable=False)
+            self._rec = rec_view(shm.buf, self.slots, self.shapes,
+                                 self.dtypes, writeable=False)
             self._task_tx, self._done_rx = task_w, done_r
             self._proc = proc
             self._free = list(range(self.slots))
@@ -238,6 +272,7 @@ class ProcessWorkerEngine:
             task_tx, self._task_tx = self._task_tx, None
             done_rx, self._done_rx = self._done_rx, None
             self._header = self._views = self._hb = None
+            self._telem = self._rec = None
         if proc is not None and proc.is_alive():
             proc.terminate()
             proc.join(5.0)
@@ -358,6 +393,8 @@ class ProcessWorkerEngine:
             self._pending[idx] = req
             header, views, task_tx = (self._header, self._views,
                                       self._task_tx)
+        tracer = get_tracer()
+        tr0 = tracer.now() if tracer.enabled else 0.0
         img_v, meta_in = views[idx][0], views[idx][1]
         header[idx, 0] = req.seq - 1        # odd: router writing
         img_v[:h, :w] = image
@@ -373,6 +410,21 @@ class ProcessWorkerEngine:
             self._finish(req, error=WorkerDied(
                 f"serve worker {self.worker_idx} pipe unusable: {e}"),
                 idx=idx)
+        if tracer.enabled:
+            # the router half of the cross-process flow arc: one
+            # proc_submit slice (slot write + token send) starting the
+            # (cat="proc", flow_id) arc the worker's serve slice steps
+            # and the deliver slice finishes
+            tr1 = tracer.now()
+            rtrack = f"router-w{self.worker_idx}"
+            tracer.add_span_rel("proc_submit", tr0,
+                                max(tr1 - tr0, 1e-7), track=rtrack,
+                                args={"slot": idx, "seq": req.seq,
+                                      "worker": self.worker_idx})
+            tracer.flow_start("req",
+                              flow_id(self.worker_idx, idx, req.seq),
+                              track=rtrack, cat="proc",
+                              ts=(tr0 + tr1) / 2.0)
         return req.future
 
     # ------------------------------------------------------------- warmup
@@ -443,6 +495,59 @@ class ProcessWorkerEngine:
                 "served": int(hb[1]),
                 "recompiles_post_warmup": int(hb[2]),
                 "restarts": self.restarts}
+
+    # ------------------------------------------------------ fleet readout
+    def telem_read(self):
+        """Seqlock-consistent copy of the worker's telemetry block (or
+        ``None``: no transport / torn) — ``obs.fleet.decode_telem``'s
+        input, the ``FleetRegistry`` merge source."""
+        with self._lock:
+            telem = self._telem
+        if telem is None:
+            return None
+        return read_block(telem)
+
+    def worker_info(self) -> dict:
+        """The router-side half of the fleet merge: liveness, lifecycle
+        counters, crash budget, in-flight ledger and the router-view
+        submit/complete counts the conservation check compares against
+        the worker's served counter."""
+        with self._lock:
+            proc, hb = self._proc, self._hb
+            running = self._running
+            in_flight = len(self._pending)
+        alive = proc is not None and proc.is_alive()
+        hb_stamp = float(hb[0]) if hb is not None else 0.0
+        hb_age = (max(0.0, time.perf_counter() - hb_stamp)
+                  if hb_stamp > 0.0 else None)
+        m = self.metrics
+        return {
+            "worker": self.worker_idx,
+            "pid": proc.pid if proc is not None else None,
+            "alive": alive,
+            "running": running,
+            "backing_off": self._backing_off,
+            "gave_up": self.gave_up,
+            "consecutive_failures": self.consecutive_failures,
+            "crash_budget": self.crash_budget,
+            "restarts": self.restarts,
+            "in_flight": in_flight,
+            "submitted": m.submitted,
+            "completed": m.completed,
+            "failed": m.failed,
+            "hb_age_s": round(hb_age, 3) if hb_age is not None else None,
+            "hb_served": int(hb[1]) if hb is not None else 0,
+            "hb_recompiles": int(hb[2]) if hb is not None else 0,
+        }
+
+    def flight_records(self) -> dict:
+        """Exhume the flight-recorder ring (tolerant of a torn write —
+        see ``obs.fleet.read_flight_records``)."""
+        with self._lock:
+            rec = self._rec
+        if rec is None:
+            return {"records": [], "count": 0, "torn": False}
+        return read_flight_records(rec)
 
     # ------------------------------------------------------------ fetcher
     def _fetch_loop(self, gen: int, proc, done_rx) -> None:
@@ -533,6 +638,25 @@ class ProcessWorkerEngine:
             self.consecutive_failures += 1
             exitcode = (self._proc.exitcode
                         if self._proc is not None else None)
+            pid = self._proc.pid if self._proc is not None else None
+            rec = self._rec
+            in_flight = [(idx, req.seq)
+                         for idx, req in self._pending.items()]
+        # exhume the flight recorder BEFORE failing the futures: the
+        # ring names the in-flight slot/seq and the last hop the dead
+        # worker completed — a SIGKILL leaves no other trace.  The
+        # region outlives the process (parent still maps it), and the
+        # reader tolerates a permanently-odd parity from a kill
+        # mid-write (torn=True, best-effort copy).
+        try:
+            flight = (read_flight_records(rec) if rec is not None
+                      else {"records": [], "count": 0, "torn": False})
+            pm = build_postmortem(self.worker_idx, pid, exitcode,
+                                  flight, in_flight)
+            self.last_postmortem = pm
+            get_sink().emit("worker_postmortem", **pm)
+        except Exception:  # noqa: BLE001 — forensics must never block
+            pass           # the failover path
         get_sink().emit("worker_died", worker=self.worker_idx,
                         exitcode=exitcode,
                         in_flight=self._pending_count())
@@ -587,6 +711,22 @@ class ProcessWorkerEngine:
                                replica=self.worker_idx)
             self.metrics.on_hops(self.worker_idx, durs)
             self.metrics.on_decode(fused=True)
+            tracer = get_tracer()
+            if tracer.enabled and idx is not None:
+                # the deliver slice finishes the cross-process flow arc
+                # the submit started and the worker's serve slice
+                # stepped; worker stamps share CLOCK_MONOTONIC with the
+                # tracer's t0 so add_span_abs lands on the same axis
+                rtrack = f"router-w{self.worker_idx}"
+                tracer.add_span_abs("proc_deliver", bounds[4],
+                                    max(t_fin - bounds[4], 1e-7),
+                                    track=rtrack,
+                                    args={"slot": idx, "seq": req.seq})
+                tracer.flow_finish(
+                    "req", flow_id(self.worker_idx, idx, req.seq),
+                    track=rtrack, cat="proc",
+                    ts=(bounds[4] - tracer.t0)
+                    + (t_fin - bounds[4]) / 2.0)
         elif req.ctx.sampled:
             req.ctx.finish(
                 "ok" if error is None
@@ -635,15 +775,31 @@ class ProcessRouter:
                  registry=None, slo=None,
                  qos_class: str = "interactive",
                  pool_kw: Optional[dict] = None,
+                 telemetry: bool = True,
+                 trace_path: Optional[str] = None,
+                 staleness_s: float = 5.0,
                  **engine_kw):
         if num_workers < 1:
             raise ValueError(f"num_workers={num_workers} must be >= 1")
         if sink_path is None:
             sink_path = getattr(get_sink(), "path", None)
         self.workers = [
-            ProcessWorkerEngine(spec, spec_kwargs, worker_idx=i,
-                                sink_path=sink_path, **engine_kw)
+            ProcessWorkerEngine(
+                spec, spec_kwargs, worker_idx=i,
+                sink_path=sink_path, telemetry=telemetry,
+                # per-worker trace shards next to the parent export —
+                # the ".pN" suffix convention tools/trace_report.py and
+                # tools/telemetry_report.py auto-discover
+                trace_path=(f"{trace_path}.p{i + 1}"
+                            if trace_path else None),
+                **engine_kw)
             for i in range(num_workers)]
+        #: the parent-side merge point: worker telemetry blocks +
+        #: router-side lifecycle state under ``worker=`` labels, the
+        #: ``/fleet`` document and the cross-process conservation check
+        self.fleet = FleetRegistry(staleness_s=staleness_s)
+        for w in self.workers:
+            self.fleet.add_engine(w)
         kw = dict(pool_kw or {})
         kw.setdefault("restart_after_s", restart_after_s)
         kw.setdefault("wedge_timeout_s", wedge_timeout_s)
@@ -705,6 +861,25 @@ class ProcessRouter:
     def worker_stats(self) -> List[dict]:
         return [w.worker_stats() for w in self.workers]
 
+    def fleet_state(self) -> dict:
+        """The ``/fleet`` route body (wire as ``MetricsServer``'s
+        ``fleet=`` callable): per-worker liveness / respawn / crash-
+        budget state, decoded telemetry with staleness age, and the
+        cross-process conservation block."""
+        return self.fleet.fleet_state()
+
+    def health_extra(self) -> dict:
+        """The ``/healthz`` fleet block (wire via
+        ``HealthSentinel.set_extra("fleet", router.health_extra)``):
+        carries its own non-ok ``status`` once any worker exhausts its
+        crash budget, which escalates the probe to 503."""
+        return self.fleet.health_extra()
+
+    def last_postmortems(self) -> List[Optional[dict]]:
+        """Per-worker last exhumed ``worker_postmortem`` (None where no
+        death was detected since start)."""
+        return [w.last_postmortem for w in self.workers]
+
     def register_into(self, registry) -> "ProcessRouter":
         """One exposition path for the whole fleet: pool + per-replica
         engine metrics through the pool's weakref collector, plus the
@@ -712,6 +887,7 @@ class ProcessRouter:
         import weakref
 
         self.pool.register_into(registry)
+        self.fleet.attach(registry)
         ref = weakref.ref(self)
 
         def _collect():
@@ -719,18 +895,22 @@ class ProcessRouter:
             if rt is None:
                 return []
             samples = []
-            for name, v in (("router_worker_respawns_total",
-                             rt.counters()["worker_respawns"]),
-                            ("router_workers_gave_up",
-                             rt.counters()["workers_gave_up"])):
-                samples.append((name, {}, "counter", float(v)))
+            samples.append(("router_worker_respawns_total", {},
+                            "counter",
+                            float(rt.counters()["worker_respawns"])))
+            # gauges, not counters: gave_up can reset on recovery and
+            # the recompile count restarts with a respawned worker —
+            # and counter naming (JGL006 / the metric-name lint) would
+            # demand a _total suffix these families don't carry
+            samples.append(("router_workers_gave_up", {}, "gauge",
+                            float(rt.counters()["workers_gave_up"])))
             for i, w in enumerate(rt.workers):
                 st = w.worker_stats()
                 samples.append(("router_worker_served_total",
                                 {"worker": str(i)}, "counter",
                                 float(st["served"])))
                 samples.append(("router_worker_recompiles_post_warmup",
-                                {"worker": str(i)}, "counter",
+                                {"worker": str(i)}, "gauge",
                                 float(st["recompiles_post_warmup"])))
             return samples
 

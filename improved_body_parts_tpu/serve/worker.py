@@ -51,12 +51,26 @@ from ..data.shm_ring import (
     _slot_layout,
     _slot_views,
 )
+from ..obs.fleet import (
+    REC_DONE,
+    REC_EXEC_DONE,
+    REC_FLOATS,
+    REC_PICKUP,
+    REC_WARMUP,
+    TELEM_FLOATS,
+    WorkerTelemetry,
+    flow_id,
+)
 from ..train.supervisor import chaos_kill_point
 
 #: wire schema version — bumped whenever the slot field list changes;
 #: router and worker are always the same build (spawned, not network
 #: peers) so this is a debugging aid, not a negotiation.
-WIRE_VERSION = 1
+#: v2: the region grew two trailing blocks after the heartbeat row —
+#: the worker telemetry snapshot block and the crash-persistent flight
+#: recorder ring (``obs.fleet`` owns both layouts); the 4-float
+#: heartbeat survives at its v1 offset as the degenerate case.
+WIRE_VERSION = 2
 
 #: response status codes (meta_out[0])
 STATUS_OK = 0.0
@@ -96,17 +110,42 @@ def wire_format(max_hw: Tuple[int, int], num_parts: int,
 
 def region_size(slots: int, shapes, dtypes) -> int:
     """Total shared-memory bytes: seqlock headers + slot rows + the
-    trailing heartbeat block."""
+    trailing heartbeat block + the telemetry snapshot block + the
+    flight-recorder ring (wire v2)."""
     _, slot_bytes = _slot_layout(shapes, dtypes)
     return (_align(slots * _HEADER_INTS * 8) + slots * slot_bytes
-            + _align(HB_FLOATS * 8))
+            + _align(HB_FLOATS * 8) + _align(TELEM_FLOATS * 8)
+            + _align(REC_FLOATS * 8))
 
 
 def hb_view(buf, slots: int, shapes, dtypes, writeable: bool):
-    """The heartbeat float64 row at the end of the region."""
+    """The 4-float heartbeat row after the slot rows (its v1 offset —
+    the degenerate case when telemetry is off)."""
     _, slot_bytes = _slot_layout(shapes, dtypes)
     off = _align(slots * _HEADER_INTS * 8) + slots * slot_bytes
     v = np.frombuffer(buf, np.float64, HB_FLOATS, offset=off)
+    v.flags.writeable = writeable
+    return v
+
+
+def telem_view(buf, slots: int, shapes, dtypes, writeable: bool):
+    """The worker telemetry snapshot block (``obs.fleet`` layout,
+    seqlock-parity word at index 0) after the heartbeat row."""
+    _, slot_bytes = _slot_layout(shapes, dtypes)
+    off = (_align(slots * _HEADER_INTS * 8) + slots * slot_bytes
+           + _align(HB_FLOATS * 8))
+    v = np.frombuffer(buf, np.float64, TELEM_FLOATS, offset=off)
+    v.flags.writeable = writeable
+    return v
+
+
+def rec_view(buf, slots: int, shapes, dtypes, writeable: bool):
+    """The crash-persistent flight-recorder ring at the region tail —
+    what the router exhumes after a worker death."""
+    _, slot_bytes = _slot_layout(shapes, dtypes)
+    off = (_align(slots * _HEADER_INTS * 8) + slots * slot_bytes
+           + _align(HB_FLOATS * 8) + _align(TELEM_FLOATS * 8))
+    v = np.frombuffer(buf, np.float64, REC_FLOATS, offset=off)
     v.flags.writeable = writeable
     return v
 
@@ -278,7 +317,10 @@ def worker_main(worker_idx: int, shm_name: str, slots: int,
                 shapes, dtypes, spec: str, spec_kwargs_json: str,
                 task_rx, done_tx, parent_pid: int,
                 sink_path: Optional[str] = None,
-                max_batch: int = 4) -> None:
+                max_batch: int = 4,
+                telemetry: bool = True,
+                trace_path: Optional[str] = None,
+                run_id: Optional[str] = None) -> None:
     """Worker process entry (spawn target — module importable).
 
     ``task_rx`` / ``done_tx`` are the one-way pipe connections of the
@@ -289,9 +331,17 @@ def worker_main(worker_idx: int, shm_name: str, slots: int,
     ``("warmup", sizes, batch_sizes)`` precompiles the predictor's
     bucket programs and arms the worker's own ``CompileWatch`` so
     post-warmup recompiles are counted IN the process that would pay
-    them (reported through the heartbeat block).  A factory/attach
-    failure answers ``("init_err", worker_idx, tb)`` and exits — the
-    router's lifecycle discipline decides whether to respawn.
+    them (published through the telemetry block; mirrored into the
+    heartbeat row for the degenerate case).  A factory/attach failure
+    answers ``("init_err", worker_idx, tb)`` and exits — the router's
+    lifecycle discipline decides whether to respawn.
+
+    ``telemetry=False`` is the explicit OFF arm of the fleet-obs A/B:
+    null sink, null tracer, no snapshot publishes, no flight records —
+    only the PR 16 4-float heartbeat moves.  ``trace_path`` names this
+    worker's span-file shard (the parent composes the ``.pN`` suffix);
+    ``run_id`` stamps the sink shard with the parent run's identity so
+    the report tools can refuse a stray shard from another run.
     """
     shm = None
     try:
@@ -301,18 +351,24 @@ def worker_main(worker_idx: int, shm_name: str, slots: int,
             cv2.setNumThreads(0)
         except Exception:  # noqa: BLE001 — cv2 optional in the child
             pass
-        sink = None
-        if sink_path:
-            from ..obs.events import EventSink, set_sink
+        from ..obs.events import EventSink, NullSink, set_sink
 
+        sink = None
+        if sink_path and telemetry:
             # the PR 3 multi-process rule: non-lead processes write
             # their own sink shard so streams never interleave
+            meta = {"role": "serve_worker", "worker": worker_idx}
+            if run_id:
+                meta["run_id"] = run_id
             sink = EventSink(sink_path + f".p{worker_idx + 1}",
-                             run_meta={"role": "serve_worker",
-                                       "worker": worker_idx})
+                             run_meta=meta)
             set_sink(sink)
             sink.emit("worker_start", worker=worker_idx,
                       pid=os.getpid(), spec=spec)
+        else:
+            # the OFF arm installs the null sink EXPLICITLY (not "no
+            # sink happened to be configured") — the A/A hazard rule
+            set_sink(NullSink())
         pred = load_predictor(spec, json.loads(spec_kwargs_json))
         serve = _build_serve_fn(pred)
         shm = _attach_shm(shm_name)
@@ -320,9 +376,18 @@ def worker_main(worker_idx: int, shm_name: str, slots: int,
                                     writeable=True)
         hb = hb_view(shm.buf, slots, shapes, dtypes, writeable=True)
         hb[3] = float(os.getpid())
-        from ..obs.recompile import CompileWatch
+        telem = telem_view(shm.buf, slots, shapes, dtypes,
+                           writeable=True)
+        rec = rec_view(shm.buf, slots, shapes, dtypes, writeable=True)
+        wt = WorkerTelemetry(worker_idx, telem, rec, enabled=telemetry,
+                             sink=sink,
+                             trace_t0=sink.t0 if sink is not None
+                             else None)
+        from ..obs.trace import set_tracer
 
-        watch = CompileWatch().install()
+        # worker-process tracer: the bounded ring the trace shard
+        # flushes from; the null recorder on the OFF arm
+        set_tracer(wt.trace)
     except BaseException:  # noqa: BLE001 — surfaced to the router
         try:
             done_tx.send(("init_err", worker_idx,
@@ -335,7 +400,8 @@ def worker_main(worker_idx: int, shm_name: str, slots: int,
 
     try:
         _serve_loop(worker_idx, header, views, hb, task_rx, done_tx,
-                    parent_pid, sink, serve, pred, watch, max_batch)
+                    parent_pid, sink, serve, pred, wt, max_batch,
+                    trace_path)
     finally:
         # live views make a plain close() raise BufferError at
         # interpreter teardown; detach quietly (the shm_ring worker
@@ -343,15 +409,35 @@ def worker_main(worker_idx: int, shm_name: str, slots: int,
         _quiet_close(shm)
 
 
-def _serve_loop(worker_idx, header, views, hb, task_rx, done_tx,
-                parent_pid, sink, serve, pred, watch,
-                max_batch: int) -> None:
-    served = 0
+#: seconds between periodic worker trace-shard flushes (busy path);
+#: idle beats and the poison-pill exit also flush, so a clean stop
+#: never loses spans — only a crash does, which is what the flight
+#: recorder ring is for
+TRACE_FLUSH_S = 5.0
 
-    def beat() -> None:
+
+def _serve_loop(worker_idx, header, views, hb, task_rx, done_tx,
+                parent_pid, sink, serve, pred, wt,
+                max_batch: int, trace_path: Optional[str]) -> None:
+    served = 0
+    watch = wt.watch
+    tracer = wt.trace
+    track = f"worker{worker_idx}-serve"
+    last_flush = time.perf_counter()
+    burst = 0
+
+    def beat(force: bool = False) -> None:
         hb[0] = time.perf_counter()
         hb[1] = float(served)
         hb[2] = float(watch.recompiles.value)
+        # busy-path publishes stay throttled (the snapshot sorts the
+        # hop reservoirs — hot-loop cost); the idle tick forces, so a
+        # quiescent parent reads CURRENT counters within one tick
+        wt.publish(force=force)
+
+    def flush_trace(now: float) -> float:
+        wt.flush_trace(trace_path)
+        return now
 
     beat()
 
@@ -362,6 +448,9 @@ def _serve_loop(worker_idx, header, views, hb, task_rx, done_tx,
         h, w = int(meta_in[0]), int(meta_in[1])
         deadline = float(meta_in[2])
         image = img_v[:h, :w]
+        # flight record BEFORE any kill point: a SIGKILL mid-serve must
+        # still leave the pickup milestone for the postmortem to name
+        wt.record(REC_PICKUP, idx, seq, a=deadline)
         # response write under the slot seqlock: odd while mutating,
         # back to even (seq + 2) when consistent — a router that reads
         # a mismatched seq discards the slot as stale
@@ -378,6 +467,7 @@ def _serve_loop(worker_idx, header, views, hb, task_rx, done_tx,
                 chaos_kill_point("worker_serve")
                 people, signals = serve(image)
                 meta_out[4] = time.perf_counter()
+                wt.record(REC_EXEC_DONE, idx, seq)
                 chaos_kill_point("worker_respond")
                 encode_people(people, signals, kps, scores, sig,
                               meta_out)
@@ -388,15 +478,51 @@ def _serve_loop(worker_idx, header, views, hb, task_rx, done_tx,
             err[:len(msg)] = np.frombuffer(msg, np.uint8)
         if meta_out[4] == 0.0:
             meta_out[4] = time.perf_counter()
-        meta_out[5] = time.perf_counter()
+        t_done = time.perf_counter()
+        meta_out[5] = t_done
         header[idx, 0] = seq + 2
+        status = float(meta_out[0])
+        wt.record(REC_DONE, idx, seq, a=status)
+        wt.count_status(status == STATUS_OK,
+                        expired=status == STATUS_EXPIRED)
+        if status == STATUS_OK:
+            # the hops this process pays, measured where they happen
+            # (the router sees the same stamps from the wire — its
+            # on_hops feed stays the SLO input; see obs.fleet)
+            wt.observe_hops(float(meta_out[4]) - float(meta_out[3]),
+                            t_done - float(meta_out[4]))
+        if tracer.enabled:
+            tracer.add_span_abs("serve", t_pickup, t_done - t_pickup,
+                                track=track,
+                                args={"slot": idx, "seq": seq,
+                                      "status": int(status)})
+            tracer.add_span_abs("device", float(meta_out[3]),
+                                float(meta_out[4]) - float(meta_out[3]),
+                                track=track)
+            tracer.add_span_abs("decode", float(meta_out[4]),
+                                t_done - float(meta_out[4]),
+                                track=track)
+            # flow step: threads the router's submit→deliver arc
+            # through this worker's serve slice — keyed (cat, id) so
+            # every (worker, slot, seq) is its own arc
+            tracer.flow_step("req", flow_id(worker_idx, idx, seq),
+                             track=track, cat="proc",
+                             ts=(t_pickup - tracer.t0)
+                             + (t_done - t_pickup) / 2.0)
         served += 1
         done_tx.send(("done", worker_idx, idx, seq))
 
     while True:
         try:
             if not task_rx.poll(2.0):
-                beat()
+                if burst:
+                    wt.on_burst(burst)
+                    burst = 0
+                wt.sample_memory()
+                beat(force=True)
+                now = time.perf_counter()
+                if trace_path and now - last_flush > TRACE_FLUSH_S:
+                    last_flush = flush_trace(now)
                 if parent_pid and os.getppid() != parent_pid:
                     return  # orphaned: the router is gone
                 continue
@@ -404,6 +530,11 @@ def _serve_loop(worker_idx, header, views, hb, task_rx, done_tx,
         except (EOFError, OSError, ValueError):
             return          # router closed the channel / died
         if task is None:
+            if burst:
+                wt.on_burst(burst)
+            wt.publish(force=True)
+            if trace_path:
+                flush_trace(time.perf_counter())
             if sink is not None:
                 sink.emit("worker_stop", worker=worker_idx,
                           served=served)
@@ -411,15 +542,27 @@ def _serve_loop(worker_idx, header, views, hb, task_rx, done_tx,
             return
         kind = task[0]
         if kind == "req":
+            burst += 1
             serve_slot(task[1], task[2])
+            if not task_rx.poll(0):
+                # burst over: no token waiting — the occupancy signal
+                # (mean requests drained back-to-back per wakeup)
+                wt.on_burst(burst)
+                burst = 0
+                now = time.perf_counter()
+                if trace_path and now - last_flush > TRACE_FLUSH_S:
+                    last_flush = flush_trace(now)
             beat()
         elif kind == "warmup":
             try:
                 info = _warmup(pred, task[1], task[2], max_batch)
                 watch.mark_warm("worker warmup precompile")
+                wt.record(REC_WARMUP, a=1.0)
                 done_tx.send(("warmup_done", worker_idx, info))
             except BaseException:  # noqa: BLE001 — warmup failure is
                 # an answer, not a crash: the router decides
+                wt.record(REC_WARMUP, a=0.0)
                 done_tx.send(("warmup_err", worker_idx,
                               traceback.format_exc()))
+            wt.publish(force=True)
             beat()

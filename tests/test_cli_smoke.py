@@ -15,7 +15,7 @@ CLIS = [
     "export_model.py", "import_torch_checkpoint.py", "make_corpus.py",
     "build_native.py", "list_coco.py", "lint.py", "program_audit.py",
     "stream_bench.py", "chaos_serve.py", "cascade_bench.py",
-    "request_report.py", "latency_audit.py",
+    "request_report.py", "latency_audit.py", "fleet_audit.py",
 ]
 
 

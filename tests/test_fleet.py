@@ -1,0 +1,634 @@
+"""Fleet observability plane tests (ISSUE 18): worker telemetry over
+the shm wire, parent-side merge, flight recorder, and the tool surface.
+
+Four tiers, none of which pays an XLA compile:
+
+- **Block units** — publish/decode round-trips of the telemetry block
+  and the flight-recorder ring on plain numpy arrays, including the
+  seqlock torn-read and version/staleness discipline.
+- **Merge semantics** — ``FleetRegistry`` scrape-time collection:
+  Prometheus-legal names across every fleet family, never-fresh-zeros
+  for unpublished workers, the ``stale`` marker, conservation math,
+  and an 8-thread merge-under-rewrite hammer (torn-read safety is
+  purely the seqlock's job).
+- **Exposition surface** — ``/fleet`` route + the ``/healthz`` fleet
+  block escalating to 503 once a worker exhausts its crash budget.
+- **Cross-process integration** — a live 2-worker ``ProcessRouter``
+  scrape with ``worker=`` labels, and the SIGKILL postmortem
+  exhumation naming the killed batch.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.obs import (
+    SCHEMA_VERSION,
+    FleetRegistry,
+    HealthSentinel,
+    MetricsServer,
+    Registry,
+    WorkerTelemetry,
+    build_postmortem,
+    decode_telem,
+    read_block,
+    read_flight_records,
+    verify_postmortem,
+)
+from improved_body_parts_tpu.obs.fleet import (
+    REC_DONE,
+    REC_FLOATS,
+    REC_PICKUP,
+    REC_SLOTS,
+    T_SERVED,
+    T_STAMP,
+    T_VERSION,
+    TELEM_FLOATS,
+    TELEM_VERSION,
+)
+from improved_body_parts_tpu.serve import ProcessRouter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPEC = "improved_body_parts_tpu.serve.worker:constant_predictor"
+NUM_PARTS = 6
+ENGINE_KW = dict(max_image_hw=(64, 64), num_parts=NUM_PARTS,
+                 max_people=8, slots=8)
+
+
+def _img(value: int, hw=(32, 32)) -> np.ndarray:
+    return np.full((*hw, 3), value, np.uint8)
+
+
+def _wt(telem=None, rec=None, **kw):
+    return WorkerTelemetry(0, telem=telem, rec=rec, **kw)
+
+
+# --------------------------------------------------------------------- #
+# telemetry block units                                                  #
+# --------------------------------------------------------------------- #
+class TestTelemBlock:
+    def test_publish_decode_roundtrip(self):
+        telem = np.zeros(TELEM_FLOATS, np.float64)
+        wt = _wt(telem=telem)
+        for ok in (True, True, False):
+            wt.count_status(ok)
+        wt.count_status(False, expired=True)
+        wt.observe_hops(0.010, 0.002)
+        wt.observe_hops(0.020, 0.004)
+        wt.on_burst(2)
+        assert wt.publish(force=True)
+        d = decode_telem(read_block(telem), staleness_s=5.0)
+        assert d["published"] and not d["torn"] and not d["stale"]
+        assert d["version"] == TELEM_VERSION
+        assert d["pid"] == os.getpid()
+        assert d["served"] == 4 and d["ok"] == 2
+        assert d["errors"] == 1 and d["expired"] == 1
+        assert d["bursts"] == 1 and d["burst_requests"] == 2
+        assert d["batch_occupancy_mean"] == 2.0
+        dev = d["hops"]["device"]
+        assert dev["count"] == 2
+        assert abs(dev["sum_s"] - 0.030) < 1e-9
+        assert 0.010 <= dev["p50_s"] <= 0.020
+
+    def test_unpublished_block_never_reads_as_fresh(self):
+        telem = np.zeros(TELEM_FLOATS, np.float64)
+        d = decode_telem(read_block(telem))
+        assert d == {"published": False, "torn": False}
+
+    def test_unknown_layout_version_is_refused(self):
+        telem = np.zeros(TELEM_FLOATS, np.float64)
+        telem[T_VERSION] = 99.0
+        d = decode_telem(read_block(telem))
+        assert not d["published"]
+        assert d["version_mismatch"] == 99
+
+    def test_stale_marker_keeps_last_known_values(self):
+        telem = np.zeros(TELEM_FLOATS, np.float64)
+        wt = _wt(telem=telem)
+        wt.count_status(True)
+        wt.publish(force=True)
+        arr = read_block(telem)
+        d = decode_telem(arr, staleness_s=5.0,
+                         now=float(arr[T_STAMP]) + 60.0)
+        assert d["published"] and d["stale"]
+        assert d["age_s"] == pytest.approx(60.0, abs=0.5)
+        assert d["served"] == 1    # last-known values, not zeros
+
+    def test_torn_block_reads_as_unpublished(self):
+        telem = np.zeros(TELEM_FLOATS, np.float64)
+        _wt(telem=telem).publish(force=True)
+        telem[0] += 1.0            # writer died mid-write: parity odd
+        assert read_block(telem, retries=4) is None
+        d = decode_telem(read_block(telem, retries=4))
+        assert d == {"published": False, "torn": True}
+
+    def test_counters_publish_hot_hop_summaries_throttled(self):
+        telem = np.zeros(TELEM_FLOATS, np.float64)
+        wt = _wt(telem=telem, publish_min_interval_s=3600.0)
+        wt.count_status(True)
+        wt.observe_hops(0.010, 0.001)
+        wt.publish(force=True)
+        # inside the throttle window: counters must still move, the
+        # reservoir summaries must not re-sort
+        wt.count_status(True)
+        wt.observe_hops(0.020, 0.002)
+        wt.publish()
+        d = decode_telem(read_block(telem))
+        assert d["served"] == 2
+        assert d["hops"]["device"]["count"] == 1
+        wt.publish(force=True)
+        d = decode_telem(read_block(telem))
+        assert d["hops"]["device"]["count"] == 2
+
+    def test_disabled_arm_never_touches_the_block(self):
+        telem = np.zeros(TELEM_FLOATS, np.float64)
+        wt = _wt(telem=telem, enabled=False)
+        wt.count_status(True)
+        wt.observe_hops(0.010, 0.001)
+        assert not wt.publish(force=True)
+        assert float(telem[T_VERSION]) == 0.0
+        assert float(telem[T_SERVED]) == 0.0
+
+
+# --------------------------------------------------------------------- #
+# flight-recorder ring                                                   #
+# --------------------------------------------------------------------- #
+class TestFlightRing:
+    def test_record_roundtrip(self):
+        rec = np.zeros(REC_FLOATS, np.float64)
+        wt = _wt(rec=rec)
+        wt.record(REC_PICKUP, slot=3, seq=7, a=123.5)
+        wt.record(REC_DONE, slot=3, seq=7, a=1.0)
+        out = read_flight_records(rec)
+        assert not out["torn"] and out["count"] == 2
+        kinds = [(r["kind"], r["slot"], r["seq"]) for r in out["records"]]
+        assert kinds == [("pickup", 3, 7), ("done", 3, 7)]
+        assert out["records"][0]["a"] == 123.5
+
+    def test_ring_wraps_keeping_the_newest(self):
+        rec = np.zeros(REC_FLOATS, np.float64)
+        wt = _wt(rec=rec)
+        n = REC_SLOTS + 5
+        for i in range(n):
+            wt.record(REC_PICKUP, slot=0, seq=i + 1)
+        out = read_flight_records(rec)
+        assert out["count"] == n
+        assert len(out["records"]) == REC_SLOTS
+        # oldest 5 evicted, newest survives
+        seqs = [r["seq"] for r in out["records"]]
+        assert seqs[0] == 6 and seqs[-1] == n
+
+    def test_sigkill_torn_ring_still_yields_records(self):
+        """A SIGKILL mid-write leaves the parity word odd forever; the
+        exhumer must take the best-effort copy and flag it, never
+        refuse."""
+        rec = np.zeros(REC_FLOATS, np.float64)
+        wt = _wt(rec=rec)
+        wt.record(REC_PICKUP, slot=1, seq=9)
+        rec[0] += 1.0              # died holding the seqlock
+        out = read_flight_records(rec)
+        assert out["torn"]
+        assert [(r["kind"], r["seq"]) for r in out["records"]] == \
+            [("pickup", 9)]
+
+    def test_build_and_verify_postmortem(self):
+        rec = np.zeros(REC_FLOATS, np.float64)
+        wt = _wt(rec=rec)
+        wt.record(REC_PICKUP, slot=2, seq=11)
+        pm = build_postmortem(0, pid=4242, exitcode=-9,
+                              flight=read_flight_records(rec),
+                              in_flight=[(2, 11), (5, 12)])
+        assert pm["in_flight"][0] == {
+            "slot": 2, "seq": 11, "last_completed_hop": "queue",
+            "last_milestone": "pickup"}
+        # never picked up: the ring legitimately has no milestone
+        assert pm["in_flight"][1]["last_completed_hop"] is None
+        assert pm["last_completed_hop"] == "queue"
+        ok, problems = verify_postmortem(pm)
+        assert ok, problems
+
+    def test_verifier_rejects_an_unidentifying_postmortem(self):
+        empty = {"records": [], "count": 0, "torn": False}
+        pm = build_postmortem(0, pid=1, exitcode=-9, flight=empty,
+                              in_flight=[])
+        ok, problems = verify_postmortem(pm)
+        assert not ok
+        assert any("unidentified" in p for p in problems)
+        pm = build_postmortem(0, pid=1, exitcode=-9, flight=empty,
+                              in_flight=[(3, 4)])
+        ok, problems = verify_postmortem(pm)
+        assert not ok     # in-flight named but no milestone matched
+        ok, _ = verify_postmortem(pm, require_in_flight=False)
+        assert ok
+        assert not verify_postmortem({"worker": "zero"})[0]
+
+
+# --------------------------------------------------------------------- #
+# parent-side merge                                                      #
+# --------------------------------------------------------------------- #
+def _fake_worker(telem, *, submitted=0, in_flight=0, alive=True,
+                 running=True, gave_up=False, hb_served=0):
+    info = {"alive": alive, "running": running, "gave_up": gave_up,
+            "backing_off": False, "consecutive_failures": 0,
+            "crash_budget": 3, "restarts": 0, "in_flight": in_flight,
+            "submitted": submitted, "hb_age_s": 0.01,
+            "hb_served": hb_served, "pid": 4242}
+    return (lambda: read_block(telem)), (lambda: info)
+
+
+def _published(served=5, ok=5):
+    telem = np.zeros(TELEM_FLOATS, np.float64)
+    wt = _wt(telem=telem)
+    for i in range(served):
+        wt.count_status(i < ok)
+        wt.observe_hops(0.01, 0.001)
+    wt.on_burst(served)
+    wt.publish(force=True)
+    return telem
+
+
+NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+class TestFleetRegistry:
+    def _fleet(self, telems, **kw):
+        fleet = FleetRegistry(staleness_s=5.0)
+        for i, telem in enumerate(telems):
+            telem_fn, info_fn = _fake_worker(telem, submitted=5, **kw)
+            fleet.add_worker(i, telem_fn, info_fn)
+        return fleet
+
+    def test_metric_name_lint_over_every_fleet_family(self):
+        """ISSUE 18 CI satellite: every fleet/worker family rides the
+        lint-checked exposition walk — Prometheus-legal names and
+        labels, counters strictly suffixed."""
+        reg = Registry()
+        fleet = self._fleet([_published(), _published()])
+        fleet.attach(reg)
+        names = set()
+        for name, labels, kind, value, help in reg._flat():
+            if not name.startswith("fleet_"):
+                continue
+            names.add(name)
+            assert NAME_RE.match(name), name
+            for k in labels:
+                assert LABEL_RE.match(str(k)), (name, k)
+            if kind == "counter":
+                assert name.endswith(("_total", "_sum", "_count")), name
+        assert {"fleet_worker_up", "fleet_worker_stale",
+                "fleet_worker_served_total", "fleet_worker_ok_total",
+                "fleet_worker_hop_latency_seconds",
+                "fleet_worker_hop_latency_seconds_sum",
+                "fleet_worker_hop_latency_seconds_count",
+                "fleet_worker_batch_occupancy_mean",
+                "fleet_worker_xla_compiles_total",
+                "fleet_worker_device_bytes_in_use",
+                "fleet_worker_restarts_total",
+                "fleet_conservation_frac"} <= names
+
+    def test_unpublished_worker_exports_liveness_only(self):
+        """Never-fresh-zeros: a worker whose block was never published
+        (version word 0) must not export served/memory zeros that read
+        as real samples — liveness/staleness families only."""
+        reg = Registry()
+        fleet = self._fleet([np.zeros(TELEM_FLOATS, np.float64)])
+        fleet.attach(reg)
+        names = {n for n, *_ in reg._flat() if n.startswith("fleet_")}
+        assert "fleet_worker_up" in names
+        assert "fleet_worker_served_total" not in names
+        assert "fleet_worker_device_bytes_in_use" not in names
+
+    def test_stale_worker_exports_with_stale_marker(self):
+        telem = _published()
+        telem[T_STAMP] = time.perf_counter() - 3600.0
+        fleet = self._fleet([telem])
+        rows = {(n, labels.get("worker")): v
+                for n, labels, k, v, h in fleet.samples()}
+        assert rows[("fleet_worker_stale", "0")] == 1.0
+        # last-known values still exported, marked — not fresh zeros,
+        # not silently dropped
+        assert rows[("fleet_worker_served_total", "0")] == 5.0
+
+    def test_conservation_balances_and_falls_back_to_heartbeat(self):
+        fleet = FleetRegistry()
+        t_fn, i_fn = _fake_worker(_published(served=3),
+                                  submitted=4, in_flight=1)
+        fleet.add_worker(0, t_fn, i_fn)
+        # unpublished telemetry: served comes from the 4-float heartbeat
+        t2, i2 = _fake_worker(np.zeros(TELEM_FLOATS, np.float64),
+                              submitted=2, hb_served=2)
+        fleet.add_worker(1, t2, i2)
+        cons = fleet.conservation()
+        assert cons == {"router_submitted": 6, "workers_served": 5,
+                        "in_flight": 1, "frac": 1.0}
+
+    def test_merge_under_scrape_hammer(self):
+        """8 scraper threads against a writer rewriting the block as
+        fast as it can, holding the invariant served == ok under the
+        seqlock.  A scrape must see either a consistent block (the
+        invariant holds) or a clean miss — never a torn mix."""
+        telem = np.zeros(TELEM_FLOATS, np.float64)
+        reg = Registry()
+        fleet = self._fleet([telem])
+        fleet.attach(reg)
+        stop = threading.Event()
+        failures = []
+        consistent_reads = [0]
+
+        def writer():
+            wt = _wt(telem=telem, publish_min_interval_s=0.0)
+            while not stop.is_set():
+                wt.count_status(True)     # served and ok move together
+                wt.publish(force=True)
+
+        def scraper():
+            ok_local = 0
+            while not stop.is_set():
+                sample = {(n, labels.get("worker")): v
+                          for n, labels, k, v, h in reg._flat()
+                          if n in ("fleet_worker_served_total",
+                                   "fleet_worker_ok_total")}
+                served = sample.get(("fleet_worker_served_total", "0"))
+                okv = sample.get(("fleet_worker_ok_total", "0"))
+                if served is None and okv is None:
+                    continue              # torn read: clean miss
+                if served != okv:
+                    failures.append((served, okv))
+                    return
+                ok_local += 1
+            consistent_reads[0] += ok_local
+
+        threads = [threading.Thread(target=writer)]
+        threads += [threading.Thread(target=scraper) for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not failures, failures[:3]
+        # the hammer must not be vacuous: scrapes DID win consistent
+        # copies against the rewrite storm
+        assert consistent_reads[0] > 0
+
+
+# --------------------------------------------------------------------- #
+# exposition surface                                                     #
+# --------------------------------------------------------------------- #
+class TestFleetRoutes:
+    def _get(self, url):
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_fleet_route_serves_state_404_when_unwired(self):
+        reg = Registry()
+        fleet = FleetRegistry()
+        t_fn, i_fn = _fake_worker(_published(), submitted=5)
+        fleet.add_worker(0, t_fn, i_fn)
+        with MetricsServer(reg, port=0,
+                           fleet=fleet.fleet_state) as srv:
+            code, body = self._get(srv.url + "/fleet")
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["workers"][0]["worker"] == 0
+            assert doc["workers"][0]["telemetry"]["served"] == 5
+            assert doc["conservation"]["frac"] == 1.0
+        with MetricsServer(reg, port=0) as srv:
+            code, _ = self._get(srv.url + "/fleet")
+            assert code == 404
+
+    def test_healthz_503_once_a_worker_exhausts_its_crash_budget(self):
+        reg = Registry()
+        sentinel = HealthSentinel(reg, policy="warn")
+        fleet = FleetRegistry()
+        t_fn, i_fn = _fake_worker(_published(), submitted=5)
+        fleet.add_worker(0, t_fn, i_fn)
+        sentinel.set_extra("fleet", fleet.health_extra)
+        with MetricsServer(reg, port=0, health=sentinel.state) as srv:
+            code, body = self._get(srv.url + "/healthz")
+            assert code == 200
+            assert json.loads(body)["fleet"]["workers"][0]["alive"]
+            # worker 1 burns through its crash budget
+            t2, i2 = _fake_worker(np.zeros(TELEM_FLOATS, np.float64),
+                                  alive=False, gave_up=True)
+            fleet.add_worker(1, t2, i2)
+            code, body = self._get(srv.url + "/healthz")
+            assert code == 503
+            doc = json.loads(body)
+            assert doc["status"] == "worker_crash_budget_exhausted"
+            assert doc["fleet"]["exhausted"] == [1]
+
+
+# --------------------------------------------------------------------- #
+# report-tool shard discovery                                            #
+# --------------------------------------------------------------------- #
+def _jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r, allow_nan=False) + "\n")
+
+
+def _run_start(run_id, **kw):
+    return {"event": "run_start", "schema": SCHEMA_VERSION, "t": 0.0,
+            "time_unix": 0.0, "pid": 1, "run_id": run_id, **kw}
+
+
+class TestShardDiscovery:
+    def _tool(self, name, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", name),
+             *args],
+            capture_output=True, text=True, timeout=120)
+
+    def test_telemetry_report_summarizes_shards_separately(self, tmp_path):
+        p = str(tmp_path / "events.jsonl")
+        _jsonl(p, [_run_start("run-a", tool="serve")])
+        _jsonl(p + ".p1", [
+            _run_start("run-a", role="serve_worker", worker=0, pid=77),
+            {"event": "worker_start", "t": 0.1, "worker": 0},
+            {"event": "worker_stop", "t": 1.0, "worker": 0,
+             "served": 12},
+        ])
+        # a stale shard from an EARLIER run next to the fresh primary
+        _jsonl(p + ".p2", [
+            _run_start("run-stale", role="serve_worker", worker=1),
+        ])
+        out = str(tmp_path / "report.json")
+        proc = self._tool("telemetry_report.py", p, "--json", out)
+        assert proc.returncode == 0, proc.stderr
+        assert "worker sink shards: 1" in proc.stdout
+        assert "skipping stale shard" in proc.stderr
+        assert "run-stale" in proc.stderr
+        shards = json.load(open(out))["worker_shards"]
+        assert len(shards) == 1
+        assert shards[0]["worker"] == 0
+        assert shards[0]["served"] == 12 and shards[0]["clean_stop"]
+
+    def test_telemetry_report_no_shards_flag(self, tmp_path):
+        p = str(tmp_path / "events.jsonl")
+        _jsonl(p, [_run_start("run-a", tool="serve")])
+        _jsonl(p + ".p1", [_run_start("run-a", worker=0)])
+        proc = self._tool("telemetry_report.py", p, "--no-shards")
+        assert proc.returncode == 0, proc.stderr
+        assert "worker sink shards" not in proc.stdout
+
+    def test_request_report_concatenates_matching_shards(self, tmp_path):
+        def req(rid):
+            return {"event": "request", "req": rid, "e2e_ms": 10.0,
+                    "status": "OK", "hop_coverage": 1.0,
+                    "nodes": [{"node": f"{rid}-n", "parent": None,
+                               "comp": "pool", "kind": "submit",
+                               "t0_ms": 0.0, "dur_ms": 10.0,
+                               "status": "OK", "won_by": None,
+                               "hops_ms": {"queue": 10.0}}]}
+
+        p = str(tmp_path / "events.jsonl")
+        _jsonl(p, [_run_start("run-a"), req("r1")])
+        _jsonl(p + ".p1", [_run_start("run-a", worker=0), req("r2")])
+        _jsonl(p + ".p2", [_run_start("run-stale", worker=1),
+                           req("r3")])
+        proc = self._tool("request_report.py", p, "--strict")
+        assert proc.returncode == 0, proc.stderr
+        # r1 + r2 merged; the stale shard's r3 skipped loudly
+        assert "2 request records" in proc.stdout
+        assert "skipping stale shard" in proc.stderr
+        proc = self._tool("request_report.py", p, "--no-shards")
+        assert "1 request records" in proc.stdout
+
+
+# --------------------------------------------------------------------- #
+# cross-process trace stitching                                          #
+# --------------------------------------------------------------------- #
+class TestTraceStitch:
+    def test_stitched_timeline_with_flow_arcs(self, tmp_path):
+        from improved_body_parts_tpu.obs.trace import TraceRecorder
+
+        parent = TraceRecorder(capacity=256)
+        parent.add_span_rel("proc_submit", 0.001, 0.0005,
+                            track="router-w0", args={"slot": 0})
+        parent.flow_start("req", 99, track="router-w0", cat="proc",
+                          ts=0.0012)
+        parent.add_span_rel("proc_deliver", 0.009, 0.0005,
+                            track="router-w0")
+        parent.flow_finish("req", 99, track="router-w0", cat="proc",
+                           ts=0.0092)
+        # the worker's ring shares the CLOCK_MONOTONIC axis but anchors
+        # at ITS OWN t0 — the stitcher must rebase by the t0 delta
+        worker = TraceRecorder(capacity=256, t0=parent.t0 + 0.002)
+        worker.add_span_rel("serve", 0.001, 0.005,
+                            track="worker0-serve")
+        worker.flow_step("req", 99, track="worker0-serve", cat="proc",
+                         ts=0.003)
+        p = str(tmp_path / "trace.json")
+        parent.save(p)
+        worker.save(p + ".p1")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "trace_report.py"), p],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "stitched worker shards: worker 0" in proc.stdout
+        assert ("cross-process flow arcs: 1 submits -> 1 worker serves "
+                "-> 1 delivers" in proc.stdout.replace("→", "->"))
+        # the rebase: +2 ms shift reported for the shard
+        assert "+2.0 ms" in proc.stdout
+
+
+# --------------------------------------------------------------------- #
+# cross-process integration                                              #
+# --------------------------------------------------------------------- #
+class TestFleetIntegration:
+    def test_live_two_worker_scrape_with_worker_labels(self):
+        """Acceptance (ISSUE 18): one merged /metrics scrape on a live
+        2-worker ProcessRouter exposes per-worker families under
+        ``worker=`` labels, and the cross-boundary ledger balances at
+        quiescence."""
+        reg = Registry()
+        with ProcessRouter(SPEC, num_workers=2,
+                           spec_kwargs={"num_parts": NUM_PARTS,
+                                        "delay_s": 0.02},
+                           **ENGINE_KW) as router:
+            router.register_into(reg)
+            futs = [router.submit(_img(v), deadline_s=60.0)
+                    for v in range(8)]
+            [f.result(timeout=60) for f in futs]
+            # the hop-summary refresh is throttled; one more beat after
+            # the interval passes the quantiles through
+            time.sleep(0.08)
+            router.submit(_img(0), deadline_s=60.0).result(timeout=60)
+            deadline = time.perf_counter() + 10.0
+            while time.perf_counter() < deadline:
+                cons = router.fleet.conservation()
+                if cons["frac"] == 1.0 and cons["in_flight"] == 0:
+                    break
+                time.sleep(0.02)
+            assert cons["frac"] == 1.0, cons
+            assert cons["router_submitted"] == 9
+            with MetricsServer(reg, port=0,
+                               fleet=router.fleet_state) as srv:
+                with urllib.request.urlopen(srv.url + "/metrics",
+                                            timeout=10) as r:
+                    text = r.read().decode()
+                with urllib.request.urlopen(srv.url + "/fleet",
+                                            timeout=10) as r:
+                    doc = json.loads(r.read().decode())
+        for family in ("fleet_worker_up", "fleet_worker_served_total",
+                       "fleet_worker_hop_latency_seconds",
+                       "fleet_worker_xla_compiles_total",
+                       "fleet_worker_batch_occupancy_mean",
+                       "fleet_worker_device_bytes_in_use",
+                       "fleet_conservation_frac"):
+            assert family in text, family
+        for w in ("0", "1"):
+            assert f'worker="{w}"' in text, w
+        # worker-side hop quantiles made it across the wire
+        assert 'hop="device"' in text and 'hop="decode"' in text
+        assert doc["conservation"]["frac"] == 1.0
+        served = sum(w["telemetry"].get("served", 0)
+                     for w in doc["workers"])
+        assert served == 9
+
+    def test_sigkill_postmortem_names_the_killed_batch(self):
+        """Acceptance (ISSUE 18): on SIGKILL — no user code runs — the
+        router exhumes the flight ring and the postmortem names the
+        in-flight slot/seq and last completed hop."""
+        with ProcessRouter(SPEC, num_workers=2,
+                           spec_kwargs={"num_parts": NUM_PARTS,
+                                        "delay_s": 0.25},
+                           restart_after_s=0.3, probe_interval_s=0.05,
+                           **ENGINE_KW) as router:
+            router.submit(_img(0)).result(timeout=60)
+            pid0 = router.workers[0].worker_stats()["pid"]
+            futs = [router.submit(_img(v), deadline_s=60.0)
+                    for v in range(6)]
+            time.sleep(0.1)
+            os.kill(pid0, __import__("signal").SIGKILL)
+            for f in futs:
+                try:
+                    f.result(timeout=60)
+                except Exception:  # noqa: BLE001 — failover may shed
+                    pass
+            deadline = time.perf_counter() + 15.0
+            pm = None
+            while pm is None and time.perf_counter() < deadline:
+                pm = router.workers[0].last_postmortem
+                time.sleep(0.02)
+        assert pm is not None, "no postmortem exhumed"
+        # death may be detected via heartbeat staleness before the
+        # process object has reaped the -9
+        assert pm["exitcode"] in (-9, None)
+        ok, problems = verify_postmortem(pm)
+        assert ok, problems
+        assert pm["in_flight"], pm
+        assert any(e["last_completed_hop"] for e in pm["in_flight"])

@@ -1094,6 +1094,42 @@ def test_scope_covers_reqtrace_and_slo_modules():
         lint(leak, path="improved_body_parts_tpu/obs/slo.py"))
 
 
+def test_scope_covers_fleet_module():
+    """ISSUE 18 satellite: worker-side telemetry (obs/fleet.py) runs ON
+    the worker serve loop — publish/record between batches is hot-path
+    code and lives in the JGL002 scope; JGL004 covers any JSON it
+    emits and JGL005 its thread/shm lifecycles (both repo-wide).
+    Locked on the file's actual path so a future move can't silently
+    drop it from the sweep."""
+    hot = """
+        import jax.numpy as jnp
+
+        def publish_loop(blocks):
+            for b in blocks:
+                v = jnp.sum(b)
+                store(float(v))
+    """
+    assert "JGL002" in rules_of(
+        lint(hot, path="improved_body_parts_tpu/obs/fleet.py"))
+    bad_json = """
+        import json
+
+        def dump(report, f):
+            json.dump(report, f)
+    """
+    assert "JGL004" in rules_of(
+        lint(bad_json, path="improved_body_parts_tpu/obs/fleet.py"))
+    leak = """
+        import threading
+
+        def watch(view):
+            t = threading.Thread(target=view.poll)
+            t.start()
+    """
+    assert "JGL005" in rules_of(
+        lint(leak, path="improved_body_parts_tpu/obs/fleet.py"))
+
+
 def test_donation_tracks_distill_factory():
     """The distill step factory is in the donating-factories config:
     JGL001 must flag a read of the state after it flowed into a

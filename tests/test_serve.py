@@ -464,6 +464,47 @@ def test_serve_bench_cli(tmp_path):
     assert serve["hop_conservation_frac"] >= 0.95
 
 
+@pytest.mark.slow
+def test_serve_bench_proc_only_cli(tmp_path):
+    """tools/serve_bench.py --proc-only: the process-pool A/B artifact
+    carries the ISSUE 18 fleet-plane block — per-worker hop quantiles
+    read back over the shm telemetry wire (worker-VIEW, measured in the
+    process that paid them) and the cross-boundary conservation ledger
+    (router-view submitted vs Σ worker-view served + in-flight)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    out = tmp_path / "PROC_BENCH.json"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "serve_bench.py"),
+         "--proc-only", "--proc-rounds", "2", "--requests", "6",
+         "--telemetry-sink", "none", "--out", str(out)],
+        check=True, timeout=1500, env=env, cwd=repo,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    r = json.loads(out.read_text())
+    ab = r["proc_ab"]
+    quant = ab["process_worker_hop_quantiles_ms"]
+    assert len(quant) == ab["workers"]
+    for w in quant:
+        assert w["published"], w
+        for hop in ("device", "decode"):
+            h = w["hops_ms"][hop]
+            assert h["count"] > 0
+            assert h["p50"] > 0 and h["p95"] >= h["p50"]
+            assert h["p99"] >= h["p95"]
+    cons = ab["cross_boundary_conservation"]
+    assert cons["router_submitted"] > 0
+    # clean run: the ledger balances (each worker's final count beat
+    # lands just AFTER the parent's future resolves, so the readback
+    # may trail by at most one request per worker — the documented
+    # chaos-tolerant gate, not an equality assert)
+    assert cons["frac"] >= 0.95
+
+
 def test_metrics_endpoint_serves_batcher_under_load(warm_pred):
     """Acceptance (ISSUE 3): a live /metrics endpoint serves valid
     Prometheus text exposition for a DynamicBatcher under concurrent

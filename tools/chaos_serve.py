@@ -550,6 +550,7 @@ def main():
         injection must not share fate with the in-process pool."""
         import signal
 
+        from improved_body_parts_tpu.obs.fleet import verify_postmortem
         from improved_body_parts_tpu.serve.router import ProcessRouter
 
         t0 = time.perf_counter()
@@ -589,6 +590,14 @@ def main():
             people = res[0] if isinstance(res, tuple) else res
             post_ok = isinstance(people, list) and len(people) > 0
             counters = router.counters()
+            # the flight recorder's proof obligation: the exhumed ring
+            # must IDENTIFY the killed batch (slot/seq + last completed
+            # hop), not merely exist — verify_postmortem checks the
+            # structure and that at least one in-flight request matched
+            # a recorded milestone
+            pm = router.workers[0].last_postmortem
+            pm_ok, pm_problems = verify_postmortem(pm) \
+                if pm is not None else (False, ["no postmortem exhumed"])
         rec = {
             "kind": "worker_sigkill",
             "in_flight_at_kill": len(futs),
@@ -600,6 +609,12 @@ def main():
             "failovers": counters["failovers"],
             "post_respawn_answered": post_ok,
             "recovery_s": round(recovered_s, 3),
+            "postmortem_ok": pm_ok,
+            "postmortem_problems": pm_problems,
+            "postmortem_in_flight": (len(pm["in_flight"])
+                                     if pm is not None else 0),
+            "postmortem_last_hop": (pm["last_completed_hop"]
+                                    if pm is not None else None),
         }
         check(ok + err == len(futs),
               "sigkill: every mid-batch future resolved")
@@ -610,6 +625,8 @@ def main():
         check(post_ok, "sigkill: respawned worker serves again")
         check(recovered_s < args.failover_bound,
               f"sigkill: recovery bounded ({recovered_s:.2f}s)")
+        check(pm_ok, "sigkill: postmortem identifies the killed batch"
+              + ("" if pm_ok else f" ({'; '.join(pm_problems)})"))
         return rec
 
     # --------------------------------------- 7: fastpath mid-skip-run

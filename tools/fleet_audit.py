@@ -1,0 +1,416 @@
+#!/usr/bin/env python
+"""Fleet observability audit → committed ``FLEET_OBS.json``.
+
+Proves the §7g fleet observability plane (worker-process telemetry over
+the shm wire, merged ``/metrics``+``/fleet``, cross-process trace
+stitching, crash flight recorder) against five gates on a LIVE
+2-worker ``ProcessRouter``:
+
+1. **Overhead** — interleaved obs-ON/obs-OFF A/B, paired per-round
+   overhead, median < ``OVERHEAD_GATE_PCT``%.  The OFF arm installs the
+   null sink, null tracer and ``telemetry=False`` workers EXPLICITLY
+   (the documented A/A hazard: an arm that merely *forgot* to configure
+   telemetry measures nothing).
+2. **Conservation** — at ON-arm quiescence, router-view submitted vs
+   Σ worker-view served + in-flight ≥ ``MIN_COVERAGE`` (1.0 on a clean
+   run; the margin tolerates crash-lost counts when chaos is in play).
+3. **Compiles** — per-arm compile-delta accounting: parent CompileWatch
+   delta + every worker's own in-process compile counters (telemetry
+   block on the ON arm, heartbeat float on the OFF arm) must show 0
+   post-warmup recompiles.
+4. **Scrape** — one live ``MetricsServer`` over the merged registry:
+   ``/metrics`` must expose per-worker hop / occupancy / compile /
+   memory families under ``worker=`` labels, ``/fleet`` the per-worker
+   document + conservation block, ``/healthz`` the fleet extra,
+   ``/slo`` the tracker state.
+5. **Chaos** — one SIGKILL round mid-batch: the exhumed flight
+   recorder's ``worker_postmortem`` must pass the structural verifier
+   (``obs.fleet.verify_postmortem``) — it names the killed batch's
+   slot/seq and last completed hop, not merely "a worker died".
+
+Plus the trace-stitch proof: the ON arm's parent export + per-worker
+``.pN`` shards stitch (``tools/trace_report.py`` machinery) into one
+timeline whose ``cat="proc"`` flow arcs thread router submit → worker
+serve → router deliver.
+
+    python tools/fleet_audit.py --rounds 6 --out FLEET_OBS.json
+    python tools/fleet_audit.py --quick        # CI-budget variant
+"""
+import argparse
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: paired-median throughput overhead the ON arm may cost, percent
+OVERHEAD_GATE_PCT = 2.0
+#: minimum (served + in-flight) / submitted coverage at quiescence
+MIN_COVERAGE = 0.95
+
+SPEC = "improved_body_parts_tpu.serve.worker:constant_predictor"
+#: per-request simulated device time — large enough that the plane's
+#: per-request cost (~tens of µs) lands well under the gate, small
+#: enough that a round stays sub-second
+DELAY_S = 0.003
+
+#: /metrics families that must appear with a worker= label on the ON
+#: arm: hop latency, occupancy, compiles, device memory (the ISSUE's
+#: acceptance list)
+REQUIRED_FAMILIES = (
+    "fleet_worker_hop_latency_seconds",
+    "fleet_worker_batch_occupancy_mean",
+    "fleet_worker_xla_compiles_total",
+    "fleet_worker_device_bytes_in_use",
+    "fleet_worker_served_total",
+    "fleet_worker_up",
+)
+
+
+def _mk_router(ProcessRouter, *, telemetry, trace_path=None, slo=None,
+               delay_s=DELAY_S, workers=2, slots=8):
+    return ProcessRouter(
+        SPEC, num_workers=workers,
+        spec_kwargs={"num_parts": 18, "n_people": 2, "delay_s": delay_s},
+        slots=slots, max_image_hw=(64, 64), num_parts=18, max_people=8,
+        restart_after_s=0.3, probe_interval_s=0.05,
+        telemetry=telemetry, trace_path=trace_path, slo=slo)
+
+
+def run_slice(router, images, n_clients, requests):
+    """Closed-loop slice: n_clients threads, each ``requests``
+    submit→result round-trips; returns imgs/sec."""
+    from improved_body_parts_tpu.serve import submit_with_retry
+
+    errs = []
+
+    def work(cid):
+        for i in range(requests):
+            img = images[(cid + i) % len(images)]
+            try:
+                fut, _ = submit_with_retry(router.submit, img,
+                                           base_s=0.002, max_s=0.05)
+                fut.result(timeout=60)
+            except Exception as e:  # noqa: BLE001 — surfaced in report
+                errs.append(repr(e))
+                return
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=work, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errs:
+        raise SystemExit(f"audit slice failed: {errs[0]}")
+    return round(n_clients * requests / wall, 3)
+
+
+def audit(args):
+    import numpy as np
+
+    from improved_body_parts_tpu.obs.events import (
+        EventSink, NullSink, set_sink)
+    from improved_body_parts_tpu.obs.fleet import verify_postmortem
+    from improved_body_parts_tpu.obs.health import HealthSentinel
+    from improved_body_parts_tpu.obs.http import MetricsServer
+    from improved_body_parts_tpu.obs.recompile import CompileWatch
+    from improved_body_parts_tpu.obs.registry import Registry
+    from improved_body_parts_tpu.obs.slo import (
+        SLOTracker, default_objectives)
+    from improved_body_parts_tpu.obs.trace import (
+        NullTraceRecorder, TraceRecorder, set_tracer)
+    from improved_body_parts_tpu.serve.router import ProcessRouter
+    from trace_report import discover_shards, stitch_shards, summarize
+
+    workdir = tempfile.mkdtemp(prefix="fleet_audit_")
+    trace_path = os.path.join(workdir, "trace.json")
+    rng = np.random.default_rng(0)
+    images = [rng.integers(0, 255, (48, 48, 3), dtype=np.uint8)
+              for _ in range(8)]
+
+    # ---------------------------------------------------------- ON arm
+    # real sink + tracer + telemetry=True workers; installed while the
+    # ON router spawns so the run_id rides into the worker shards
+    sink = EventSink(os.path.join(workdir, "events.jsonl"),
+                     run_meta={"run_id": "fleet-audit"})
+    tracer = TraceRecorder(capacity=65536, t0=sink.t0)
+    null_tracer = NullTraceRecorder()
+    null_sink = NullSink()
+    set_sink(sink)
+    set_tracer(tracer)
+    registry = Registry()
+    watch = CompileWatch(registry=registry, sink=null_sink).install()
+    slo = SLOTracker(default_objectives(),
+                     default_class="interactive")
+    on_router = _mk_router(ProcessRouter, telemetry=True,
+                           trace_path=trace_path, slo=slo)
+    on_router.register_into(registry)
+    on_router.start()
+    on_router.warmup([(64, 64)])
+
+    # --------------------------------------------------------- OFF arm
+    # the A/A hazard rule: disable EXPLICITLY — null sink + null tracer
+    # + telemetry=False (workers install NullSink/NullTraceRecorder and
+    # never publish; only the 4-float heartbeat moves).  The SLO
+    # tracker feeds on BOTH arms: it is the PR 15 layer, not the fleet
+    # plane under test, so its per-request cost must cancel in the pair
+    set_sink(null_sink)
+    set_tracer(null_tracer)
+    off_router = _mk_router(ProcessRouter, telemetry=False, slo=slo)
+    off_router.start()
+    off_router.warmup([(64, 64)])
+    watch.mark_warm("fleet audit warmup")
+    c_warm = int(watch.compiles.value)
+
+    # one unmeasured slice per arm: first-touch costs (track
+    # registration, ring growth, page faults on the telem block) are
+    # startup, not per-request overhead
+    set_sink(sink)
+    set_tracer(tracer)
+    run_slice(on_router, images, args.clients, args.requests)
+    set_sink(null_sink)
+    set_tracer(null_tracer)
+    run_slice(off_router, images, args.clients, args.requests)
+
+    report = {
+        "generated_by": "tools/fleet_audit.py",
+        "protocol": {
+            "workers": 2, "clients": args.clients,
+            "requests_per_client": args.requests,
+            "rounds": args.rounds, "predictor_delay_s": DELAY_S,
+            "interleaved": True,
+            "off_arm": "explicit NullSink + NullTraceRecorder + "
+                       "telemetry=False (never 'unconfigured')",
+        },
+    }
+
+    # ------------------------------------------- 1: interleaved A/B
+    on_ips, off_ips = [], []
+    arm_compile_delta = {"on": 0, "off": 0}
+    for rnd in range(args.rounds):
+        set_sink(sink)
+        set_tracer(tracer)
+        c0 = int(watch.compiles.value)
+        on_ips.append(run_slice(on_router, images, args.clients,
+                                args.requests))
+        arm_compile_delta["on"] += int(watch.compiles.value) - c0
+        set_sink(null_sink)
+        set_tracer(null_tracer)
+        c0 = int(watch.compiles.value)
+        off_ips.append(run_slice(off_router, images, args.clients,
+                                 args.requests))
+        arm_compile_delta["off"] += int(watch.compiles.value) - c0
+        print(f"round {rnd}: on {on_ips[-1]} vs off {off_ips[-1]} "
+              "imgs/s", flush=True)
+    per_round = [round((off - on) / off * 100.0, 3)
+                 for on, off in zip(on_ips, off_ips)]
+    median_overhead = round(statistics.median(per_round), 3)
+    report["overhead"] = {
+        "on_imgs_per_sec": on_ips, "off_imgs_per_sec": off_ips,
+        "per_round_overhead_pct": per_round,
+        "paired_median_overhead_pct": median_overhead,
+        "gate_pct": OVERHEAD_GATE_PCT,
+        "ok": bool(median_overhead < OVERHEAD_GATE_PCT),
+    }
+
+    # restore the ON plane for the remaining gates
+    set_sink(sink)
+    set_tracer(tracer)
+
+    # ------------------------------------------- 2: conservation
+    cons = on_router.fleet.conservation()
+    report["conservation"] = {
+        **cons, "gate": MIN_COVERAGE,
+        "ok": bool(cons["frac"] is not None
+                   and cons["frac"] >= MIN_COVERAGE),
+    }
+
+    # ------------------------------------------- 3: compile deltas
+    telem_rows = [w["telemetry"]
+                  for w in on_router.fleet_state()["workers"]]
+    worker_recompiles = {
+        "on": sum(int(t.get("recompiles_post_warmup", 0))
+                  for t in telem_rows),
+        "off": sum(int(w["recompiles_post_warmup"])
+                   for w in off_router.worker_stats()),
+    }
+    report["compiles"] = {
+        "parent_warmup_compiles": c_warm,
+        "parent_per_arm_delta": arm_compile_delta,
+        "worker_recompiles_post_warmup": worker_recompiles,
+        "ok": bool(arm_compile_delta["on"] == 0
+                   and arm_compile_delta["off"] == 0
+                   and worker_recompiles["on"] == 0
+                   and worker_recompiles["off"] == 0),
+    }
+
+    # ------------------------------------------- 4: live scrape
+    import json as _json
+    import urllib.request
+
+    sentinel = HealthSentinel(registry=registry, sink=null_sink)
+    sentinel.set_extra("fleet", on_router.health_extra)
+    with MetricsServer(registry, health=sentinel.state,
+                       slo=slo.state,
+                       fleet=on_router.fleet_state) as srv:
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=10) as r:
+            prom = r.read().decode()
+        with urllib.request.urlopen(srv.url + "/fleet", timeout=10) as r:
+            fleet_doc = _json.loads(r.read().decode())
+        with urllib.request.urlopen(srv.url + "/healthz",
+                                    timeout=10) as r:
+            healthz = _json.loads(r.read().decode())
+            healthz_code = r.status
+        with urllib.request.urlopen(srv.url + "/slo", timeout=10) as r:
+            slo_code = r.status
+    missing = [f for f in REQUIRED_FAMILIES
+               if f'{f}{{' not in prom.replace(" ", "")
+               or 'worker="0"' not in prom or 'worker="1"' not in prom]
+    report["scrape"] = {
+        "families_required": list(REQUIRED_FAMILIES),
+        "families_missing": missing,
+        "fleet_route_workers": len(fleet_doc.get("workers", [])),
+        "fleet_route_conservation":
+            fleet_doc.get("conservation", {}).get("frac"),
+        "healthz_status": healthz.get("status"),
+        "healthz_fleet_workers": len(
+            (healthz.get("fleet") or {}).get("workers", [])),
+        "healthz_code": healthz_code,
+        "slo_code": slo_code,
+        "ok": bool(not missing
+                   and len(fleet_doc.get("workers", [])) == 2
+                   and healthz_code == 200
+                   and len((healthz.get("fleet") or {})
+                           .get("workers", [])) == 2),
+    }
+
+    # stop the A/B fleet (poison pill flushes the worker trace shards)
+    on_router.stop()
+    off_router.stop()
+    tracer.save(trace_path)
+
+    # ------------------------------------------- trace stitch
+    import json as _json2
+
+    with open(trace_path) as f:
+        parent = _json2.load(f)
+    shards = discover_shards(trace_path)
+    shard_events, shard_infos = stitch_shards(
+        parent.get("otherData", {}), shards)
+    stitched = parent["traceEvents"] + shard_events
+    summary = summarize([e for e in stitched
+                         if isinstance(e, dict)],
+                        parent.get("otherData", {}))
+    pf = summary.get("proc_flows") or {}
+    report["trace_stitch"] = {
+        "shards": shard_infos,
+        "proc_flows": pf,
+        "ok": bool(len(shard_infos) == 2
+                   and pf.get("starts", 0) > 0
+                   and pf.get("steps", 0) > 0
+                   and pf.get("finishes", 0) > 0),
+    }
+
+    # ------------------------------------------- 5: chaos postmortem
+    import signal
+
+    chaos_router = _mk_router(ProcessRouter, telemetry=True,
+                              delay_s=0.2, slots=16)
+    with chaos_router:
+        img = images[0]
+        chaos_router.submit(img).result(timeout=60)
+        pid0 = chaos_router.workers[0].worker_stats()["pid"]
+        futs = [chaos_router.submit(img) for _ in range(6)]
+        time.sleep(0.05)                       # land the kill MID-batch
+        os.kill(pid0, signal.SIGKILL)
+        resolved = {"ok": 0, "error": 0}
+        for f in futs:
+            try:
+                f.result(timeout=60)
+                resolved["ok"] += 1
+            except Exception:  # noqa: BLE001 — typed = resolved
+                resolved["error"] += 1
+        deadline = time.perf_counter() + 10
+        while (chaos_router.workers[0].last_postmortem is None
+               and time.perf_counter() < deadline):
+            time.sleep(0.02)
+        pm = chaos_router.workers[0].last_postmortem
+    pm_ok, pm_problems = (verify_postmortem(pm) if pm is not None
+                          else (False, ["no postmortem exhumed"]))
+    report["chaos"] = {
+        "injection": "SIGKILL worker 0 mid-batch",
+        "killed_pid": pid0,
+        "futures_resolved": resolved,
+        "postmortem_ok": pm_ok,
+        "postmortem_problems": pm_problems,
+        "postmortem": pm,
+        "ok": bool(pm_ok
+                   and sum(resolved.values()) == len(futs)),
+    }
+
+    set_sink(null_sink)
+    set_tracer(null_tracer)
+    sink.close()
+    if not args.keep_workdir:
+        shutil.rmtree(workdir, ignore_errors=True)
+    else:
+        report["workdir"] = workdir
+
+    report["ok"] = bool(all(report[k]["ok"] for k in
+                            ("overhead", "conservation", "compiles",
+                             "scrape", "trace_stitch", "chaos")))
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rounds", type=int, default=6,
+                    help="interleaved A/B round pairs")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=50,
+                    help="closed-loop requests per client per round")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI budget: 3 rounds x 20 requests")
+    ap.add_argument("--keep-workdir", action="store_true",
+                    help="keep the trace/sink workdir for inspection")
+    ap.add_argument("--out", default="FLEET_OBS.json")
+    args = ap.parse_args()
+    if args.quick:
+        args.rounds, args.requests = 3, 20
+
+    report = audit(args)
+
+    from improved_body_parts_tpu.obs.events import strict_dump
+
+    with open(args.out, "w") as f:
+        strict_dump(report, f, indent=2, sort_keys=True)
+    ov = report["overhead"]
+    print(f"overhead: median {ov['paired_median_overhead_pct']}% "
+          f"(gate < {ov['gate_pct']}%) "
+          f"{'OK' if ov['ok'] else 'FAIL'}")
+    print(f"conservation: frac {report['conservation']['frac']} "
+          f"(gate >= {report['conservation']['gate']}) "
+          f"{'OK' if report['conservation']['ok'] else 'FAIL'}")
+    print(f"compiles: {report['compiles']['parent_per_arm_delta']} "
+          f"{'OK' if report['compiles']['ok'] else 'FAIL'}")
+    print(f"scrape: missing={report['scrape']['families_missing']} "
+          f"{'OK' if report['scrape']['ok'] else 'FAIL'}")
+    print(f"trace stitch: {report['trace_stitch']['proc_flows']} "
+          f"{'OK' if report['trace_stitch']['ok'] else 'FAIL'}")
+    print(f"chaos: postmortem_ok={report['chaos']['postmortem_ok']} "
+          f"{'OK' if report['chaos']['ok'] else 'FAIL'}")
+    print(f"wrote {args.out}  overall: "
+          f"{'OK' if report['ok'] else 'FAIL'}")
+    if not report["ok"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

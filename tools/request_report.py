@@ -33,6 +33,7 @@ The tool answers the two questions the raw records exist for:
     python tools/request_report.py LATENCY_AUDIT.json --strict
 """
 import argparse
+import glob
 import json
 import os
 import sys
@@ -50,12 +51,47 @@ from improved_body_parts_tpu.obs.events import (  # noqa: E402
 MIN_COVERAGE = 0.95
 
 
-def load_records(path):
+def discover_shards(path):
+    """Per-worker sink shards ``<path>.pN`` next to a primary stream
+    (worker processes write their own shard so streams never
+    interleave).  Globbed rather than probed consecutively from
+    ``.p1`` — a crashed worker can leave a numbering hole that must
+    not hide the surviving workers' shards."""
+    shards = []
+    for p in glob.glob(glob.escape(path) + ".p*"):
+        suffix = p[len(path) + 2:]
+        if suffix.isdigit():
+            shards.append((int(suffix), p))
+    return [p for _, p in sorted(shards)]
+
+
+def load_records(path, shards=True):
     """``request`` records from a JSONL event stream or a JSON file
-    with a top-level ``records`` list."""
+    with a top-level ``records`` list.
+
+    For JSONL streams, per-worker sink shards (``<path>.pN``) are
+    auto-discovered and their request records concatenated — unlike
+    timing summaries, a request record carries its whole causal tree
+    and durations in ms, so merging across processes is sound.  A shard
+    whose ``run_start`` carries a ``run_id`` other than the primary
+    stream's is a stale leftover from an earlier run: skipped loudly."""
     if path.endswith(".jsonl"):
-        return [e for e in read_events(path)
-                if e.get("event") == "request"]
+        events = read_events(path)
+        run_id = next((e.get("run_id") for e in reversed(events)
+                       if e.get("event") == "run_start"), None)
+        records = [e for e in events if e.get("event") == "request"]
+        for sp in (discover_shards(path) if shards else []):
+            sev = read_events(sp)
+            srid = next((e.get("run_id") for e in reversed(sev)
+                         if e.get("event") == "run_start"), None)
+            if srid != run_id:
+                print(f"{sp}: shard run_id {srid!r} does not match the "
+                      f"primary stream's {run_id!r}; skipping stale "
+                      "shard", file=sys.stderr)
+                continue
+            records.extend(e for e in sev
+                           if e.get("event") == "request")
+        return records
     with open(path) as f:
         data = json.load(f)
     if isinstance(data, dict):
@@ -229,9 +265,12 @@ def main():
                          "slowest trees to this path")
     ap.add_argument("--strict", action="store_true",
                     help="exit nonzero on any completeness violation")
+    ap.add_argument("--no-shards", action="store_true",
+                    help="skip auto-discovery of <events>.pN worker "
+                         "sink shards")
     args = ap.parse_args()
 
-    records = load_records(args.events)
+    records = load_records(args.events, shards=not args.no_shards)
     if not records:
         raise SystemExit(f"{args.events}: 0 request records — nothing "
                          "to report (was reqtrace enabled?)")

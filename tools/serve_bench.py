@@ -475,7 +475,23 @@ def bench_proc_ab(args, telemetry, rounds):
         # waterfalls (matching the registry's {replica=,hop=} labels)
         # and merge only the conservation frac, which sums exactly
         wsnaps = [w.metrics.snapshot() for w in router.workers]
+        # worker-VIEW readout over the shm telemetry block (the fleet
+        # plane): per-worker hop quantiles measured in the process that
+        # paid them, plus the cross-boundary conservation ledger —
+        # router-view submitted vs Σ worker-view served + in-flight
+        fleet = router.fleet_state()
     out["per_arm_recompiles_post_warmup"] = arm_recompiles
+    out["process_worker_hop_quantiles_ms"] = [
+        {"worker": w["worker"],
+         "published": w["telemetry"].get("published", False),
+         "hops_ms": {
+             hop: {"count": int(h["count"]),
+                   "p50": round(h["p50_s"] * 1e3, 3),
+                   "p95": round(h["p95_s"] * 1e3, 3),
+                   "p99": round(h["p99_s"] * 1e3, 3)}
+             for hop, h in (w["telemetry"].get("hops") or {}).items()}}
+        for w in fleet["workers"]]
+    out["cross_boundary_conservation"] = fleet["conservation"]
     # the thread arm has no hop decomposition (no wire stamps), so
     # only the process arm gets the waterfall + conservation readout
     out["process_hops_ms_per_worker"] = [s["hops_ms"] for s in wsnaps]

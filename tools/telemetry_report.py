@@ -14,19 +14,28 @@ and reports:
 - the recompile timeline: every post-warmup XLA compile
   (``obs.CompileWatch``), each one a silent multi-second pipeline stall;
 - epoch losses, ``timed`` span records, and serve snapshots when
-  present.
+  present;
+- per-worker sink shards (``<events>.pN``, written by serve worker
+  processes) auto-discovered next to the primary stream and summarized
+  SEPARATELY under ``worker_shards`` — a shard whose ``run_start``
+  carries a different ``run_id`` than the primary stream is a stale
+  leftover from an earlier run and is skipped loudly.
 
     python tools/telemetry_report.py checkpoints/events.jsonl
     python tools/telemetry_report.py events.jsonl --json report.json
 """
 import argparse
+import glob
 import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from improved_body_parts_tpu.obs.events import strict_dump  # noqa: E402
+from improved_body_parts_tpu.obs.events import (  # noqa: E402
+    read_events,
+    strict_dump,
+)
 
 # above this fraction of attributed wall time spent waiting on data the
 # run is input-bound; below half of it, compute-bound; between, mixed.
@@ -45,6 +54,49 @@ def _pct(xs, q):
     for v in xs:
         m.update(v)
     return m.percentile(q)
+
+
+def discover_shards(path):
+    """Per-worker sink shards ``<path>.pN`` next to a primary stream
+    (worker processes write their own shard so streams never
+    interleave).  Globbed rather than probed consecutively from
+    ``.p1`` — a crashed worker can leave a numbering hole that must
+    not hide the surviving workers' shards."""
+    shards = []
+    for p in glob.glob(glob.escape(path) + ".p*"):
+        suffix = p[len(path) + 2:]
+        if suffix.isdigit():
+            shards.append((int(suffix), p))
+    return [p for _, p in sorted(shards)]
+
+
+def summarize_shard(path, primary_run_id):
+    """Small per-shard summary.  Shards are summarized SEPARATELY,
+    never concatenated into the primary stream: a worker's monotonic
+    ``t`` axis starts at ITS sink open, not the parent's, so merged
+    timings would be nonsense.  Returns ``None`` — after a loud stderr
+    note — when the shard's ``run_start`` carries a ``run_id`` other
+    than the primary stream's: a stale shard from an earlier run
+    sitting next to a fresh primary must not be reported as this run."""
+    events = read_events(path)
+    header = next((e for e in reversed(events)
+                   if e.get("event") == "run_start"), {})
+    if header.get("run_id") != primary_run_id:
+        print(f"{path}: shard run_id {header.get('run_id')!r} does not "
+              f"match the primary stream's {primary_run_id!r}; skipping "
+              "stale shard", file=sys.stderr)
+        return None
+    stop = next((e for e in reversed(events)
+                 if e.get("event") == "worker_stop"), None)
+    return {
+        "path": os.path.basename(path),
+        "worker": header.get("worker"),
+        "pid": header.get("pid"),
+        "role": header.get("role"),
+        "events": len(events),
+        "served": (stop or {}).get("served"),
+        "clean_stop": stop is not None,
+    }
 
 
 def summarize(events):
@@ -248,6 +300,16 @@ def render(summary):
                      f"{last.get('train_loss')}"
                      + (f" val_loss {last['val_loss']}"
                         if "val_loss" in last else ""))
+    if s.get("worker_shards"):
+        lines.append(f"worker sink shards: {len(s['worker_shards'])}")
+        for g in s["worker_shards"]:
+            served = g.get("served")
+            lines.append(
+                f"  worker {g.get('worker')} (pid {g.get('pid')}): "
+                f"{g['events']} events, served "
+                f"{served if served is not None else '?'}, "
+                + ("clean stop" if g["clean_stop"]
+                   else "no worker_stop (crashed?)"))
     return "\n".join(lines)
 
 
@@ -257,14 +319,20 @@ def main():
                                    "(obs.EventSink output)")
     ap.add_argument("--json", default=None,
                     help="also write the machine-readable summary here")
+    ap.add_argument("--no-shards", action="store_true",
+                    help="skip auto-discovery of <events>.pN worker "
+                         "sink shards")
     args = ap.parse_args()
-
-    from improved_body_parts_tpu.obs import read_events
 
     events = read_events(args.events)
     if not events:
         raise SystemExit(f"no events parsed from {args.events}")
     summary = summarize(events)
+    shard_paths = [] if args.no_shards else discover_shards(args.events)
+    if shard_paths:
+        shards = [summarize_shard(p, summary.get("run_id"))
+                  for p in shard_paths]
+        summary["worker_shards"] = [s for s in shards if s is not None]
     print(render(summary))
     if args.json:
         with open(args.json, "w") as f:

@@ -10,6 +10,17 @@ tool:
   pid/tid; complete spans need a non-negative ``dur``) and refuses a
   file with no valid events — a truncated or hand-damaged trace should
   fail loudly here, not render as an empty mystery in the UI;
+- auto-discovers per-worker trace shards next to the input
+  (``<trace>.pN`` — what ``ProcessRouter(trace_path=...)`` hands each
+  worker process) and STITCHES them into the parent timeline: every
+  shard's events are rebased onto the parent's clock axis via the
+  ``t0_mono`` anchor both exports carry (CLOCK_MONOTONIC is
+  system-wide on Linux, so the shift is exact, not estimated), the
+  shard's process is renamed ``serve worker N``, and the router's
+  ``cat="proc"`` flow ids line up with the workers' — one request
+  renders as ONE arc: router submit → worker serve → router deliver.
+  A shard with no ``t0_mono`` anchor cannot be placed and is skipped
+  loudly;
 - writes a normalized ``<input>.perfetto.json`` (events sorted
   parent-before-child) that loads directly at https://ui.perfetto.dev
   or ``chrome://tracing``;
@@ -27,6 +38,7 @@ tool:
     python tools/trace_report.py trace.json --json summary.json
 """
 import argparse
+import glob
 import json
 import os
 import statistics
@@ -73,6 +85,69 @@ def _load_events(path):
         raise SystemExit(f"{path}: 0 structurally valid trace events "
                          f"({invalid} invalid) — refusing to report")
     return valid, invalid, other
+
+
+def discover_shards(path):
+    """Per-worker trace shards next to ``path``: ``<path>.p1``,
+    ``<path>.p2``, ... (the ``ProcessRouter`` → worker ``trace_path``
+    naming).  Globbed, not probed consecutively: a SIGKILLed worker
+    never flushes its shard, and that hole must not hide the survivors'
+    shards.  Returns existing paths sorted by worker number."""
+    out = []
+    for p in glob.glob(glob.escape(path) + ".p*"):
+        suffix = p[len(path) + 2:]
+        if suffix.isdigit():
+            out.append((int(suffix), p))
+    return [p for _, p in sorted(out)]
+
+
+def stitch_shards(parent_other, shard_paths):
+    """Load worker trace shards and rebase them onto the parent's
+    timeline axis.
+
+    Both exports carry ``otherData.t0_mono`` — the absolute
+    CLOCK_MONOTONIC anchor their relative timestamps count from.  The
+    clock is system-wide, so ``(t0_shard - t0_parent)`` microseconds is
+    the EXACT shift that places a worker span among the router's spans
+    (no correlation or estimation step).  Returns ``(events, infos)``:
+    the rebased shard events (metadata process names rewritten to
+    ``serve worker N``) and one info dict per shard for the summary.
+    """
+    t0_parent = parent_other.get("t0_mono")
+    events, infos = [], []
+    for path in shard_paths:
+        # worker number from the .pN suffix (worker_idx + 1)
+        n = int(path.rsplit(".p", 1)[1])
+        try:
+            sh_events, sh_invalid, sh_other = _load_events(path)
+        except SystemExit as e:
+            print(f"warning: skipping trace shard {path}: {e}",
+                  file=sys.stderr)
+            continue
+        t0_shard = sh_other.get("t0_mono")
+        if t0_parent is None or t0_shard is None:
+            print(f"warning: skipping trace shard {path}: no t0_mono "
+                  "anchor on "
+                  + ("both exports" if t0_parent is None else "the shard")
+                  + " — cannot place it on the parent's axis",
+                  file=sys.stderr)
+            continue
+        shift_us = (float(t0_shard) - float(t0_parent)) * 1e6
+        for ev in sh_events:
+            ev = dict(ev)
+            if ev["ph"] == "M":
+                if ev["name"] == "process_name":
+                    ev["args"] = {"name": f"serve worker {n - 1}"}
+            else:
+                ev["ts"] = round(ev["ts"] + shift_us, 3)
+            events.append(ev)
+        infos.append({"path": path, "worker": n - 1,
+                      "events": len(sh_events),
+                      "invalid": sh_invalid,
+                      "shift_ms": round(shift_us / 1e3, 3),
+                      "dropped_events": int(
+                          sh_other.get("dropped_events", 0))})
+    return events, infos
 
 
 def _verdict(wait_frac):
@@ -122,6 +197,18 @@ def summarize(events, other):
                 opened[e.get("id")] = e["ts"]
             elif e["ph"] == "e" and e.get("id") in opened:
                 lat_ms.append((e["ts"] - opened.pop(e["id"])) / 1e3)
+    # cross-process flow arcs (router submit → worker serve → router
+    # deliver): a stitched fleet timeline shows starts ≈ steps ≈
+    # finishes; steps at 0 with starts present means the worker shards
+    # were NOT stitched (or workers ran with telemetry off)
+    flows = {"s": 0, "t": 0, "f": 0}
+    for e in events:
+        if e.get("cat") == "proc" and e["ph"] in flows:
+            flows[e["ph"]] += 1
+    proc_flows = ({"starts": flows["s"], "steps": flows["t"],
+                   "finishes": flows["f"]}
+                  if any(flows.values()) else None)
+
     batches = [e.get("args", {}).get("batch") for e in spans
                if e["name"] == "execute"]
     batches = [b for b in batches if b]
@@ -157,6 +244,7 @@ def summarize(events, other):
         },
         "verdict": verdict,
         "serve": serve,
+        "proc_flows": proc_flows,
     }
 
 
@@ -189,6 +277,17 @@ def render_text(summary):
             + (f", latency mean {sv['latency_ms']['mean']:.1f} ms "
                f"max {sv['latency_ms']['max']:.1f} ms"
                if sv["latency_ms"] else ""))
+    if summary.get("shards"):
+        lines.append(
+            "stitched worker shards: "
+            + ", ".join(f"worker {s['worker']} ({s['events']} ev, "
+                        f"shift {s['shift_ms']:+.1f} ms)"
+                        for s in summary["shards"]))
+    if summary.get("proc_flows"):
+        pf = summary["proc_flows"]
+        lines.append(
+            f"cross-process flow arcs: {pf['starts']} submits → "
+            f"{pf['steps']} worker serves → {pf['finishes']} delivers")
     lines.append("critical path (total span time, desc):")
     for name, st in list(summary["by_name"].items())[:10]:
         lines.append(f"  {name:<14} {st['total_ms']:>10.1f} ms  "
@@ -206,12 +305,26 @@ def main():
                          "<trace>.perfetto.json)")
     ap.add_argument("--json", default=None,
                     help="also write the summary dict to this path")
+    ap.add_argument("--no-shards", action="store_true",
+                    help="do not auto-discover/stitch <trace>.pN worker "
+                         "shards")
     args = ap.parse_args()
 
     events, invalid, other = _load_events(args.trace)
     if invalid:
         print(f"warning: dropped {invalid} structurally invalid events",
               file=sys.stderr)
+    shard_infos = []
+    if not args.no_shards:
+        shard_events, shard_infos = stitch_shards(
+            other, discover_shards(args.trace))
+        events = events + shard_events
+        if shard_infos:
+            # the lossy flag must see the WHOLE stitched timeline
+            other = dict(other)
+            other["dropped_events"] = (
+                int(other.get("dropped_events", 0))
+                + sum(s["dropped_events"] for s in shard_infos))
     # parent-before-child: ts ascending, longer span first on ties
     body = sorted((e for e in events if e["ph"] != "M"),
                   key=lambda e: (e["ts"], -e.get("dur", 0.0)))
@@ -226,6 +339,7 @@ def main():
                      "otherData": other}, f)
 
     summary = summarize(events, other)
+    summary["shards"] = shard_infos
     summary["perfetto"] = out_path
     if args.json:
         with open(args.json, "w") as f:
