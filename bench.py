@@ -695,6 +695,50 @@ def _fleetobs_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _history_summary(fallback, budget_s):
+    """Run tools/history_audit.py --quick (the telemetry-history layer:
+    sampler-on/off A/B over a 2-worker ProcessRouter, exact counter
+    conservation across registry/history/router, gap accounting,
+    /history + /query routes, capacity fit, replay bit-identity) and
+    return a compact summary, or an {"error"/"skipped"} marker — the
+    "chaos" key contract.  Subprocess so a worker-process failure can
+    never take down the primary metric; bounded by the REMAINING driver
+    budget.  ``IBP_BENCH_HISTORY=0`` skips it unconditionally."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_HISTORY") == "0":
+        return {"skipped": "IBP_BENCH_HISTORY=0"}
+    if budget_s < 240:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (HISTORY_AUDIT.json has the full "
+                           "audit)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(tempfile.mkdtemp(prefix="history_audit_"),
+                       "HISTORY_AUDIT.json")
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "history_audit.py"),
+             "--quick", "--out", out],
+            capture_output=True, timeout=min(900, budget_s), check=True,
+            env=dict(os.environ))
+        with open(out) as f:
+            r = json.load(f)
+        return {
+            "ok": r["ok"],
+            "overhead_median_pct":
+                r["overhead"]["paired_median_overhead_pct"],
+            "conservation_ok": r["conservation"]["ok"],
+            "gaps_ok": r["gaps"]["ok"],
+            "routes_ok": r["routes"]["ok"],
+            "capacity_knee_qps": r["capacity"]["fit"]["knee_qps"],
+            "replay_bit_identical": r["replay"]["replay_bit_identical"],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def _audit_summary(budget_s):
     """Run tools/program_audit.py (the graftaudit compiled-program tier:
     jaxpr checks + fingerprint gating over the program registry, at
@@ -1021,6 +1065,11 @@ def main():
     # stitch, postmortem), same discipline
     fleetobs = _fleetobs_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # telemetry-history layer (sampler-on/off A/B, exact conservation,
+    # gap accounting, routes, capacity fit, replay bit-identity), same
+    # discipline
+    history = _history_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     # GSPMD weak-scaling smoke (partitioned step, virtual meshes), same
     # discipline
     scaling = _scaling_summary(
@@ -1059,6 +1108,7 @@ def main():
         "servechaos": servechaos,
         "procpool": procpool,
         "fleetobs": fleetobs,
+        "history": history,
         "scaling": scaling,
         "cascade": cascade,
         "slo": slo,

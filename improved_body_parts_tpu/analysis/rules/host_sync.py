@@ -63,7 +63,13 @@ class HiddenHostSync(Rule):
              # worker-side telemetry publishes into the shm block and
              # records flight-ring milestones ON the serve loop between
              # batches — same hot-path discipline
-             "improved_body_parts_tpu/obs/fleet.py")
+             "improved_body_parts_tpu/obs/fleet.py",
+             # the ISSUE 19 history sampler scrapes every registry
+             # collector at a fixed cadence while serving is live — a
+             # hidden host sync inside its tick would stall the same
+             # GIL the dispatch threads run on, so it keeps the serve
+             # tree's discipline
+             "improved_body_parts_tpu/obs/history.py")
 
     def check(self, ctx: ModuleContext) -> None:
         if not ctx.under(*self.SCOPE):

@@ -33,6 +33,11 @@ package replaces that with one process-wide pipeline every layer shares:
   parent-side merge registry (``FleetRegistry``; served at ``/fleet``)
   and the crash flight recorder (``build_postmortem``,
   ``verify_postmortem``);
+- :mod:`history`  — bounded multi-resolution telemetry history with
+  explicit gap accounting, strict-JSON shard persistence, offline
+  replay and the derived control-plane signal feed (``HistoryStore``;
+  served at ``/history`` + ``/query``; fitted into replica counts by
+  ``serve.capacity.CapacityModel``);
 - :mod:`run`      — the per-run bundle (``RunTelemetry``).
 
 ``tools/telemetry_report.py`` folds a run's JSONL stream into a
@@ -60,7 +65,14 @@ from .fleet import (
     verify_postmortem,
 )
 from .health import POLICIES, DivergenceError, HealthSentinel
-from .http import MetricsServer
+from .history import (
+    HISTORY_SCHEMA,
+    HistoryStore,
+    discover_history_shards,
+    history_path_for,
+    series_key,
+)
+from .http import ROUTES, MetricsServer
 from .memory import DeviceMemory
 from .recompile import COMPILE_EVENT, CompileWatch
 from .registry import (
@@ -99,4 +111,6 @@ __all__ = [
     "TELEM_VERSION", "FleetRegistry", "WorkerTelemetry",
     "build_postmortem", "decode_telem", "flow_id", "read_block",
     "read_flight_records", "verify_postmortem",
+    "HISTORY_SCHEMA", "HistoryStore", "ROUTES",
+    "discover_history_shards", "history_path_for", "series_key",
 ]

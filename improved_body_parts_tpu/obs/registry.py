@@ -21,6 +21,7 @@ mirroring every mutation into a second object.
 """
 from __future__ import annotations
 
+import functools
 import re
 import threading
 import time
@@ -34,8 +35,11 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 Sample = Tuple[str, Dict[str, str], str, float]
 
 
+@functools.lru_cache(maxsize=4096)
 def _sanitize(name: str) -> str:
-    """Prometheus metric-name charset; everything else becomes ``_``."""
+    """Prometheus metric-name charset; everything else becomes ``_``.
+    Cached: metric names are a small fixed set, and scrape-time callers
+    (``_flat``, the history sampler) hit this once per sample per tick."""
     name = _NAME_RE.sub("_", name)
     return name if not name[:1].isdigit() else "_" + name
 
@@ -253,6 +257,15 @@ class Registry:
                            float(value), help)
             except Exception:  # noqa: BLE001 — one dead collector must
                 continue       # not take down the whole exposition
+
+    def iter_samples(self) -> Iterator[Tuple[str, Dict[str, str], str,
+                                             float, str]]:
+        """Public sample walk: ``(name, labels, kind, value, help)`` for
+        every signal the registry would expose — histograms expanded to
+        quantile/_sum/_count samples, collectors folded in.  The shared
+        ingestion surface for consumers that are neither Prometheus nor
+        JSON (``obs.history.HistoryStore`` samples it on a cadence)."""
+        return self._flat()
 
     def prometheus(self) -> str:
         """Prometheus text exposition format 0.0.4."""

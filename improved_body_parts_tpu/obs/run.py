@@ -50,7 +50,8 @@ class RunTelemetry:
                  on_divergence: str = "warn",
                  grad_norm_limit: float = 0.0,
                  reqtrace_sample: Optional[int] = None,
-                 slo=None):
+                 slo=None,
+                 history=None):
         self.registry = registry if registry is not None else get_registry()
         self.sink = (EventSink(sink_path, run_meta=run_meta)
                      if sink_path else NullSink())
@@ -99,6 +100,14 @@ class RunTelemetry:
         self.slo = slo
         if slo is not None:
             slo.register_into(self.registry)
+        # optional telemetry-history store (obs.history): its sampler
+        # runs for the life of the bundle, its meta-signals join the
+        # registry, and the endpoint serves it at /history + /query;
+        # close() stops the sampler and flushes its shards
+        self.history = history
+        if history is not None:
+            history.register_into(self.registry)
+            history.start()
         # device-memory accounting (graceful no-op on statless backends)
         self.memory = DeviceMemory(self.registry, self.sink)
         # run-health sentinel; its state backs the endpoint's /healthz
@@ -113,7 +122,8 @@ class RunTelemetry:
                                      extra=self._server_extra,
                                      health=self.health.state,
                                      slo=(slo.state if slo is not None
-                                          else None))
+                                          else None),
+                                     history=history)
                        if http_port is not None and http_port >= 0 else None)
         self._phases: Dict[str, StepPhases] = {}
         self._closed = False
@@ -144,6 +154,8 @@ class RunTelemetry:
         self._closed = True
         if self.server is not None:
             self.server.close()
+        if self.history is not None:
+            self.history.close()
         self.compile_watch.uninstall()
         if self.trace.enabled and self.trace_path:
             # count via the ring's length — events() would serialize the

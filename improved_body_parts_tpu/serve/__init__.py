@@ -26,12 +26,17 @@ user requests to the chip).
 - ``policy``   — :class:`PolicyClient` + :func:`submit_with_retry`:
   client-side deadlines, jittered retry on ``ServerOverloaded``, hedged
   dispatch for tail latency.
+- ``capacity`` — :class:`CapacityModel`: measured per-replica
+  saturation (QPS vs latency knee, occupancy headroom) fitted from the
+  telemetry history (``obs.history``) into
+  ``replicas_needed(target_qps, objective)``.
 
 Load generator / benchmark: ``tools/serve_bench.py`` → SERVE_BENCH.json.
 Fault-injection harness: ``tools/chaos_serve.py`` → SERVE_CHAOS.json.
 """
 from .batcher import DeadlineExceeded, DynamicBatcher, ServerOverloaded
 from .breaker import CircuitBreaker
+from .capacity import CapacityModel
 from .cascade import CascadeEngine, CascadeMetrics, EscalationPolicy
 from .metrics import ServeMetrics
 from .policy import PolicyClient, PolicyStats, jittered_backoff, submit_with_retry
@@ -39,7 +44,8 @@ from .pool import EnginePool
 from .router import ProcessRouter, ProcessWorkerEngine
 from .warmup import pow2_batch_sizes, precompile
 
-__all__ = ["CascadeEngine", "CascadeMetrics", "CircuitBreaker",
+__all__ = ["CapacityModel",
+           "CascadeEngine", "CascadeMetrics", "CircuitBreaker",
            "DeadlineExceeded", "DynamicBatcher", "EnginePool",
            "EscalationPolicy", "PolicyClient", "PolicyStats",
            "ProcessRouter", "ProcessWorkerEngine",

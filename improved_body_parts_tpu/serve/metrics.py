@@ -226,6 +226,7 @@ class ServeMetrics:
                 (str(replica), hop, m.summary(), m.sum)
                 for replica, per in sorted(self._hops_by_replica.items())
                 for hop, m in per.items()]
+            hop_sum_total = sum(m.sum for m in self.hops.values())
         # the per-tier label dimension: one dict merged into EVERY
         # sample's labels, so a shared registry separates student vs
         # teacher traffic without a second registry or prefix fork
@@ -252,6 +253,21 @@ class ServeMetrics:
              float(lat["count"])),
             (f"{prefix}_imgs_per_sec", dict(base), "gauge",
              self.throughput()),
+            # the conservation invariant as a scrapeable gauge (ROADMAP
+            # item 1 names it as an autoscaler input; snapshot() alone
+            # kept it off /metrics and out of the history store).  1.0
+            # is the vacuous reading — before any completion, and at
+            # layers that never receive on_hops (the pool-level rollup:
+            # hop attribution lives on the engines) — because a 0.0
+            # would read as a hard accounting break
+            (f"{prefix}_hop_conservation_frac", dict(base), "gauge",
+             (hop_sum_total / lat_sum
+              if lat_sum > 0 and hop_sum_total > 0 else 1.0)),
+            # mean images per dispatched batch — the occupancy-headroom
+            # input of serve.capacity.CapacityModel
+            (f"{prefix}_batch_occupancy_mean", dict(base), "gauge",
+             (sum(k * v for k, v in occupancy.items())
+              / sum(occupancy.values()) if occupancy else 0.0)),
         ]
         # the per-hop attribution families: {model=,replica=,hop=}
         # labeled quantiles + _sum/_count, one series set per hop per

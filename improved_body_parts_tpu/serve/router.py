@@ -163,6 +163,13 @@ class ProcessWorkerEngine:
         #: worker death is detected with the ring attached)
         self.last_postmortem: Optional[dict] = None
         self._backing_off = False
+        # scrape-path liveness cache: ``worker_info`` is read per
+        # history/metrics tick and ``proc.is_alive()`` is a waitpid
+        # syscall (~ms under load on a loaded host); the reporting view
+        # tolerates sub-second staleness — death DETECTION stays with
+        # the probe loop, which reads ``proc.is_alive()`` directly.
+        # Keyed on the proc object so a respawn invalidates it.
+        self._alive_cache = (None, 0.0, False)
         self._task_tx = None    # parent write end of the task pipe
         self._done_rx = None    # parent read end of the done pipe
         # multiple client threads write the task channel; pipe sends
@@ -516,7 +523,13 @@ class ProcessWorkerEngine:
             proc, hb = self._proc, self._hb
             running = self._running
             in_flight = len(self._pending)
-        alive = proc is not None and proc.is_alive()
+        c_proc, c_t, c_alive = self._alive_cache
+        now = time.perf_counter()
+        if proc is c_proc and now - c_t < 0.5:
+            alive = c_alive
+        else:
+            alive = proc is not None and proc.is_alive()
+            self._alive_cache = (proc, now, alive)
         hb_stamp = float(hb[0]) if hb is not None else 0.0
         hb_age = (max(0.0, time.perf_counter() - hb_stamp)
                   if hb_stamp > 0.0 else None)

@@ -47,16 +47,25 @@ class PercentileMeter:
         self.count = 0
         self.sum = 0.0
         self._samples: list = []
+        # sorted view of the reservoir, rebuilt lazily on read and
+        # dropped only when the reservoir actually mutates: a scrape
+        # reads several quantiles back to back (one sort instead of
+        # three), and once the reservoir is full most updates replace
+        # nothing (probability capacity/count), so the cache stays warm
+        # between scrapes under steady load
+        self._sorted: list = None
 
     def update(self, val: float):
         self.count += 1
         self.sum += val
         if len(self._samples) < self.capacity:
             self._samples.append(val)
+            self._sorted = None
         else:
             j = self._rng.randrange(self.count)
             if j < self.capacity:
                 self._samples[j] = val
+                self._sorted = None
 
     @property
     def avg(self) -> float:
@@ -67,7 +76,9 @@ class PercentileMeter:
         [0, 100]; 0.0 when no samples were recorded."""
         if not self._samples:
             return 0.0
-        s = sorted(self._samples)
+        s = self._sorted
+        if s is None:
+            s = self._sorted = sorted(self._samples)
         pos = (len(s) - 1) * q / 100.0
         lo = int(pos)
         hi = min(lo + 1, len(s) - 1)

@@ -16,6 +16,7 @@ CLIS = [
     "build_native.py", "list_coco.py", "lint.py", "program_audit.py",
     "stream_bench.py", "chaos_serve.py", "cascade_bench.py",
     "request_report.py", "latency_audit.py", "fleet_audit.py",
+    "history_audit.py", "history_report.py",
 ]
 
 

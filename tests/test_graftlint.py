@@ -1130,6 +1130,35 @@ def test_scope_covers_fleet_module():
         lint(leak, path="improved_body_parts_tpu/obs/fleet.py"))
 
 
+def test_scope_covers_history_module():
+    """ISSUE 19 satellite: the telemetry-history sampler (obs/history.py)
+    scrapes every registry collector at a fixed cadence while serving is
+    live — a hidden host sync inside its tick would stall the same GIL
+    the dispatch threads run on, so it lives in the JGL002 scope; JGL005
+    sees its sampler-thread lifecycle (repo-wide).  Locked on the file's
+    actual path so a future move can't silently drop it from the
+    sweep."""
+    hot = """
+        import jax.numpy as jnp
+
+        def fold_loop(samples):
+            for s in samples:
+                v = jnp.sum(s)
+                ingest(float(v))
+    """
+    assert "JGL002" in rules_of(
+        lint(hot, path="improved_body_parts_tpu/obs/history.py"))
+    leak = """
+        import threading
+
+        def start_sampler(store):
+            t = threading.Thread(target=store.sample_now)
+            t.start()
+    """
+    assert "JGL005" in rules_of(
+        lint(leak, path="improved_body_parts_tpu/obs/history.py"))
+
+
 def test_donation_tracks_distill_factory():
     """The distill step factory is in the donating-factories config:
     JGL001 must flag a read of the state after it flowed into a

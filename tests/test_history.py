@@ -1,0 +1,699 @@
+"""ISSUE 19: the telemetry-history layer — bounded multi-resolution
+retention with explicit gap accounting, strict-JSON shard persistence
+with bit-identical offline replay, the derived control-plane signal
+feed, the measured capacity model fitted from it, the ``/history`` +
+``/query`` routes, and the report tool's completeness verifier.
+
+Everything time-driven runs on an injected clock: the fold path is
+purely (t, v)-driven by design (that is what makes replay exact), so
+the tests drive it deterministically instead of sleeping.
+"""
+import json
+import os
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from improved_body_parts_tpu.obs import MetricsServer, Registry
+from improved_body_parts_tpu.obs.events import read_events, strict_dumps
+from improved_body_parts_tpu.obs.history import (
+    HistoryStore,
+    discover_history_shards,
+    history_path_for,
+    series_key,
+)
+from improved_body_parts_tpu.serve.capacity import CapacityModel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _store(reg=None, clock=None, **kw):
+    kw.setdefault("cadence_s", 0.25)
+    return HistoryStore(reg, clock=clock or FakeClock(), **kw)
+
+
+def _tick(store, clock, reg_updates=(), dt=0.25):
+    for fn in reg_updates:
+        fn()
+    clock.advance(dt)
+    return store.sample_now()
+
+
+class TestFoldAndRetention:
+    def test_raw_ring_is_bounded(self):
+        clock = FakeClock()
+        reg = Registry()
+        g = reg.gauge("depth")
+        st = _store(reg, clock, raw_capacity=8)
+        for i in range(20):
+            g.set(float(i))
+            _tick(st, clock)
+        q = st.query("depth")
+        assert len(q["points"]) == 8
+        # newest points survive, oldest fall off
+        assert q["points"][-1][1] == 19.0
+        assert q["points"][0][1] == 12.0
+
+    def test_aggregate_buckets_minmax_sum_count_last(self):
+        clock = FakeClock()
+        reg = Registry()
+        g = reg.gauge("v")
+        st = _store(reg, clock, levels=((2.0, 16),))
+        # 4 ticks at t=0.25..1.0 all land in bucket [0,2): 3, 1, 7, 5
+        for v in (3.0, 1.0, 7.0, 5.0):
+            g.set(v)
+            _tick(st, clock)
+        q = st.query("v", step=2.0)
+        assert q["step"] == 2.0
+        b = q["points"][-1]
+        assert (b["min"], b["max"], b["sum"], b["count"], b["last"]) \
+            == (1.0, 7.0, 16.0, 4, 5.0)
+
+    def test_open_bucket_is_visible_and_freezes_on_boundary(self):
+        clock = FakeClock()
+        reg = Registry()
+        g = reg.gauge("v")
+        st = _store(reg, clock, levels=((5.0, 16),))
+        g.set(2.0)
+        _tick(st, clock)               # t=0.25, bucket [0,5) open
+        assert len(st.query("v", step=5.0)["points"]) == 1
+        g.set(9.0)
+        _tick(st, clock, dt=5.0)       # t=5.25 → [0,5) frozen, new open
+        pts = st.query("v", step=5.0)["points"]
+        assert len(pts) == 2
+        assert pts[0]["last"] == 2.0 and pts[1]["last"] == 9.0
+
+    def test_query_is_bounded_and_truncation_flagged(self):
+        clock = FakeClock()
+        reg = Registry()
+        g = reg.gauge("v")
+        st = _store(reg, clock)
+        for i in range(10):
+            g.set(float(i))
+            _tick(st, clock)
+        q = st.query("v", limit=3)
+        assert q["truncated"] is True
+        assert [p[1] for p in q["points"]] == [7.0, 8.0, 9.0]
+        # since= filters from the left on the same t axis
+        q2 = st.query("v", since=st.latest("v")[0] - 0.3)
+        assert len(q2["points"]) == 2
+
+    def test_unknown_series_raises_keyerror(self):
+        st = _store()
+        with pytest.raises(KeyError):
+            st.query("nope")
+
+    def test_max_series_bound_drops_loudly(self):
+        clock = FakeClock()
+        st = _store(None, clock, max_series=2,
+                    sources=[lambda: [(f"g{i}", {}, "gauge", 1.0)
+                                      for i in range(5)]])
+        _tick(st, clock)
+        assert len(st.keys()) == 2
+        assert st.doc()["series_dropped"] == 3
+
+    def test_series_key_matches_snapshot_key_format(self):
+        reg = Registry()
+        reg.counter("x_total", labels={"b": "2", "a": "1"}).inc()
+        snap_keys = set(reg.snapshot())
+        assert series_key("x_total", {"a": "1", "b": "2"}) in snap_keys
+
+
+class TestGaps:
+    def test_gap_detected_marked_never_interpolated(self):
+        clock = FakeClock()
+        reg = Registry()
+        c = reg.counter("n_total")
+        st = _store(reg, clock)        # cadence 0.25, gap_factor 2.5
+        c.inc()
+        _tick(st, clock)
+        c.inc()
+        _tick(st, clock)
+        c.inc(3)
+        _tick(st, clock, dt=2.0)       # 2.0 > 0.625 → blackout
+        doc = st.doc()["gaps"]
+        assert doc["count"] == 1
+        g = doc["recent"][0]
+        assert g["missed"] == 7        # int(2.0 / 0.25) - 1
+        # the raw ring holds only REAL samples — nothing was invented
+        assert len(st.query(series_key("n_total"))["points"]) == 3
+        # and the rate stream marks the interval that bridges it
+        rs = st.rate_series(series_key("n_total"))
+        assert [gap for _, _, _, gap in rs] == [False, True]
+
+    def test_sub_threshold_spacing_is_not_a_gap(self):
+        clock = FakeClock()
+        reg = Registry()
+        reg.gauge("v").set(1.0)
+        st = _store(reg, clock)
+        for _ in range(4):
+            _tick(st, clock, dt=0.5)   # 2x cadence < 2.5x threshold
+        assert st.doc()["gaps"]["count"] == 0
+
+
+class TestDerivedSignals:
+    def test_rate_endpoint_difference_and_unknown_is_none(self):
+        clock = FakeClock()
+        reg = Registry()
+        c = reg.counter("done_total")
+        st = _store(reg, clock)
+        _tick(st, clock)
+        assert st.rate(series_key("done_total"), 10.0) is None  # 1 point
+        for _ in range(4):
+            c.inc(5)
+            _tick(st, clock)
+        # 20 increments over 1.0 s of ticks
+        assert st.rate(series_key("done_total"), 10.0) == pytest.approx(20.0)
+        assert st.rate("absent", 10.0) is None
+
+    def test_integrate_rate_telescopes_to_counter_delta(self):
+        clock = FakeClock()
+        reg = Registry()
+        c = reg.counter("done_total")
+        st = _store(reg, clock)
+        _tick(st, clock)
+        for inc in (1, 4, 2, 8):
+            c.inc(inc)
+            _tick(st, clock)
+        assert st.integrate_rate(series_key("done_total")) \
+            == pytest.approx(15.0, abs=1e-9)
+
+    def test_trend_recovers_a_linear_slope(self):
+        clock = FakeClock()
+        reg = Registry()
+        g = reg.gauge("v")
+        st = _store(reg, clock)
+        for i in range(8):
+            g.set(3.0 * clock.t + 1.0)
+            _tick(st, clock)
+        # set() used pre-advance t; slope of v = 3(t - 0.25) + 1 is 3
+        assert st.trend("v", 10.0) == pytest.approx(3.0)
+
+    def test_window_quantiles_match_percentile_meter(self):
+        from improved_body_parts_tpu.utils.meters import PercentileMeter
+
+        clock = FakeClock()
+        reg = Registry()
+        g = reg.gauge("v")
+        st = _store(reg, clock)
+        vals = [5.0, 1.0, 9.0, 3.0, 7.0, 2.0]
+        pm = PercentileMeter()
+        for v in vals:
+            g.set(v)
+            pm.update(v)
+            _tick(st, clock)
+        wq = st.window_quantiles("v", 100.0)
+        for q, k in ((50.0, "p50"), (95.0, "p95"), (99.0, "p99")):
+            assert wq["p%g" % q] == pytest.approx(pm.percentile(q))
+
+    def test_signals_feed_and_prefix_fallback(self):
+        """The control-plane feed carries the ROADMAP item 1 inputs, and
+        scans by family SUFFIX: a pool/router deployment (pool_* and
+        pool_engine_* families, no serve_*) feeds the same signals."""
+        clock = FakeClock()
+        st = _store(None, clock, sources=[lambda: [
+            ("pool_queue_depth", {}, "gauge", 3.0),
+            ("pool_engine_queue_depth", {"replica": "0"}, "gauge", 2.0),
+            ("pool_engine_queue_depth", {"replica": "1"}, "gauge", 1.0),
+            ("pool_completed_total", {}, "counter", clock.t * 10.0),
+            ("pool_engine_hop_latency_seconds",
+             {"replica": "0", "hop": "queue", "quantile": "0.99"},
+             "gauge", 0.02),
+            ("pool_engine_hop_latency_seconds",
+             {"replica": "1", "hop": "queue", "quantile": "0.99"},
+             "gauge", 0.05),
+            ("pool_hop_conservation_frac", {}, "gauge", 1.0),
+            ("pool_engine_hop_conservation_frac", {"replica": "0"},
+             "gauge", 0.97),
+            ("slo_burn_rate", {"class": "default", "window": "5m"},
+             "gauge", 1.5),
+        ]])
+        for _ in range(6):
+            _tick(st, clock)
+        sig = st.signals()
+        assert sig["t"] == st.doc()["last_t"]
+        assert sig["queue_depth"] == 3.0          # engine tier sum
+        assert sig["admitted_depth"] == 3.0       # pool rollup
+        assert sig["hop_p99_s"] == {"queue": 0.05}  # worst replica
+        assert sig["hop_conservation_frac"] == 0.97  # worst layer
+        assert sig["burn_rate"] == {"default": {"5m": 1.5}}
+        assert sig["completed_rate"] == pytest.approx(10.0)
+
+    def test_signals_absent_is_none_not_zero(self):
+        clock = FakeClock()
+        st = _store(None, clock, sources=[lambda: [
+            ("unrelated", {}, "gauge", 1.0)]])
+        _tick(st, clock)
+        sig = st.signals()
+        assert sig["queue_depth"] is None
+        assert sig["completed_rate"] is None
+        assert st.signals(now=None) is not None
+        assert _store().signals() == {"t": None}  # never sampled
+
+
+class TestPersistenceAndReplay:
+    def _seed(self, tmp_path, shard_records=4):
+        clock = FakeClock()
+        reg = Registry()
+        c = reg.counter("done_total")
+        g = reg.gauge("depth", labels={"replica": "0"})
+        path = str(tmp_path / "events_history.jsonl")
+        st = HistoryStore(reg, cadence_s=0.25, clock=clock,
+                          persist_path=path, shard_records=shard_records,
+                          run_id="t-run")
+        for i in range(10):
+            c.inc(i + 1)
+            g.set(float(i % 3))
+            dt = 2.0 if i == 6 else 0.25   # one blackout mid-stream
+            clock.advance(dt)
+            st.sample_now()
+        st.close()
+        return path, st
+
+    def test_rotation_shards_and_headers(self, tmp_path):
+        path, _ = self._seed(tmp_path)
+        shards = discover_history_shards(path)
+        assert len(shards) == 3            # 10 ticks / 4 per shard
+        assert shards[1].endswith(".p1") and shards[2].endswith(".p2")
+        for i, p in enumerate(shards):
+            recs = read_events(p)
+            assert recs[0]["event"] == "history_start"
+            assert recs[0]["shard"] == i
+            assert recs[0]["run_id"] == "t-run"
+            # every shard is self-describing: series re-declared
+            declared = {r["key"] for r in recs
+                        if r["event"] == "history_series"}
+            sampled = set()
+            for r in recs:
+                if r["event"] == "history_sample":
+                    sampled |= set(r["v"])
+            assert sampled <= declared
+
+    def test_replay_is_bit_identical_on_every_derived_signal(
+            self, tmp_path):
+        path, live = self._seed(tmp_path)
+        rep = HistoryStore.replay(path)
+
+        def feed(st):
+            return {
+                "keys": st.keys(),
+                "latest": st.latest(series_key("done_total")),
+                "rate": st.rate(series_key("done_total"), 10.0),
+                "trend": st.trend(series_key("done_total"), 10.0),
+                "quantiles": st.window_quantiles(
+                    series_key("depth", {"replica": "0"}), 10.0),
+                "integral": st.integrate_rate(series_key("done_total")),
+                "signals": st.signals(),
+                "gaps": st.doc()["gaps"],
+                "samples": st.doc()["samples"],
+                "raw": st.query(series_key("done_total"))["points"],
+                "agg": st.query(series_key("done_total"),
+                                step=5.0)["points"],
+            }
+
+        assert feed(live) == feed(rep)     # ==, no tolerance
+        assert rep.run_id == "t-run"
+
+    def test_replay_missing_stream_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            HistoryStore.replay(str(tmp_path / "absent.jsonl"))
+
+    def test_history_path_convention(self):
+        assert history_path_for("/x/events.jsonl") \
+            == "/x/events_history.jsonl"
+        assert discover_history_shards("/nonexistent/h.jsonl") == []
+
+    def test_shard_discovery_sorts_numerically(self, tmp_path):
+        base = str(tmp_path / "h.jsonl")
+        for p in [base] + [f"{base}.p{i}" for i in (1, 2, 9, 10, 11)]:
+            with open(p, "w") as f:
+                f.write("{}\n")
+        shards = discover_history_shards(base)
+        assert [os.path.basename(s) for s in shards[-3:]] \
+            == ["h.jsonl.p9", "h.jsonl.p10", "h.jsonl.p11"]
+
+
+class TestSampleUnderScrapeHammer:
+    def test_eight_reader_threads_against_the_sampler(self, tmp_path):
+        """8 reader threads hammering query/signals/rate/doc against a
+        sampler folding as fast as it can, then exact conservation at
+        quiescence: the last sample must equal the counter — a torn
+        fold or a lost tick cannot hide."""
+        reg = Registry()
+        c = reg.counter("done_total")
+        g = reg.gauge("depth")
+        st = HistoryStore(reg, cadence_s=0.001,
+                          persist_path=str(tmp_path / "h.jsonl"),
+                          shard_records=200)
+        stop = threading.Event()
+        errors = []
+        reads = [0]
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                c.inc()
+                g.set(float(i % 7))
+                i += 1
+
+        def reader():
+            n = 0
+            key = series_key("done_total")
+            while not stop.is_set():
+                try:
+                    st.doc()
+                    st.signals()
+                    st.rate(key, 1.0)
+                    st.window_quantiles("depth", 1.0)
+                    try:
+                        st.query(key, limit=50)
+                    except KeyError:
+                        pass           # before the first tick landed
+                    n += 1
+                except Exception as e:  # noqa: BLE001 — the failure
+                    errors.append(repr(e))   # under test
+                    return
+            reads[0] += n
+
+        threads = [threading.Thread(target=writer)]
+        threads += [threading.Thread(target=reader) for _ in range(8)]
+        st.start()
+        for t in threads:
+            t.start()
+        import time as _time
+        _time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        st.stop()
+        assert not errors, errors[:3]
+        assert reads[0] > 0
+        # quiescence: one forced tick, then three views agree EXACTLY
+        t_fin = st.sample_now()
+        key = series_key("done_total")
+        assert st.latest(key) == (t_fin, c.value)
+        assert st.doc()["sample_errors"] == 0
+        st.close()
+        # and the persisted stream replays to the same final value
+        rep = HistoryStore.replay(str(tmp_path / "h.jsonl"))
+        assert rep.latest(key) == (t_fin, c.value)
+
+
+class TestCapacityModel:
+    POINTS = [(10.0, 20.0), (20.0, 22.0), (40.0, 30.0),
+              (80.0, 45.0), (100.0, 140.0)]
+
+    def test_knee_from_base_latency_factor(self):
+        m = CapacityModel.fit_from_points(self.POINTS, replicas=2)
+        assert m.base_ms == 20.0
+        assert m.objective_ms == 40.0      # 2.0 x base
+        assert m.knee_qps == 40.0          # last point inside 40 ms
+        assert m.per_replica_qps() == 20.0
+        assert m.measured_max_qps == 100.0
+
+    def test_replicas_needed_with_headroom_and_flags(self):
+        m = CapacityModel.fit_from_points(self.POINTS, replicas=2)
+        need = m.replicas_needed(68.0, headroom=0.85)
+        assert need["replicas"] == 4       # ceil(68 / (20*0.85))
+        assert need["objective_unmet"] is False
+        assert need["extrapolated"] is False
+        far = m.replicas_needed(500.0)
+        assert far["extrapolated"] is True
+        # explicit objective re-evaluates the knee without refitting
+        tight = m.replicas_needed(30.0, objective_ms=21.0)
+        assert tight["knee_qps"] == 10.0
+
+    def test_objective_unmet_is_flagged_not_faked(self):
+        m = CapacityModel.fit_from_points(
+            [(10.0, 50.0), (20.0, 80.0)], objective_ms=10.0)
+        need = m.replicas_needed(15.0)
+        assert need["replicas"] is None
+        assert need["objective_unmet"] is True
+
+    def test_no_measurements_answers_none(self):
+        m = CapacityModel.fit_from_points([])
+        assert m.knee_qps is None
+        assert m.replicas_needed(10.0)["replicas"] is None
+
+    def test_occupancy_headroom(self):
+        m = CapacityModel(
+            [{"qps": 10.0, "mean_ms": 5.0, "occupancy": 6.0},
+             {"qps": 30.0, "mean_ms": 50.0, "occupancy": 8.0}],
+            objective_ms=10.0, max_batch=8)
+        assert m.knee_occupancy == 6.0
+        assert m.occupancy_headroom() == pytest.approx(0.25)
+
+    def test_fit_from_history_store_with_prefix(self):
+        """The exact-counter fit path: a synthetic pool_* load ramp in a
+        store (two 1 s plateaus at 10 then 40 qps with known latency
+        sums) fits windows whose qps/mean are the counter deltas."""
+        clock = FakeClock()
+        state = {"done": 0.0, "lat": 0.0, "qps": 10.0, "ms": 10.0}
+
+        def src():
+            return [
+                ("pool_completed_total", {}, "counter", state["done"]),
+                ("pool_latency_seconds_sum", {}, "counter",
+                 state["lat"]),
+                ("pool_latency_seconds_count", {}, "counter",
+                 state["done"]),
+                ("pool_batch_occupancy_mean", {}, "gauge", 4.0),
+            ]
+
+        st = _store(None, clock, sources=[src])
+        for i in range(17):
+            if i == 8:
+                state["qps"], state["ms"] = 40.0, 35.0
+            state["done"] += state["qps"] * 0.25
+            state["lat"] += state["qps"] * 0.25 * state["ms"] / 1e3
+            _tick(st, clock)
+        m = CapacityModel.fit(st, window_s=1.0, prefix="pool")
+        assert m.meta["prefix"] == "pool"
+        assert len(m.points) >= 3
+        qps = [round(p["qps"]) for p in m.points]
+        assert 10 in qps and 40 in qps
+        # pure plateau windows carry the exact counter-delta latency;
+        # the one window straddling the transition is a blend and is
+        # deliberately not pinned
+        for p in m.points:
+            if round(p["qps"]) == 10:
+                assert p["mean_ms"] == pytest.approx(10.0, abs=1e-6)
+            elif round(p["qps"]) == 40:
+                assert p["mean_ms"] == pytest.approx(35.0, abs=1e-6)
+            assert p["occupancy"] == pytest.approx(4.0)
+        # serve-prefixed fit over the same store sees nothing
+        assert CapacityModel.fit(st, window_s=1.0).points == []
+
+    def test_register_into_exports_capacity_gauges(self):
+        reg = Registry()
+        m = CapacityModel.fit_from_points(self.POINTS, replicas=2)
+        m.register_into(reg)
+        snap = reg.snapshot()
+        assert snap["capacity_knee_qps"] == 40.0
+        assert snap["capacity_replicas"] == 2.0
+
+
+class TestHistoryRoutes:
+    def _served(self):
+        clock = FakeClock()
+        reg = Registry()
+        c = reg.counter("done_total")
+        st = _store(reg, clock)
+        for _ in range(6):
+            c.inc(2)
+            _tick(st, clock)
+        return reg, st
+
+    def test_history_and_query_roundtrip_with_head_parity(self):
+        reg, st = self._served()
+        with MetricsServer(reg, port=0, history=st) as srv:
+            with urllib.request.urlopen(srv.url + "/history",
+                                        timeout=10) as r:
+                doc = json.loads(r.read().decode())
+                glen = int(r.headers["Content-Length"])
+            assert doc["samples"] == 6
+            assert series_key("done_total") in doc["keys"]
+            req = urllib.request.Request(srv.url + "/history",
+                                         method="HEAD")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert int(r.headers["Content-Length"]) == glen
+                assert r.read() == b""
+            q_url = (srv.url + "/query?series="
+                     + urllib.parse.quote(series_key("done_total"))
+                     + "&limit=3")
+            with urllib.request.urlopen(q_url, timeout=10) as r:
+                q = json.loads(r.read().decode())
+            assert q["truncated"] is True and len(q["points"]) == 3
+            with urllib.request.urlopen(q_url + "&step=5",
+                                        timeout=10) as r:
+                agg = json.loads(r.read().decode())
+            assert agg["step"] == 5.0
+            assert agg["points"][-1]["count"] >= 1
+
+    def test_query_error_codes(self):
+        reg, st = self._served()
+
+        def code(path):
+            try:
+                urllib.request.urlopen(srv.url + path, timeout=10)
+                return 200
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        with MetricsServer(reg, port=0, history=st) as srv:
+            assert code("/query") == 400
+            assert code("/query?series=nope") == 404
+            assert code("/query?series=done_total&since=zzz") == 400
+            assert code("/query?series=done_total") == 200
+
+    def test_unwired_history_is_404_and_404_body_lists_routes(self):
+        reg = Registry()
+        with MetricsServer(reg, port=0) as srv:
+            for path in ("/history", "/query?series=x"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(srv.url + path, timeout=10)
+                assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/nope", timeout=10)
+            body = ei.value.read().decode()
+            for route in ("/metrics", "/history", "/query"):
+                assert route in body
+
+    def test_routes_table_matches_module_doc(self):
+        from improved_body_parts_tpu.obs import ROUTES
+        from improved_body_parts_tpu.obs import http as obs_http
+
+        for path, _ in ROUTES:
+            assert path in obs_http.__doc__
+
+
+class TestReportVerifier:
+    def _seed(self, tmp_path):
+        clock = FakeClock()
+        reg = Registry()
+        c = reg.counter("done_total")
+        path = str(tmp_path / "h.jsonl")
+        st = HistoryStore(reg, cadence_s=0.25, clock=clock,
+                          persist_path=path, shard_records=4,
+                          run_id="vr")
+        for i in range(9):
+            c.inc()
+            clock.advance(2.0 if i == 4 else 0.25)
+            st.sample_now()
+        st.close()
+        return path
+
+    def test_healthy_stream_verifies_ok(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            from history_report import verify_history
+        finally:
+            sys.path.pop(0)
+        path = self._seed(tmp_path)
+        ok, problems, stats = verify_history(path)
+        assert ok, problems
+        assert stats["ticks"] == 9 and stats["shards"] == 3
+        assert stats["gaps_persisted"] == stats["gaps_redetected"] == 1
+
+    def test_broken_streams_cannot_pass_for_healthy(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            from history_report import verify_history
+        finally:
+            sys.path.pop(0)
+        path = self._seed(tmp_path)
+        # 1: a dropped middle shard (numbering hole → position mismatch)
+        os.rename(path + ".p1", path + ".p1.bak")
+        ok, problems, _ = verify_history(path)
+        assert not ok and any("shard" in p for p in problems)
+        os.rename(path + ".p1.bak", path + ".p1")
+        # 2: an undeclared series smuggled into a sample record
+        with open(path + ".p2", "a") as f:
+            t = read_events(path + ".p2")[-1]["t"] + 0.25
+            f.write(strict_dumps({"event": "history_sample", "t": t,
+                                  "v": {"ghost": 1.0}}) + "\n")
+        ok, problems, _ = verify_history(path)
+        assert not ok and any("undeclared" in p for p in problems)
+
+    def test_report_cli_strict_renders_and_gates(self, tmp_path):
+        import subprocess
+        import sys
+        path = self._seed(tmp_path)
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "history_report.py"), path,
+             "--series", "done_total", "--strict"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr[-500:]
+        assert "verifier: OK" in r.stdout
+        assert "done_total" in r.stdout
+
+
+class TestHistoryMetricNameLint:
+    """The history/capacity families ride the same Prometheus naming
+    rules the ISSUE 7 walk enforces — linted here over a registry that
+    carries both collectors plus the store's own sampled meta-signals."""
+
+    NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+    def test_history_and_capacity_names_are_prometheus_legal(self):
+        clock = FakeClock()
+        reg = Registry()
+        reg.counter("done_total").inc()
+        st = _store(reg, clock)
+        st.register_into(reg)
+        m = CapacityModel.fit_from_points(
+            [(10.0, 20.0), (40.0, 90.0)], replicas=2)
+        m.register_into(reg)
+        clock.advance(0.25)
+        st.sample_now()
+        names = set()
+        for name, labels, kind, value, help in reg._flat():
+            names.add(name)
+            assert self.NAME_RE.match(name), name
+            for k in labels:
+                assert self.LABEL_RE.match(str(k)), (name, k)
+            if kind == "counter":
+                assert name.endswith(("_total", "_sum", "_count")), name
+        assert {"history_samples_total", "history_gaps_total",
+                "history_series", "history_series_dropped_total",
+                "history_sample_errors_total",
+                "history_persist_records_total",
+                "history_persist_shards"} <= names
+        assert {"capacity_windows", "capacity_replicas",
+                "capacity_base_latency_ms", "capacity_objective_ms",
+                "capacity_knee_qps", "capacity_per_replica_qps",
+                "capacity_measured_max_qps"} <= names
+        # self-describing: the store sampled its own meta-signals
+        assert "history_samples_total" in st.keys()
+
+
+class TestGraftlintScope:
+    def test_jgl002_scope_covers_history_module(self):
+        """ISSUE 19 satellite: the history sampler runs while serving is
+        live — locked into the JGL002 hot-path sweep on its actual
+        path, so a move out of obs/ can't silently drop it."""
+        from improved_body_parts_tpu.analysis.rules.host_sync import (
+            HiddenHostSync,
+        )
+
+        assert "improved_body_parts_tpu/obs/history.py" \
+            in HiddenHostSync.SCOPE

@@ -1,0 +1,511 @@
+#!/usr/bin/env python
+"""Telemetry-history audit → committed ``HISTORY_AUDIT.json``.
+
+Proves the §7h history layer (``obs.history.HistoryStore`` +
+``serve.capacity.CapacityModel`` + the ``/history``/``/query`` routes)
+against six gates on a LIVE 2-worker ``ProcessRouter``:
+
+1. **Overhead** — interleaved sampler-ON/sampler-OFF A/B, paired
+   per-round overhead, median < ``OVERHEAD_GATE_PCT``%.  Both arms run
+   the identical, EXPLICITLY nulled base plane (NullSink +
+   NullTraceRecorder + ``telemetry=False`` workers, per the PR 15/18
+   estimator discipline — an arm that merely *forgot* to configure
+   something measures nothing); the ONLY difference is the ON arm's
+   history sampler thread (0.1 s cadence, persistence on) running
+   during its slices.
+2. **Conservation** — at ON-arm quiescence one forced sample tick must
+   agree EXACTLY (==, no tolerance) with the registry and the router's
+   own counters: last history sample of ``pool_completed_total`` ==
+   registry value == router completed; and the rate integral over the
+   raw ring (``Σ rate·dt``) must telescope back to the counter delta.
+3. **Gaps** — sampler blackouts (every inter-round stop, plus one
+   deliberately injected 4-tick stall) are accounted explicitly: the
+   store's gap count == the ``history_gap`` records persisted in the
+   shards, and the injected gap reports ≥ 3 missed ticks.  Never
+   interpolated, never silently absorbed.
+4. **Compiles** — per-arm compile-delta accounting (parent CompileWatch
+   + every worker's own counters): 0 post-warmup recompiles per arm.
+5. **Routes** — one live ``MetricsServer`` over the ON registry:
+   ``/history`` serves the store document, ``/query`` serves raw and
+   aggregate reads with ``since=``/``step=``, responses stay bounded
+   under ``limit=``, HEAD answers with GET's exact headers and no
+   body, malformed params 400, unknown series 404.
+6. **Replay** — ``HistoryStore.replay`` over the shards this audit just
+   wrote (rotation forced: multiple ``.pN`` shards) reconstructs the
+   full derived signal feed — ``signals()``, rates, trends, window
+   quantiles, gap accounting, and the fitted ``CapacityModel`` —
+   BIT-IDENTICALLY to the values the live store answered.
+
+Plus the capacity fit: a 3-phase load ramp (1→2→4 clients) sampled into
+history, fitted into a measured QPS-vs-latency knee and a
+``replicas_needed`` answer, committed in the artifact.
+
+    python tools/history_audit.py --rounds 6 --out HISTORY_AUDIT.json
+    python tools/history_audit.py --quick        # CI-budget variant
+"""
+import argparse
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: paired-median throughput overhead the sampler may cost, percent
+OVERHEAD_GATE_PCT = 2.0
+#: sampler cadence under audit — the production default
+#: (``HistoryStore(cadence_s=0.25)``): the overhead claim is for the
+#: configuration users actually run, and the A/B slices are sized so
+#: each one still spans several live ticks
+CADENCE_S = 0.25
+#: ticks per shard — small enough that the audit itself exercises
+#: rotation and multi-shard replay (the run takes a few dozen ticks)
+SHARD_RECORDS = 15
+
+SPEC = "improved_body_parts_tpu.serve.worker:constant_predictor"
+#: per-request simulated device time — large enough that the sampler's
+#: per-tick cost lands well under the gate, small enough that a round
+#: stays sub-second
+DELAY_S = 0.003
+
+#: the router registers its pool rollup — ``ProcessRouter.register_into``
+#: exports the ServeMetrics family set under the ``pool_`` prefix (plus
+#: per-replica ``pool_engine_*``); there is no ``serve_``-prefixed
+#: series on this registry
+COMPLETED = "pool_completed_total"
+
+
+def _mk_router(ProcessRouter, *, workers=2, slots=8, delay_s=DELAY_S):
+    return ProcessRouter(
+        SPEC, num_workers=workers,
+        spec_kwargs={"num_parts": 18, "n_people": 2, "delay_s": delay_s},
+        slots=slots, max_image_hw=(64, 64), num_parts=18, max_people=8,
+        restart_after_s=0.3, probe_interval_s=0.05,
+        telemetry=False)
+
+
+def run_slice(router, images, n_clients, requests):
+    """Closed-loop slice: n_clients threads, each ``requests``
+    submit→result round-trips; returns imgs/sec."""
+    from improved_body_parts_tpu.serve import submit_with_retry
+
+    errs = []
+
+    def work(cid):
+        for i in range(requests):
+            img = images[(cid + i) % len(images)]
+            try:
+                fut, _ = submit_with_retry(router.submit, img,
+                                           base_s=0.002, max_s=0.05)
+                fut.result(timeout=60)
+            except Exception as e:  # noqa: BLE001 — surfaced in report
+                errs.append(repr(e))
+                return
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=work, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errs:
+        raise SystemExit(f"audit slice failed: {errs[0]}")
+    return round(n_clients * requests / wall, 3)
+
+
+def derived_feed(store, capacity_model):
+    """The full derived-signal feed at the store's last tick — computed
+    identically against the live store and the replayed one (the
+    bit-identity gate compares these two dicts with ==)."""
+    return {
+        "signals": store.signals(),
+        "completed_rate_10s": store.rate(COMPLETED, 10.0),
+        "completed_trend_10s": store.trend(COMPLETED, 10.0),
+        "queue_depth_quantiles_10s":
+            store.window_quantiles("pool_queue_depth", 10.0),
+        "completed_rate_integral": store.integrate_rate(COMPLETED),
+        "gap_count": store.doc()["gaps"]["count"],
+        "gaps_recent": store.doc()["gaps"]["recent"],
+        "samples": store.doc()["samples"],
+        "series": store.doc()["series"],
+        "capacity_fit": capacity_model.to_dict(),
+    }
+
+
+def audit(args):
+    import numpy as np
+
+    from improved_body_parts_tpu.obs.events import (
+        NullSink, set_sink)
+    from improved_body_parts_tpu.obs.history import (
+        HistoryStore, discover_history_shards, history_path_for)
+    from improved_body_parts_tpu.obs.http import MetricsServer
+    from improved_body_parts_tpu.obs.recompile import CompileWatch
+    from improved_body_parts_tpu.obs.registry import Registry
+    from improved_body_parts_tpu.obs.trace import (
+        NullTraceRecorder, set_tracer)
+    from improved_body_parts_tpu.serve.capacity import CapacityModel
+    from improved_body_parts_tpu.serve.router import ProcessRouter
+
+    workdir = tempfile.mkdtemp(prefix="history_audit_")
+    rng = np.random.default_rng(0)
+    images = [rng.integers(0, 255, (48, 48, 3), dtype=np.uint8)
+              for _ in range(8)]
+
+    # identical, EXPLICITLY nulled base plane on BOTH arms — the A/B
+    # isolates the history sampler, nothing else
+    set_sink(NullSink())
+    set_tracer(NullTraceRecorder())
+
+    reg_on, reg_off = Registry(), Registry()
+    watch = CompileWatch(registry=reg_on, sink=NullSink()).install()
+    on_router = _mk_router(ProcessRouter)
+    on_router.register_into(reg_on)
+    on_router.start()
+    on_router.warmup([(64, 64)])
+    off_router = _mk_router(ProcessRouter)
+    off_router.register_into(reg_off)
+    off_router.start()
+    off_router.warmup([(64, 64)])
+    watch.mark_warm("history audit warmup")
+    c_warm = int(watch.compiles.value)
+
+    hist_path = history_path_for(os.path.join(workdir, "events.jsonl"))
+    store = HistoryStore(reg_on, cadence_s=CADENCE_S,
+                         persist_path=hist_path,
+                         shard_records=SHARD_RECORDS,
+                         run_id="history-audit")
+    store.register_into(reg_on)
+
+    # one unmeasured slice per arm: first-touch costs (series creation,
+    # shard open, ring growth) are startup, not per-request overhead.
+    # Every sampling session is book-ended with one forced tick so even
+    # a sub-cadence slice leaves a sample (and its session boundaries
+    # leave detectable gaps) — the forced ticks run OUTSIDE the timed
+    # windows, so they never touch the A/B
+    store.start()
+    store.sample_now()
+    run_slice(on_router, images, args.clients, args.requests)
+    store.sample_now()
+    store.stop()
+    run_slice(off_router, images, args.clients, args.requests)
+
+    report = {
+        "generated_by": "tools/history_audit.py",
+        "protocol": {
+            "workers": 2, "clients": args.clients,
+            "requests_per_client": args.requests,
+            "rounds": args.rounds, "predictor_delay_s": DELAY_S,
+            "cadence_s": CADENCE_S, "shard_records": SHARD_RECORDS,
+            "interleaved": True,
+            "arm_order": "alternating per round (A/A-measured ~1.4% "
+                         "first-position bias cancels in the paired "
+                         "median)",
+            "arms": "identical explicitly-nulled base plane (NullSink "
+                    "+ NullTraceRecorder + telemetry=False workers); "
+                    "ON adds the history sampler thread + persistence, "
+                    "OFF runs no HistoryStore at all",
+        },
+    }
+
+    # ----------------------------------------------- 1: interleaved A/B
+    # Two estimator defenses, both calibrated with A/A dry runs
+    # (sampler never started) on a 1-core host:
+    # - arm order ALTERNATES per round: the A/A measured a ~1.4%
+    #   median deficit for whichever arm runs first in a round —
+    #   position bias that a fixed on-first order would book as
+    #   sampler overhead.  Alternation cancels it in the paired
+    #   median.
+    # - MANY SHORT rounds instead of few long ones: host noise here is
+    #   bursty at the ~100 ms–1 s scale, so with ~1 s slices a burst
+    #   lands inside ONE arm of a pair and the per-round delta
+    #   inherits its full amplitude (observed spread: same code,
+    #   ±5% medians across runs).  With sub-second slices a pair
+    #   spans less than the burst, the noise becomes common-mode and
+    #   cancels in the delta — and the median gets 3–4× the pairs.
+    # The sampler runs for the whole ON slice either way, so the
+    # measured quantity (per-second sampling cost) is unchanged.
+    on_ips, off_ips = [], []
+    arm_compile_delta = {"on": 0, "off": 0}
+
+    def _on_slice():
+        store.start()
+        store.sample_now()
+        c0 = int(watch.compiles.value)
+        on_ips.append(run_slice(on_router, images, args.clients,
+                                args.requests))
+        arm_compile_delta["on"] += int(watch.compiles.value) - c0
+        store.sample_now()
+        store.stop()
+
+    def _off_slice():
+        c0 = int(watch.compiles.value)
+        off_ips.append(run_slice(off_router, images, args.clients,
+                                 args.requests))
+        arm_compile_delta["off"] += int(watch.compiles.value) - c0
+
+    for rnd in range(args.rounds):
+        first, second = ((_on_slice, _off_slice) if rnd % 2 == 0
+                         else (_off_slice, _on_slice))
+        first()
+        second()
+        print(f"round {rnd}: on {on_ips[-1]} vs off {off_ips[-1]} "
+              f"imgs/s ({'on' if rnd % 2 == 0 else 'off'} first)",
+              flush=True)
+    per_round = [round((off - on) / off * 100.0, 3)
+                 for on, off in zip(on_ips, off_ips)]
+    median_overhead = round(statistics.median(per_round), 3)
+    report["overhead"] = {
+        "on_imgs_per_sec": on_ips, "off_imgs_per_sec": off_ips,
+        "per_round_overhead_pct": per_round,
+        "paired_median_overhead_pct": median_overhead,
+        "gate_pct": OVERHEAD_GATE_PCT,
+        "ok": bool(median_overhead < OVERHEAD_GATE_PCT),
+    }
+
+    # ----------------------------------------------- capacity load ramp
+    # sampled phases at 1→2→4 clients: the (qps, latency) spread the
+    # capacity model needs a knee from
+    ramp_phases = []
+    store.start()
+    store.sample_now()
+    for n_clients in args.ramp:
+        ips = run_slice(on_router, images, n_clients, args.ramp_requests)
+        store.sample_now()
+        ramp_phases.append({"clients": n_clients, "imgs_per_sec": ips})
+    store.stop()
+    report["ramp"] = ramp_phases
+
+    # ----------------------------------------------- 3: gap accounting
+    # deliberate blackout: the sampler is down for 4 cadences (3+
+    # missed ticks) and the next tick must mark it — never interpolate
+    time.sleep(4 * CADENCE_S)
+    store.sample_now()
+    gaps = store.doc()["gaps"]
+    injected = gaps["recent"][-1] if gaps["recent"] else {}
+    persisted_gaps = sum(
+        1 for p in discover_history_shards(hist_path)
+        for r in _read_events(p) if r.get("event") == "history_gap")
+    report["gaps"] = {
+        "threshold_s": store.gap_factor * store.cadence_s,
+        "detected": gaps["count"],
+        "persisted_gap_records": persisted_gaps,
+        "injected_last": injected,
+        # every detected blackout must be persisted (exact ==) and the
+        # injected 0.4 s stall must be marked with its missed-tick
+        # count — explicit accounting, never interpolation
+        "ok": bool(gaps["count"] >= 1
+                   and gaps["count"] == persisted_gaps
+                   and injected.get("missed", 0) >= 3),
+    }
+
+    # ----------------------------------------------- 2: conservation
+    # quiesce (closed-loop clients already joined; depth is 0), force
+    # one tick, then all three views must agree EXACTLY
+    t_final = store.sample_now()
+    reg_val = reg_on.snapshot()[COMPLETED]
+    hist_t, hist_val = store.latest(COMPLETED)
+    router_completed = float(on_router.metrics.completed)
+    raw = store.query(COMPLETED)["points"]
+    ring_delta = raw[-1][1] - raw[0][1]
+    integral = store.integrate_rate(COMPLETED)
+    report["conservation"] = {
+        "history_last_sample": hist_val,
+        "history_last_t": hist_t,
+        "registry_value": reg_val,
+        "router_completed": router_completed,
+        "rate_integral": integral,
+        "ring_counter_delta": ring_delta,
+        "ok": bool(hist_t == t_final
+                   and hist_val == reg_val == router_completed
+                   and abs(integral - ring_delta) < 1e-6),
+    }
+
+    # ----------------------------------------------- 4: compile deltas
+    worker_recompiles = {
+        "on": sum(int(w["recompiles_post_warmup"])
+                  for w in on_router.worker_stats()),
+        "off": sum(int(w["recompiles_post_warmup"])
+                   for w in off_router.worker_stats()),
+    }
+    report["compiles"] = {
+        "parent_warmup_compiles": c_warm,
+        "parent_per_arm_delta": arm_compile_delta,
+        "worker_recompiles_post_warmup": worker_recompiles,
+        "ok": bool(arm_compile_delta["on"] == 0
+                   and arm_compile_delta["off"] == 0
+                   and worker_recompiles["on"] == 0
+                   and worker_recompiles["off"] == 0),
+    }
+
+    # ----------------------------------------------- 5: live routes
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    with MetricsServer(reg_on, history=store) as srv:
+        with urllib.request.urlopen(srv.url + "/history", timeout=10) as r:
+            hdoc = _json.loads(r.read().decode())
+            hist_len = int(r.headers["Content-Length"])
+        req = urllib.request.Request(srv.url + "/history", method="HEAD")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            head_len = int(r.headers["Content-Length"])
+            head_body = len(r.read())
+        q_url = (srv.url + f"/query?series={COMPLETED}"
+                 f"&since={t_final - 30.0}&limit=5")
+        with urllib.request.urlopen(q_url, timeout=10) as r:
+            qdoc = _json.loads(r.read().decode())
+        with urllib.request.urlopen(
+                srv.url + f"/query?series={COMPLETED}&step=5",
+                timeout=10) as r:
+            qagg = _json.loads(r.read().decode())
+        codes = {}
+        for name, path in (("missing_series", "/query"),
+                           ("unknown_series", "/query?series=nope"),
+                           ("bad_param",
+                            f"/query?series={COMPLETED}&since=zzz")):
+            try:
+                urllib.request.urlopen(srv.url + path, timeout=10)
+                codes[name] = 200
+            except urllib.error.HTTPError as e:
+                codes[name] = e.code
+    report["routes"] = {
+        "history_doc_series": hdoc.get("series"),
+        "history_doc_samples": hdoc.get("samples"),
+        "head_content_length": head_len,
+        "get_content_length": hist_len,
+        "head_body_bytes": head_body,
+        "query_points": len(qdoc.get("points", [])),
+        "query_truncated": qdoc.get("truncated"),
+        "query_agg_step": qagg.get("step"),
+        "error_codes": codes,
+        "ok": bool(hdoc.get("series", 0) > 0
+                   and head_len == hist_len and head_body == 0
+                   and len(qdoc.get("points", [])) <= 5
+                   and qdoc.get("truncated") is True
+                   and qagg.get("step") == 5.0
+                   and codes == {"missing_series": 400,
+                                 "unknown_series": 404,
+                                 "bad_param": 400}),
+    }
+
+    # ----------------------------------------------- capacity fit
+    cap = CapacityModel.fit(store, window_s=0.5, replicas=2,
+                            prefix="pool")
+    need = cap.replicas_needed(
+        2.0 * (cap.measured_max_qps or 1.0))
+    report["capacity"] = {
+        "fit": cap.to_dict(),
+        "replicas_needed_2x_max": need,
+        "ok": bool(len(cap.points) >= 2
+                   and cap.measured_max_qps is not None
+                   and (need["replicas"] is not None
+                        or need["objective_unmet"])),
+    }
+
+    # ----------------------------------------------- 6: replay
+    live_feed = derived_feed(store, cap)
+    on_router.stop()
+    off_router.stop()
+    store.close()
+    shards = discover_history_shards(hist_path)
+    replayed = HistoryStore.replay(hist_path)
+    cap_replay = CapacityModel.fit(replayed, window_s=0.5, replicas=2,
+                                   prefix="pool")
+    replay_feed = derived_feed(replayed, cap_replay)
+    mismatched = sorted(k for k in live_feed
+                        if live_feed[k] != replay_feed[k])
+    report["replay"] = {
+        "shards": len(shards),
+        "live_feed": live_feed,
+        "replay_bit_identical": not mismatched,
+        "mismatched_keys": mismatched,
+        "ok": bool(len(shards) >= 2 and not mismatched),
+    }
+    watch.uninstall()
+
+    if not args.keep_workdir:
+        shutil.rmtree(workdir, ignore_errors=True)
+    else:
+        report["workdir"] = workdir
+
+    report["ok"] = bool(all(report[k]["ok"] for k in
+                            ("overhead", "conservation", "gaps",
+                             "compiles", "routes", "capacity",
+                             "replay")))
+    return report
+
+
+def _read_events(path):
+    from improved_body_parts_tpu.obs.events import read_events
+    return read_events(path)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rounds", type=int, default=24,
+                    help="interleaved A/B round pairs (even: arm order "
+                         "alternates per round; many short rounds beat "
+                         "few long ones — see the loop comment)")
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=40,
+                    help="closed-loop requests per client per round "
+                         "(short slices: a round pair spans well under "
+                         "a second, so bursty host noise hits both "
+                         "arms of the pair and cancels in the delta)")
+    ap.add_argument("--ramp", type=int, nargs="+", default=[1, 2, 4],
+                    help="client counts for the capacity load ramp")
+    ap.add_argument("--ramp-requests", type=int, default=300,
+                    help="requests per client per ramp phase")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI budget: 5 rounds x 50 requests, 2-phase ramp")
+    ap.add_argument("--keep-workdir", action="store_true",
+                    help="keep the shard workdir for inspection")
+    ap.add_argument("--out", default="HISTORY_AUDIT.json")
+    args = ap.parse_args()
+    if args.quick:
+        args.rounds, args.requests = 12, 20
+        args.ramp, args.ramp_requests = [1, 4], 120
+
+    report = audit(args)
+
+    from improved_body_parts_tpu.obs.events import strict_dump
+
+    with open(args.out, "w") as f:
+        strict_dump(report, f, indent=2, sort_keys=True)
+    ov = report["overhead"]
+    print(f"overhead: median {ov['paired_median_overhead_pct']}% "
+          f"(gate < {ov['gate_pct']}%) "
+          f"{'OK' if ov['ok'] else 'FAIL'}")
+    cons = report["conservation"]
+    print(f"conservation: history {cons['history_last_sample']} == "
+          f"registry {cons['registry_value']} == router "
+          f"{cons['router_completed']}; integral "
+          f"{cons['rate_integral']} vs delta "
+          f"{cons['ring_counter_delta']} "
+          f"{'OK' if cons['ok'] else 'FAIL'}")
+    print(f"gaps: {report['gaps']['detected']} detected == "
+          f"{report['gaps']['persisted_gap_records']} persisted "
+          f"{'OK' if report['gaps']['ok'] else 'FAIL'}")
+    print(f"compiles: {report['compiles']['parent_per_arm_delta']} "
+          f"{'OK' if report['compiles']['ok'] else 'FAIL'}")
+    print(f"routes: {report['routes']['error_codes']} "
+          f"{'OK' if report['routes']['ok'] else 'FAIL'}")
+    print(f"capacity: knee {report['capacity']['fit']['knee_qps']} qps "
+          f"over {report['capacity']['fit']['windows']} windows "
+          f"{'OK' if report['capacity']['ok'] else 'FAIL'}")
+    print(f"replay: {report['replay']['shards']} shards, "
+          f"bit_identical={report['replay']['replay_bit_identical']} "
+          f"{'OK' if report['replay']['ok'] else 'FAIL'}")
+    print(f"wrote {args.out}  overall: "
+          f"{'OK' if report['ok'] else 'FAIL'}")
+    if not report["ok"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,12 @@ and reports:
   processes) auto-discovered next to the primary stream and summarized
   SEPARATELY under ``worker_shards`` — a shard whose ``run_start``
   carries a different ``run_id`` than the primary stream is a stale
-  leftover from an earlier run and is skipped loudly.
+  leftover from an earlier run and is skipped loudly;
+- the run's telemetry-history stream (``<events-stem>_history.jsonl``
+  + ``.pN``, written by ``obs.history.HistoryStore``) auto-discovered
+  the same way, with the same loud stale-``run_id`` skip, and pointed
+  at ``tools/history_report.py`` for rendering (``--no-shards``
+  disables both discoveries).
 
     python tools/telemetry_report.py checkpoints/events.jsonl
     python tools/telemetry_report.py events.jsonl --json report.json
@@ -96,6 +101,57 @@ def summarize_shard(path, primary_run_id):
         "events": len(events),
         "served": (stop or {}).get("served"),
         "clean_stop": stop is not None,
+    }
+
+
+def summarize_history(events_path, primary_run_id):
+    """Small summary of the telemetry-history stream a
+    ``obs.history.HistoryStore`` persisted next to this run's events
+    (``<events-stem>_history.jsonl`` + ``.pN`` rotation shards).
+    Returns ``None`` when there is no stream, or — after a loud stderr
+    note — when its header carries a ``run_id`` other than the primary
+    stream's: a stale history from an earlier run sitting next to a
+    fresh events file must not be reported as this run.  (A header
+    without a run_id is kept: stores wired outside ``RunTelemetry``
+    legitimately don't stamp one.)"""
+    from improved_body_parts_tpu.obs.history import (
+        discover_history_shards, history_path_for)
+
+    hist_path = history_path_for(events_path)
+    shards = discover_history_shards(hist_path)
+    if not shards:
+        return None
+    header = next((e for e in read_events(shards[0])
+                   if e.get("event") == "history_start"), {})
+    hist_run = header.get("run_id")
+    if (hist_run is not None and primary_run_id is not None
+            and hist_run != primary_run_id):
+        print(f"{hist_path}: history run_id {hist_run!r} does not match "
+              f"the primary stream's {primary_run_id!r}; skipping stale "
+              "history shards", file=sys.stderr)
+        return None
+    ticks = series = gaps = 0
+    last_t = None
+    for p in shards:
+        for e in read_events(p):
+            ev = e.get("event")
+            if ev == "history_sample":
+                ticks += 1
+                last_t = e.get("t", last_t)
+            elif ev == "history_gap":
+                gaps += 1
+    # every shard re-declares its series; count the last shard's
+    series = sum(1 for e in read_events(shards[-1])
+                 if e.get("event") == "history_series")
+    return {
+        "path": os.path.basename(hist_path),
+        "shards": len(shards),
+        "run_id": hist_run,
+        "cadence_s": header.get("cadence_s"),
+        "ticks": ticks,
+        "series": series,
+        "gaps": gaps,
+        "last_t": last_t,
     }
 
 
@@ -310,6 +366,13 @@ def render(summary):
                 f"{served if served is not None else '?'}, "
                 + ("clean stop" if g["clean_stop"]
                    else "no worker_stop (crashed?)"))
+    h = s.get("history")
+    if h:
+        lines.append(
+            f"telemetry history: {h['path']} — {h['shards']} shard(s), "
+            f"{h['ticks']} ticks @ {h['cadence_s']}s, {h['series']} "
+            f"series, {h['gaps']} gap(s)"
+            " (tools/history_report.py renders it)")
     return "\n".join(lines)
 
 
@@ -321,7 +384,8 @@ def main():
                     help="also write the machine-readable summary here")
     ap.add_argument("--no-shards", action="store_true",
                     help="skip auto-discovery of <events>.pN worker "
-                         "sink shards")
+                         "sink shards and the <events-stem>_history"
+                         ".jsonl telemetry-history stream")
     args = ap.parse_args()
 
     events = read_events(args.events)
@@ -333,6 +397,10 @@ def main():
         shards = [summarize_shard(p, summary.get("run_id"))
                   for p in shard_paths]
         summary["worker_shards"] = [s for s in shards if s is not None]
+    if not args.no_shards:
+        hist = summarize_history(args.events, summary.get("run_id"))
+        if hist is not None:
+            summary["history"] = hist
     print(render(summary))
     if args.json:
         with open(args.json, "w") as f:
