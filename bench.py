@@ -370,6 +370,83 @@ def _fastpath_summary(fallback, budget_s):
         return {"error": f"{type(e).__name__}"}
 
 
+def _onchip_summary(fallback, budget_s):
+    """Run the ISSUE 20 on-chip campaign smoke — tools/tta_bench.py
+    --ab (fused multi-scale TTA vs the per-entry dispatch loop,
+    payload-equality + AP-parity gated) plus tools/pallas_check.py
+    --peaks --limbs (interpreter-parity rows for the Pallas decode
+    kernels) — and return a compact summary, or an {"error"/"skipped"}
+    marker under the "serve"/"decode" key contract.  Subprocess so an
+    on-chip-campaign failure can never take down the primary metric;
+    bounded by the REMAINING driver budget.  ``IBP_BENCH_ONCHIP=0``
+    skips it unconditionally.  The speedup gate only binds off-CPU
+    (TTA_AB.json carries the full protocol + the CPU
+    inter-program-parallelism caveat)."""
+    import subprocess
+    import tempfile
+
+    if os.environ.get("IBP_BENCH_ONCHIP") == "0":
+        return {"skipped": "IBP_BENCH_ONCHIP=0"}
+    if budget_s < 240:
+        return {"skipped": f"only {budget_s:.0f}s left in the bench "
+                           "budget (TTA_AB.json / PALLAS_CHECK.json "
+                           "have the full runs)"}
+    here = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="onchip_")
+    ab_out = os.path.join(tmp, "TTA_AB.json")
+    pk_out = os.path.join(tmp, "PALLAS_CHECK.json")
+    if fallback:
+        ab_argv = ["--config", "tiny", "--num-images", "2",
+                   "--rounds", "1", "--size", "128",
+                   "--scales", "0.5,1.0", "--rotations", "0,30",
+                   "--telemetry-sink", "none"]
+        pk_iters = "3"
+        timeout = min(420, budget_s)
+    else:
+        ab_argv = ["--config", "tiny", "--num-images", "4",
+                   "--rounds", "3", "--size", "128",
+                   "--telemetry-sink", "none"]
+        pk_iters = "10"
+        timeout = min(600, budget_s)
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "tta_bench.py"),
+             "--ab", "--out", ab_out] + ab_argv,
+            capture_output=True, timeout=timeout, check=True,
+            env=dict(os.environ))
+        with open(ab_out) as f:
+            ab = json.load(f)
+        # the kernels are interpreter-mode on every platform here; a
+        # real chip re-blesses via pallas_check --json (the committed
+        # PALLAS_CHECK.json workflow)
+        subprocess.run(
+            [sys.executable,
+             os.path.join(here, "tools", "pallas_check.py"),
+             "--peaks", "--limbs", "--interpret", "--iters", pk_iters,
+             "--hw", "64", "--json", pk_out],
+            capture_output=True,
+            timeout=max(60, min(300, budget_s - timeout)), check=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        with open(pk_out) as f:
+            pk = json.load(f)
+        return {
+            "payload_equal_all_images": ab["payload_equal_all_images"],
+            "ap_parity_equal": ab["ap_parity"]["equal"],
+            "median_fused_speedup": ab["median_fused_speedup"],
+            "fused_speedup_gate_binding":
+                ab["fused_speedup_gate_binding"],
+            "median_fused_dispatches_per_image":
+                ab["median_fused_dispatches_per_image"],
+            "median_looped_dispatches_per_image":
+                ab["median_looped_dispatches_per_image"],
+            "recompiles_post_warmup": ab["recompiles_post_warmup"],
+            "pallas_decode_parity_ok": pk["parity_ok"],
+            "pallas_kernels": [r["kernel"] for r in pk["kernels"]],
+        }
+    except Exception as e:  # noqa: BLE001 — the primary metric must land
+        return {"error": f"{type(e).__name__}"}
+
+
 def _feed_rate_summary(fallback, budget_s):
     """Run tools/feed_rate.py (sync vs shm-worker input feed rate) and
     return a compact summary for the bench line, or an {"error"/"skipped"}
@@ -1040,6 +1117,10 @@ def main():
     # full-frame every frame), same discipline
     fastpath = _fastpath_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
+    # on-chip campaign smoke (fused-TTA A/B + Pallas decode kernel
+    # parity), same discipline
+    onchip = _onchip_summary(
+        fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
     # input feed rate (sync vs shm workers), same budget discipline
     feed = _feed_rate_summary(
         fallback, TOTAL_TIMEOUT_S - 60 - (time.monotonic() - t_start))
@@ -1101,6 +1182,7 @@ def main():
         "decode": decode,
         "stream": stream,
         "fastpath": fastpath,
+        "onchip": onchip,
         "feed": feed,
         "telemetry": telemetry,
         "ckpt": ckpt,

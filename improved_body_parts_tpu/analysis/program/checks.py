@@ -56,8 +56,11 @@ PROGRAM_RULES = (
     ProgramRule(
         "PRG002", "dtype-drift", "error",
         "float64 anywhere in the program (silent upcasts double memory "
-        "and are 10-100x slower on TPU), or a program declared "
-        "bf16-compute that compiled with no bf16 left in it"),
+        "and are 10-100x slower on TPU), a program declared "
+        "bf16-compute that compiled with no bf16 left in it, or a "
+        "program declared int8-quantized (expect_int8) whose jaxpr "
+        "carries no int8 — the dequant chain was folded out and the "
+        "artifact silently serves full-precision weights"),
     ProgramRule(
         "PRG003", "donation-aliasing", "error",
         "a donate_argnums declaration the compiled executable did not "
@@ -132,6 +135,14 @@ def check_dtype_drift(spec: ProgramSpec, trace: TraceInfo,
             "program is declared bf16-compute but no bfloat16 appears "
             "in its jaxpr — the mixed-precision path silently upcast "
             f"to {{{', '.join(sorted(trace.dtypes))}}}"))
+    if spec.expect_int8 and "int8" not in trace.dtypes:
+        out.append(_make(
+            config, spec, "PRG002",
+            "program is declared int8-quantized (expect_int8) but no "
+            "int8 appears in its jaxpr — the weight-only quantization "
+            "chain (utils.precision.quantize_int8) is not in the "
+            "program, so the artifact would serve dequantized or "
+            "full-precision weights unaudited"))
     return out
 
 

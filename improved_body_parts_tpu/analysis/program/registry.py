@@ -14,7 +14,11 @@ tier (ISSUE 13) adds three: the student forward and student fused
 decode with bf16 PARAM storage (the quantized artifact's programs —
 ``tools/export_model.py`` gates exports on their blessed fingerprints),
 and the distillation train step (student state donated, frozen teacher
-variables a non-donated argument).
+variables a non-donated argument).  The on-chip campaign (ISSUE 20)
+adds two more: the student fused decode with INT8 weight-only storage
+(per-output-channel scales, dequant chain audited in-program by
+PRG002's expect_int8 facet), and the fused multi-scale TTA compact
+program (the whole scale×rotation grid as one dispatch).
 
 ``build()`` returns the jitted callable plus ``ShapeDtypeStruct``
 example arguments: tracing/lowering/compiling them runs ZERO model
@@ -64,6 +68,11 @@ class ProgramSpec:
     donate_argnums: Tuple[int, ...] = ()
     #: program is declared bf16-compute: PRG002 requires bf16 to appear
     expect_bf16: bool = False
+    #: program is declared int8-quantized (weight-only storage with the
+    #: in-program dequant chain): PRG002 requires int8 to appear — the
+    #: refusal facet that keeps the quantization chain honest exactly
+    #: like the bf16 cast chain
+    expect_int8: bool = False
     #: f64 anywhere is an error unless explicitly allowed
     allow_f64: bool = False
     #: a `while` primitive is a hazard unless declared intentional
@@ -183,7 +192,8 @@ def _build_swa_update() -> BuiltProgram:
     return BuiltProgram(fn=jax.jit(update_swa), args=(swa_state,))
 
 
-def _abstract_predictor(name: str = "tiny", bf16_params: bool = False):
+def _abstract_predictor(name: str = "tiny", bf16_params: bool = False,
+                        int8_params: bool = False):
     """A Predictor over abstract variables: ``_ensemble_fn`` only ever
     threads the variables through to the jitted program, so the
     ShapeDtypeStruct tree traces/lowers exactly like real weights.
@@ -192,7 +202,10 @@ def _abstract_predictor(name: str = "tiny", bf16_params: bool = False):
     storage (via ``utils.precision.bf16_params`` under ``eval_shape`` —
     the SAME cast ``tools/export_model.py --dtype bf16`` applies to real
     weights, so the audited program and the exported artifact share one
-    fingerprint)."""
+    fingerprint).  ``int8_params=True`` runs the weight-only int8
+    quantization the same way (``apply_serve_dtype("int8", ...)`` under
+    ``eval_shape``): int8 weights + fp32 scales as program inputs, the
+    dequant chain as program ops."""
     import jax
 
     from ...infer.predict import Predictor
@@ -211,6 +224,11 @@ def _abstract_predictor(name: str = "tiny", bf16_params: bool = False):
         from ...utils.precision import bf16_params as cast
 
         variables = jax.eval_shape(cast, variables)
+    if int8_params:
+        from ...utils.precision import DequantizingModel, quantize_int8
+
+        variables = jax.eval_shape(quantize_int8, variables)
+        model = DequantizingModel(model)
     return cfg, Predictor(model, variables, cfg.skeleton)
 
 
@@ -290,6 +308,47 @@ def _build_student_serve_decode() -> BuiltProgram:
     img = jax.ShapeDtypeStruct((b, b, 3), jnp.float32)
     valid = jax.ShapeDtypeStruct((), jnp.int32)
     return BuiltProgram(fn=fn, args=(p.variables, img, valid, valid))
+
+
+def _build_student_serve_decode_int8() -> BuiltProgram:
+    """The student tier's fused decode serve program with INT8 weight
+    storage (``tools/export_model.py --config tiny_student --dtype
+    int8``): int8 weights + per-output-channel fp32 scales as inputs,
+    the dequant multiply traced into the program — PRG002's expect_int8
+    facet refuses the artifact if the chain ever folds out."""
+    import jax
+    import jax.numpy as jnp
+
+    _, p = _abstract_predictor("tiny_student", int8_params=True)
+    b = p.bucket
+    fn = p.decode_program((b, b))
+    img = jax.ShapeDtypeStruct((b, b, 3), jnp.float32)
+    valid = jax.ShapeDtypeStruct((), jnp.int32)
+    return BuiltProgram(fn=fn, args=(p.variables, img, valid, valid))
+
+
+def _build_fused_tta_compact() -> BuiltProgram:
+    """The FUSED multi-scale TTA program (``Predictor._fused_grid_fn``):
+    the whole (scale × rotation) grid — rotation lanes and width-flips
+    batched into the lane dim, on-device regrid + averaging + compact
+    extraction — as ONE program, the accuracy tier's
+    1-dispatch-per-image path.  Registered on a 2-scale × 2-rotation
+    grid so the lane batching, the rotation warps and the multi-shape
+    accumulate are all structurally audited."""
+    import jax
+    import jax.numpy as jnp
+
+    _, p = _abstract_predictor()
+    b = p.bucket
+    # two scales (full bucket + a half-valid entry) × (0°, 30°)
+    entries = (((b, b), (b, b)), ((b, b), (b // 2, b // 2)))
+    angles = (0.0, 30.0)
+    prm = p.params
+    fn = p._fused_grid_fn(entries, (b, b), angles, prm.thre1,
+                          p._compact_spec(prm), "compact")
+    imgs = [jax.ShapeDtypeStruct((b, b, 3), jnp.float32)
+            for _ in entries]
+    return BuiltProgram(fn=fn, args=(p.variables, *imgs))
 
 
 def _build_distill_train_step() -> BuiltProgram:
@@ -477,6 +536,26 @@ def program_registry() -> List[ProgramSpec]:
             expect_bf16=True, allow_while=True,
             tags=("tier=student", "params=bf16", "bucket=128x128",
                   "batch=1")),
+        ProgramSpec(
+            name="student_serve_decode_int8_b1",
+            description="student FUSED decode serve program, bucket "
+                        "128, batch 1, INT8 weight-only storage "
+                        "(per-output-channel scales, dequant chain in "
+                        "the program) — the int8 artifact's subject; "
+                        "declared bounded while, as serve_decode_b1",
+            build=_build_student_serve_decode_int8,
+            expect_bf16=True, expect_int8=True, allow_while=True,
+            tags=("tier=student", "params=int8", "bucket=128x128",
+                  "batch=1")),
+        ProgramSpec(
+            name="fused_tta_compact",
+            description="FUSED multi-scale TTA compact program: 2 "
+                        "scales x 2 rotations with flip pairs in the "
+                        "lane dim, device-resident regrid + averaging "
+                        "+ compact extraction in ONE dispatch (the "
+                        "accuracy tier's grid path)",
+            build=_build_fused_tta_compact, expect_bf16=True,
+            tags=("grid=2x2", "bucket=128x128")),
         ProgramSpec(
             name="distill_train_step",
             description="heatmap-distillation train step "

@@ -69,7 +69,14 @@ class HiddenHostSync(Rule):
              # hidden host sync inside its tick would stall the same
              # GIL the dispatch threads run on, so it keeps the serve
              # tree's discipline
-             "improved_body_parts_tpu/obs/history.py")
+             "improved_body_parts_tpu/obs/history.py",
+             # the ISSUE 20 decode-payload ops: peaks.py is traced into
+             # every compact decode program and pallas_peaks.py is its
+             # config-selectable Mosaic twin — both sit under the serve
+             # dispatch path, where a hidden readback would serialize
+             # the whole program queue
+             "improved_body_parts_tpu/ops/peaks.py",
+             "improved_body_parts_tpu/ops/pallas_peaks.py")
 
     def check(self, ctx: ModuleContext) -> None:
         if not ctx.under(*self.SCOPE):

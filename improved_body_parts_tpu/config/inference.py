@@ -27,6 +27,12 @@ class InferenceParams:
     # assembly pruning (reference: evaluate.py:491-496)
     min_parts: int = 2
     min_mean_score: float = 0.45
+    # route the compact extraction's hot inner loops (peak top-K,
+    # dense limb gather) through the ops/pallas_peaks.py kernels —
+    # off by default: the XLA path is the validated production path,
+    # and off-TPU the kernels run in interpreter mode (parity-exact
+    # but not faster); tools/pallas_check.py owns the hardware A/B
+    use_pallas_decode: bool = False
 
 
 @dataclass(frozen=True)

@@ -141,7 +141,7 @@ class Predictor:
                  params: Optional[InferenceParams] = None,
                  model_params: Optional[InferenceModelParams] = None,
                  bucket: int = 128, mesh=None, compact_topk: int = 64,
-                 assembly_pmax: int = 32):
+                 assembly_pmax: int = 32, fused_tta: bool = True):
         from ..config import default_inference_params
 
         d_params, d_model_params = default_inference_params()
@@ -171,6 +171,16 @@ class Predictor:
         # in-progress skeletons than this set the person_overflow flag
         # and the caller falls back to the host decoder
         self.assembly_pmax = assembly_pmax
+        # multi-scale TTA grids dispatch ONE fused device program per
+        # image (scales + rotation/flip lanes resized and averaged on
+        # device) instead of one program per grid entry; the looped
+        # path stays selectable for the tools/tta_bench.py A/B
+        self.fused_tta = fused_tta
+        # jitted-program dispatches issued by the multi-scale grid
+        # paths — the instrumentation tools/tta_bench.py reads to prove
+        # the fused path's 1-dispatch-per-image claim (measured at the
+        # call sites, not computed from the grid size)
+        self.dispatch_count = 0
         # jitted program cache keyed by (padded shape, mode, thre1)
         self._fns: Dict[Tuple[Tuple[int, int], str, Optional[float]],
                         object] = {}
@@ -287,23 +297,50 @@ class Predictor:
         program): (maps, valid_h, valid_w) → (TopKPeaks,
         LimbCandidates).  The shared front half of the compact and fused
         decode extractors."""
-        from ..ops.peaks import limb_topk_candidates, topk_peaks
+        from ..ops.peaks import (limb_topk_candidates,
+                                 limb_topk_from_stats, topk_peaks)
 
         sk = self.skeleton
-        thre2, mid_num, radius, topk, connect_ration = spec
+        # engine rides the spec tuple (appended — spec[3]=topk holds
+        # for every positional consumer) so the program cache keys and
+        # recompiles on an engine flip exactly like any other knob
+        thre2, mid_num, radius, topk, connect_ration, engine = spec
         limbs_from = tuple(a for a, _ in sk.limbs_conn)
         limbs_to = tuple(b for _, b in sk.limbs_conn)
+        if engine == "pallas":
+            import jax
+
+            from ..ops.pallas_peaks import (limb_pair_stats_pallas,
+                                            topk_peaks_pallas)
+
+            # Mosaic lowering needs a real TPU; anywhere else the
+            # kernels run in interpreter mode (parity-exact, slower)
+            interp = jax.default_backend() != "tpu"
 
         def records(maps, valid_h, valid_w):
             kp = maps[..., sk.paf_layers:sk.paf_layers + sk.num_parts]
-            peaks = topk_peaks(kp, valid_h, valid_w, thre=thre1,
-                               k=topk, radius=radius)
-            cands = limb_topk_candidates(
-                maps[..., :sk.paf_layers], peaks, valid_h,
-                limbs_from=limbs_from, limbs_to=limbs_to,
-                num_samples=mid_num, thre2=thre2,
-                connect_ration=connect_ration,
-                m_cap=COMPACT_M_FACTOR * topk)
+            paf = maps[..., :sk.paf_layers]
+            if engine == "pallas":
+                peaks = topk_peaks_pallas(kp, valid_h, valid_w,
+                                          thre=thre1, k=topk,
+                                          radius=radius, interpret=interp)
+                stats = limb_pair_stats_pallas(
+                    paf, peaks.x_ref, peaks.y_ref,
+                    limbs_from=limbs_from, limbs_to=limbs_to,
+                    num_samples=mid_num, thre2=thre2, interpret=interp)
+                cands = limb_topk_from_stats(
+                    stats, peaks, valid_h, limbs_from=limbs_from,
+                    limbs_to=limbs_to, connect_ration=connect_ration,
+                    m_cap=COMPACT_M_FACTOR * topk)
+            else:
+                peaks = topk_peaks(kp, valid_h, valid_w, thre=thre1,
+                                   k=topk, radius=radius)
+                cands = limb_topk_candidates(
+                    paf, peaks, valid_h,
+                    limbs_from=limbs_from, limbs_to=limbs_to,
+                    num_samples=mid_num, thre2=thre2,
+                    connect_ration=connect_ration,
+                    m_cap=COMPACT_M_FACTOR * topk)
             return peaks, cands
 
         return records
@@ -395,13 +432,16 @@ class Predictor:
 
     def predict_compact_ms(self, image_bgr: np.ndarray,
                            thre1: Optional[float] = None,
-                           params: Optional[InferenceParams] = None):
+                           params: Optional[InferenceParams] = None,
+                           fused: Optional[bool] = None):
         """Multi-scale compact path; see :meth:`predict_compact_ms_async`."""
-        return self.predict_compact_ms_async(image_bgr, thre1, params)()
+        return self.predict_compact_ms_async(image_bgr, thre1, params,
+                                             fused=fused)()
 
     def predict_compact_ms_async(self, image_bgr: np.ndarray,
                                  thre1: Optional[float] = None,
-                                 params: Optional[InferenceParams] = None):
+                                 params: Optional[InferenceParams] = None,
+                                 fused: Optional[bool] = None):
         """Multi-scale ensemble with DEVICE-RESIDENT averaging + compact
         extraction — the full scale-grid protocol (reference:
         evaluate.py:87-161) without any map ever crossing the device
@@ -417,10 +457,15 @@ class Predictor:
         coordinates rescaled back — the same documented deviation as the
         fast path (the reference averages at original image resolution
         with cv2 resizes, evaluate.py:143-161).
+
+        ``fused`` (default: the predictor's ``fused_tta`` flag) selects
+        the whole-grid single-program path vs the per-entry dispatch
+        loop — see :meth:`_compact_ms_dispatch`; payloads are bit-equal
+        either way (tests/test_fused_tta.py, TTA_AB.json).
         """
         prm = params or self.params
         packed_d, rh0, coord_scale = self._compact_ms_dispatch(
-            image_bgr, thre1, prm)
+            image_bgr, thre1, prm, fused=fused)
 
         def resolve():
             return self._unpack_compact(np.asarray(packed_d),
@@ -430,7 +475,8 @@ class Predictor:
 
     def _compact_ms_dispatch(self, image_bgr: np.ndarray,
                              thre1: Optional[float], prm: InferenceParams,
-                             mode: str = "compact"):
+                             mode: str = "compact",
+                             fused: Optional[bool] = None):
         """Dispatch the (scale × rotation) grid ensemble for one image;
         returns the DEVICE-resident packed buffer plus the decode-grid
         metadata, so callers choose between a per-image fetch
@@ -438,7 +484,14 @@ class Predictor:
         (the grid branch of :meth:`predict_compact_batch_async`).
         ``mode="decode"`` runs the fused on-device assembly on the
         averaged grid maps (the :meth:`predict_decoded_async` grid
-        route)."""
+        route).
+
+        ``fused`` (default: the predictor's ``fused_tta`` flag) selects
+        between ONE fused device program for the whole grid
+        (:meth:`_fused_grid_fn` — one dispatch, one host→device image
+        transfer per scale, zero intermediate device arrays surfacing
+        to Python) and the per-entry loop (one program per (scale,
+        rotation) entry plus the averaging program)."""
         mp = self.model_params
         if self.mesh is not None:
             raise ValueError(
@@ -446,6 +499,8 @@ class Predictor:
                 "mesh (use Predictor.predict for mesh-sharded inference)")
         if thre1 is None:
             thre1 = prm.thre1
+        if fused is None:
+            fused = self.fused_tta
         oh, ow = image_bgr.shape[:2]
 
         # decode on the LARGEST scale's grid (finest resolution, and
@@ -454,14 +509,26 @@ class Predictor:
         prepared = [self._prepare_input(image_bgr, s) for s in scales]
         rh0, rw0 = max((p[1] for p in prepared), key=lambda v: v[0] * v[1])
 
+        spec = (self._decode_spec(prm) if mode == "decode"
+                else self._compact_spec(prm))
+
+        if fused:
+            entries = tuple((img.shape[:2], (rh, rw))
+                            for img, (rh, rw) in prepared)
+            fn = self._fused_grid_fn(entries, (rh0, rw0),
+                                     tuple(prm.rotation_search), thre1,
+                                     spec, mode)
+            self.dispatch_count += 1
+            packed_d = fn(self.variables, *[img for img, _ in prepared])
+            return packed_d, rh0, (ow / rw0, oh / rh0)
+
         maps_d = [
             self._scale_to_grid_fn(img.shape[:2], (rh, rw), (rh0, rw0),
                                    angle)(self.variables, img)
             for img, (rh, rw) in prepared
             for angle in prm.rotation_search]
+        self.dispatch_count += len(maps_d) + 1
 
-        spec = (self._decode_spec(prm) if mode == "decode"
-                else self._compact_spec(prm))
         packed_d = self._compact_avg_fn(len(maps_d), (rh0, rw0), thre1,
                                         spec, mode)(maps_d)
         return packed_d, rh0, (ow / rw0, oh / rh0)
@@ -537,6 +604,82 @@ class Predictor:
         def fn(maps_list):
             maps = sum(maps_list) / len(maps_list)
             return one_image(maps, grid[0], grid[1])
+
+        jitted = jax.jit(fn)
+        self._fns[key] = jitted
+        return jitted
+
+    def _fused_grid_fn(self, entries, grid: Tuple[int, int],
+                       angles: Tuple[float, ...], thre1: float, spec,
+                       mode: str = "compact"):
+        """ONE jitted program for the whole (scale × rotation) TTA grid:
+        per (scale, rotation) entry the flip pair runs as one 2-lane
+        ``model.apply`` (the flip rides the lane dim, the same program
+        shape the looped path traces per entry — a wider 2R-lane batch
+        measured SLOWER end to end, tools/tta_bench.py --ab), the
+        merged maps are regridded and accumulated on device in the same
+        scale-major/rotation-minor order as the looped path, and the
+        compact (or fused-decode) extraction runs on the mean — the
+        accuracy tier pays one dispatch and one device→host round-trip
+        per image instead of one per grid entry, and none of the
+        per-entry grid maps ever materialize as program outputs.
+
+        ``entries`` is the static per-scale geometry: a tuple of
+        ((padded H, W), (valid rh, rw)).  Cache key mirrors the looped
+        path's two program families combined, so flipping any knob
+        compiles a fresh program.  The per-lane math is the SAME traced
+        code as :meth:`_scale_to_grid_fn` + :meth:`_compact_avg_fn`
+        (rotate → 2-lane flip ensemble → crop/unrotate/regrid → mean),
+        just batched into the lane dim — payload equality against the
+        looped path is pinned by tests/test_fused_tta.py.
+        """
+        key = (entries, grid, angles, thre1, spec, mode + "_fused")
+        if key in self._fns:
+            return self._fns[key]
+
+        import jax
+        import jax.numpy as jnp
+
+        pad_norm = self.model_params.pad_value / 255.0
+        one_image = (self._decode_extract_fn(thre1, spec)
+                     if mode == "decode"
+                     else self._compact_extract_fn(thre1, spec))
+        n_entries = len(entries) * len(angles)
+        stride = self.skeleton.stride
+
+        def fn(variables, *imgs):
+            acc = None
+            for img, (_, (rh, rw)) in zip(imgs, entries):
+                center = (rh / 2, rw / 2)  # the reference's (x, y) quirk
+                for angle in angles:
+                    if angle != 0.0:
+                        lane = img.at[rh:].set(0.0).at[:, rw:].set(0.0)
+                        lane = _warp_rotate(lane, angle, center)
+                        lane = lane.at[rh:].set(pad_norm) \
+                                   .at[:, rw:].set(pad_norm)
+                    else:
+                        lane = img
+                    # the flip pair rides the lane dim: [straight,
+                    # mirrored] in ONE apply — the same 2-lane shape
+                    # the looped path's per-entry programs trace, so
+                    # the conv batching (and its bits) match exactly
+                    both = jnp.stack([lane, lane[:, ::-1, :]], axis=0)
+                    preds = self.model.apply(variables, both,
+                                             train=False)
+                    out = preds[-1][0]         # (2, H/4, W/4, C)
+                    maps = self._merge_flip(out[0], out[1, :, ::-1, :])
+                    mh = maps.shape[0] * stride
+                    mw = maps.shape[1] * stride
+                    maps = jax.image.resize(
+                        maps, (mh, mw, maps.shape[-1]), method="cubic")
+                    m = maps[:rh, :rw]
+                    if angle != 0.0:
+                        m = _warp_rotate(m, -angle, center)
+                    m = jax.image.resize(m, (*grid, m.shape[-1]),
+                                         method="cubic")
+                    acc = m if acc is None else acc + m
+            mean = acc / n_entries
+            return one_image(mean, grid[0], grid[1])
 
         jitted = jax.jit(fn)
         self._fns[key] = jitted
@@ -664,13 +807,17 @@ class Predictor:
         return compiled
 
     def _compact_spec(self, prm: InferenceParams
-                      ) -> Tuple[float, int, int, int, float]:
-        """The (thre2, mid_num, offset_radius, top-K, connect_ration)
-        tuple every compact program bakes in — ONE construction site so
-        the program-cache keys, the dispatch paths and the AOT
-        accessors below can never disagree on the layout."""
+                      ) -> Tuple[float, int, int, int, float, str]:
+        """The (thre2, mid_num, offset_radius, top-K, connect_ration,
+        engine) tuple every compact program bakes in — ONE construction
+        site so the program-cache keys, the dispatch paths and the AOT
+        accessors below can never disagree on the layout.  ``engine``
+        selects the extraction kernels ("xla", or "pallas" for the
+        ``ops.pallas_peaks`` variants) and rides the tuple so flipping
+        ``use_pallas_decode`` compiles fresh programs."""
         return (prm.thre2, prm.mid_num, prm.offset_radius,
-                self.compact_topk, prm.connect_ration)
+                self.compact_topk, prm.connect_ration,
+                "pallas" if prm.use_pallas_decode else "xla")
 
     def _decode_spec(self, prm: InferenceParams):
         """The fused-decode program spec: the compact spec plus every

@@ -1,0 +1,370 @@
+"""Pallas sketches of the compact decode path's two dense inner loops.
+
+``ops.peaks`` runs the whole compact extraction as XLA ops inside the
+fused serve program; its two hot inner loops are
+
+- the per-channel NMS + top-K + sub-pixel refinement of
+  :func:`ops.peaks.topk_peaks` (one independent (H, W) problem per
+  keypoint channel), and
+- the dense (L, K, K, S) limb-score gather of
+  :func:`ops.peaks.limb_pair_stats` (one independent (K, K, S) sampling
+  problem per limb channel).
+
+Both are embarrassingly parallel over their leading channel axis, which
+XLA cannot exploit as a schedule: it fuses them into the surrounding
+program and serializes the gathers.  This module hand-schedules each as
+a Pallas kernel — ONE grid step per channel/limb, the channel's map
+resident in VMEM for the whole step, peak/sample coordinates produced
+and consumed on-core — following the ``ops/pallas_assembly.py`` sketch
+discipline.
+
+The kernels replicate the reference functions' jnp computation
+graph operation-for-operation, so interpreter mode is EXACTLY
+bit-identical to ``ops.peaks`` (tests/test_pallas_peaks.py pins the
+full payload).  Associative reductions (the 3×3 NMS max) are decomposed
+into shifted ``jnp.maximum`` chains, which are order-exact; everything
+else is elementwise or matches the reference's own reduction shapes.
+
+Status: SKETCHES, gated behind ``tools/pallas_check.py --peaks`` /
+``--limbs`` like the focal and assembly kernels before them —
+parity-tested in interpreter mode on CPU, to be timed under the real
+Mosaic lowering the moment a chip is available.  Production selection:
+``InferenceParams.use_pallas_decode`` routes the compact extraction
+through these variants (interpreter mode off-TPU), so the real-hardware
+A/B is one config flip, but the XLA path stays the shipped default
+either way.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .peaks import _NEG, PairStats, TopKPeaks
+
+# --------------------------------------------------------------------- #
+# peak NMS + top-K + refinement (ops/peaks.py topk_peaks)                #
+# --------------------------------------------------------------------- #
+
+
+def _peaks_kernel(heat_ref, vh_ref, vw_ref, xs_ref, ys_ref, xr_ref,
+                  yr_ref, sc_ref, va_ref, ct_ref, *, thre: float, k: int,
+                  radius: int):
+    """One keypoint channel's NMS → top-K → refinement, map in VMEM."""
+    heat = heat_ref[0]                                   # (H, W)
+    h, w = heat.shape
+    valid_h = vh_ref[0, 0]
+    valid_w = vw_ref[0, 0]
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (h, w), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (h, w), 1)
+    region = (rows < valid_h) & (cols < valid_w)
+    masked = jnp.where(region, heat, _NEG)
+
+    # 3×3 reflect-pad max pool as a chain of shifted maxima — max is
+    # associative/commutative exactly, so this equals the reference's
+    # reduce_window bit-for-bit
+    padded = jnp.pad(masked, ((1, 1), (1, 1)), mode="reflect")
+    hmax = masked
+    for dy in range(3):
+        for dx in range(3):
+            hmax = jnp.maximum(hmax,
+                               jax.lax.slice(padded, (dy, dx),
+                                             (dy + h, dx + w)))
+    keep = (hmax == masked) & (masked >= thre)
+    ct_ref[0] = keep.sum(dtype=jnp.int32)
+
+    flat = jnp.where(keep, masked, _NEG).reshape(h * w)
+
+    # iterative top-K: K rounds of (max, first-max-index, mask) — the
+    # same value/tie order as lax.top_k (stable: equal values ascend by
+    # index), expressed in maxima/where vector ops a Mosaic lowering
+    # supports
+    iota = jax.lax.broadcasted_iota(jnp.int32, (h * w,), 0)
+
+    def select(_, carry):
+        flat, vals, idxs, i = carry
+        v = jnp.max(flat)
+        j = jnp.min(jnp.where(flat == v, iota, h * w))
+        vals = vals.at[i].set(v)
+        idxs = idxs.at[i].set(j)
+        flat = jnp.where(iota == j, -jnp.inf, flat)
+        return flat, vals, idxs, i + 1
+
+    vals = jnp.full((k,), -jnp.inf, heat.dtype)
+    idxs = jnp.zeros((k,), jnp.int32)
+    _, vals, idxs, _ = jax.lax.fori_loop(
+        0, k, select, (flat, vals, idxs, jnp.int32(0)))
+
+    ys = idxs // w
+    xs = idxs % w
+    valid = vals >= thre
+
+    # weighted-centroid refinement over (2r+1)² windows gathered from
+    # the RAW map (clipped indices), exactly the reference's shapes
+    r = radius
+    # 2-D iota (TPU requires ≥2-D) sliced down — jnp.arange would be a
+    # captured host constant, which pallas_call rejects
+    offs = jax.lax.broadcasted_iota(jnp.int32, (1, 2 * r + 1), 1)[0] - r
+    wy = jnp.clip(ys[:, None] + offs[None, :], 0, h - 1)
+    wx = jnp.clip(xs[:, None] + offs[None, :], 0, w - 1)
+    flat_idx = (wy[:, :, None] * w + wx[:, None, :]).reshape(-1)
+    boxes = jnp.take(heat.reshape(h * w), flat_idx).reshape(
+        k, 2 * r + 1, 2 * r + 1)
+
+    total = boxes.sum(axis=(-1, -2))
+    total = jnp.where(total == 0, 1.0, total)
+    offs_f = offs.astype(boxes.dtype)
+    gx = (boxes * offs_f[None, None, :]).sum(axis=(-1, -2)) / total
+    gy = (boxes * offs_f[None, :, None]).sum(axis=(-1, -2)) / total
+    inside = ((xs - r >= 0) & (xs + r + 1 <= valid_w)
+              & (ys - r >= 0) & (ys + r + 1 <= valid_h))
+    xs_ref[0] = xs
+    ys_ref[0] = ys
+    xr_ref[0] = jnp.where(inside, xs + gx, xs.astype(gx.dtype))
+    yr_ref[0] = jnp.where(inside, ys + gy, ys.astype(gy.dtype))
+    sc_ref[0] = jnp.where(inside, boxes.mean(axis=(-1, -2)), vals)
+    va_ref[0] = valid.astype(jnp.int32)
+
+
+def topk_peaks_pallas(heat: jnp.ndarray, valid_h, valid_w, *, thre: float,
+                      k: int, radius: int,
+                      interpret: bool = False) -> TopKPeaks:
+    """Pallas variant of :func:`ops.peaks.topk_peaks` — one grid step
+    per keypoint channel, that channel's (H, W) map VMEM-resident for
+    NMS, top-K selection AND refinement (the XLA path re-materializes
+    it between the fused stages).  Same contract, bit-identical payload
+    in interpreter mode."""
+    h, w, c = heat.shape
+    chan = jnp.transpose(heat, (2, 0, 1))                # (C, H, W)
+    vh = jnp.asarray(valid_h, jnp.int32).reshape(1, 1)
+    vw = jnp.asarray(valid_w, jnp.int32).reshape(1, 1)
+    scalar = pl.BlockSpec((1, 1), lambda ci: (0, 0),
+                          memory_space=pltpu.SMEM)
+    row = lambda dt: jax.ShapeDtypeStruct((c, k), dt)   # noqa: E731
+    import functools
+
+    xs, ys, xr, yr, sc, va, ct = pl.pallas_call(
+        functools.partial(_peaks_kernel, thre=thre, k=k, radius=radius),
+        grid=(c,),
+        in_specs=[pl.BlockSpec((1, h, w), lambda ci: (ci, 0, 0)),
+                  scalar, scalar],
+        out_specs=[pl.BlockSpec((1, k), lambda ci: (ci, 0))] * 6
+        + [pl.BlockSpec((1,), lambda ci: (ci,),
+                        memory_space=pltpu.SMEM)],
+        out_shape=[row(jnp.int32), row(jnp.int32), row(jnp.float32),
+                   row(jnp.float32), row(jnp.float32), row(jnp.int32),
+                   jax.ShapeDtypeStruct((c,), jnp.int32)],
+        interpret=interpret,
+    )(chan, vh, vw)
+    return TopKPeaks(xs, ys, xr, yr, sc, va.astype(bool), ct)
+
+
+# --------------------------------------------------------------------- #
+# dense (L, K, K, S) limb-score gather (ops/peaks.py limb_pair_stats)   #
+# --------------------------------------------------------------------- #
+
+
+def _limbs_kernel(paf_ref, ax_ref, ay_ref, bx_ref, by_ref, mean_ref,
+                  above_ref, m_ref, norm_ref, *, num_samples: int,
+                  thre2: float, h: int, w: int):
+    """One limb channel's dense A×B segment sampling, map in VMEM."""
+    paf_row = paf_ref[0]                                 # (H*W,)
+    ax, ay = ax_ref[0], ay_ref[0]                        # (K,)
+    bx, by = bx_ref[0], by_ref[0]
+
+    vx = bx[None, :] - ax[:, None]                       # (K, K)
+    vy = by[None, :] - ay[:, None]
+    norm = jnp.sqrt(vx * vx + vy * vy)
+    m = jnp.minimum(jnp.round(norm + 1), num_samples).astype(jnp.int32)
+
+    s = jax.lax.broadcasted_iota(norm.dtype, (1, num_samples), 1)[0]
+    denom = jnp.maximum(m - 1, 1).astype(norm.dtype)
+    t = jnp.minimum(s[None, None, :] / denom[..., None], 1.0)
+    px = ax[:, None, None] + t * vx[..., None]           # (K, K, S)
+    py = ay[:, None, None] + t * vy[..., None]
+    xi = jnp.clip(jnp.round(px).astype(jnp.int32), 0, w - 1)
+    yi = jnp.clip(jnp.round(py).astype(jnp.int32), 0, h - 1)
+
+    vals = jnp.take(paf_row, (yi * w + xi).reshape(-1)).reshape(px.shape)
+
+    in_seg = s[None, None, :] < m[..., None]
+    mean_ref[0] = (jnp.where(in_seg, vals, 0.0).sum(-1)
+                   / jnp.maximum(m, 1).astype(vals.dtype))
+    above_ref[0] = ((vals > thre2) & in_seg).sum(-1, dtype=jnp.int32)
+    m_ref[0] = m
+    norm_ref[0] = norm
+
+
+def limb_pair_stats_pallas(paf: jnp.ndarray, x_ref: jnp.ndarray,
+                           y_ref: jnp.ndarray, *,
+                           limbs_from: Tuple[int, ...],
+                           limbs_to: Tuple[int, ...], num_samples: int,
+                           thre2: float,
+                           interpret: bool = False) -> PairStats:
+    """Pallas variant of :func:`ops.peaks.limb_pair_stats` — one grid
+    step per limb, that limb's paf channel VMEM-resident for all K×K×S
+    samples (the dense gather never leaves the core).  Same contract,
+    bit-identical payload in interpreter mode."""
+    import functools
+
+    h, w, n_limbs = paf.shape
+    k = x_ref.shape[1]
+    la = jnp.asarray(limbs_from)
+    lb = jnp.asarray(limbs_to)
+    paf_t = paf.transpose(2, 0, 1).reshape(n_limbs, h * w)
+    ends = (x_ref[la], y_ref[la], x_ref[lb], y_ref[lb])  # (L, K) each
+    row_k = pl.BlockSpec((1, k), lambda li: (li, 0))
+    grid_kk = pl.BlockSpec((1, k, k), lambda li: (li, 0, 0))
+    out = lambda dt: jax.ShapeDtypeStruct((n_limbs, k, k), dt)  # noqa: E731
+
+    mean, above, m, norm = pl.pallas_call(
+        functools.partial(_limbs_kernel, num_samples=num_samples,
+                          thre2=thre2, h=h, w=w),
+        grid=(n_limbs,),
+        in_specs=[pl.BlockSpec((1, h * w), lambda li: (li, 0))]
+        + [row_k] * 4,
+        out_specs=[grid_kk] * 4,
+        out_shape=[out(jnp.float32), out(jnp.int32), out(jnp.int32),
+                   out(jnp.float32)],
+        interpret=interpret,
+    )(paf_t, *ends)
+    return PairStats(mean, above, m, norm)
+
+
+# --------------------------------------------------------------------- #
+# parity + timing benchmarks (tools/pallas_check.py --peaks / --limbs)  #
+# --------------------------------------------------------------------- #
+
+
+def _rand_peaks_fixture(rng, h, w, c, peaky: float = 0.02):
+    """A heat tensor with sparse genuine peaks (most maps are near-flat
+    noise with a few strong modes — the regime the NMS tie/threshold
+    logic actually sees)."""
+    import numpy as np
+
+    heat = rng.normal(0.0, 0.05, (h, w, c)).astype(np.float32)
+    n_spikes = max(1, int(h * w * peaky))
+    for ci in range(c):
+        ys = rng.integers(0, h, n_spikes)
+        xs = rng.integers(0, w, n_spikes)
+        heat[ys, xs, ci] += rng.uniform(0.3, 1.0, n_spikes)
+    return heat
+
+
+def peaks_parity_benchmark(h: int = 128, w: int = 128, c: int = 18,
+                           k: int = 32, radius: int = 2,
+                           thre: float = 0.1, trials: int = 4,
+                           iters: int = 10,
+                           interpret: bool = False) -> dict:
+    """Parity + timing of the Pallas top-K peaks kernel vs the XLA path
+    (``ops.peaks.topk_peaks``) — the check ``tools/pallas_check.py
+    --peaks`` runs.  Parity is EXACT payload equality."""
+    import time
+
+    import numpy as np
+
+    from .peaks import topk_peaks
+
+    rng = np.random.default_rng(0)
+    ok = True
+    fixture = None
+    for ti in range(trials):
+        heat = _rand_peaks_fixture(rng, h, w, c)
+        vh = int(rng.integers(h // 2, h + 1))
+        vw = int(rng.integers(w // 2, w + 1))
+        fixture = fixture or (heat, vh, vw)
+        want = topk_peaks(jnp.asarray(heat), vh, vw, thre=thre, k=k,
+                          radius=radius)
+        got = topk_peaks_pallas(jnp.asarray(heat), vh, vw, thre=thre,
+                                k=k, radius=radius, interpret=interpret)
+        for a, b in zip(want, got):
+            ok = ok and bool((np.asarray(a) == np.asarray(b)).all())
+
+    heat, vh, vw = fixture
+    run_p = jax.jit(lambda x: topk_peaks_pallas(
+        x, vh, vw, thre=thre, k=k, radius=radius, interpret=interpret))
+    run_x = jax.jit(lambda x: topk_peaks(
+        x, vh, vw, thre=thre, k=k, radius=radius))
+    heat_d = jnp.asarray(heat)
+    jax.block_until_ready(run_p(heat_d))
+    jax.block_until_ready(run_x(heat_d))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run_p(heat_d)
+    jax.block_until_ready(out)
+    pallas_ms = (time.perf_counter() - t0) / iters * 1e3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run_x(heat_d)
+    jax.block_until_ready(out)
+    xla_ms = (time.perf_counter() - t0) / iters * 1e3
+    return {"kernel": "topk_peaks", "parity_ok": ok,
+            "pallas_ms": pallas_ms, "xla_ms": xla_ms,
+            "pallas_wins": pallas_ms < xla_ms, "trials": trials,
+            "shape": [h, w, c], "k": k, "interpret": interpret}
+
+
+def limbs_parity_benchmark(h: int = 128, w: int = 128, c: int = 18,
+                           n_limbs: int = 30, k: int = 32,
+                           num_samples: int = 20, thre2: float = 0.05,
+                           trials: int = 4, iters: int = 10,
+                           interpret: bool = False) -> dict:
+    """Parity + timing of the Pallas limb-gather kernel vs the XLA path
+    (``ops.peaks.limb_pair_stats``) — the check ``tools/pallas_check.py
+    --limbs`` runs.  Parity is EXACT payload equality."""
+    import time
+
+    import numpy as np
+
+    from .peaks import limb_pair_stats
+
+    rng = np.random.default_rng(1)
+    limbs_from = tuple(int(v) for v in rng.integers(0, c, n_limbs))
+    limbs_to = tuple(int(v) for v in rng.integers(0, c, n_limbs))
+    ok = True
+    fixture = None
+    for _ in range(trials):
+        paf = rng.normal(0.0, 0.2, (h, w, n_limbs)).astype(np.float32)
+        x_ref = rng.uniform(0, w - 1, (c, k)).astype(np.float32)
+        y_ref = rng.uniform(0, h - 1, (c, k)).astype(np.float32)
+        fixture = fixture or (paf, x_ref, y_ref)
+        want = limb_pair_stats(jnp.asarray(paf), jnp.asarray(x_ref),
+                               jnp.asarray(y_ref), limbs_from=limbs_from,
+                               limbs_to=limbs_to,
+                               num_samples=num_samples, thre2=thre2)
+        got = limb_pair_stats_pallas(
+            jnp.asarray(paf), jnp.asarray(x_ref), jnp.asarray(y_ref),
+            limbs_from=limbs_from, limbs_to=limbs_to,
+            num_samples=num_samples, thre2=thre2, interpret=interpret)
+        for a, b in zip(want, got):
+            ok = ok and bool((np.asarray(a) == np.asarray(b)).all())
+
+    paf, x_ref, y_ref = fixture
+    args = (jnp.asarray(paf), jnp.asarray(x_ref), jnp.asarray(y_ref))
+    run_p = jax.jit(lambda p, x, y: limb_pair_stats_pallas(
+        p, x, y, limbs_from=limbs_from, limbs_to=limbs_to,
+        num_samples=num_samples, thre2=thre2, interpret=interpret))
+    run_x = jax.jit(lambda p, x, y: limb_pair_stats(
+        p, x, y, limbs_from=limbs_from, limbs_to=limbs_to,
+        num_samples=num_samples, thre2=thre2))
+    jax.block_until_ready(run_p(*args))
+    jax.block_until_ready(run_x(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run_p(*args)
+    jax.block_until_ready(out)
+    pallas_ms = (time.perf_counter() - t0) / iters * 1e3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run_x(*args)
+    jax.block_until_ready(out)
+    xla_ms = (time.perf_counter() - t0) / iters * 1e3
+    return {"kernel": "limb_pair_stats", "parity_ok": ok,
+            "pallas_ms": pallas_ms, "xla_ms": xla_ms,
+            "pallas_wins": pallas_ms < xla_ms, "trials": trials,
+            "shape": [h, w, n_limbs], "k": k,
+            "num_samples": num_samples, "interpret": interpret}

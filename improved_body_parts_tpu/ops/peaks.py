@@ -201,6 +201,21 @@ def limb_topk_candidates(paf: jnp.ndarray, peaks: TopKPeaks, image_size,
     st = limb_pair_stats(paf, peaks.x_ref, peaks.y_ref,
                          limbs_from=limbs_from, limbs_to=limbs_to,
                          num_samples=num_samples, thre2=thre2)
+    return limb_topk_from_stats(st, peaks, image_size,
+                                limbs_from=limbs_from, limbs_to=limbs_to,
+                                connect_ration=connect_ration, m_cap=m_cap)
+
+
+@partial(jax.jit, static_argnames=("limbs_from", "limbs_to",
+                                   "connect_ration", "m_cap"))
+def limb_topk_from_stats(st: PairStats, peaks: TopKPeaks, image_size,
+                         *, limbs_from: Tuple[int, ...],
+                         limbs_to: Tuple[int, ...], connect_ration: float,
+                         m_cap: int) -> LimbCandidates:
+    """Acceptance + top-M rank selection over precomputed pair stats —
+    the back half of :func:`limb_topk_candidates`, split out so the
+    Pallas variant of the dense sampling stage (``ops.pallas_peaks``)
+    can feed the identical selection logic."""
     la = jnp.asarray(limbs_from)
     lb = jnp.asarray(limbs_to)
     size_f = jnp.asarray(image_size, st.norm.dtype)

@@ -34,6 +34,7 @@ user requests to the chip).
 Load generator / benchmark: ``tools/serve_bench.py`` → SERVE_BENCH.json.
 Fault-injection harness: ``tools/chaos_serve.py`` → SERVE_CHAOS.json.
 """
+from .artifacts import cascade_predictors, checkpoint_predictor
 from .batcher import DeadlineExceeded, DynamicBatcher, ServerOverloaded
 from .breaker import CircuitBreaker
 from .capacity import CapacityModel
@@ -49,5 +50,6 @@ __all__ = ["CapacityModel",
            "DeadlineExceeded", "DynamicBatcher", "EnginePool",
            "EscalationPolicy", "PolicyClient", "PolicyStats",
            "ProcessRouter", "ProcessWorkerEngine",
-           "ServeMetrics", "ServerOverloaded", "jittered_backoff",
+           "ServeMetrics", "ServerOverloaded", "cascade_predictors",
+           "checkpoint_predictor", "jittered_backoff",
            "pow2_batch_sizes", "precompile", "submit_with_retry"]

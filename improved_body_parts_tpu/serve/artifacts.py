@@ -1,0 +1,91 @@
+"""Serve-time predictor factories: checkpoints → dtype'd Predictors.
+
+The worker processes (``serve.worker.load_predictor``) build their
+predictor from an importable ``"module:callable"`` spec; this module is
+the production spec target.  :func:`checkpoint_predictor` routes the
+weight-storage policy through ``utils.precision.apply_serve_dtype`` —
+the SAME chain ``tools/export_model.py`` serializes and the graftaudit
+registry fingerprints — so a worker spawned with
+``params_dtype="int8"`` serves the exact program family the int8
+artifact's blessed fingerprint covers.
+
+    ProcessRouter(..., spec="improved_body_parts_tpu.serve.artifacts:"
+                       "checkpoint_predictor",
+                  spec_kwargs={"config": "canonical",
+                               "checkpoint": ".../epoch_99",
+                               "params_dtype": "int8"})
+
+:func:`cascade_predictors` is the two-tier wiring: int8 (or bf16)
+student + full-precision teacher, ready for ``CascadeEngine.build`` —
+the cheap tier answers, quantization error is one more escalation
+reason the policy's free decode signals already catch.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+
+def checkpoint_predictor(config: str = "canonical",
+                         checkpoint: Optional[str] = None,
+                         params_dtype: str = "fp32",
+                         boxsize: int = 0, bucket: int = 128,
+                         compact_topk: int = 64,
+                         assembly_pmax: int = 32,
+                         fused_tta: bool = True,
+                         seed: int = 0):
+    """Build a serving ``Predictor`` from a config name + optional
+    checkpoint, with the storage dtype applied through the one audited
+    construction site (``apply_serve_dtype``).
+
+    ``checkpoint=None`` initializes fresh weights from ``seed`` —
+    shape/ABI checks and process-isolation tests without an artifact
+    on disk.  ``params_dtype``: fp32 / bf16 / auto / int8 (weight-only
+    per-output-channel quantization, dequant traced into the serve
+    programs).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import InferenceModelParams, get_config
+    from ..infer import Predictor
+    from ..models import build_model
+    from ..utils.precision import apply_serve_dtype
+
+    cfg = get_config(config)
+    model = build_model(cfg)
+    if checkpoint:
+        from ..train import restore_checkpoint
+
+        payload = restore_checkpoint(checkpoint)
+        variables = {"params": payload["params"],
+                     "batch_stats": payload["batch_stats"]}
+    else:
+        h = cfg.skeleton.height
+        variables = model.init(jax.random.PRNGKey(seed),
+                               jnp.zeros((1, h, h, 3), jnp.float32),
+                               train=False)
+    model, variables = apply_serve_dtype(params_dtype, model, variables)
+    model_params = (InferenceModelParams(boxsize=boxsize) if boxsize
+                    else None)
+    return Predictor(model, variables, cfg.skeleton,
+                     model_params=model_params, bucket=bucket,
+                     compact_topk=compact_topk,
+                     assembly_pmax=assembly_pmax, fused_tta=fused_tta)
+
+
+def cascade_predictors(student_config: str = "tiny_student",
+                       teacher_config: str = "canonical",
+                       student_checkpoint: Optional[str] = None,
+                       teacher_checkpoint: Optional[str] = None,
+                       student_dtype: str = "int8",
+                       teacher_dtype: str = "fp32",
+                       **kwargs) -> Tuple[object, object]:
+    """The cascade's (student, teacher) predictor pair: a cheap-storage
+    student (int8 by default — FasterPose's cheap-representation knee)
+    under a full-precision teacher.  Pass the pair straight to
+    ``CascadeEngine.build``; extra kwargs go to both factories."""
+    student = checkpoint_predictor(student_config, student_checkpoint,
+                                   params_dtype=student_dtype, **kwargs)
+    teacher = checkpoint_predictor(teacher_config, teacher_checkpoint,
+                                   params_dtype=teacher_dtype, **kwargs)
+    return student, teacher

@@ -1,5 +1,31 @@
-"""Parameter-precision helpers."""
+"""Parameter-precision helpers.
+
+Three serve-time weight-storage tiers (TRAINING.md's dtype matrix):
+
+- **fp32** — the training dtype; reference storage.
+- **bf16** — a plain cast of every fp32 leaf (:func:`bf16_params`),
+  halving weight HBM traffic; PR 13's audited student-export win.
+- **int8** — weight-only quantization (:func:`quantize_int8`):
+  per-output-channel absmax/127 scales on the 'params' collection's
+  matrix/conv leaves, dequantized INSIDE the traced program
+  (:class:`DequantizingModel`), so the artifact ships 4× smaller
+  weights and the dequant multiply-add fuses into the first use of
+  each weight.  Biases, norm parameters and ``batch_stats`` stay
+  fp32 — decode exactness (the compact extraction's NMS/threshold
+  logic) never sees a quantized value, only the network activations
+  the dequantized weights produce.
+
+:func:`apply_serve_dtype` is the ONE construction site that turns a
+(mode, model, variables) triple into the pair every consumer builds a
+``Predictor`` from — export, evaluation, serving artifacts and the
+graftaudit registry all route through it, so the quantization chain
+they fingerprint is the chain production serves.
+"""
 from __future__ import annotations
+
+# quantized-leaf marker: a dict with exactly these keys replaces an
+# fp32 weight leaf in a quantized 'params' tree
+_QKEYS = frozenset(("int8_q", "int8_scale"))
 
 
 def resolve_params_dtype(mode: str, variables):
@@ -36,3 +62,95 @@ def bf16_params(tree):
     return jax.tree.map(
         lambda x: x.astype(jnp.bfloat16)
         if hasattr(x, "dtype") and x.dtype == jnp.float32 else x, tree)
+
+
+def _quantizable(leaf) -> bool:
+    """Weight-only policy: quantize fp32 leaves with ≥2 dims (conv
+    kernels, dense matrices); 1-d leaves (biases, norm scales/offsets)
+    stay fp32 — they are tiny and their precision is load-bearing."""
+    return (hasattr(leaf, "dtype") and leaf.dtype == "float32"
+            and getattr(leaf, "ndim", 0) >= 2)
+
+
+def quantize_int8(variables):
+    """Weight-only int8 quantization of a variables tree.
+
+    Every quantizable leaf of the ``params`` collection becomes a
+    ``{"int8_q": int8 array, "int8_scale": fp32 per-output-channel
+    scales}`` dict — symmetric absmax/127 over all axes but the LAST
+    (Flax convention: the output-feature axis is last for both conv
+    kernels and dense matrices), so each output channel keeps its own
+    dynamic range.  Zero channels get scale 1 (dequant to exact zeros).
+    Other collections (``batch_stats``) pass through untouched.
+
+    Works under ``jax.eval_shape`` (abstract leaves) — the graftaudit
+    registry builds the int8 programs the same way export does.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def quant(leaf):
+        if not _quantizable(leaf):
+            return leaf
+        red = tuple(range(leaf.ndim - 1))
+        absmax = jnp.max(jnp.abs(leaf), axis=red)
+        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(leaf / scale), -127, 127).astype(jnp.int8)
+        return {"int8_q": q, "int8_scale": scale.astype(jnp.float32)}
+
+    out = dict(variables)
+    out["params"] = jax.tree.map(quant, variables["params"])
+    return out
+
+
+def is_quantized_leaf(leaf) -> bool:
+    return isinstance(leaf, dict) and set(leaf.keys()) == set(_QKEYS)
+
+
+def dequantize_int8(variables):
+    """Inverse of :func:`quantize_int8`: expand every quantized-leaf
+    dict back to an fp32 array.  Traced inside the serve program by
+    :class:`DequantizingModel`, so XLA folds the multiply into the
+    first consumer of each weight."""
+    import jax
+    import jax.numpy as jnp
+
+    def dequant(leaf):
+        if not is_quantized_leaf(leaf):
+            return leaf
+        return (leaf["int8_q"].astype(jnp.float32)
+                * leaf["int8_scale"].astype(jnp.float32))
+
+    out = dict(variables)
+    out["params"] = jax.tree.map(dequant, variables["params"],
+                                 is_leaf=is_quantized_leaf)
+    return out
+
+
+class DequantizingModel:
+    """Model wrapper whose ``apply`` dequantizes an int8-quantized
+    variables tree INSIDE the trace before delegating — every jitted
+    program built from it (Predictor programs, AOT exports, registry
+    fingerprints) carries the int8 weights as inputs and the dequant
+    chain as program ops, exactly like the bf16 cast chain PRG002
+    audits."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def apply(self, variables, *args, **kwargs):
+        return self.inner.apply(dequantize_int8(variables), *args,
+                                **kwargs)
+
+
+def apply_serve_dtype(mode: str, model, variables):
+    """The single construction site for serve-time weight storage:
+    (mode, model, variables) → the (model, variables) pair to build a
+    ``Predictor`` from.  ``mode`` extends :func:`resolve_params_dtype`
+    with ``"int8"``; fp32/bf16/auto return the model unchanged."""
+    if mode == "int8":
+        return DequantizingModel(model), quantize_int8(variables)
+    return model, resolve_params_dtype(mode, variables)

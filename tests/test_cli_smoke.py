@@ -16,7 +16,7 @@ CLIS = [
     "build_native.py", "list_coco.py", "lint.py", "program_audit.py",
     "stream_bench.py", "chaos_serve.py", "cascade_bench.py",
     "request_report.py", "latency_audit.py", "fleet_audit.py",
-    "history_audit.py", "history_report.py",
+    "history_audit.py", "history_report.py", "tta_bench.py",
 ]
 
 
@@ -51,6 +51,20 @@ def test_export_gate_flags_in_export_help():
     assert r.returncode == 0
     out = r.stdout.decode()
     for flag in ("--audit-program", "--dtype", "--program"):
+        assert flag in out, flag
+
+
+def test_pallas_decode_flags_in_pallas_check_help():
+    """The ISSUE 20 decode-kernel A/B modes stay wired: the hardware
+    check must surface --peaks/--limbs and the strict-JSON artifact
+    flag in --help."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "pallas_check.py"),
+         "--help"], capture_output=True, timeout=120, env=env)
+    assert r.returncode == 0
+    out = r.stdout.decode()
+    for flag in ("--peaks", "--limbs", "--json", "--assembly"):
         assert flag in out, flag
 
 

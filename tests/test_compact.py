@@ -591,7 +591,11 @@ def test_compact_ms_multi_scale_matches_host_mirror():
     params, _ = default_inference_params()
     ms_params = dc.replace(params, scale_search=(0.75, 1.0))
 
-    res = pred.predict_compact_ms(img, params=ms_params)
+    # the looped per-entry path (fused=False): this test pins the
+    # per-scale to_grid + shared compact_avg program wiring; the fused
+    # single-program path has its own cache/payload suite
+    # (tests/test_fused_tta.py)
+    res = pred.predict_compact_ms(img, params=ms_params, fused=False)
 
     # host mirror: rebuild the averaged grid maps from the stub's content
     stub_maps = pred.model.maps

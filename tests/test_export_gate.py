@@ -99,6 +99,110 @@ def test_unregistered_audit_program_refuses_fast(tmp_path):
     assert not os.path.exists(out)
 
 
+@pytest.fixture(scope="module")
+def int8_export(tmp_path_factory):
+    """One gated INT8 student fused-decode export (weight-only
+    per-output-channel quantization, dequant folded into the program),
+    shared by the int8 assertions below."""
+    out = str(tmp_path_factory.mktemp("export") / "student_int8.jaxexport")
+    proc = _run(["--config", "tiny_student", "--dtype", "int8",
+                 "--program", "decode", "--size", "128",
+                 "--audit-program", "student_serve_decode_int8_b1",
+                 "--out", out])
+    return proc, out
+
+
+@pytestmark_slow
+def test_gated_int8_export_passes_and_stamps_manifest(int8_export):
+    proc, out = int8_export
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert os.path.exists(out) and os.path.getsize(out) > 0
+    with open(out + ".manifest.json") as f:
+        manifest = json.load(f)
+    assert manifest["params_dtype"] == "int8"
+    assert manifest["audit_gate"]["program"] == \
+        "student_serve_decode_int8_b1"
+    if _same_jax_version():
+        assert manifest["audit_gate"]["status"] == "passed"
+        golden_fp = _golden()["programs"]["student_serve_decode_int8_b1"][
+            "fingerprint"]["compiled"]
+        fp = manifest["graftaudit"]["compiled_fingerprint"]
+        assert fp["hlo_instruction_count"] == \
+            golden_fp["hlo_instruction_count"]
+
+
+@pytestmark_slow
+def test_int8_export_load_round_trip(int8_export):
+    """Deserialize the int8 artifact and call it with real quantized
+    weights: the packed decode payload must be bit-identical to the
+    in-process jitted program's — the artifact serves exactly the
+    program the predictor runs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import export as jexport
+
+    from improved_body_parts_tpu.config import get_config
+    from improved_body_parts_tpu.infer import Predictor
+    from improved_body_parts_tpu.models import build_model
+    from improved_body_parts_tpu.utils.precision import apply_serve_dtype
+
+    proc, out = int8_export
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out, "rb") as f:
+        loaded = jexport.deserialize(f.read())
+
+    cfg = get_config("tiny_student")
+    model = build_model(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 128, 128, 3), jnp.float32),
+                           train=False)
+    model, variables = apply_serve_dtype("int8", model, variables)
+    pred = Predictor(model, variables, cfg.skeleton)
+    b = pred.bucket
+    rng = np.random.default_rng(0)
+    img = rng.uniform(0, 1, (b, b, 3)).astype(np.float32)
+    want = pred.decode_program((b, b))(variables, img,
+                                       np.int32(b), np.int32(b))
+    got = loaded.call(variables, img, np.int32(b), np.int32(b))
+    assert (np.asarray(want) == np.asarray(got)).all()
+
+
+@pytest.mark.skipif(not _same_jax_version(),
+                    reason="cross-jax-version goldens gate as warnings "
+                           "by design")
+def test_int8_fingerprint_refusal_seeded_both_directions():
+    """Tier-1's quantization-chain gate probe: the bf16 program's
+    fingerprint against the int8 blessed entry REFUSES, and vice versa
+    — exercised on the gate function itself with the committed goldens
+    (no compile), the fail-fast twin of the slow-tier CLI refusals."""
+    import importlib
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        export_model = importlib.import_module("export_model")
+    finally:
+        sys.path.pop(0)
+    golden = _golden()
+    jaxv = golden["jax_version"]
+    fp_bf16 = golden["programs"]["student_serve_decode_b1"][
+        "fingerprint"]["compiled"]
+    fp_int8 = golden["programs"]["student_serve_decode_int8_b1"][
+        "fingerprint"]["compiled"]
+    assert fp_bf16 != fp_int8  # the chains fingerprint differently
+    for name, wrong_fp in (("student_serve_decode_int8_b1", fp_bf16),
+                           ("student_serve_decode_b1", fp_int8)):
+        entry = golden["programs"][name]
+        with pytest.raises(SystemExit, match="REFUSED"):
+            export_model._audit_gate(name, golden,
+                                     entry["fingerprint"]["compiled"],
+                                     wrong_fp, jaxv)
+    # and the matching direction passes
+    status = export_model._audit_gate(
+        "student_serve_decode_int8_b1", golden, fp_int8, fp_int8, jaxv)
+    assert status == "passed"
+
+
 @pytestmark_slow
 def test_ungated_export_still_stamps_fingerprint(tmp_path):
     """Without --audit-program the manifest still carries the compiled

@@ -1193,3 +1193,28 @@ def test_scope_covers_partition_module():
                  "improved_body_parts_tpu/parallel/prefetch.py",
                  "improved_body_parts_tpu/parallel/mesh.py"):
         assert "JGL002" in rules_of(lint(hot, path=path)), path
+
+
+def test_scope_covers_decode_payload_ops():
+    """ISSUE 20 satellite: the decode-payload ops (ops/peaks.py and its
+    config-selectable Pallas twin ops/pallas_peaks.py) are traced into
+    every compact decode program on the serve dispatch path — a hidden
+    readback there would serialize the program queue, so both live in
+    the JGL002 hot-path scope.  Locked on the files' actual paths so a
+    future move can't silently drop them; the rest of ops/ (loss/
+    training code) stays out."""
+    hot = """
+        import jax.numpy as jnp
+
+        def gather_loop(maps):
+            rows = []
+            for m in maps:
+                v = jnp.max(m)
+                rows.append(float(v))
+            return rows
+    """
+    for path in ("improved_body_parts_tpu/ops/peaks.py",
+                 "improved_body_parts_tpu/ops/pallas_peaks.py"):
+        assert "JGL002" in rules_of(lint(hot, path=path)), path
+    assert "JGL002" not in rules_of(
+        lint(hot, path="improved_body_parts_tpu/ops/losses.py"))

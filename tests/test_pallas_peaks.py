@@ -1,0 +1,157 @@
+"""Interpreter-parity tests for the Pallas decode kernels.
+
+``ops/pallas_peaks.py`` re-expresses ``ops.peaks.topk_peaks`` and
+``ops.peaks.limb_pair_stats`` as Pallas kernels using the reference
+functions' computation graph operation-for-operation, so interpreter
+mode (which executes the kernel body as jax ops) must be EXACTLY
+bit-identical — any drift is a transcription bug, not float noise.
+These tests pin the full payload on seeded inputs, plus the
+config-selected route through the Predictor (``use_pallas_decode``).
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from improved_body_parts_tpu.config import (
+    InferenceModelParams,
+    default_inference_params,
+    get_config,
+)
+from improved_body_parts_tpu.ops.pallas_peaks import (
+    _rand_peaks_fixture,
+    limb_pair_stats_pallas,
+    limbs_parity_benchmark,
+    peaks_parity_benchmark,
+    topk_peaks_pallas,
+)
+from improved_body_parts_tpu.ops.peaks import limb_pair_stats, topk_peaks
+
+CFG = get_config("canonical")
+SK = CFG.skeleton
+
+
+def _assert_payload_equal(want, got):
+    for name, a, b in zip(want._fields, tuple(want), tuple(got)):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype, name
+        assert (a == b).all(), name
+
+
+@pytest.mark.parametrize("valid_frac", [1.0, 0.6])
+def test_topk_peaks_interpreter_parity_exact(valid_frac):
+    """Full TopKPeaks payload — xs/ys/x_ref/y_ref/score/valid/count —
+    bit-identical to the XLA path on a sparse-spiked fixture, full and
+    partial valid regions."""
+    rng = np.random.default_rng(7)
+    h, w, c, k, r = 64, 56, SK.num_parts, 16, 2
+    heat = _rand_peaks_fixture(rng, h, w, c)
+    vh, vw = int(h * valid_frac), int(w * valid_frac)
+    want = topk_peaks(heat, vh, vw, thre=0.1, k=k, radius=r)
+    got = topk_peaks_pallas(heat, vh, vw, thre=0.1, k=k, radius=r,
+                            interpret=True)
+    _assert_payload_equal(want, got)
+
+
+def test_topk_peaks_parity_survives_exact_ties():
+    """lax.top_k breaks value ties by LOWER flat index; the kernel's
+    argmax loop must reproduce that ordering on a map with many exactly
+    equal isolated peaks."""
+    h, w, c, k = 32, 32, 3, 8
+    heat = np.zeros((h, w, c), np.float32)
+    # isolated equal-valued peaks (spaced >1 apart so NMS keeps all)
+    for ci in range(c):
+        for i, (y, x) in enumerate([(4, 4), (4, 20), (20, 4), (20, 20),
+                                    (12, 12)]):
+            heat[y, x, ci] = 0.5 if i < 4 else 0.9
+    want = topk_peaks(heat, h, w, thre=0.1, k=k, radius=2)
+    got = topk_peaks_pallas(heat, h, w, thre=0.1, k=k, radius=2,
+                            interpret=True)
+    _assert_payload_equal(want, got)
+
+
+def test_limb_pair_stats_interpreter_parity_exact():
+    """Full PairStats payload — mean_score/above/num_samples/norm — on
+    the real skeleton's limb wiring, bit-identical to the XLA path."""
+    rng = np.random.default_rng(11)
+    h, w, k = 64, 56, 16
+    limbs_from = tuple(a for a, _ in SK.limbs_conn)
+    limbs_to = tuple(b for _, b in SK.limbs_conn)
+    paf = rng.normal(0.0, 0.2, (h, w, SK.paf_layers)).astype(np.float32)
+    x_ref = rng.uniform(0, w - 1, (SK.num_parts, k)).astype(np.float32)
+    y_ref = rng.uniform(0, h - 1, (SK.num_parts, k)).astype(np.float32)
+    want = limb_pair_stats(paf, x_ref, y_ref, limbs_from=limbs_from,
+                           limbs_to=limbs_to, num_samples=20, thre2=0.05)
+    got = limb_pair_stats_pallas(paf, x_ref, y_ref, limbs_from=limbs_from,
+                                 limbs_to=limbs_to, num_samples=20,
+                                 thre2=0.05, interpret=True)
+    _assert_payload_equal(want, got)
+
+
+def test_parity_benchmarks_report_parity_ok():
+    """The dict contract tools/pallas_check.py consumes: parity_ok True
+    plus timing rows present."""
+    r = peaks_parity_benchmark(h=48, w=40, c=5, k=8, trials=2, iters=2,
+                               interpret=True)
+    assert r["parity_ok"] and r["kernel"] == "topk_peaks"
+    assert r["pallas_ms"] > 0 and r["xla_ms"] > 0
+    r = limbs_parity_benchmark(h=48, w=40, c=5, n_limbs=4, k=8,
+                               num_samples=10, trials=2, iters=2,
+                               interpret=True)
+    assert r["parity_ok"] and r["kernel"] == "limb_pair_stats"
+
+
+class _StubModel:
+    def __init__(self, maps):
+        self.maps = maps
+
+    def apply(self, variables, imgs, train=False):
+        import jax.numpy as jnp
+
+        n, h, w, _ = imgs.shape
+        maps = jnp.asarray(self.maps[:h // 4, :w // 4])
+        return [[jnp.broadcast_to(maps, (n, *maps.shape))]]
+
+
+def test_use_pallas_decode_route_matches_xla_payload():
+    """Flipping InferenceParams.use_pallas_decode routes the compact
+    program through the Pallas kernels (interpreter mode off-TPU) and
+    must return the exact same records as the XLA engine."""
+    from improved_body_parts_tpu.infer import Predictor
+
+    rng = np.random.default_rng(3)
+    h = w = 128
+    maps = rng.uniform(0, 1, (h // 4, w // 4, SK.num_layers)).astype(
+        np.float32)
+    params, _ = default_inference_params()
+    mp = InferenceModelParams(boxsize=h, max_downsample=64)
+    pred = Predictor(_StubModel(maps), {}, SK, params, mp, bucket=64)
+    img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+
+    res_x = pred.predict_compact(img)
+    prm = dataclasses.replace(params, use_pallas_decode=True)
+    res_p = pred.predict_compact(img, params=prm)
+    _assert_payload_equal(res_x.peaks, res_p.peaks)
+    _assert_payload_equal(res_x.stats, res_p.stats)
+    # the engine rides the program-cache key: both engines' programs
+    # coexist without evicting each other
+    assert any("pallas" in str(k) for k in pred._fns)
+
+
+def test_committed_pallas_check_artifact():
+    """PALLAS_CHECK.json (tools/pallas_check.py --peaks --limbs --json)
+    stays committed, strict-JSON-parseable, and records exact parity
+    for BOTH decode kernels — the artifact a TPU session re-blesses."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PALLAS_CHECK.json")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["parity_ok"] is True
+    kernels = {r["kernel"]: r for r in doc["kernels"]}
+    assert set(kernels) == {"topk_peaks", "limb_pair_stats"}
+    for r in kernels.values():
+        assert r["parity_ok"] is True
+        assert r["pallas_ms"] > 0 and r["xla_ms"] > 0

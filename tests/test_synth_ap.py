@@ -40,3 +40,19 @@ def test_synth_ap_tool_end_to_end(tmp_path):
     # artifacts stayed inside the workdir (the --dump-name regression)
     assert not (tmp_path / "results").exists()
     assert (tmp_path / "work" / "results").is_dir()
+
+
+def test_committed_dtype_matrix_artifact():
+    """ISSUE 20 acceptance: the committed SYNTH_AP_DTYPE.json proves
+    the int8 serve path lands within 1 synthetic-AP point of bf16 on
+    the trained protocol (same checkpoint, same val set, only the
+    serve-time weight storage differs)."""
+    doc = json.load(open(os.path.join(REPO, "SYNTH_AP_DTYPE.json")))
+    for key in ("ap_trained", "ap_trained_bf16", "ap_trained_int8"):
+        assert 0.0 < doc[key] <= 1.0, (key, doc[key])
+    assert doc["ap_untrained"] == 0.0
+    assert doc["int8_ap_tolerance"] == 0.01
+    delta = abs(doc["ap_trained_int8"] - doc["ap_trained_bf16"])
+    assert delta <= doc["int8_ap_tolerance"]
+    assert round(delta, 6) == doc["int8_vs_bf16_ap_delta"]
+    assert doc["int8_within_tolerance"] is True

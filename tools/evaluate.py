@@ -24,14 +24,14 @@ def load_predictor(config_name: str, checkpoint: str, bucket: int = 128,
     from improved_body_parts_tpu.infer import Predictor
     from improved_body_parts_tpu.models import build_model
     from improved_body_parts_tpu.train import restore_checkpoint
-    from improved_body_parts_tpu.utils.precision import resolve_params_dtype
+    from improved_body_parts_tpu.utils.precision import apply_serve_dtype
 
     cfg = get_config(config_name)
     model = build_model(cfg)
     payload = restore_checkpoint(checkpoint)
-    variables = resolve_params_dtype(
-        params_dtype, {"params": payload["params"],
-                       "batch_stats": payload["batch_stats"]})
+    model, variables = apply_serve_dtype(
+        params_dtype, model, {"params": payload["params"],
+                              "batch_stats": payload["batch_stats"]})
     model_params = InferenceModelParams(boxsize=boxsize) if boxsize else None
     return Predictor(model, variables, cfg.skeleton, bucket=bucket,
                      model_params=model_params)
@@ -69,11 +69,13 @@ def main():
                          "[models] boxsize, utils/config:37-41); 0 keeps "
                          "the library default")
     ap.add_argument("--params-dtype", default="auto",
-                    choices=["auto", "bf16", "fp32"],
+                    choices=["auto", "bf16", "fp32", "int8"],
                     help="inference weight storage; auto = bf16 on TPU "
                          "(halves weight HBM traffic, PERF_AUDIT_BF16.json; "
                          "matches the reference's AMP-O1 eval), fp32 "
-                         "elsewhere")
+                         "elsewhere; int8 = weight-only per-channel "
+                         "quantization with in-program dequant "
+                         "(utils.precision.quantize_int8)")
     ap.add_argument("--oks-proxy", action="store_true",
                     help="evaluate with the dependency-free OKS evaluator "
                          "(COCOeval ignore/crowd/maxDets semantics, "

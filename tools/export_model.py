@@ -132,10 +132,13 @@ def main():
                          "batch program (default: the singleton-flush "
                          "program)")
     ap.add_argument("--dtype", default="fp32",
-                    choices=("fp32", "bf16"),
+                    choices=("fp32", "bf16", "int8"),
                     help="parameter storage dtype of the artifact "
-                         "(bf16 = the quantized fast-tier artifact; "
-                         "compute dtype follows the config regardless)")
+                         "(bf16 = the cast fast-tier artifact; int8 = "
+                         "weight-only per-output-channel quantization "
+                         "with the dequant chain folded into the "
+                         "program; compute dtype follows the config "
+                         "regardless)")
     ap.add_argument("--audit-program", default=None, metavar="NAME",
                     help="GATE the export on this registry program's "
                          "blessed PROGRAM_AUDIT.json entry: refuse when "
@@ -154,7 +157,7 @@ def main():
 
     from improved_body_parts_tpu.config import get_config
     from improved_body_parts_tpu.models import build_model
-    from improved_body_parts_tpu.utils.precision import resolve_params_dtype
+    from improved_body_parts_tpu.utils.precision import apply_serve_dtype
 
     golden = golden_fp = None
     if args.audit_program:
@@ -174,7 +177,10 @@ def main():
                      "batch_stats": payload["batch_stats"]}
     else:
         variables = model.init(jax.random.PRNGKey(0), imgs, train=False)
-    variables = resolve_params_dtype(args.dtype, variables)
+    # ONE construction site for the storage-dtype chain (bf16 cast or
+    # int8 quantize+in-program-dequant) — the registry's abstract twins
+    # apply the same transform, so fingerprints line up
+    model, variables = apply_serve_dtype(args.dtype, model, variables)
 
     from jax import export as jexport
 

@@ -17,12 +17,40 @@ assembly's inner candidate walk (ops/pallas_assembly.py, the Mosaic
 variant of the fused decode program's bounded while_loop) — parity
 against the host reference walk plus timing.  Same rule: wire it into
 ``ops.assembly.greedy_assemble`` only if it wins on hardware.
+
+``--peaks`` / ``--limbs`` check the ISSUE 20 decode kernels
+(ops/pallas_peaks.py): the per-channel top-K peak extractor and the
+dense (L,K,K,S) limb-sample gather.  Parity there is EXACT (bitwise
+against ops.peaks — the payloads feed the deterministic assembly), and
+the flags compose: ``--peaks --limbs`` runs both.  Flip
+``InferenceParams.use_pallas_decode`` only on a hardware win.
+
+``--json PATH`` writes every kernel row run this invocation as a
+strict-JSON artifact (the committed ``PALLAS_CHECK.json``), so a TPU
+session can re-bless the A/B with one command:
+
+    python tools/pallas_check.py --peaks --limbs --json PALLAS_CHECK.json
 """
 import argparse
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _write_json(path, platform, interpret, rows):
+    import jax
+
+    from improved_body_parts_tpu.obs.events import strict_dump
+
+    doc = {"platform": platform, "interpret": bool(interpret),
+           "jax_version": jax.__version__,
+           "parity_ok": all(r["parity_ok"] for r in rows),
+           "kernels": rows}
+    with open(path, "w") as f:
+        strict_dump(doc, f, indent=2)
+        f.write("\n")
+    print(f"wrote {path} ({len(rows)} kernel row(s))")
 
 
 def main():
@@ -38,6 +66,15 @@ def main():
                     help="check the decode-assembly candidate-walk "
                          "kernel (ops/pallas_assembly.py) instead of "
                          "the focal loss")
+    ap.add_argument("--peaks", action="store_true",
+                    help="check the top-K peak-extraction kernel "
+                         "(ops/pallas_peaks.py, exact parity)")
+    ap.add_argument("--limbs", action="store_true",
+                    help="check the limb pair-stats gather kernel "
+                         "(ops/pallas_peaks.py, exact parity)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the kernel rows as a strict-JSON "
+                         "artifact (PALLAS_CHECK.json)")
     args = ap.parse_args()
     if args.iters < 1:
         ap.error("--iters must be >= 1")
@@ -53,6 +90,31 @@ def main():
     except (RuntimeError, TimeoutError) as e:
         raise SystemExit(str(e))
     print(f"platform={platform} interpret={args.interpret}")
+
+    if args.peaks or args.limbs:
+        from improved_body_parts_tpu.ops.pallas_peaks import (
+            limbs_parity_benchmark, peaks_parity_benchmark)
+
+        rows = []
+        if args.peaks:
+            rows.append(peaks_parity_benchmark(
+                h=args.hw, w=args.hw, iters=args.iters,
+                interpret=args.interpret))
+        if args.limbs:
+            rows.append(limbs_parity_benchmark(
+                h=args.hw, w=args.hw, iters=args.iters,
+                interpret=args.interpret))
+        for r in rows:
+            verdict = "PALLAS WINS" if r["pallas_wins"] else "XLA wins"
+            print(f"{r['kernel']:12s} pallas {r['pallas_ms']:7.3f} ms   "
+                  f"xla {r['xla_ms']:7.3f} ms   "
+                  f"exact parity {'OK' if r['parity_ok'] else 'FAIL'} "
+                  f"({r['trials']} randomized trials); {verdict}")
+        if args.json:
+            _write_json(args.json, platform, args.interpret, rows)
+        print("flip InferenceParams.use_pallas_decode only if the "
+              "Mosaic lowerings win on TPU")
+        sys.exit(0 if all(r["parity_ok"] for r in rows) else 1)
 
     if args.assembly:
         from improved_body_parts_tpu.ops.pallas_assembly import (

@@ -15,6 +15,13 @@ tests).
 
 Writes one JSON artifact with the AP of the trained model on held-out
 images, plus an untrained-baseline AP for contrast.
+
+``--dtype-matrix`` additionally re-evaluates the TRAINED checkpoint
+under the serve weight-storage dtypes (``evaluate.py --params-dtype``
+bf16 and int8 — the same ``apply_serve_dtype`` chain the export gate
+fingerprints), and gates the on-chip campaign's quantization tolerance:
+|AP(int8) − AP(bf16)| must stay within 1 synthetic-AP point
+(SYNTH_AP_DTYPE.json).
 """
 import argparse
 import json
@@ -150,6 +157,11 @@ def main():
     ap.add_argument("--val-seed", type=int, default=12345,
                     help="val-set seed (use 777 with --val-images 64 for "
                          "the big-val protocol of SYNTH_AP_DEEP_BIGVAL)")
+    ap.add_argument("--dtype-matrix", action="store_true",
+                    help="re-evaluate the trained checkpoint under the "
+                         "serve storage dtypes (bf16, int8 weight-only "
+                         "quantization) and gate |AP(int8) - AP(bf16)| "
+                         "<= 0.01 (1 synthetic-AP point)")
     ap.add_argument("--keep-workdir", action="store_true")
     ap.add_argument("--train-platform", default="",
                     help="JAX_PLATFORMS for the train subprocess (e.g. "
@@ -241,6 +253,20 @@ def main():
         eval_args + ["--checkpoint", latest, "--dump-name", "synth_trained"],
         cwd=work, env_extra=eval_env))
 
+    dtype_matrix = {}
+    if args.dtype_matrix:
+        # the serve storage-dtype matrix over the SAME checkpoint, val
+        # set and decode path — only apply_serve_dtype's weight storage
+        # varies, so the AP deltas are pure quantization effect
+        for dtype in ("bf16", "int8"):
+            print(f"evaluating trained checkpoint @ {dtype}...",
+                  flush=True)
+            dtype_matrix[dtype] = parse_ap(run_cli(
+                eval_args + ["--checkpoint", latest,
+                             "--params-dtype", dtype,
+                             "--dump-name", f"synth_trained_{dtype}"],
+                cwd=work, env_extra=eval_env))
+
     # contrast: an untrained (fresh-init) model through the same protocol
     # — shows the AP is learned, not an artifact of the decoder
     fresh_dir = os.path.join(work, "ckpt_fresh")
@@ -272,9 +298,18 @@ def main():
                     "OKS-proxy evaluator (APCHECK.md); real train/evaluate "
                     "CLIs as subprocesses",
     }
+    if args.dtype_matrix:
+        delta = abs(dtype_matrix["int8"] - dtype_matrix["bf16"])
+        result["ap_trained_bf16"] = dtype_matrix["bf16"]
+        result["ap_trained_int8"] = dtype_matrix["int8"]
+        result["int8_vs_bf16_ap_delta"] = round(delta, 6)
+        result["int8_ap_tolerance"] = 0.01  # 1 synthetic-AP point
+        result["int8_within_tolerance"] = bool(delta <= 0.01)
     with open(args.out, "w") as f:
         strict_dump(result, f, indent=2)
     print(strict_dumps(result))
+    if args.dtype_matrix and not result["int8_within_tolerance"]:
+        sys.exit(1)
     if not args.keep_workdir and args.workdir is None:
         import shutil
         shutil.rmtree(work, ignore_errors=True)
